@@ -1,0 +1,45 @@
+"""dmlc_tpu — a TPU-native rebuild of the dmlc-core data substrate.
+
+The reference (octaviansima/dmlc-core) is the common substrate of the DMLC
+ecosystem: URI-addressed stream IO, partitioned record-aware input splitting,
+multi-threaded parsing of ML text formats into sparse row blocks,
+producer/consumer prefetch pipelines, parameter/registry/serialization
+utilities, and a distributed job tracker.
+
+This package re-designs those capabilities TPU-first:
+
+- parsers emit HBM-resident ``jax.Array`` / BCOO batches
+  (:mod:`dmlc_tpu.data.device`),
+- the prefetch pipeline (`ThreadedIter`, reference include/dmlc/threadediter.h)
+  becomes an async host->device double-buffered pipeline,
+- input sharding (`InputSplit`, reference src/io/input_split_base.cc) maps a
+  partition per ``jax.process_index()`` and assembles global sharded arrays,
+- the tracker (reference tracker/dmlc_tracker/tracker.py) gains a ``tpu-pod``
+  backend wired to the ``jax.distributed`` coordinator,
+- hot parse loops run in a C++ host library (:mod:`dmlc_tpu.native`), with a
+  pure-numpy fallback.
+
+Layout (mirrors SURVEY.md layer map):
+
+- ``utils/``    — layers 0-2: logging/check, registry, Parameter, config,
+                  serializer, timers.
+- ``io/``       — layers 3-4: Stream/FileSystem/URI, RecordIO, InputSplit,
+                  ThreadedIter.
+- ``data/``     — layer 5: RowBlock, parsers (libsvm/csv/libfm), row iterators,
+                  device pipeline.
+- ``ops/``      — device-side transforms: CSR->BCOO, padded dense, sparse
+                  matvec (XLA + Pallas).
+- ``parallel/`` — mesh/sharding helpers, collectives, jax.distributed
+                  bootstrap from the DMLC_* env contract.
+- ``models/``   — reference-style linear learners (the reference's Row::SDot,
+                  data.h:146-161, exists to serve exactly these) used as the
+                  flagship end-to-end slice.
+- ``tracker/``  — layer 7: rank-coordination tracker + dmlc-submit launchers.
+"""
+
+__version__ = "0.1.0"
+
+from dmlc_tpu.utils.registry import Registry
+from dmlc_tpu.utils.params import Parameter
+
+__all__ = ["Registry", "Parameter", "__version__"]

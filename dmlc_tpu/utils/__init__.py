@@ -1,0 +1,17 @@
+"""Core utilities: logging/check, registry, parameters, config, serializer.
+
+TPU-native equivalents of reference layers 0-2 (include/dmlc/logging.h,
+registry.h, parameter.h, config.h, serializer.h, timer.h).
+"""
+
+from dmlc_tpu.utils.check import DMLCError, check, check_eq, check_ne, check_lt, check_le, check_gt, check_ge, get_logger
+from dmlc_tpu.utils.registry import Registry
+from dmlc_tpu.utils.params import Parameter, field
+from dmlc_tpu.utils.config import Config
+from dmlc_tpu.utils.timer import Timer, get_time
+
+__all__ = [
+    "DMLCError", "check", "check_eq", "check_ne", "check_lt", "check_le",
+    "check_gt", "check_ge", "get_logger", "Registry", "Parameter", "field",
+    "Config", "Timer", "get_time",
+]

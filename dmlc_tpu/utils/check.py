@@ -1,0 +1,78 @@
+"""Error type + CHECK helpers + logging.
+
+TPU-native equivalent of reference include/dmlc/logging.h: glog-style
+``CHECK*`` macros that raise :class:`DMLCError` (the reference's
+fatal-throws-``dmlc::Error`` default, logging.h:29, base.h:21) and an
+env-gated debug logger (``DMLC_LOG_DEBUG``, reference logging.h:131-146).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+
+class DMLCError(RuntimeError):
+    """Raised by failed checks — analog of ``dmlc::Error`` (logging.h:29)."""
+
+
+_LOGGER: logging.Logger | None = None
+
+
+def get_logger() -> logging.Logger:
+    """Process-wide logger; level gated by DMLC_LOG_DEBUG like logging.h:131-146."""
+    global _LOGGER
+    if _LOGGER is None:
+        logger = logging.getLogger("dmlc_tpu")
+        if not logger.handlers:
+            handler = logging.StreamHandler(sys.stderr)
+            handler.setFormatter(
+                logging.Formatter("[%(asctime)s] %(levelname)s %(name)s: %(message)s")
+            )
+            logger.addHandler(handler)
+        debug = os.environ.get("DMLC_LOG_DEBUG", "0") not in ("", "0", "false", "False")
+        logger.setLevel(logging.DEBUG if debug else logging.INFO)
+        _LOGGER = logger
+    return _LOGGER
+
+
+def _fail(msg: str, detail: str = "") -> None:
+    text = msg if not detail else f"{msg}: {detail}"
+    raise DMLCError(text)
+
+
+def check(cond: bool, msg: str = "check failed") -> None:
+    """``CHECK(cond)`` — reference logging.h:205."""
+    if not cond:
+        _fail(msg)
+
+
+def check_eq(a, b, msg: str = "") -> None:
+    if not (a == b):
+        _fail(f"check failed: {a!r} == {b!r}", msg)
+
+
+def check_ne(a, b, msg: str = "") -> None:
+    if not (a != b):
+        _fail(f"check failed: {a!r} != {b!r}", msg)
+
+
+def check_lt(a, b, msg: str = "") -> None:
+    if not (a < b):
+        _fail(f"check failed: {a!r} < {b!r}", msg)
+
+
+def check_le(a, b, msg: str = "") -> None:
+    if not (a <= b):
+        _fail(f"check failed: {a!r} <= {b!r}", msg)
+
+
+def check_gt(a, b, msg: str = "") -> None:
+    if not (a > b):
+        _fail(f"check failed: {a!r} > {b!r}", msg)
+
+
+def check_ge(a, b, msg: str = "") -> None:
+    if not (a >= b):
+        _fail(f"check failed: {a!r} >= {b!r}", msg)

@@ -1,0 +1,116 @@
+"""key=value config-file parser.
+
+TPU-native equivalent of reference include/dmlc/config.h + src/config.cc:
+tokenizes ``key = value`` lines with quoted strings (incl. escaped quotes) and
+``#`` comments (Tokenizer, config.cc:30-80), supports multi-value mode where a
+repeated key keeps all values (config.h:63-70), and renders a proto-text style
+string (``ToProtoString``, config.h:102).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Tuple
+
+from dmlc_tpu.utils.check import DMLCError
+
+
+def _tokenize(text: str) -> Iterator[str]:
+    """Yield tokens: bare words and quoted strings. ``#`` starts a comment."""
+    i, n = 0, len(text)
+    while i < n:
+        ch = text[i]
+        if ch == "#":
+            while i < n and text[i] != "\n":
+                i += 1
+        elif ch == '"':
+            j = i + 1
+            out = []
+            while j < n:
+                if text[j] == "\\" and j + 1 < n and text[j + 1] == '"':
+                    out.append('"')
+                    j += 2
+                elif text[j] == '"':
+                    break
+                else:
+                    out.append(text[j])
+                    j += 1
+            if j >= n:
+                raise DMLCError("config: unterminated quoted string")
+            yield '"' + "".join(out)  # mark as string token
+            i = j + 1
+        elif ch == "=":
+            yield "="
+            i += 1
+        elif ch.isspace():
+            i += 1
+        else:
+            j = i
+            while j < n and not text[j].isspace() and text[j] not in "=#":
+                j += 1
+            yield text[i:j]
+            i = j
+
+
+class Config:
+    """Ordered key=value config — analog of dmlc::Config (config.h:40-175)."""
+
+    def __init__(self, text: str = "", multi_value: bool = False):
+        self.multi_value = multi_value
+        self._order: List[Tuple[str, str]] = []
+        self._map: Dict[str, List[str]] = {}
+        if text:
+            self.load(text)
+
+    def load(self, text: str) -> None:
+        tokens = list(_tokenize(text))
+        if len(tokens) % 3 != 0:
+            raise DMLCError(f"config: dangling tokens {tokens[-(len(tokens) % 3):]!r}")
+        for i in range(0, len(tokens), 3):
+            key, eq, value = tokens[i], tokens[i + 1], tokens[i + 2]
+            if eq != "=" or key == "=" or value == "=":
+                raise DMLCError(f"config: expected 'key = value' near {tokens[i:i+3]!r}")
+            if key.startswith('"'):
+                key = key[1:]
+            if value.startswith('"'):
+                value = value[1:]
+            self.set(key, value)
+
+    def set(self, key: str, value: str) -> None:
+        if not self.multi_value and key in self._map:
+            # single-value mode: last assignment wins (config.h:63 SetParam)
+            self._map[key] = [value]
+            self._order = [(k, v) for (k, v) in self._order if k != key]
+        else:
+            self._map.setdefault(key, []).append(value)
+        self._order.append((key, value))
+
+    def get(self, key: str) -> str:
+        """Last value for key — GetParam (config.h:56)."""
+        if key not in self._map:
+            raise DMLCError(f"config: key {key!r} not found")
+        return self._map[key][-1]
+
+    def get_all(self, key: str) -> List[str]:
+        return list(self._map.get(key, []))
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._map
+
+    def items(self) -> List[Tuple[str, str]]:
+        """Insertion-ordered (key, value) pairs — Config iteration order."""
+        return list(self._order)
+
+    def to_proto_string(self) -> str:
+        """Proto-text rendering — ToProtoString (config.h:102)."""
+        out = []
+        for key, value in self._order:
+            out.append(f'{key} : "{value}"' if not _is_number(value) else f"{key} : {value}")
+        return "\n".join(out) + ("\n" if out else "")
+
+
+def _is_number(s: str) -> bool:
+    try:
+        float(s)
+        return True
+    except ValueError:
+        return False

@@ -1,0 +1,215 @@
+"""Parameter reflection system.
+
+TPU-native equivalent of reference include/dmlc/parameter.h: declarative typed
+fields with defaults, ranges, enums, aliases and doc generation
+(DMLC_DECLARE_FIELD / FieldEntry<T>::set_default/set_range/set_lower_bound/
+add_enum/describe, parameter.h:265-298, 549-900), kwargs ``init`` with
+unknown-key policies (parameter.h:77-84, 140-165), JSON round-trip
+(parameter.h:190-202), ``__DOC__``-style docstring generation
+(parameter.h:214-218), and typed env access (GetEnv/SetEnv,
+parameter.h:50-61).
+
+Usage::
+
+    class CSVParserParam(Parameter):
+        format = field(str, default="csv")
+        label_column = field(int, default=-1, lower_bound=-1,
+                             help="Column index of the label.")
+
+    p = CSVParserParam()
+    unknown = p.init({"label_column": "3", "junk": "1"}, allow_unknown=True)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional, Tuple, Type
+
+from dmlc_tpu.utils.check import DMLCError
+
+
+def _parse_bool(s: str) -> bool:
+    t = s.strip().lower()
+    if t in ("1", "true", "yes", "on"):
+        return True
+    if t in ("0", "false", "no", "off"):
+        return False
+    raise ValueError(f"cannot parse bool from {s!r}")
+
+
+class Field:
+    """One declared parameter — analog of FieldEntry<T> (parameter.h:549+)."""
+
+    def __init__(
+        self,
+        type_: Type,
+        default: Any = ...,
+        *,
+        lower_bound: Any = None,
+        upper_bound: Any = None,
+        enum: Optional[Iterable[Any]] = None,
+        aliases: Iterable[str] = (),
+        help: str = "",
+    ):
+        self.type = type_
+        self.default = default
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.enum = list(enum) if enum is not None else None
+        self.aliases = list(aliases)
+        self.help = help
+        self.name: str = "<unbound>"
+
+    # -- string -> typed value, mirroring FieldEntry::Set (istream parse) --
+    def parse(self, value: Any) -> Any:
+        if isinstance(value, self.type) and not (self.type is int and isinstance(value, bool)):
+            out = value
+        elif self.type is bool:
+            out = _parse_bool(str(value))
+        else:
+            try:
+                out = self.type(value)
+            except (TypeError, ValueError) as exc:
+                raise DMLCError(
+                    f"parameter {self.name}: cannot parse {value!r} as {self.type.__name__}"
+                ) from exc
+        self.validate(out)
+        return out
+
+    def validate(self, value: Any) -> None:
+        """Range/enum constraints — set_range/add_enum (parameter.h:600s)."""
+        if self.lower_bound is not None and value < self.lower_bound:
+            raise DMLCError(
+                f"parameter {self.name}: value {value!r} below lower bound {self.lower_bound!r}"
+            )
+        if self.upper_bound is not None and value > self.upper_bound:
+            raise DMLCError(
+                f"parameter {self.name}: value {value!r} above upper bound {self.upper_bound!r}"
+            )
+        if self.enum is not None and value not in self.enum:
+            raise DMLCError(
+                f"parameter {self.name}: value {value!r} not in allowed set {self.enum!r}"
+            )
+
+
+def field(type_: Type, default: Any = ..., **kwargs) -> Field:
+    """Declare a parameter field — analog of DMLC_DECLARE_FIELD (parameter.h:265)."""
+    return Field(type_, default, **kwargs)
+
+
+class Parameter:
+    """Base class for declarative parameter structs (parameter.h:104-298)."""
+
+    __fields__: Dict[str, Field]
+    __alias_map__: Dict[str, str]
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        fields: Dict[str, Field] = {}
+        # inherit parent fields first (CRTP parameter structs don't inherit in
+        # the reference, but it is natural in Python)
+        for base in cls.__mro__[1:]:
+            if issubclass(base, Parameter) and base is not Parameter:
+                fields.update(getattr(base, "__fields__", {}))
+        for name, value in list(cls.__dict__.items()):
+            if isinstance(value, Field):
+                value.name = name
+                fields[name] = value
+                delattr_safe(cls, name)
+        cls.__fields__ = fields
+        alias_map: Dict[str, str] = {}
+        for name, f in fields.items():
+            for alias in f.aliases:
+                if alias in fields or alias in alias_map:
+                    raise DMLCError(f"parameter alias {alias!r} collides")
+                alias_map[alias] = name
+        cls.__alias_map__ = alias_map
+
+    def __init__(self, **kwargs):
+        for name, f in self.__fields__.items():
+            if f.default is not ...:
+                object.__setattr__(self, name, f.default)
+        self.init(kwargs)
+
+    # -- kwargs init with unknown-key policy (parameter.h:77-84,140-165) --
+    def init(self, kwargs: Dict[str, Any], *, allow_unknown: bool = False) -> Dict[str, Any]:
+        """Set fields from a string/any dict; returns the unknown leftovers.
+
+        ``allow_unknown=False`` mirrors kAllowUnknown=false: unknown keys
+        raise. Missing fields without defaults raise, as the reference's
+        RunInit does for required fields (parameter.h:857-880).
+        """
+        unknown: Dict[str, Any] = {}
+        for key, value in kwargs.items():
+            name = self.__alias_map__.get(key, key)
+            f = self.__fields__.get(name)
+            if f is None:
+                if not allow_unknown:
+                    raise DMLCError(
+                        f"{type(self).__name__}: unknown parameter {key!r}; "
+                        f"known: {sorted(self.__fields__)}"
+                    )
+                unknown[key] = value
+                continue
+            object.__setattr__(self, name, f.parse(value))
+        for name, f in self.__fields__.items():
+            if not hasattr(self, name):
+                raise DMLCError(
+                    f"{type(self).__name__}: required parameter {name!r} not set"
+                )
+        return unknown
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Analog of __DICT__ (parameter.h:204-212)."""
+        return {name: getattr(self, name) for name in self.__fields__}
+
+    # -- JSON round trip (parameter.h:190-202) --
+    def save_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    def load_json(self, text: str, *, allow_unknown: bool = False) -> Dict[str, Any]:
+        return self.init(json.loads(text), allow_unknown=allow_unknown)
+
+    @classmethod
+    def doc(cls) -> str:
+        """Generated docstring — analog of __DOC__ (parameter.h:214-218)."""
+        lines: List[str] = [f"Parameters of {cls.__name__}:"]
+        for name, f in cls.__fields__.items():
+            default = "required" if f.default is ... else f"default={f.default!r}"
+            constraints = []
+            if f.lower_bound is not None:
+                constraints.append(f">={f.lower_bound!r}")
+            if f.upper_bound is not None:
+                constraints.append(f"<={f.upper_bound!r}")
+            if f.enum is not None:
+                constraints.append(f"in {f.enum!r}")
+            extra = (", " + ", ".join(constraints)) if constraints else ""
+            lines.append(f"  {name} ({f.type.__name__}, {default}{extra}): {f.help}")
+        return "\n".join(lines)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        items = ", ".join(f"{k}={v!r}" for k, v in self.to_dict().items())
+        return f"{type(self).__name__}({items})"
+
+
+def delattr_safe(cls, name):
+    try:
+        delattr(cls, name)
+    except AttributeError:
+        pass
+
+
+# -- typed env access, analog of GetEnv/SetEnv (parameter.h:50-61) --
+
+def get_env(key: str, type_: Type, default: Any):
+    raw = os.environ.get(key)
+    if raw is None or raw == "":
+        return default
+    if type_ is bool:
+        return _parse_bool(raw)
+    return type_(raw)
+
+
+def set_env(key: str, value: Any) -> None:
+    os.environ[key] = str(value)

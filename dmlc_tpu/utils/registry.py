@@ -1,0 +1,103 @@
+"""Global factory registry.
+
+TPU-native equivalent of reference include/dmlc/registry.h: named singleton
+registries of factory entries with ``register``/``find``/``alias``/``list``
+(reference Registry<E>::__REGISTER__/Find/AddAlias, registry.h:48-126).
+Python gives us decorators instead of static-init macros
+(DMLC_REGISTRY_REGISTER, registry.h:229-235).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Generic, Iterable, Optional, TypeVar
+
+from dmlc_tpu.utils.check import DMLCError
+
+T = TypeVar("T")
+
+_REGISTRIES: Dict[str, "Registry"] = {}
+_REGISTRIES_LOCK = threading.Lock()
+
+
+class RegistryEntry(Generic[T]):
+    """Factory entry — analog of FunctionRegEntryBase (registry.h:150-226)."""
+
+    def __init__(self, name: str, body: T, description: str = ""):
+        self.name = name
+        self.body = body
+        self.description = description
+        self.arguments: list[tuple[str, str, str]] = []  # (name, type, description)
+
+    def describe(self, description: str) -> "RegistryEntry[T]":
+        self.description = description
+        return self
+
+    def add_argument(self, name: str, type_str: str, description: str) -> "RegistryEntry[T]":
+        self.arguments.append((name, type_str, description))
+        return self
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"RegistryEntry({self.name!r})"
+
+
+class Registry(Generic[T]):
+    """Named registry of factories — analog of Registry<EntryType> (registry.h:26-126)."""
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._entries: Dict[str, RegistryEntry[T]] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def get(kind: str) -> "Registry":
+        """Singleton per kind — analog of Registry::Get() (registry.h:78-89)."""
+        with _REGISTRIES_LOCK:
+            reg = _REGISTRIES.get(kind)
+            if reg is None:
+                reg = Registry(kind)
+                _REGISTRIES[kind] = reg
+            return reg
+
+    def register(self, name: str, description: str = "", override: bool = False) -> Callable[[T], T]:
+        """Decorator registering ``body`` under ``name``."""
+
+        def deco(body: T) -> T:
+            with self._lock:
+                if name in self._entries and not override:
+                    raise DMLCError(f"{self.kind}: entry {name!r} already registered")
+                self._entries[name] = RegistryEntry(name, body, description)
+            return body
+
+        return deco
+
+    def add_alias(self, name: str, alias: str) -> None:
+        """Analog of AddAlias (registry.h:63-72)."""
+        with self._lock:
+            if name not in self._entries:
+                raise DMLCError(f"{self.kind}: cannot alias unknown entry {name!r}")
+            if alias in self._entries:
+                raise DMLCError(f"{self.kind}: alias {alias!r} already registered")
+            self._entries[alias] = self._entries[name]
+
+    def find(self, name: str) -> Optional[RegistryEntry[T]]:
+        """Analog of Find (registry.h:55-61); None when missing."""
+        with self._lock:
+            return self._entries.get(name)
+
+    def lookup(self, name: str) -> RegistryEntry[T]:
+        """Find-or-raise with the available names in the message."""
+        entry = self.find(name)
+        if entry is None:
+            raise DMLCError(
+                f"{self.kind}: unknown entry {name!r}; known: {sorted(self._entries)}"
+            )
+        return entry
+
+    def create(self, name: str, *args, **kwargs):
+        """Lookup + call the factory body."""
+        return self.lookup(name).body(*args, **kwargs)
+
+    def list_names(self) -> Iterable[str]:
+        with self._lock:
+            return sorted(self._entries)

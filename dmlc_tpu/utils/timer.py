@@ -1,0 +1,100 @@
+"""Wall-clock timing + lightweight throughput counters.
+
+Analog of reference include/dmlc/timer.h (GetTime, timer.h:27-47) plus the
+inline MB/sec progress logging pattern used by the load path
+(basic_row_iter.h:68-81, disk_row_iter.h:117-140).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from dmlc_tpu.utils.check import get_logger
+
+
+def get_time() -> float:
+    """Seconds, monotonic — analog of dmlc::GetTime (timer.h:27)."""
+    return time.monotonic()
+
+
+class Timer:
+    """Context-manager stopwatch."""
+
+    def __init__(self):
+        self.start = 0.0
+        self.elapsed = 0.0
+
+    def __enter__(self) -> "Timer":
+        self.start = get_time()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.elapsed = get_time() - self.start
+
+
+class ThroughputMeter:
+    """Bytes-in / items-out counter logging every `log_every_mb` MB.
+
+    Mirrors the reference's inline progress logging in BasicRowIter::Init
+    (basic_row_iter.h:68-81): logs ``N MB read, X MB/sec`` every 10 MB and a
+    final summary. Also tracks consumer stall time, the observability hook
+    the TPU pipeline needs to prove "zero input-bound stalls".
+    """
+
+    def __init__(self, name: str = "load", log_every_mb: float = 10.0, silent: bool = False):
+        self.name = name
+        self.log_every = log_every_mb * (1 << 20)
+        self.silent = silent
+        self.bytes = 0
+        self.items = 0
+        self.stall_seconds = 0.0
+        self._next_log = self.log_every
+        self._start: Optional[float] = None
+
+    def start(self) -> None:
+        if self._start is None:
+            self._start = get_time()
+
+    def add(self, nbytes: int, nitems: int = 0) -> None:
+        self.start()
+        self.bytes += nbytes
+        self.items += nitems
+        if not self.silent and self.bytes >= self._next_log:
+            self._next_log += self.log_every
+            get_logger().info(
+                "%s: %.1f MB read, %.2f MB/sec", self.name, self.mb, self.mb_per_sec
+            )
+
+    def add_stall(self, seconds: float) -> None:
+        self.stall_seconds += seconds
+
+    @property
+    def mb(self) -> float:
+        return self.bytes / (1 << 20)
+
+    @property
+    def elapsed(self) -> float:
+        return 0.0 if self._start is None else get_time() - self._start
+
+    @property
+    def mb_per_sec(self) -> float:
+        e = self.elapsed
+        return self.mb / e if e > 0 else 0.0
+
+    def summary(self) -> dict:
+        return {
+            "name": self.name,
+            "mb": self.mb,
+            "items": self.items,
+            "seconds": self.elapsed,
+            "mb_per_sec": self.mb_per_sec,
+            "stall_seconds": self.stall_seconds,
+        }
+
+    def log_final(self) -> None:
+        if not self.silent:
+            get_logger().info(
+                "%s: finished %.1f MB in %.2f s, %.2f MB/sec (stall %.3f s)",
+                self.name, self.mb, self.elapsed, self.mb_per_sec, self.stall_seconds,
+            )

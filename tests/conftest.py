@@ -1,0 +1,16 @@
+"""Test config: force an 8-device virtual CPU mesh before jax imports.
+
+Multi-chip hardware is not available in CI; sharding tests run on
+``xla_force_host_platform_device_count=8`` CPU devices as SURVEY.md §4(d)
+prescribes.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

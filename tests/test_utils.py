@@ -1,0 +1,186 @@
+"""Tests for the utils layer (registry, params, config, serializer, check).
+
+Mirrors the reference unit-test coverage of unittest_param.cc,
+unittest_config.cc, unittest_serializer.cc, unittest_env.cc.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.utils import (
+    Config, DMLCError, Parameter, Registry, check, check_eq, check_lt,
+)
+from dmlc_tpu.utils.params import field, get_env, set_env
+from dmlc_tpu.utils import serializer as ser
+
+
+# ---------------- check ----------------
+
+def test_check_raises():
+    check(True)
+    with pytest.raises(DMLCError):
+        check(False, "boom")
+    check_eq(1, 1)
+    with pytest.raises(DMLCError):
+        check_eq(1, 2)
+    with pytest.raises(DMLCError):
+        check_lt(3, 2)
+
+
+# ---------------- registry ----------------
+
+def test_registry_register_find_alias():
+    reg = Registry.get("test_reg_1")
+
+    @reg.register("foo", description="a foo")
+    def make_foo():
+        return "foo!"
+
+    assert reg.find("foo").body() == "foo!"
+    assert reg.find("bar") is None
+    reg.add_alias("foo", "foo2")
+    assert reg.create("foo2") == "foo!"
+    with pytest.raises(DMLCError):
+        reg.lookup("nope")
+    with pytest.raises(DMLCError):
+        @reg.register("foo")
+        def make_foo_again():
+            return None
+    assert "foo" in reg.list_names()
+
+
+# ---------------- params ----------------
+
+class MyParam(Parameter):
+    size = field(int, default=100, lower_bound=0, help="a size")
+    name = field(str, default="x")
+    ratio = field(float, default=0.5, lower_bound=0.0, upper_bound=1.0)
+    kind = field(str, default="a", enum=["a", "b"])
+    num_hidden = field(int, default=0, aliases=["nhidden"])
+
+
+def test_param_defaults_and_init():
+    p = MyParam()
+    assert p.size == 100 and p.name == "x"
+    unknown = p.init({"size": "7", "junk": "1"}, allow_unknown=True)
+    assert p.size == 7
+    assert unknown == {"junk": "1"}
+    with pytest.raises(DMLCError):
+        p.init({"junk": "1"})  # unknown not allowed
+
+
+def test_param_range_enum_alias():
+    p = MyParam()
+    with pytest.raises(DMLCError):
+        p.init({"size": "-1"})
+    with pytest.raises(DMLCError):
+        p.init({"ratio": "1.5"})
+    with pytest.raises(DMLCError):
+        p.init({"kind": "c"})
+    p.init({"nhidden": "32"})  # alias, like DMLC_DECLARE_ALIAS (parameter.cc:30)
+    assert p.num_hidden == 32
+
+
+def test_param_required_and_json():
+    class Req(Parameter):
+        must = field(int)
+
+    with pytest.raises(DMLCError):
+        Req()
+    r = Req(must=3)
+    assert r.must == 3
+
+    p = MyParam(size=9)
+    text = p.save_json()
+    q = MyParam()
+    q.load_json(text)
+    assert q.size == 9
+    assert "size" in MyParam.doc()
+
+
+def test_env_access(monkeypatch):
+    monkeypatch.setenv("DMLC_TEST_KEY", "42")
+    assert get_env("DMLC_TEST_KEY", int, 0) == 42
+    assert get_env("DMLC_TEST_MISSING", int, 7) == 7
+    set_env("DMLC_TEST_KEY2", 5)
+    assert get_env("DMLC_TEST_KEY2", int, 0) == 5
+    monkeypatch.setenv("DMLC_TEST_BOOL", "true")
+    assert get_env("DMLC_TEST_BOOL", bool, False) is True
+
+
+# ---------------- config ----------------
+
+def test_config_basic():
+    cfg = Config('a = 1\nb = "hello # not comment" # real comment\nc=2.5\n')
+    assert cfg.get("a") == "1"
+    assert cfg.get("b") == "hello # not comment"
+    assert cfg.get("c") == "2.5"
+    assert "a" in cfg and "zz" not in cfg
+
+
+def test_config_override_and_multi():
+    cfg = Config("k = 1\nk = 2\n")
+    assert cfg.get("k") == "2"
+    assert cfg.get_all("k") == ["2"]  # single-value mode: last wins
+
+    mcfg = Config("k = 1\nk = 2\n", multi_value=True)
+    assert mcfg.get_all("k") == ["1", "2"]
+
+
+def test_config_escaped_quote_and_proto():
+    cfg = Config('s = "say \\"hi\\""\nn = 3\n')
+    assert cfg.get("s") == 'say "hi"'
+    proto = cfg.to_proto_string()
+    assert 'n : 3' in proto and 's : "say "hi""' in proto
+
+
+def test_config_errors():
+    with pytest.raises(DMLCError):
+        Config("a = ")
+    with pytest.raises(DMLCError):
+        Config('a = "unterminated')
+
+
+# ---------------- serializer ----------------
+
+def test_scalar_roundtrip_little_endian():
+    buf = io.BytesIO()
+    ser.write_scalar(buf, 0x01020304, "uint32")
+    # wire bytes are little-endian regardless of host (endian.h:39 analog)
+    assert buf.getvalue() == b"\x04\x03\x02\x01"
+    buf.seek(0)
+    assert ser.read_scalar(buf, "uint32") == 0x01020304
+
+
+def test_obj_roundtrip():
+    obj = {
+        "a": 1, "b": 2.5, "c": "hey", "d": [1, 2, [3, "x"]],
+        "e": None, "f": True, "g": b"\x00\x01",
+        "arr": np.arange(6, dtype=np.float32).reshape(2, 3),
+    }
+    buf = io.BytesIO()
+    ser.write_obj(buf, obj)
+    buf.seek(0)
+    out = ser.read_obj(buf)
+    assert out["a"] == 1 and out["f"] is True and out["c"] == "hey"
+    np.testing.assert_array_equal(out["arr"], obj["arr"])
+    assert out["d"] == [1, 2, [3, "x"]]
+
+
+def test_ndarray_dtype_preserved():
+    for dtype in (np.uint64, np.int32, np.float64, np.uint8):
+        arr = np.array([1, 2, 3], dtype=dtype)
+        buf = io.BytesIO()
+        ser.write_ndarray(buf, arr)
+        buf.seek(0)
+        out = ser.read_ndarray(buf)
+        assert out.dtype == np.dtype(dtype)
+        np.testing.assert_array_equal(out, arr)
+
+
+def test_truncated_stream_raises():
+    buf = io.BytesIO(b"\x01\x02")
+    with pytest.raises(DMLCError):
+        ser.read_scalar(buf, "uint64")
