@@ -1,0 +1,29 @@
+"""IO layer: streams, filesystems, RecordIO, input splitting, prefetch.
+
+TPU-native equivalent of reference layers 3-4 (include/dmlc/io.h, src/io/,
+include/dmlc/recordio.h, include/dmlc/threadediter.h).
+"""
+
+from dmlc_tpu.io.uri import URI, URISpec
+from dmlc_tpu.io.filesystem import (
+    FileInfo, FileSystem, LocalFileSystem, MemoryFileSystem, get_filesystem,
+)
+from dmlc_tpu.io.stream import open_stream
+from dmlc_tpu.io.recordio import (
+    RECORDIO_MAGIC, RecordIOWriter, RecordIOReader, RecordIOChunkReader,
+    read_index_file, write_indexed_recordio,
+)
+from dmlc_tpu.io.threaded_iter import ThreadedIter
+from dmlc_tpu.io.input_split import (
+    InputSplit, LineSplitter, RecordIOSplitter, IndexedRecordIOSplitter,
+    ThreadedInputSplit, create_input_split,
+)
+
+__all__ = [
+    "URI", "URISpec", "FileInfo", "FileSystem", "LocalFileSystem",
+    "MemoryFileSystem", "get_filesystem", "open_stream",
+    "RECORDIO_MAGIC", "RecordIOWriter", "RecordIOReader", "RecordIOChunkReader",
+    "read_index_file", "write_indexed_recordio",
+    "ThreadedIter", "InputSplit", "LineSplitter", "RecordIOSplitter",
+    "IndexedRecordIOSplitter", "ThreadedInputSplit", "create_input_split",
+]

@@ -1,0 +1,235 @@
+"""FileSystem abstraction with a protocol registry.
+
+Equivalent of reference io.h:582-631 (FileSystem interface) + src/io.cc:30-71
+(protocol dispatch) + src/io/local_filesys.cc (local impl) +
+src/io/filesys.cc:8-25 (recursive listing). A MemoryFileSystem is added for
+hermetic tests (the reference tests against temp dirs; we support both).
+
+Cloud members (GCS/S3/HDFS) register their protocol slots here; GCS is the
+cloud-native member of the TPU rebuild (SURVEY.md §7) and arrives with the
+native core. Unregistered protocols raise with the known-protocol list.
+"""
+
+from __future__ import annotations
+
+import io as _pyio
+import os
+import threading
+from typing import BinaryIO, Callable, Dict, List
+
+from dmlc_tpu.io.uri import URI
+from dmlc_tpu.utils.check import DMLCError
+
+FILE_TYPE = "file"
+DIR_TYPE = "directory"
+
+
+class FileInfo:
+    """path + size + type — analog of dmlc::io::FileInfo (io.h:560-570)."""
+
+    def __init__(self, path: URI, size: int, type_: str):
+        self.path = path
+        self.size = size
+        self.type = type_
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"FileInfo({self.path}, size={self.size}, type={self.type})"
+
+
+class FileSystem:
+    """Abstract filesystem — analog of dmlc::io::FileSystem (io.h:582)."""
+
+    def get_path_info(self, path: URI) -> FileInfo:
+        raise NotImplementedError
+
+    def list_directory(self, path: URI) -> List[FileInfo]:
+        raise NotImplementedError
+
+    def list_directory_recursive(self, path: URI) -> List[FileInfo]:
+        """BFS recursive listing — analog of filesys.cc:8-25."""
+        out: List[FileInfo] = []
+        queue = [path]
+        while queue:
+            dir_uri = queue.pop(0)
+            for info in self.list_directory(dir_uri):
+                if info.type == DIR_TYPE:
+                    queue.append(info.path)
+                else:
+                    out.append(info)
+        return out
+
+    def open(self, path: URI, mode: str) -> BinaryIO:
+        """Open a binary stream; mode in {'r','w','a'} (io.h:57 flags)."""
+        raise NotImplementedError
+
+    def open_for_read(self, path: URI) -> BinaryIO:
+        return self.open(path, "r")
+
+    def exists(self, path: URI) -> bool:
+        try:
+            self.get_path_info(path)
+            return True
+        except (DMLCError, OSError):
+            return False
+
+
+_FS_FACTORIES: Dict[str, Callable[[URI], FileSystem]] = {}
+_FS_LOCK = threading.Lock()
+
+
+def register_filesystem(protocol: str, factory: Callable[[URI], FileSystem]) -> None:
+    with _FS_LOCK:
+        _FS_FACTORIES[protocol] = factory
+
+
+def get_filesystem(uri: URI | str) -> FileSystem:
+    """Protocol dispatch — analog of FileSystem::GetInstance (src/io.cc:30-71)."""
+    if isinstance(uri, str):
+        uri = URI(uri)
+    with _FS_LOCK:
+        factory = _FS_FACTORIES.get(uri.protocol)
+    if factory is None:
+        raise DMLCError(
+            f"unknown filesystem protocol {uri.protocol!r}; "
+            f"known: {sorted(_FS_FACTORIES)}"
+        )
+    return factory(uri)
+
+
+class LocalFileSystem(FileSystem):
+    """POSIX filesystem — analog of src/io/local_filesys.cc."""
+
+    _instance: "LocalFileSystem | None" = None
+
+    @classmethod
+    def instance(cls, uri: URI | None = None) -> "LocalFileSystem":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def get_path_info(self, path: URI) -> FileInfo:
+        name = path.name
+        try:
+            st = os.stat(name)
+        except OSError as exc:
+            raise DMLCError(f"LocalFileSystem.get_path_info: {name!r}: {exc}") from exc
+        type_ = DIR_TYPE if os.path.isdir(name) else FILE_TYPE
+        return FileInfo(URI(name), st.st_size, type_)
+
+    def list_directory(self, path: URI) -> List[FileInfo]:
+        name = path.name
+        try:
+            entries = sorted(os.listdir(name))
+        except OSError as exc:
+            raise DMLCError(f"LocalFileSystem.list_directory: {name!r}: {exc}") from exc
+        out = []
+        for entry in entries:
+            full = os.path.join(name, entry)
+            try:
+                out.append(self.get_path_info(URI(full)))
+            except DMLCError:
+                # tolerate dangling symlinks like local_filesys.cc:99-145
+                continue
+        return out
+
+    def open(self, path: URI, mode: str) -> BinaryIO:
+        name = path.name
+        if name == "stdin" and mode == "r":
+            return _pyio.BufferedReader(_pyio.FileIO(0, "rb", closefd=False))
+        if name == "stdout" and mode in ("w", "a"):
+            return _pyio.BufferedWriter(_pyio.FileIO(1, "wb", closefd=False))
+        pymode = {"r": "rb", "w": "wb", "a": "ab"}.get(mode)
+        if pymode is None:
+            raise DMLCError(f"LocalFileSystem.open: bad mode {mode!r}")
+        try:
+            return open(name, pymode)
+        except OSError as exc:
+            raise DMLCError(f"LocalFileSystem.open: {name!r}: {exc}") from exc
+
+
+class _MemFile(_pyio.BytesIO):
+    """BytesIO flushing back to the in-memory store on close."""
+
+    def __init__(self, store: Dict[str, bytes], key: str, data: bytes = b""):
+        super().__init__(data)
+        self._store = store
+        self._key = key
+        self._writable = True
+
+    def close(self) -> None:
+        if self._writable:
+            self._store[self._key] = self.getvalue()
+        super().close()
+
+
+class MemoryFileSystem(FileSystem):
+    """In-memory FS under ``mem://`` for hermetic tests.
+
+    Not in the reference (it tests against TemporaryDirectory,
+    filesystem.h:54); added because it makes parser/split tests run on
+    in-memory corpora, the same spirit as unittest_parser.cc's in-memory
+    data iters.
+    """
+
+    _instance: "MemoryFileSystem | None" = None
+
+    def __init__(self):
+        self.store: Dict[str, bytes] = {}
+
+    @classmethod
+    def instance(cls, uri: URI | None = None) -> "MemoryFileSystem":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._instance = None
+
+    def _key(self, path: URI) -> str:
+        # include the host segment: mem://bucket/a.txt -> "bucket/a.txt"
+        return path.host + path.name
+
+    def get_path_info(self, path: URI) -> FileInfo:
+        key = self._key(path)
+        if key in self.store:
+            return FileInfo(URI("mem://" + key), len(self.store[key]), FILE_TYPE)
+        prefix = key.rstrip("/") + "/"
+        if any(k.startswith(prefix) for k in self.store):
+            return FileInfo(URI("mem://" + key), 0, DIR_TYPE)
+        raise DMLCError(f"MemoryFileSystem: no such path {key!r}")
+
+    def list_directory(self, path: URI) -> List[FileInfo]:
+        prefix = self._key(path).rstrip("/") + "/"
+        seen: Dict[str, FileInfo] = {}
+        for key, data in sorted(self.store.items()):
+            if not key.startswith(prefix):
+                continue
+            rest = key[len(prefix):]
+            if "/" in rest:
+                sub = rest.split("/", 1)[0]
+                seen.setdefault(sub, FileInfo(URI("mem://" + prefix + sub), 0, DIR_TYPE))
+            else:
+                seen[rest] = FileInfo(URI("mem://" + key), len(data), FILE_TYPE)
+        if not seen:
+            raise DMLCError(f"MemoryFileSystem: no such directory {path.raw!r}")
+        return list(seen.values())
+
+    def open(self, path: URI, mode: str) -> BinaryIO:
+        key = self._key(path)
+        if mode == "r":
+            if key not in self.store:
+                raise DMLCError(f"MemoryFileSystem: no such file {key!r}")
+            f = _pyio.BytesIO(self.store[key])
+            return f
+        if mode == "w":
+            return _MemFile(self.store, key)
+        if mode == "a":
+            f = _MemFile(self.store, key, self.store.get(key, b""))
+            f.seek(0, 2)
+            return f
+        raise DMLCError(f"MemoryFileSystem.open: bad mode {mode!r}")
+
+
+register_filesystem("file://", LocalFileSystem.instance)
+register_filesystem("mem://", MemoryFileSystem.instance)

@@ -1,0 +1,848 @@
+"""Partitioned, record-aware input splitting.
+
+Behavioral equivalent of reference src/io/input_split_base.{h,cc},
+line_split.cc, recordio_split.cc, indexed_recordio_split.cc,
+single_file_split.h, threaded_input_split.h and input_split_shuffle.h —
+rebuilt in Python around byte chunks + memoryview records (the C++ native
+core supplies the same contract for the hot path).
+
+The partition invariant (the reference's hardest-won correctness property,
+see PR#385/PR#452 citations at input_split_base.cc:196-199, 235-242):
+
+- The logical dataset is the concatenation of all matched files.
+- Partition ``k`` of ``n`` owns byte range ``[k*step, (k+1)*step)`` with
+  ``step = align(ceil(total/n))`` (ResetPartition, input_split_base.cc:30-64).
+- Both range ends are advanced to the next record head by scanning from the
+  raw byte offset (``seek_record_begin``) unless they sit exactly on a file
+  boundary — file joins are implicit record boundaries.
+- A '\\n' is injected at text-file joins so NOEOL files never merge records
+  across files (Read, input_split_base.cc:196-199), and at end-of-partition
+  when the final record lacks a newline (ReadChunk, input_split_base.cc:235-242).
+
+Every record is therefore owned by exactly one partition: no loss, no
+duplication — tested by looping all parts in-process (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import random
+import struct
+from bisect import bisect_right
+from typing import BinaryIO, Iterator, List, Optional, Tuple
+
+from dmlc_tpu.io import recordio as rio
+from dmlc_tpu.io.filesystem import FileSystem, get_filesystem
+from dmlc_tpu.io.threaded_iter import ThreadedIter
+from dmlc_tpu.io.uri import URI
+from dmlc_tpu.utils.check import DMLCError, check
+
+_EOL = (0x0A, 0x0D)  # '\n', '\r'
+DEFAULT_CHUNK_BYTES = 1 << 20
+
+
+class InputSplit:
+    """Abstract input split — analog of dmlc::InputSplit (io.h:190-242)."""
+
+    def next_record(self) -> Optional[memoryview]:
+        raise NotImplementedError
+
+    def next_chunk(self) -> Optional[memoryview]:
+        raise NotImplementedError
+
+    def before_first(self) -> None:
+        raise NotImplementedError
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        raise NotImplementedError
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def iter_records(self) -> Iterator[memoryview]:
+        while True:
+            rec = self.next_record()
+            if rec is None:
+                return
+            yield rec
+
+    def iter_chunks(self) -> Iterator[memoryview]:
+        while True:
+            chunk = self.next_chunk()
+            if chunk is None:
+                return
+            yield chunk
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class _Chunk:
+    """A loaded chunk being consumed record-by-record."""
+
+    __slots__ = ("data", "pos")
+
+    def __init__(self, data: bytes):
+        self.data = memoryview(data)
+        self.pos = 0
+
+    @property
+    def exhausted(self) -> bool:
+        return self.pos >= len(self.data)
+
+
+class InputSplitBase(InputSplit):
+    """Core sharding engine — analog of InputSplitBase (input_split_base.cc)."""
+
+    is_text = False
+    align_bytes = 1
+
+    def __init__(
+        self,
+        fs: FileSystem,
+        uri: str,
+        recurse_directories: bool = False,
+    ):
+        self.fs = fs
+        self.files: List = []
+        self._init_file_info(uri, recurse_directories)
+        self.file_offset = [0]
+        for info in self.files:
+            check(
+                info.size % self.align_bytes == 0,
+                f"file {info.path} does not align by {self.align_bytes} bytes",
+            )
+            self.file_offset.append(self.file_offset[-1] + info.size)
+        self.offset_begin = 0
+        self.offset_end = 0
+        self.offset_curr = 0
+        self.file_ptr = 0
+        self._fp: Optional[BinaryIO] = None
+        self._overflow = b""
+        self._chunk: Optional[_Chunk] = None
+        self._chunk_bytes = DEFAULT_CHUNK_BYTES
+        self.bytes_read = 0
+
+    # ---------------- file matching ----------------
+
+    def _init_file_info(self, uri: str, recurse: bool) -> None:
+        """Expand ';'-separated URIs, directories, and regex basename patterns
+        (ConvertToURIs/InitInputFileInfo, input_split_base.cc:96-175)."""
+        import re
+
+        for part in uri.split(";"):
+            if not part:
+                continue
+            path = URI(part)
+            matched = False
+            try:
+                info = self.fs.get_path_info(path)
+                if info.type == "directory":
+                    listing = (
+                        self.fs.list_directory_recursive(info.path)
+                        if recurse
+                        else self.fs.list_directory(info.path)
+                    )
+                    for f in listing:
+                        if f.type == "file" and f.size > 0:
+                            self.files.append(f)
+                else:
+                    if info.size > 0:
+                        self.files.append(info)
+                matched = True
+            except DMLCError:
+                pass
+            if not matched:
+                # regex match over the parent directory's entries
+                pos = path.name.rstrip("/").rfind("/")
+                if pos <= 0:
+                    continue
+                dir_uri = URI(path.protocol + path.host + path.name[:pos]
+                              if path.protocol != "file://" else path.name[:pos])
+                pattern = re.compile(path.name)
+                try:
+                    listing = self.fs.list_directory(dir_uri)
+                except DMLCError:
+                    continue
+                for f in listing:
+                    if f.type != "file" or f.size == 0:
+                        continue
+                    if pattern.fullmatch(f.path.name.rstrip("/")):
+                        self.files.append(f)
+        check(len(self.files) > 0, f"Cannot find any files that match the URI pattern {uri!r}")
+
+    # ---------------- subclass contract ----------------
+
+    def seek_record_begin(self, stream: BinaryIO) -> int:
+        """Bytes from the stream position to the next record head."""
+        raise NotImplementedError
+
+    def find_last_record_begin(self, data: bytes) -> int:
+        """Offset of the last record head in ``data`` (0 = none found)."""
+        raise NotImplementedError
+
+    def extract_next_record(self, chunk: _Chunk) -> Optional[memoryview]:
+        """Pop one record off the chunk; None when exhausted."""
+        raise NotImplementedError
+
+    # ---------------- partitioning ----------------
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        """Byte-range partition + record-boundary adjustment
+        (ResetPartition, input_split_base.cc:30-64)."""
+        ntotal = self.file_offset[-1]
+        nstep = (ntotal + num_parts - 1) // num_parts
+        align = self.align_bytes
+        nstep = ((nstep + align - 1) // align) * align
+        self.offset_begin = min(nstep * part_index, ntotal)
+        self.offset_end = min(nstep * (part_index + 1), ntotal)
+        self.offset_curr = self.offset_begin
+        if self.offset_begin == self.offset_end:
+            self._close_fp()
+            return
+        file_ptr = bisect_right(self.file_offset, self.offset_begin) - 1
+        file_ptr_end = bisect_right(self.file_offset, self.offset_end) - 1
+        # adjust the end: extend to the next record head unless on a file join
+        if self.offset_end != self.file_offset[file_ptr_end]:
+            check(file_ptr_end < len(self.files), "partition end out of range")
+            with self.fs.open_for_read(self.files[file_ptr_end].path) as f:
+                f.seek(self.offset_end - self.file_offset[file_ptr_end])
+                self.offset_end += self.seek_record_begin(f)
+        # adjust the begin the same way
+        self.file_ptr = file_ptr
+        if self.offset_begin != self.file_offset[file_ptr]:
+            with self.fs.open_for_read(self.files[file_ptr].path) as f:
+                f.seek(self.offset_begin - self.file_offset[file_ptr])
+                self.offset_begin += self.seek_record_begin(f)
+        self.before_first()
+
+    def before_first(self) -> None:
+        """Seek back to the partition start (BeforeFirst, input_split_base.cc:66-82)."""
+        if self.offset_begin >= self.offset_end:
+            return
+        self.file_ptr = bisect_right(self.file_offset, self.offset_begin) - 1
+        self._close_fp()
+        self._fp = self.fs.open_for_read(self.files[self.file_ptr].path)
+        self._fp.seek(self.offset_begin - self.file_offset[self.file_ptr])
+        self.offset_curr = self.offset_begin
+        self._overflow = b""
+        self._chunk = None
+
+    # ---------------- reading ----------------
+
+    def _read(self, size: int) -> bytes:
+        """Read up to ``size`` payload bytes across file joins, injecting '\\n'
+        at text-file joins (Read, input_split_base.cc:177-219)."""
+        if self._fp is None or self.offset_begin >= self.offset_end:
+            return b""
+        size = min(size, self.offset_end - self.offset_curr)
+        if size <= 0:
+            return b""
+        out = bytearray()
+        nleft = size
+        while nleft > 0:
+            data = self._fp.read(nleft)
+            if data:
+                out += data
+                nleft -= len(data)
+                self.offset_curr += len(data)
+                continue
+            # file exhausted
+            if self.is_text:
+                # newline injection at file joins (PR#385)
+                out += b"\n"
+                nleft -= 1
+            check(
+                self.offset_curr == self.file_offset[self.file_ptr + 1],
+                "file offset not calculated correctly",
+            )
+            if self.file_ptr + 1 >= len(self.files):
+                break
+            self.file_ptr += 1
+            self._close_fp()
+            self._fp = self.fs.open_for_read(self.files[self.file_ptr].path)
+        self.bytes_read += len(out)
+        return bytes(out)
+
+    def read_chunk(self, max_size: int) -> Optional[bytes]:
+        """One chunk of whole records; b'' means grow the buffer; None = EOF
+        (ReadChunk, input_split_base.cc:221-258)."""
+        if max_size <= len(self._overflow):
+            return b""
+        olen = len(self._overflow)
+        data = self._overflow + self._read(max_size - olen)
+        self._overflow = b""
+        if len(data) == 0:
+            return None
+        if self.is_text:
+            if len(data) == olen:
+                # final record of the partition lacked a newline (PR#452)
+                data += b"\n"
+        else:
+            if len(data) != max_size:
+                return data  # EOF tail: records are exactly complete
+        cut = self.find_last_record_begin(data)
+        self._overflow = data[cut:]
+        return data[:cut]
+
+    def _load_chunk(self) -> Optional[_Chunk]:
+        """Grow-on-demand chunk load (Chunk::Load, input_split_base.cc:260-277)."""
+        size = self._chunk_bytes
+        while True:
+            data = self.read_chunk(size)
+            if data is None:
+                return None
+            if len(data) == 0:
+                size *= 2
+                continue
+            return _Chunk(data)
+
+    # ---------------- public iteration ----------------
+
+    def next_record(self) -> Optional[memoryview]:
+        while True:
+            if self._chunk is not None:
+                rec = self.extract_next_record(self._chunk)
+                if rec is not None:
+                    return rec
+            self._chunk = self._load_chunk()
+            if self._chunk is None:
+                return None
+
+    def next_chunk(self) -> Optional[memoryview]:
+        # pending chunk tail first (ExtractNextChunk, input_split_base.cc:300-306)
+        if self._chunk is not None and not self._chunk.exhausted:
+            out = self._chunk.data[self._chunk.pos:]
+            self._chunk = None
+            return out
+        chunk = self._load_chunk()
+        if chunk is None:
+            return None
+        return chunk.data
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        self._chunk_bytes = max(chunk_size, 4096)
+
+    def records_in_chunk(self, chunk: bytes | memoryview) -> Iterator[memoryview]:
+        """Iterate the records inside an already-loaded chunk blob."""
+        c = _Chunk(chunk)  # type: ignore[arg-type]
+        while True:
+            rec = self.extract_next_record(c)
+            if rec is None:
+                return
+            yield rec
+
+    def _close_fp(self) -> None:
+        if self._fp is not None:
+            self._fp.close()
+            self._fp = None
+
+    def close(self) -> None:
+        self._close_fp()
+
+
+class LineSplitter(InputSplitBase):
+    """Record = line — analog of src/io/line_split.cc.
+
+    EOL handling matches the reference: '\\n' and '\\r' both terminate, runs
+    of EOL bytes collapse (so blank lines produce no records), records
+    returned exclude the terminator.
+    """
+
+    is_text = True
+    align_bytes = 1
+
+    def seek_record_begin(self, stream: BinaryIO) -> int:
+        """Scan to the first EOL, then past the EOL run (line_split.cc:9-26)."""
+        nstep = 0
+        # phase 1: find an EOL
+        found = False
+        while not found:
+            block = stream.read(512)
+            if not block:
+                return nstep
+            for i, b in enumerate(block):
+                nstep += 1
+                if b in _EOL:
+                    found = True
+                    rest = block[i + 1:]
+                    break
+        # phase 2: consume the EOL run
+        while True:
+            for b in rest:
+                if b in _EOL:
+                    nstep += 1
+                else:
+                    return nstep
+            rest = stream.read(512)
+            if not rest:
+                return nstep
+
+    def find_last_record_begin(self, data: bytes) -> int:
+        """Position after the last EOL (line_split.cc:27-34); 0 if none."""
+        pos = max(data.rfind(b"\n"), data.rfind(b"\r"))
+        return pos + 1 if pos >= 0 else 0
+
+    def extract_next_record(self, chunk: _Chunk) -> Optional[memoryview]:
+        data, pos, end = chunk.data, chunk.pos, len(chunk.data)
+        # skip any leading EOL run (blank lines collapse, line_split.cc:36-55)
+        while pos < end and data[pos] in _EOL:
+            pos += 1
+        if pos >= end:
+            chunk.pos = end
+            return None
+        nl = _find_eol(data, pos)
+        rec = data[pos:nl]
+        pos = nl
+        while pos < end and data[pos] in _EOL:
+            pos += 1
+        chunk.pos = pos
+        return rec
+
+
+def _find_eol(data: memoryview, start: int) -> int:
+    nl = bytes_find(data, 0x0A, start)
+    cr = bytes_find(data, 0x0D, start)
+    if nl < 0:
+        return cr if cr >= 0 else len(data)
+    if cr < 0:
+        return nl
+    return min(nl, cr)
+
+
+def bytes_find(data: memoryview, byte: int, start: int) -> int:
+    # bytes(data) would copy; search in slices to stay cheap
+    block = 4096
+    n = len(data)
+    pos = start
+    while pos < n:
+        stop = min(pos + block, n)
+        idx = bytes(data[pos:stop]).find(byte)
+        if idx >= 0:
+            return pos + idx
+        pos = stop
+    return -1
+
+
+class RecordIOSplitter(InputSplitBase):
+    """Record = RecordIO frame — analog of src/io/recordio_split.cc."""
+
+    is_text = False
+    align_bytes = 4
+
+    def seek_record_begin(self, stream: BinaryIO) -> int:
+        """Scan 4-byte cells for a head (magic + cflag 0|1)
+        (recordio_split.cc:9-25)."""
+        nstep = 0
+        while True:
+            cell = stream.read(4)
+            if len(cell) < 4:
+                return nstep
+            nstep += 4
+            if struct.unpack("<I", cell)[0] == rio.RECORDIO_MAGIC:
+                lrec_raw = stream.read(4)
+                check(len(lrec_raw) == 4, "invalid recordio format")
+                nstep += 4
+                lrec = struct.unpack("<I", lrec_raw)[0]
+                if rio.decode_flag(lrec) in (0, 1):
+                    return nstep - 8
+
+    def find_last_record_begin(self, data: bytes) -> int:
+        heads = rio.find_record_heads(data)
+        return int(heads[-1]) if len(heads) else 0
+
+    def extract_next_record(self, chunk: _Chunk) -> Optional[memoryview]:
+        if chunk.exhausted:
+            return None
+        rec, chunk.pos = rio.extract_record(chunk.data, chunk.pos, len(chunk.data))
+        return rec
+
+
+class SingleFileSplit(InputSplit):
+    """Line reading of a single file or stdin, no partitioning
+    (src/io/single_file_split.h)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._records: Optional[Iterator[memoryview]] = None
+        self._data: Optional[bytes] = None
+
+    def _load(self) -> None:
+        if self._data is None:
+            if self.path == "stdin":
+                import sys
+
+                self._data = sys.stdin.buffer.read()
+            else:
+                with get_filesystem(self.path).open_for_read(URI(self.path)) as f:
+                    self._data = f.read()
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        check(part_index == 0 and num_parts == 1,
+              "SingleFileSplit does not support partitioning")
+        self.before_first()
+
+    def before_first(self) -> None:
+        self._load()
+        mv = memoryview(self._data)
+        self._records = iter(
+            [mv[s:e] for s, e in _line_spans(self._data)]
+        )
+
+    def next_record(self) -> Optional[memoryview]:
+        if self._records is None:
+            self.before_first()
+        return next(self._records, None)
+
+    def next_chunk(self) -> Optional[memoryview]:
+        if self._records is None:
+            self.before_first()
+            data = memoryview(self._data)
+            self._records = iter(())
+            return data if len(data) else None
+        return None
+
+
+def _line_spans(data: bytes) -> List[Tuple[int, int]]:
+    spans = []
+    pos, n = 0, len(data)
+    while pos < n:
+        while pos < n and data[pos] in _EOL:
+            pos += 1
+        if pos >= n:
+            break
+        end = data.find(b"\n", pos)
+        cr = data.find(b"\r", pos)
+        if end < 0 or (0 <= cr < end):
+            end = cr
+        if end < 0:
+            end = n
+        spans.append((pos, end))
+        pos = end
+    return spans
+
+
+class IndexedRecordIOSplitter(InputSplitBase):
+    """Record-count partitioning with an external index + optional shuffle —
+    analog of src/io/indexed_recordio_split.cc."""
+
+    is_text = False
+    align_bytes = 4
+
+    def __init__(
+        self,
+        fs: FileSystem,
+        uri: str,
+        index_uri: str,
+        batch_size: int = 256,
+        shuffle: bool = False,
+        seed: int = 0,
+    ):
+        super().__init__(fs, uri)
+        with get_filesystem(index_uri).open_for_read(URI(index_uri)) as f:
+            self.index = rio.read_index_file(f, self.file_offset[-1])
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.rng = random.Random(seed)
+        self.index_begin = 0
+        self.index_end = 0
+        self.current_index = 0
+        self.permutation: List[int] = []
+
+    seek_record_begin = RecordIOSplitter.seek_record_begin
+    find_last_record_begin = RecordIOSplitter.find_last_record_begin
+    extract_next_record = RecordIOSplitter.extract_next_record
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        """Partition by record count (indexed_recordio_split.cc:12-41)."""
+        ntotal = len(self.index)
+        nstep = (ntotal + num_parts - 1) // num_parts
+        if part_index * nstep >= ntotal:
+            self.offset_begin = self.offset_end = 0
+            self.index_begin = self.index_end = 0
+            self._close_fp()
+            return
+        self.index_begin = part_index * nstep
+        self.offset_begin = self.index[self.index_begin][0]
+        if (part_index + 1) * nstep < ntotal:
+            self.index_end = (part_index + 1) * nstep
+            self.offset_end = self.index[self.index_end][0]
+        else:
+            self.index_end = ntotal
+            self.offset_end = self.file_offset[-1]
+        self.before_first()
+
+    def before_first(self) -> None:
+        if self.shuffle:
+            self.permutation = list(range(self.index_begin, self.index_end))
+            self.rng.shuffle(self.permutation)
+            self.current_index = 0
+        else:
+            self.current_index = self.index_begin
+        super().before_first()
+
+    def _next_batch_data(self, n_records: int) -> Optional[bytes]:
+        """Load the next ``n_records`` as one contiguous buffer
+        (NextBatchEx, indexed_recordio_split.cc:159-212)."""
+        if self.shuffle:
+            parts: List[bytes] = []
+            taken = 0
+            while taken < n_records and self.current_index < len(self.permutation):
+                rec_idx = self.permutation[self.current_index]
+                offset, size = self.index[rec_idx]
+                parts.append(self._read_span(offset, size))
+                self.current_index += 1
+                taken += 1
+            if not parts:
+                return None
+            return b"".join(parts)
+        if self.current_index >= self.index_end:
+            return None
+        last = min(self.current_index + n_records, self.index_end)
+        begin_off = self.index[self.current_index][0]
+        end_off = (
+            self.index[last][0] if last < len(self.index) else self.file_offset[-1]
+        )
+        if last == self.index_end:
+            end_off = self.offset_end
+        data = self._read_span(begin_off, end_off - begin_off)
+        self.current_index = last
+        return data
+
+    def _read_span(self, offset: int, size: int) -> bytes:
+        """Read an absolute [offset, offset+size) span across files."""
+        out = bytearray()
+        while size > 0:
+            fidx = bisect_right(self.file_offset, offset) - 1
+            if fidx >= len(self.files):
+                break
+            if self.file_ptr != fidx or self._fp is None:
+                self._close_fp()
+                self.file_ptr = fidx
+                self._fp = self.fs.open_for_read(self.files[fidx].path)
+            self._fp.seek(offset - self.file_offset[fidx])
+            take = min(size, self.file_offset[fidx + 1] - offset)
+            data = self._fp.read(take)
+            if not data:
+                break
+            out += data
+            offset += len(data)
+            size -= len(data)
+        self.bytes_read += len(out)
+        return bytes(out)
+
+    def next_chunk(self) -> Optional[memoryview]:
+        return self.next_batch(self.batch_size)
+
+    def next_batch(self, n_records: int) -> Optional[memoryview]:
+        data = self._next_batch_data(n_records)
+        return memoryview(data) if data is not None else None
+
+    def next_record(self) -> Optional[memoryview]:
+        while True:
+            if self._chunk is not None:
+                rec = self.extract_next_record(self._chunk)
+                if rec is not None:
+                    return rec
+            data = self._next_batch_data(self.batch_size)
+            if data is None:
+                self._chunk = None
+                return None
+            self._chunk = _Chunk(data)
+
+
+class ThreadedInputSplit(InputSplit):
+    """Prefetch decorator: a producer thread loads chunks ahead
+    (src/io/threaded_input_split.h; capacity 2 per reference :33-42)."""
+
+    def __init__(self, base: InputSplitBase, capacity: int = 2):
+        self.base = base
+        self._capacity = capacity
+        self._iter = ThreadedIter(self._produce, self._reset_base, max_capacity=capacity)
+        self._chunk: Optional[_Chunk] = None
+
+    def _produce(self, cell):
+        chunk = self.base.next_chunk()
+        if chunk is None:
+            return False, None
+        return True, _Chunk(chunk)
+
+    def _reset_base(self):
+        self.base.before_first()
+
+    def next_chunk(self) -> Optional[memoryview]:
+        chunk = self._iter.next()
+        return chunk.data if chunk is not None else None
+
+    def next_record(self) -> Optional[memoryview]:
+        while True:
+            if self._chunk is not None:
+                rec = self.base.extract_next_record(self._chunk)
+                if rec is not None:
+                    return rec
+            self._chunk = self._iter.next()
+            if self._chunk is None:
+                return None
+
+    def before_first(self) -> None:
+        self._iter.before_first()
+        self._chunk = None
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        # quiesce the producer, repartition the base, restart
+        self._iter.destroy()
+        self.base.reset_partition(part_index, num_parts)
+        self._iter = ThreadedIter(
+            self._produce, self._reset_base, max_capacity=self._capacity
+        )
+        self._chunk = None
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        self.base.hint_chunk_size(chunk_size)
+
+    def close(self) -> None:
+        self._iter.destroy()
+        self.base.close()
+
+    @property
+    def stall_seconds(self) -> float:
+        return self._iter.stall_seconds
+
+
+class ShuffledInputSplit(InputSplit):
+    """Chunk-level global shuffle wrapper —
+    analog of include/dmlc/input_split_shuffle.h.
+
+    Splits this rank's partition into ``num_shuffle_parts`` sub-partitions and
+    visits them in a shuffled order each epoch (input_split_shuffle.h:19-60).
+    """
+
+    def __init__(
+        self,
+        make_base,
+        part_index: int,
+        num_parts: int,
+        num_shuffle_parts: int,
+        seed: int = 0,
+    ):
+        check(num_shuffle_parts > 0, "num_shuffle_parts must be positive")
+        self._make_base = make_base
+        self.base: InputSplit = make_base()
+        self.part_index = part_index
+        self.num_parts = num_parts
+        self.num_shuffle_parts = num_shuffle_parts
+        self.rng = random.Random(seed)
+        self._order: List[int] = []
+        self._order_pos = 0
+        self._active = False
+        self.before_first()
+
+    def _sub_parts(self) -> List[int]:
+        base = self.part_index * self.num_shuffle_parts
+        return [base + i for i in range(self.num_shuffle_parts)]
+
+    def before_first(self) -> None:
+        self._order = self._sub_parts()
+        self.rng.shuffle(self._order)
+        self._order_pos = 0
+        self._active = False
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        self.part_index = part_index
+        self.num_parts = num_parts
+        self.before_first()
+
+    def _advance(self) -> bool:
+        if self._order_pos >= len(self._order):
+            return False
+        sub = self._order[self._order_pos]
+        self._order_pos += 1
+        self.base.reset_partition(sub, self.num_parts * self.num_shuffle_parts)
+        self._active = True
+        return True
+
+    def next_record(self) -> Optional[memoryview]:
+        while True:
+            if self._active:
+                rec = self.base.next_record()
+                if rec is not None:
+                    return rec
+                self._active = False
+            if not self._advance():
+                return None
+
+    def next_chunk(self) -> Optional[memoryview]:
+        while True:
+            if self._active:
+                chunk = self.base.next_chunk()
+                if chunk is not None:
+                    return chunk
+                self._active = False
+            if not self._advance():
+                return None
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        self.base.hint_chunk_size(chunk_size)
+
+    def close(self) -> None:
+        self.base.close()
+
+
+def create_input_split(
+    uri: str,
+    part_index: int,
+    num_parts: int,
+    type_: str = "text",
+    *,
+    index_uri: Optional[str] = None,
+    shuffle: bool = False,
+    seed: int = 0,
+    batch_size: int = 256,
+    threaded: bool = True,
+    recurse_directories: bool = False,
+    num_shuffle_parts: int = 0,
+    chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+) -> InputSplit:
+    """Factory — analog of InputSplit::Create (src/io.cc:74-130).
+
+    type_: 'text' (alias 'line'), 'recordio', 'indexed_recordio', 'stdin'.
+    Wraps in a prefetch thread by default (src/io.cc:119-124) and in the
+    chunk-shuffle decorator when num_shuffle_parts > 0
+    (input_split_shuffle.h InputSplit::Create overload).
+    """
+    check(part_index < num_parts, f"part_index {part_index} >= num_parts {num_parts}")
+    if uri == "stdin" or type_ == "stdin":
+        return SingleFileSplit(uri)
+    fs = get_filesystem(uri)
+
+    def make_raw() -> InputSplitBase:
+        if type_ in ("text", "line"):
+            base = LineSplitter(fs, uri, recurse_directories)
+        elif type_ == "recordio":
+            base = RecordIOSplitter(fs, uri, recurse_directories)
+        elif type_ == "indexed_recordio":
+            check(index_uri is not None, "indexed_recordio requires index_uri")
+            base = IndexedRecordIOSplitter(
+                fs, uri, index_uri, batch_size=batch_size, shuffle=shuffle, seed=seed
+            )
+        else:
+            raise DMLCError(f"unknown input split type {type_!r}")
+        base.hint_chunk_size(chunk_bytes)
+        return base
+
+    def make_base() -> InputSplit:
+        base: InputSplit = make_raw()
+        return ThreadedInputSplit(base) if threaded else base
+
+    if num_shuffle_parts > 0:
+        return ShuffledInputSplit(
+            make_base, part_index, num_parts, num_shuffle_parts, seed=seed
+        )
+    base = make_raw()
+    base.reset_partition(part_index, num_parts)
+    return ThreadedInputSplit(base) if threaded else base

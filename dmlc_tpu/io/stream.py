@@ -1,0 +1,43 @@
+"""Stream factory.
+
+Equivalent of reference ``Stream::Create(uri, flag)`` (io.h:57, src/io.cc:132)
+and ``SeekStream::CreateForRead`` (io.h:127). Python file objects already
+satisfy the Stream interface (read/write/seek/tell/close); this module is the
+URI-dispatching factory plus small adapters.
+"""
+
+from __future__ import annotations
+
+from typing import BinaryIO
+
+from dmlc_tpu.io.filesystem import get_filesystem
+from dmlc_tpu.io.uri import URI
+from dmlc_tpu.utils.check import DMLCError
+
+
+def open_stream(uri: str, mode: str = "r", allow_null: bool = False) -> BinaryIO | None:
+    """Open a binary stream for a URI — analog of Stream::Create (src/io.cc:132).
+
+    mode: 'r' read, 'w' write, 'a' append. Returns None when allow_null and
+    the target cannot be opened (io.h:57 ``allow_null`` contract).
+    """
+    if mode not in ("r", "w", "a"):
+        raise DMLCError(f"open_stream: bad mode {mode!r}")
+    parsed = URI(uri)
+    try:
+        fs = get_filesystem(parsed)
+        return fs.open(parsed, mode)
+    except DMLCError:
+        if allow_null:
+            return None
+        raise
+
+
+def read_all(uri: str) -> bytes:
+    with open_stream(uri, "r") as f:
+        return f.read()
+
+
+def write_all(uri: str, data: bytes) -> None:
+    with open_stream(uri, "w") as f:
+        f.write(data)

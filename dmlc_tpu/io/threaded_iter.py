@@ -1,0 +1,199 @@
+"""Producer/consumer prefetch pipeline.
+
+Behavioral equivalent of reference include/dmlc/threadediter.h: a single
+producer thread fills a bounded queue ahead of the consumer, with
+
+- cell recycling so buffers are reused instead of reallocated
+  (Next/Recycle, threadediter.h:443-488),
+- ``before_first`` epoch reset that interrupts and restarts the producer
+  (signal kBeforeFirst, threadediter.h:210-235),
+- exceptions in the producer captured and rethrown on the consumer side
+  (threadediter.h:406-436, 490-505),
+- clean destruction joining the thread (kDestroy + ScopedThread,
+  threadediter.h:283-313).
+
+The producer callback contract matches the reference's ``next(cell)``:
+``produce_fn(cell) -> (ok, cell)`` where ``cell`` is a recycled buffer or
+None, and ok=False signals end of stream. A simpler ``iterator`` front-end
+(:func:`ThreadedIter.from_factory`) covers the common case.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable, Deque, Generic, Optional, Tuple, TypeVar
+
+from dmlc_tpu.utils.check import DMLCError
+from dmlc_tpu.utils.timer import get_time
+
+T = TypeVar("T")
+
+# producer signals (threadediter.h:243-247)
+_SIG_PRODUCE = 0
+_SIG_BEFORE_FIRST = 1
+_SIG_DESTROY = 2
+
+
+class ThreadedIter(Generic[T]):
+    """Bounded-queue prefetch iterator with recycling + epoch reset."""
+
+    def __init__(
+        self,
+        produce_fn: Callable[[Optional[T]], Tuple[bool, Optional[T]]],
+        before_first_fn: Optional[Callable[[], None]] = None,
+        max_capacity: int = 8,
+    ):
+        self._produce = produce_fn
+        self._before_first = before_first_fn
+        self._capacity = max_capacity
+        self._lock = threading.Condition()
+        self._queue: Deque[T] = deque()
+        self._free: Deque[T] = deque()
+        self._produce_end = False
+        self._signal = _SIG_PRODUCE
+        self._signal_processed = False
+        self._exc: Optional[BaseException] = None
+        self._destroyed = False
+        self.stall_seconds = 0.0  # consumer time spent waiting on the producer
+        self._thread = threading.Thread(target=self._producer_loop, daemon=True)
+        self._thread.start()
+
+    # ---------------- producer side ----------------
+
+    def _producer_loop(self) -> None:
+        while True:
+            cell: Optional[T] = None
+            with self._lock:
+                # wait for: destroy/reset signal, or space to produce
+                self._lock.wait_for(
+                    lambda: self._signal != _SIG_PRODUCE
+                    or (not self._produce_end and (len(self._queue) < self._capacity or self._free))
+                )
+                if self._signal == _SIG_DESTROY:
+                    self._signal_processed = True
+                    self._lock.notify_all()
+                    return
+                if self._signal == _SIG_BEFORE_FIRST:
+                    # epoch reset: drop queued items into the free list
+                    while self._queue:
+                        self._free.append(self._queue.popleft())
+                    try:
+                        if self._before_first is not None:
+                            self._before_first()
+                        self._produce_end = False
+                    except BaseException as exc:  # noqa: BLE001 - rethrown on consumer
+                        self._exc = exc
+                        self._produce_end = True
+                    self._signal = _SIG_PRODUCE
+                    self._signal_processed = True
+                    self._lock.notify_all()
+                    continue
+                if self._free:
+                    cell = self._free.popleft()
+            # run the producer outside the lock (threadediter.h:365 next())
+            try:
+                ok, value = self._produce(cell)
+            except BaseException as exc:  # noqa: BLE001 - captured for consumer
+                with self._lock:
+                    self._exc = exc
+                    self._produce_end = True
+                    self._lock.notify_all()
+                continue
+            with self._lock:
+                if ok:
+                    self._queue.append(value)  # type: ignore[arg-type]
+                else:
+                    self._produce_end = True
+                    if cell is not None:
+                        self._free.append(cell)
+                self._lock.notify_all()
+
+    # ---------------- consumer side ----------------
+
+    def next(self) -> Optional[T]:
+        """Pop the next item; None at end of stream. Rethrows producer errors."""
+        if self._destroyed:
+            raise DMLCError("ThreadedIter: already destroyed")
+        t0 = get_time()
+        with self._lock:
+            self._lock.wait_for(lambda: self._queue or self._produce_end)
+            self.stall_seconds += get_time() - t0
+            if self._queue:
+                item = self._queue.popleft()
+                self._lock.notify_all()
+                return item
+            self._check_exc_locked()
+            return None
+
+    def recycle(self, item: T) -> None:
+        """Return a consumed cell for reuse (threadediter.h:476-488)."""
+        with self._lock:
+            self._free.append(item)
+            self._lock.notify_all()
+            self._check_exc_locked()
+
+    def before_first(self) -> None:
+        """Reset to the epoch start; blocks until the producer acknowledges."""
+        with self._lock:
+            self._check_exc_locked()
+            self._signal = _SIG_BEFORE_FIRST
+            self._signal_processed = False
+            self._lock.notify_all()
+            self._lock.wait_for(lambda: self._signal_processed)
+            self._signal_processed = False
+            self._check_exc_locked()
+
+    def destroy(self) -> None:
+        """Stop and join the producer thread."""
+        if self._destroyed:
+            return
+        with self._lock:
+            self._signal = _SIG_DESTROY
+            self._signal_processed = False
+            self._lock.notify_all()
+        self._thread.join(timeout=30.0)
+        self._destroyed = True
+
+    def _check_exc_locked(self) -> None:
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            self._produce_end = True
+            raise exc
+
+    def __iter__(self):
+        while True:
+            item = self.next()
+            if item is None:
+                return
+            yield item
+
+    def __del__(self):  # pragma: no cover - best effort
+        try:
+            self.destroy()
+        except Exception:
+            pass
+
+    # ---------------- convenience front-end ----------------
+
+    @staticmethod
+    def from_factory(
+        iterator_factory: Callable[[], Any], max_capacity: int = 8
+    ) -> "ThreadedIter":
+        """Prefetch over a restartable iterator factory.
+
+        Each epoch calls ``iterator_factory()`` for a fresh iterator; this is
+        the Pythonic face of the (next_fn, beforefirst_fn) pair.
+        """
+        state = {"it": iterator_factory()}
+
+        def produce(cell):
+            try:
+                return True, next(state["it"])
+            except StopIteration:
+                return False, None
+
+        def before_first():
+            state["it"] = iterator_factory()
+
+        return ThreadedIter(produce, before_first, max_capacity=max_capacity)

@@ -1,0 +1,72 @@
+"""URI parsing + the dmlc URI sugar spec.
+
+Equivalents of reference io.h:539-554 (URI: protocol/host/name) and
+src/io/uri_spec.h:42-75 (URISpec: ``path?format=libsvm&k=v#cachefile``,
+with the cache file gaining a ``.splitN.partK`` suffix for multi-part
+loads, uri_spec.h:47-53).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from dmlc_tpu.utils.check import DMLCError
+
+
+class URI:
+    """``protocol://host/path`` split — analog of dmlc::io::URI (io.h:539)."""
+
+    def __init__(self, uri: str):
+        self.raw = uri
+        pos = uri.find("://")
+        if pos < 0:
+            self.protocol = "file://"
+            self.host = ""
+            self.name = uri
+        else:
+            self.protocol = uri[: pos + 3]
+            rest = uri[pos + 3:]
+            slash = rest.find("/")
+            if slash < 0:
+                self.host, self.name = rest, ""
+            else:
+                self.host, self.name = rest[:slash], rest[slash:]
+
+    def str_nohost(self) -> str:
+        """protocol + name, host dropped (io.h: used for FS-relative paths)."""
+        return self.protocol + self.name if self.protocol != "file://" else self.name
+
+    def __str__(self) -> str:
+        if self.protocol == "file://" and not self.host:
+            return self.name
+        return f"{self.protocol}{self.host}{self.name}"
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"URI({str(self)!r})"
+
+
+class URISpec:
+    """URI sugar: ``real_uri?k=v&k2=v2#cache_file`` (uri_spec.h:42-75)."""
+
+    def __init__(self, uri: str, part_index: int = 0, num_parts: int = 1):
+        name_cache = uri.split("#")
+        if len(name_cache) == 2:
+            cache = name_cache[1]
+            if num_parts != 1:
+                cache = f"{cache}.split{num_parts}.part{part_index}"
+            self.cache_file: str | None = cache
+        elif len(name_cache) == 1:
+            self.cache_file = None
+        else:
+            raise DMLCError("only one `#` is allowed in file path for cachefile specification")
+        name_args = name_cache[0].split("?")
+        self.args: Dict[str, str] = {}
+        if len(name_args) == 2:
+            for i, kv in enumerate(name_args[1].split("&")):
+                if "=" not in kv:
+                    raise DMLCError(f"Invalid uri argument format for arg {i + 1}: {kv!r}")
+                key, value = kv.split("=", 1)
+                self.args[key] = value
+        elif len(name_args) != 1:
+            raise DMLCError("only one `?` is allowed in file path for argument specification")
+        self.uri = name_args[0]

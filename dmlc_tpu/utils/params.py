@@ -107,10 +107,11 @@ class Parameter:
     def __init_subclass__(cls, **kwargs):
         super().__init_subclass__(**kwargs)
         fields: Dict[str, Field] = {}
-        # inherit parent fields first (CRTP parameter structs don't inherit in
-        # the reference, but it is natural in Python)
-        for base in cls.__mro__[1:]:
-            if issubclass(base, Parameter) and base is not Parameter:
+        # inherit parent fields, least-derived first so overrides win
+        # (CRTP parameter structs don't inherit in the reference, but it is
+        # natural in Python)
+        for base in reversed(cls.__mro__[1:]):
+            if isinstance(base, type) and issubclass(base, Parameter) and base is not Parameter:
                 fields.update(getattr(base, "__fields__", {}))
         for name, value in list(cls.__dict__.items()):
             if isinstance(value, Field):

@@ -106,6 +106,8 @@ def write_obj(stream: BinaryIO, obj: Any) -> None:
         write_scalar(stream, _TAG_BOOL, "uint8")
         write_scalar(stream, int(obj), "uint8")
     elif isinstance(obj, int):
+        if not (-(1 << 63) <= obj < (1 << 63)):
+            raise DMLCError(f"serializer: int {obj} out of int64 range")
         write_scalar(stream, _TAG_INT, "uint8")
         write_scalar(stream, obj, "int64")
     elif isinstance(obj, float):
