@@ -1,0 +1,122 @@
+"""Benchmark: RowBlockIter MB/s into HBM (the BASELINE.md north star).
+
+Measures the full path on a HIGGS-like libsvm corpus:
+  file -> InputSplit -> parser -> RowBlock -> fixed-shape dense batches ->
+  jax.device_put -> HBM (consumer touches every batch on device).
+
+Baseline (vs_baseline denominator): the same corpus through the
+single-threaded host-only parse (no device), i.e. BASELINE.json config #1's
+"single-host CPU reference". >1.0 means the async pipeline into HBM beats
+host-only parsing.
+
+Prints ONE JSON line on stdout; everything else goes to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache")
+CORPUS = os.path.join(CACHE_DIR, "higgs_like.libsvm")
+TARGET_MB = float(os.environ.get("DMLC_BENCH_MB", "64"))
+NUM_COL = 28  # HIGGS has 28 features
+BATCH = 8192
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_corpus() -> str:
+    """Generate a HIGGS-like dense libsvm corpus once, cached on disk."""
+    import numpy as np
+
+    if os.path.exists(CORPUS) and os.path.getsize(CORPUS) >= TARGET_MB * 0.95 * 2**20:
+        return CORPUS
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    rng = np.random.default_rng(42)
+    log(f"bench: generating ~{TARGET_MB:.0f} MB corpus at {CORPUS}")
+    with open(CORPUS, "w") as f:
+        written = 0
+        target = TARGET_MB * 2**20
+        while written < target:
+            rows = []
+            vals = rng.standard_normal((2000, NUM_COL)).astype(np.float32)
+            labels = rng.integers(0, 2, 2000)
+            for lbl, row in zip(labels, vals):
+                feats = " ".join(f"{j}:{row[j]:.6f}" for j in range(NUM_COL))
+                rows.append(f"{lbl} {feats}")
+            chunk = "\n".join(rows) + "\n"
+            f.write(chunk)
+            written += len(chunk)
+    return CORPUS
+
+
+def host_only_mb_per_sec(path: str, size_mb: float) -> float:
+    """Single-threaded parse to RowBlocks on the host (the CPU reference)."""
+    from dmlc_tpu.data import create_parser
+
+    parser = create_parser(path, 0, 1, "libsvm", threaded=False)
+    t0 = time.monotonic()
+    rows = 0
+    for block in parser:
+        rows += len(block)
+    dt = time.monotonic() - t0
+    parser.close()
+    log(f"bench: host-only parse {rows} rows in {dt:.2f}s = {size_mb/dt:.1f} MB/s")
+    return size_mb / dt
+
+
+def into_hbm_mb_per_sec(path: str, size_mb: float):
+    """Full async pipeline into device HBM."""
+    import jax
+
+    from dmlc_tpu.data import create_parser
+    from dmlc_tpu.data.device import DeviceIter
+
+    dev = jax.devices()[0]
+    log(f"bench: device = {dev}")
+    parser = create_parser(path, 0, 1, "libsvm", threaded=True)
+    it = DeviceIter(parser, num_col=NUM_COL, batch_size=BATCH, layout="dense",
+                    prefetch=2)
+    t0 = time.monotonic()
+    nbatches = 0
+    last = None
+    for batch in it:
+        last = batch
+        nbatches += 1
+    # ensure all transfers have actually landed in HBM
+    if last is not None:
+        jax.block_until_ready(last)
+    dt = time.monotonic() - t0
+    stats = it.stats()
+    it.close()
+    log(
+        f"bench: into-HBM {nbatches} batches in {dt:.2f}s = {size_mb/dt:.1f} MB/s, "
+        f"device bytes {stats['bytes_to_device']/2**20:.1f} MB, "
+        f"host stall {stats['stall_seconds']:.2f}s"
+    )
+    return size_mb / dt, stats
+
+
+def main() -> None:
+    path = make_corpus()
+    size_mb = os.path.getsize(path) / 2**20
+    log(f"bench: corpus {size_mb:.1f} MB")
+    baseline = host_only_mb_per_sec(path, size_mb)
+    value, _stats = into_hbm_mb_per_sec(path, size_mb)
+    print(json.dumps({
+        "metric": "rowblockiter_mb_per_sec_into_hbm",
+        "value": round(value, 2),
+        "unit": "MB/s",
+        "vs_baseline": round(value / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""Data layer: sparse row blocks, ML-text parsers, row iterators, device feed.
+
+TPU-native equivalent of reference layer 5 (include/dmlc/data.h, src/data/):
+parsers emit numpy-CSR RowBlocks on the host; :mod:`dmlc_tpu.data.device`
+turns them into HBM-resident jax.Array / BCOO batches.
+"""
+
+from dmlc_tpu.data.row_block import Row, RowBlock, RowBlockContainer
+from dmlc_tpu.data.parsers import (
+    Parser, LibSVMParser, CSVParser, LibFMParser, ThreadedParser, create_parser,
+)
+from dmlc_tpu.data.iterators import (
+    RowBlockIter, BasicRowIter, DiskRowIter, create_row_block_iter,
+)
+
+__all__ = [
+    "Row", "RowBlock", "RowBlockContainer",
+    "Parser", "LibSVMParser", "CSVParser", "LibFMParser", "ThreadedParser",
+    "create_parser",
+    "RowBlockIter", "BasicRowIter", "DiskRowIter", "create_row_block_iter",
+]

@@ -1,0 +1,199 @@
+"""Async host->HBM batch pipeline.
+
+This is the TPU-native replacement for the reference's ThreadedIter-based
+prefetch chain (SURVEY.md north star): parsed RowBlocks are rebatched to a
+fixed shape on the host (so XLA compiles one step), converted to the chosen
+device layout, and ``jax.device_put`` is issued ahead of consumption —
+double-buffered by default — so the accelerator never waits on input.
+``jax.device_put`` on TPU is asynchronous: it returns immediately while the
+DMA proceeds, which is what lets a pure-Python loop overlap transfer with
+compute. Stall time (consumer waiting on host data) is tracked, because the
+BASELINE target is ">=90% host->HBM line-rate with zero input-bound stalls".
+
+Layouts: 'dense' (padded [B, D], MXU-friendly), 'ell' (static-shape sparse),
+'bcoo' (jax.experimental.sparse interop). See dmlc_tpu.ops.sparse.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+
+from dmlc_tpu.data.parsers import Parser
+from dmlc_tpu.data.row_block import RowBlock, RowBlockContainer
+from dmlc_tpu.io.threaded_iter import ThreadedIter
+from dmlc_tpu.ops.sparse import EllBatch, block_to_bcoo, block_to_dense, block_to_ell
+from dmlc_tpu.utils.check import DMLCError, check
+from dmlc_tpu.utils.timer import get_time
+
+
+def rebatch_blocks(
+    blocks: Iterator[RowBlock], batch_size: int, drop_remainder: bool = False
+) -> Iterator[RowBlock]:
+    """Re-slice a stream of variable-size RowBlocks into fixed-size batches.
+
+    The final partial batch is emitted as-is (callers pad via
+    ``pad_rows_to``) unless ``drop_remainder``.
+    """
+    pending = RowBlockContainer()
+    pending_rows = 0
+    for block in blocks:
+        pending.push_block(block)
+        pending_rows += len(block)
+        if pending_rows >= batch_size:
+            merged = pending.to_block()
+            pos = 0
+            while pos + batch_size <= len(merged):
+                yield merged.slice(pos, pos + batch_size)
+                pos += batch_size
+            pending = RowBlockContainer()
+            pending_rows = len(merged) - pos
+            if pending_rows:
+                pending.push_block(merged.slice(pos, len(merged)))
+    if pending_rows and not drop_remainder:
+        yield pending.to_block()
+
+
+class DeviceIter:
+    """Double-buffered host->device batch iterator.
+
+    Pipeline stages, each ahead of the next:
+      1. parser/iterator thread (already prefetched upstream),
+      2. host convert thread: rebatch + layout conversion (numpy),
+      3. this object: ``device_put`` issued ``prefetch`` batches ahead.
+    """
+
+    def __init__(
+        self,
+        source,
+        num_col: int,
+        batch_size: int,
+        layout: str = "dense",
+        *,
+        mesh=None,
+        data_axis: str = "data",
+        shardings=None,
+        max_nnz: Optional[int] = None,
+        prefetch: int = 2,
+        convert_ahead: int = 4,
+        drop_remainder: bool = False,
+        device=None,
+    ):
+        check(layout in ("dense", "ell", "bcoo"), f"unknown layout {layout!r}")
+        self.source = source
+        self.num_col = num_col
+        self.batch_size = batch_size
+        self.layout = layout
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.shardings = tuple(shardings) if shardings is not None else None
+        self.max_nnz = max_nnz
+        self.prefetch = max(1, prefetch)
+        self.drop_remainder = drop_remainder
+        self.device = device
+        self.stall_seconds = 0.0
+        self.batches_fed = 0
+        self.bytes_to_device = 0
+        self._host_iter = ThreadedIter.from_factory(
+            self._host_batches, max_capacity=convert_ahead
+        )
+        self._inflight: deque = deque()
+
+    # ---------------- host side ----------------
+
+    def _blocks(self) -> Iterator[RowBlock]:
+        self.source.before_first()
+        while True:
+            blk = self.source.next_block()
+            if blk is None:
+                return
+            yield blk
+
+    def _host_batches(self):
+        for block in rebatch_blocks(
+            self._blocks(), self.batch_size, self.drop_remainder
+        ):
+            yield self._convert(block)
+
+    def _convert(self, block: RowBlock):
+        pad = self.batch_size if len(block) != self.batch_size else None
+        if self.layout == "dense":
+            x, y, w = block_to_dense(block, self.num_col, pad_rows_to=pad)
+            return ("dense", x, y, w)
+        if self.layout == "ell":
+            ell = block_to_ell(block, self.num_col, max_nnz=self.max_nnz, pad_rows_to=pad)
+            return ("ell",) + tuple(ell)
+        return ("bcoo", block)
+
+    # ---------------- device side ----------------
+
+    def _put(self, host_batch):
+        kind = host_batch[0]
+        if kind == "bcoo":
+            block = host_batch[1]
+            return block_to_bcoo(block, self.num_col), jax.numpy.asarray(block.label)
+        arrays = host_batch[1:]
+        self.bytes_to_device += sum(a.nbytes for a in arrays)
+        if self.mesh is not None:
+            from dmlc_tpu.parallel.mesh import local_batch_to_global
+
+            if self.shardings is not None:
+                # exact placement the consumer's jit expects (e.g. a learner's
+                # batch_shardings()) — committed arrays must match in JAX
+                out = tuple(
+                    jax.make_array_from_process_local_data(sh, np.asarray(a))
+                    for sh, a in zip(self.shardings, arrays)
+                )
+            else:
+                out = local_batch_to_global(self.mesh, arrays, axis=self.data_axis)
+        elif self.device is not None:
+            out = tuple(jax.device_put(a, self.device) for a in arrays)
+        else:
+            out = tuple(jax.device_put(a) for a in arrays)
+        if kind == "ell":
+            return EllBatch(*out)
+        return out  # (x, y, w)
+
+    def _fill(self) -> None:
+        while len(self._inflight) < self.prefetch:
+            host_batch = self._host_iter.next()
+            if host_batch is None:
+                return
+            self._inflight.append(self._put(host_batch))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        t0 = get_time()
+        self._fill()
+        if not self._inflight:
+            raise StopIteration
+        out = self._inflight.popleft()
+        # issue the replacement transfer before handing the batch out
+        self._fill()
+        self.stall_seconds += self._host_iter.stall_seconds
+        self._host_iter.stall_seconds = 0.0
+        self.batches_fed += 1
+        _ = t0
+        return out
+
+    def reset(self) -> None:
+        """New epoch: restart the host pipeline (upstream before_first)."""
+        self._inflight.clear()
+        self._host_iter.before_first()
+
+    def close(self) -> None:
+        self._host_iter.destroy()
+        if hasattr(self.source, "close"):
+            self.source.close()
+
+    def stats(self) -> dict:
+        return {
+            "batches": self.batches_fed,
+            "bytes_to_device": self.bytes_to_device,
+            "stall_seconds": self.stall_seconds,
+        }

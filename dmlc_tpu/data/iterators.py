@@ -1,0 +1,216 @@
+"""Multi-pass row-block iterators over parsed datasets.
+
+Equivalent of reference RowBlockIter (data.h:254-274) with its two
+implementations: BasicRowIter (in-RAM, src/data/basic_row_iter.h) and
+DiskRowIter (page-cached on disk, src/data/disk_row_iter.h), plus the
+``#cachefile`` URI dispatch of src/data.cc:88-107.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from dmlc_tpu.data.parsers import Parser, create_parser
+from dmlc_tpu.data.row_block import RowBlock, RowBlockContainer
+from dmlc_tpu.io.stream import open_stream
+from dmlc_tpu.io.threaded_iter import ThreadedIter
+from dmlc_tpu.io.uri import URISpec
+from dmlc_tpu.utils import serializer as ser
+from dmlc_tpu.utils.check import DMLCError, check, get_logger
+from dmlc_tpu.utils.timer import ThroughputMeter
+
+# 64 MB cache pages (disk_row_iter.h:32 kPageSize)
+CACHE_PAGE_BYTES = 64 << 20
+_CACHE_MAGIC = b"DMLCTPU-RBCACHE1"
+
+
+class RowBlockIter:
+    """Multi-pass iterator interface — analog of dmlc::RowBlockIter
+    (data.h:254-274)."""
+
+    def next_block(self) -> Optional[RowBlock]:
+        raise NotImplementedError
+
+    def before_first(self) -> None:
+        raise NotImplementedError
+
+    @property
+    def num_col(self) -> int:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[RowBlock]:
+        while True:
+            blk = self.next_block()
+            if blk is None:
+                return
+            yield blk
+
+    def close(self) -> None:
+        pass
+
+
+class BasicRowIter(RowBlockIter):
+    """Drain the parser into RAM at init; each epoch yields one big block
+    (src/data/basic_row_iter.h:35-42, 61-82)."""
+
+    def __init__(self, parser: Parser, silent: bool = False):
+        meter = ThroughputMeter("load", silent=silent)
+        container = RowBlockContainer()
+        for block in parser:
+            container.push_block(block)
+            meter.add(parser.bytes_read - meter.bytes, len(block))
+        self.block = container.to_block()
+        meter.log_final()
+        self.load_mb_per_sec = meter.mb_per_sec
+        self._done = False
+        parser.close()
+
+    def next_block(self) -> Optional[RowBlock]:
+        if self._done:
+            return None
+        self._done = True
+        return self.block
+
+    def before_first(self) -> None:
+        self._done = False
+
+    @property
+    def num_col(self) -> int:
+        return self.block.num_col
+
+
+class DiskRowIter(RowBlockIter):
+    """Build a page cache of serialized RowBlocks once, then stream pages
+    with prefetch each epoch (src/data/disk_row_iter.h:95-141)."""
+
+    def __init__(
+        self,
+        parser: Optional[Parser],
+        cache_file: str,
+        page_bytes: int = CACHE_PAGE_BYTES,
+        silent: bool = False,
+    ):
+        self.cache_file = cache_file
+        self.page_bytes = page_bytes
+        self._num_col = 0
+        self._iter: Optional[ThreadedIter] = None
+        if not self._try_load_cache():
+            check(parser is not None, f"no cache at {cache_file} and no parser given")
+            self._build_cache(parser, silent)
+            parser.close()
+            check(self._try_load_cache(), "cache build failed to produce a readable cache")
+
+    # -- cache format: [magic][num_col u64][npages u64][page offsets...][pages] --
+
+    def _build_cache(self, parser: Parser, silent: bool) -> None:
+        meter = ThroughputMeter("cache-build", log_every_mb=64.0, silent=silent)
+        pages: List[int] = []
+        container = RowBlockContainer()
+        cur_bytes = 0
+        with open_stream(self.cache_file, "w") as f:
+            f.write(_CACHE_MAGIC)
+            ser.write_scalar(f, 0, "uint64")  # num_col placeholder
+            ser.write_scalar(f, 0, "uint64")  # npages placeholder
+
+            def flush_page():
+                nonlocal container, cur_bytes
+                if len(container) == 0:
+                    return
+                pages.append(f.tell())
+                container.to_block().save(f)
+                container = RowBlockContainer()
+                cur_bytes = 0
+
+            for block in parser:
+                container.push_block(block)
+                self._num_col = max(self._num_col, block.num_col)
+                cur_bytes += block.mem_cost_bytes()
+                meter.add(block.mem_cost_bytes(), len(block))
+                if cur_bytes >= self.page_bytes:
+                    flush_page()
+            flush_page()
+            tail = f.tell()
+            ser.write_scalar(f, len(pages), "uint64")
+            for off in pages:
+                ser.write_scalar(f, off, "uint64")
+        # back-patch header (always little-endian, like the wire format)
+        import struct
+
+        with open(self.cache_file, "r+b") as f:
+            f.seek(len(_CACHE_MAGIC))
+            f.write(struct.pack("<QQ", self._num_col, tail))
+        meter.log_final()
+
+    def _try_load_cache(self) -> bool:
+        f = open_stream(self.cache_file, "r", allow_null=True)
+        if f is None:
+            return False
+        with f:
+            magic = f.read(len(_CACHE_MAGIC))
+            if magic != _CACHE_MAGIC:
+                return False
+            self._num_col = ser.read_scalar(f, "uint64")
+            tail = ser.read_scalar(f, "uint64")
+            if tail == 0:
+                return False
+            f.seek(tail)
+            npages = ser.read_scalar(f, "uint64")
+            self._page_offsets = [ser.read_scalar(f, "uint64") for _ in range(npages)]
+        self._start_iter()
+        return True
+
+    def _read_pages(self):
+        for off in self._page_offsets:
+            with open_stream(self.cache_file, "r") as f:
+                f.seek(off)
+                yield RowBlock.load(f)
+
+    def _start_iter(self) -> None:
+        if self._iter is not None:
+            self._iter.destroy()
+        self._iter = ThreadedIter.from_factory(self._read_pages, max_capacity=2)
+
+    def next_block(self) -> Optional[RowBlock]:
+        return self._iter.next()
+
+    def before_first(self) -> None:
+        self._iter.before_first()
+
+    @property
+    def num_col(self) -> int:
+        return int(self._num_col)
+
+    def close(self) -> None:
+        if self._iter is not None:
+            self._iter.destroy()
+            self._iter = None
+
+
+def create_row_block_iter(
+    uri: str,
+    part_index: int = 0,
+    num_parts: int = 1,
+    type_: str = "auto",
+    index_dtype=np.uint64,
+    silent: bool = False,
+    **parser_kw,
+) -> RowBlockIter:
+    """RowBlockIter factory — analog of RowBlockIter::Create
+    (data.h:267 -> src/data.cc:88-107).
+
+    A ``#cachefile`` URI suffix selects the disk-cached iterator; the cache
+    path is partition-qualified ``.splitN.partK`` (uri_spec.h:47-53).
+    """
+    spec = URISpec(uri, part_index, num_parts)
+    if spec.cache_file is None:
+        parser = create_parser(uri, part_index, num_parts, type_,
+                               index_dtype=index_dtype, **parser_kw)
+        return BasicRowIter(parser, silent=silent)
+    if os.path.exists(spec.cache_file):
+        return DiskRowIter(None, spec.cache_file, silent=silent)
+    parser = create_parser(uri, part_index, num_parts, type_,
+                           index_dtype=index_dtype, **parser_kw)
+    return DiskRowIter(parser, spec.cache_file, silent=silent)

@@ -1,0 +1,428 @@
+"""ML text-format parsers: libsvm, csv, libfm.
+
+Equivalent of reference src/data/{parser.h,text_parser.h,libsvm_parser.h,
+csv_parser.h,libfm_parser.h} + the factory/registry in src/data.cc.
+
+Parsing strategy: the reference splits each chunk across OS threads and runs
+a char-by-char scanner (text_parser.h:110-146). The Python engine instead
+parses a whole chunk with vectorized numpy string conversion (one C-level
+``split`` + one ``astype`` per chunk); the C++ native core
+(:mod:`dmlc_tpu.native`) supplies the multi-threaded scanner for the hot
+path. Both emit identical RowBlocks (tested against each other).
+
+Semantics matched to the reference:
+- libsvm: ``label[:weight] [qid:N] idx[:val]...``; ``#`` comments
+  (libsvm_parser.h:67-84); missing values mean binary features; 1-based
+  index heuristic à la sklearn when indexing_mode=-1 (libsvm_parser.h:159-168).
+- csv: dense rows, synthetic indices 0..k (csv_parser.h:120-121);
+  ``label_column``/``weight_column``/single-char ``delimiter`` params.
+- libfm: ``label field:idx:val...``; indexing_mode applies to both field and
+  index (libfm_parser.h:130-143).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from dmlc_tpu.data.row_block import RowBlock
+from dmlc_tpu.io.input_split import InputSplit, create_input_split
+from dmlc_tpu.io.threaded_iter import ThreadedIter
+from dmlc_tpu.io.uri import URISpec
+from dmlc_tpu.utils.check import DMLCError, check
+from dmlc_tpu.utils.params import Parameter, field
+from dmlc_tpu.utils.registry import Registry
+
+PARSER_REGISTRY: Registry = Registry.get("parser")
+
+
+class Parser:
+    """Single-pass RowBlock iterator — analog of dmlc::Parser (data.h:293-320)."""
+
+    def next_block(self) -> Optional[RowBlock]:
+        raise NotImplementedError
+
+    def before_first(self) -> None:
+        raise NotImplementedError
+
+    @property
+    def bytes_read(self) -> int:
+        return 0
+
+    def __iter__(self) -> Iterator[RowBlock]:
+        while True:
+            blk = self.next_block()
+            if blk is None:
+                return
+            yield blk
+
+    def close(self) -> None:
+        pass
+
+
+# ---------------- param structs ----------------
+
+class LibSVMParserParam(Parameter):
+    """libsvm_parser.h:24-39."""
+    format = field(str, default="libsvm")
+    indexing_mode = field(
+        int, default=0, enum=[-1, 0, 1],
+        help=">0: 1-based indices; 0: 0-based; <0: sklearn-style auto-detect.",
+    )
+
+
+class CSVParserParam(Parameter):
+    """csv_parser.h:23-40."""
+    format = field(str, default="csv")
+    label_column = field(int, default=-1, help="0-based column index of the label.")
+    delimiter = field(str, default=",", help="Single-character field delimiter.")
+    weight_column = field(int, default=-1, help="0-based column of instance weights.")
+    dtype = field(str, default="float32", enum=["float32", "int32", "int64"],
+                  help="Value dtype (data.cc instantiates real_t/int32/int64).")
+
+
+class LibFMParserParam(Parameter):
+    """libfm_parser.h:24-39."""
+    format = field(str, default="libfm")
+    indexing_mode = field(int, default=0, enum=[-1, 0, 1])
+
+
+# ---------------- chunk parsers ----------------
+
+class TextParserBase(Parser):
+    """Pulls chunks from an InputSplit and parses each into a RowBlock
+    (analog of TextParserBase::FillData, text_parser.h:110-146)."""
+
+    def __init__(self, source: InputSplit, index_dtype=np.uint64):
+        self.source = source
+        self.index_dtype = index_dtype
+        self._bytes = 0
+
+    def parse_chunk(self, chunk: bytes) -> RowBlock:
+        raise NotImplementedError
+
+    def next_block(self) -> Optional[RowBlock]:
+        while True:
+            chunk = self.source.next_chunk()
+            if chunk is None:
+                return None
+            self._bytes += len(chunk)
+            block = self.parse_chunk(bytes(chunk))
+            if len(block) > 0:
+                return block
+
+    def before_first(self) -> None:
+        self.source.before_first()
+
+    @property
+    def bytes_read(self) -> int:
+        return self._bytes
+
+    def close(self) -> None:
+        self.source.close()
+
+
+def _strip_comments(chunk: bytes) -> bytes:
+    """Remove ``#``-to-EOL spans (IgnoreCommentAndBlank, libsvm_parser.h:67-84)."""
+    if b"#" not in chunk:
+        return chunk
+    out = []
+    for line in chunk.split(b"\n"):
+        pos = line.find(b"#")
+        out.append(line if pos < 0 else line[:pos])
+    return b"\n".join(out)
+
+
+def _tokenize_lines(chunk: bytes):
+    """Split a text chunk into per-line token lists, skipping blanks.
+
+    UTF-8 BOM at chunk start is skipped (text_parser.h:81-95).
+    """
+    if chunk.startswith(b"\xef\xbb\xbf"):
+        chunk = chunk[3:]
+    chunk = _strip_comments(chunk.replace(b"\r", b"\n"))
+    lines = []
+    for line in chunk.split(b"\n"):
+        toks = line.split()
+        if toks:
+            lines.append(toks)
+    return lines
+
+
+def _apply_indexing_mode(index: np.ndarray, mode: int) -> np.ndarray:
+    """1-based -> 0-based conversion per libsvm_parser.h:159-168."""
+    if len(index) == 0:
+        return index
+    if mode > 0 or (mode < 0 and int(index.min()) > 0):
+        return index - 1
+    return index
+
+
+class LibSVMParser(TextParserBase):
+    """libsvm text -> RowBlock (libsvm_parser.h:85-169)."""
+
+    def __init__(self, source: InputSplit, args: Dict[str, str] | None = None,
+                 index_dtype=np.uint64):
+        super().__init__(source, index_dtype)
+        self.param = LibSVMParserParam()
+        self.param.init(dict(args or {}), allow_unknown=True)
+        check(self.param.format == "libsvm", "LibSVMParser: format must be libsvm")
+
+    def parse_chunk(self, chunk: bytes) -> RowBlock:
+        lines = _tokenize_lines(chunk)
+        n = len(lines)
+        label_toks = []
+        weight_vals: list = []
+        qid_vals: list = []
+        has_qid = False
+        nnz = np.empty(n, dtype=np.int64)
+        feat_toks: list = []
+        for i, toks in enumerate(lines):
+            label_toks.append(toks[0])
+            f = toks[1:]
+            if f and f[0].startswith(b"qid:"):
+                qid_vals.append(int(f[0][4:]))
+                f = f[1:]
+                has_qid = True
+            elif has_qid:
+                raise DMLCError("libsvm: qid must appear on every row or none")
+            nnz[i] = len(f)
+            feat_toks.extend(f)
+        if n == 0:
+            return RowBlock(np.zeros(1, np.int64), np.empty(0, np.float32),
+                            np.empty(0, self.index_dtype))
+        # labels (with optional :weight)
+        label_arr = np.array(label_toks)
+        label_blob = b" ".join(label_toks)
+        if b":" in label_blob:
+            pairs = np.char.partition(label_arr, b":")
+            labels = pairs[:, 0].astype(np.float32)
+            wcol = pairs[:, 2]
+            if np.any(wcol == b""):
+                raise DMLCError("libsvm: label:weight must be set on every row or none")
+            weights = wcol.astype(np.float32)
+        else:
+            labels = label_arr.astype(np.float32)
+            weights = None
+        # features idx[:val]
+        if feat_toks:
+            feat_arr = np.array(feat_toks)
+            blob = b" ".join(feat_toks)
+            ncolon = blob.count(b":")
+            if ncolon == len(feat_toks):
+                # fast path: every feature has a value
+                nums = np.array(blob.replace(b":", b" ").split())
+                index = nums[0::2].astype(np.int64)
+                value = nums[1::2].astype(np.float32)
+            elif ncolon == 0:
+                # all-binary features
+                index = feat_arr.astype(np.int64)
+                value = None
+            else:
+                # mixed: treat missing values as 1.0
+                parts = np.char.partition(feat_arr, b":")
+                index = parts[:, 0].astype(np.int64)
+                vals = parts[:, 2]
+                value = np.where(vals == b"", b"1", vals).astype(np.float32)
+        else:
+            index = np.empty(0, np.int64)
+            value = None
+        index = _apply_indexing_mode(index, self.param.indexing_mode)
+        offset = np.concatenate([[0], np.cumsum(nnz)])
+        return RowBlock(
+            offset=offset,
+            label=labels,
+            index=index.astype(self.index_dtype, copy=False),
+            value=value,
+            weight=weights,
+            qid=np.array(qid_vals, np.int64) if has_qid else None,
+        )
+
+
+class CSVParser(TextParserBase):
+    """Dense csv -> RowBlock with synthetic indices (csv_parser.h:85-146)."""
+
+    def __init__(self, source: InputSplit, args: Dict[str, str] | None = None,
+                 index_dtype=np.uint64):
+        super().__init__(source, index_dtype)
+        self.param = CSVParserParam()
+        self.param.init(dict(args or {}), allow_unknown=True)
+        check(self.param.format == "csv", "CSVParser: format must be csv")
+        check(len(self.param.delimiter) == 1, "CSVParser: delimiter must be one char")
+        check(
+            self.param.label_column != self.param.weight_column
+            or self.param.label_column < 0,
+            "CSVParser: label_column must differ from weight_column",
+        )
+        self._dtype = np.dtype(self.param.dtype)
+
+    def parse_chunk(self, chunk: bytes) -> RowBlock:
+        if chunk.startswith(b"\xef\xbb\xbf"):
+            chunk = chunk[3:]
+        delim = self.param.delimiter.encode()
+        norm = chunk.replace(b"\r", b"\n")
+        rows = [r for r in norm.split(b"\n") if r]
+        n = len(rows)
+        if n == 0:
+            return RowBlock(np.zeros(1, np.int64), np.empty(0, np.float32),
+                            np.empty(0, self.index_dtype))
+        ncol = rows[0].count(delim) + 1
+        # single vectorized conversion of the whole chunk
+        tokens = np.array(norm.replace(delim, b" ").split())
+        if len(tokens) != n * ncol:
+            raise DMLCError(
+                f"csv: ragged chunk - expected {n}x{ncol} cells, got {len(tokens)}"
+            )
+        cells = tokens.astype(self._dtype).reshape(n, ncol)
+        lc, wc = self.param.label_column, self.param.weight_column
+        check(lc < ncol, f"csv: label_column {lc} >= num columns {ncol}")
+        check(wc < ncol, f"csv: weight_column {wc} >= num columns {ncol}")
+        feat_cols = [c for c in range(ncol) if c != lc and c != wc]
+        values = cells[:, feat_cols].astype(np.float32)
+        label = cells[:, lc].astype(np.float32) if lc >= 0 else np.zeros(n, np.float32)
+        weight = cells[:, wc].astype(np.float32) if wc >= 0 else None
+        k = len(feat_cols)
+        index = np.tile(np.arange(k, dtype=self.index_dtype), n)
+        offset = np.arange(0, (n + 1) * k, k, dtype=np.int64)
+        return RowBlock(
+            offset=offset, label=label, index=index,
+            value=values.reshape(-1), weight=weight,
+        )
+
+
+class LibFMParser(TextParserBase):
+    """libfm ``label field:idx:val`` -> RowBlock (libfm_parser.h:85-143)."""
+
+    def __init__(self, source: InputSplit, args: Dict[str, str] | None = None,
+                 index_dtype=np.uint64):
+        super().__init__(source, index_dtype)
+        self.param = LibFMParserParam()
+        self.param.init(dict(args or {}), allow_unknown=True)
+        check(self.param.format == "libfm", "LibFMParser: format must be libfm")
+
+    def parse_chunk(self, chunk: bytes) -> RowBlock:
+        lines = _tokenize_lines(chunk)
+        n = len(lines)
+        if n == 0:
+            return RowBlock(np.zeros(1, np.int64), np.empty(0, np.float32),
+                            np.empty(0, self.index_dtype))
+        label_toks = []
+        nnz = np.empty(n, dtype=np.int64)
+        feat_toks: list = []
+        for i, toks in enumerate(lines):
+            label_toks.append(toks[0])
+            nnz[i] = len(toks) - 1
+            feat_toks.extend(toks[1:])
+        labels = np.array(label_toks).astype(np.float32)
+        if feat_toks:
+            blob = b" ".join(feat_toks)
+            check(blob.count(b":") == 2 * len(feat_toks),
+                  "libfm: features must be field:index:value triples")
+            nums = np.array(blob.replace(b":", b" ").split())
+            fields = nums[0::3].astype(np.int64)
+            index = nums[1::3].astype(np.int64)
+            value = nums[2::3].astype(np.float32)
+        else:
+            fields = np.empty(0, np.int64)
+            index = np.empty(0, np.int64)
+            value = None
+        mode = self.param.indexing_mode
+        # heuristic applies to BOTH field and index (libfm_parser.h:130-143)
+        if len(index):
+            if mode > 0 or (mode < 0 and int(index.min()) > 0 and int(fields.min()) > 0):
+                index = index - 1
+                fields = fields - 1
+        offset = np.concatenate([[0], np.cumsum(nnz)])
+        return RowBlock(
+            offset=offset, label=labels,
+            index=index.astype(self.index_dtype, copy=False),
+            value=value,
+            field=fields.astype(self.index_dtype, copy=False),
+        )
+
+
+class ThreadedParser(Parser):
+    """Parse-ahead decorator — analog of ThreadedParser (parser.h:70-126,
+    ThreadedIter capacity 8)."""
+
+    def __init__(self, base: TextParserBase, capacity: int = 8):
+        self.base = base
+        self._iter = ThreadedIter(self._produce, base.before_first, max_capacity=capacity)
+
+    def _produce(self, cell):
+        block = self.base.next_block()
+        if block is None:
+            return False, None
+        return True, block
+
+    def next_block(self) -> Optional[RowBlock]:
+        return self._iter.next()
+
+    def before_first(self) -> None:
+        self._iter.before_first()
+
+    @property
+    def bytes_read(self) -> int:
+        return self.base.bytes_read
+
+    @property
+    def stall_seconds(self) -> float:
+        return self._iter.stall_seconds
+
+    def close(self) -> None:
+        self._iter.destroy()
+        self.base.close()
+
+
+# ---------------- factory & registry (src/data.cc) ----------------
+
+def _make_text_parser(cls, threaded_default: bool):
+    def factory(uri, args, part_index, num_parts, index_dtype, threaded, **split_kw):
+        source = create_input_split(
+            uri, part_index, num_parts, "text",
+            threaded=threaded, **split_kw,
+        )
+        base = cls(source, args, index_dtype=index_dtype)
+        if threaded and threaded_default:
+            return ThreadedParser(base)
+        return base
+    return factory
+
+
+# CSV is registered unthreaded in the reference (data.cc:51-60 wraps libsvm
+# and libfm only); we thread it anyway — the vectorized chunk parse benefits
+# identically, and tests cover both paths.
+PARSER_REGISTRY.register("libsvm", "libsvm text format")(
+    _make_text_parser(LibSVMParser, True))
+PARSER_REGISTRY.register("libfm", "libfm field:index:value format")(
+    _make_text_parser(LibFMParser, True))
+PARSER_REGISTRY.register("csv", "dense csv format")(
+    _make_text_parser(CSVParser, True))
+
+
+def create_parser(
+    uri: str,
+    part_index: int = 0,
+    num_parts: int = 1,
+    type_: str = "auto",
+    index_dtype=np.uint64,
+    threaded: bool = True,
+    **split_kw,
+) -> Parser:
+    """Parser factory — analog of dmlc::Parser::Create (src/data.cc:62-85).
+
+    ``type_='auto'`` resolves from the URI's ``format=`` arg, defaulting to
+    libsvm (data.cc:70-76). URI args (``?k=v``) flow into the parser params.
+    """
+    spec = URISpec(uri, part_index, num_parts)
+    if type_ == "auto":
+        type_ = spec.args.get("format", "libsvm")
+    entry = PARSER_REGISTRY.find(type_)
+    if entry is None:
+        raise DMLCError(
+            f"unknown parser format {type_!r}; known: {list(PARSER_REGISTRY.list_names())}"
+        )
+    return entry.body(
+        spec.uri, spec.args, part_index, num_parts, index_dtype, threaded, **split_kw
+    )

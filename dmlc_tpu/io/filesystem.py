@@ -157,6 +157,8 @@ class _MemFile(_pyio.BytesIO):
         self._writable = True
 
     def close(self) -> None:
+        if self.closed:
+            return
         if self._writable:
             self._store[self._key] = self.getvalue()
         super().close()

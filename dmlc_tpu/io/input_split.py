@@ -83,11 +83,22 @@ class InputSplit:
 
 
 class _Chunk:
-    """A loaded chunk being consumed record-by-record."""
+    """A loaded chunk being consumed record-by-record.
 
-    __slots__ = ("data", "pos")
+    ``raw`` is the backing bytes object so searches use C-speed bytes.find;
+    a full-span memoryview shares it without a copy, partial views are
+    materialized once.
+    """
 
-    def __init__(self, data: bytes):
+    __slots__ = ("raw", "data", "pos")
+
+    def __init__(self, data):
+        if isinstance(data, memoryview):
+            if isinstance(data.obj, bytes) and len(data) == len(data.obj):
+                data = data.obj
+            else:
+                data = bytes(data)
+        self.raw: bytes = data
         self.data = memoryview(data)
         self.pos = 0
 
@@ -203,7 +214,10 @@ class InputSplitBase(InputSplit):
         self.offset_end = min(nstep * (part_index + 1), ntotal)
         self.offset_curr = self.offset_begin
         if self.offset_begin == self.offset_end:
+            # empty partition: drop any state from a previous partition too
             self._close_fp()
+            self._overflow = b""
+            self._chunk = None
             return
         file_ptr = bisect_right(self.file_offset, self.offset_begin) - 1
         file_ptr_end = bisect_right(self.file_offset, self.offset_end) - 1
@@ -396,7 +410,7 @@ class LineSplitter(InputSplitBase):
         if pos >= end:
             chunk.pos = end
             return None
-        nl = _find_eol(data, pos)
+        nl = _find_eol(chunk.raw, pos)
         rec = data[pos:nl]
         pos = nl
         while pos < end and data[pos] in _EOL:
@@ -405,28 +419,13 @@ class LineSplitter(InputSplitBase):
         return rec
 
 
-def _find_eol(data: memoryview, start: int) -> int:
-    nl = bytes_find(data, 0x0A, start)
-    cr = bytes_find(data, 0x0D, start)
-    if nl < 0:
-        return cr if cr >= 0 else len(data)
-    if cr < 0:
-        return nl
-    return min(nl, cr)
-
-
-def bytes_find(data: memoryview, byte: int, start: int) -> int:
-    # bytes(data) would copy; search in slices to stay cheap
-    block = 4096
-    n = len(data)
-    pos = start
-    while pos < n:
-        stop = min(pos + block, n)
-        idx = bytes(data[pos:stop]).find(byte)
-        if idx >= 0:
-            return pos + idx
-        pos = stop
-    return -1
+def _find_eol(raw: bytes, start: int) -> int:
+    nl = raw.find(b"\n", start)
+    end = nl if nl >= 0 else len(raw)
+    # bound the \r search to before the \n so a \r-free chunk is not
+    # rescanned end-to-end for every record
+    cr = raw.find(b"\r", start, end)
+    return cr if cr >= 0 else end
 
 
 class RecordIOSplitter(InputSplitBase):
@@ -471,6 +470,7 @@ class SingleFileSplit(InputSplit):
         self.path = path
         self._records: Optional[Iterator[memoryview]] = None
         self._data: Optional[bytes] = None
+        self._chunk_given = False
 
     def _load(self) -> None:
         if self._data is None:
@@ -493,6 +493,7 @@ class SingleFileSplit(InputSplit):
         self._records = iter(
             [mv[s:e] for s, e in _line_spans(self._data)]
         )
+        self._chunk_given = False
 
     def next_record(self) -> Optional[memoryview]:
         if self._records is None:
@@ -500,12 +501,19 @@ class SingleFileSplit(InputSplit):
         return next(self._records, None)
 
     def next_chunk(self) -> Optional[memoryview]:
+        """The whole file as one chunk, once per epoch.
+
+        Chunks and records draw from one shared stream (like every other
+        InputSplit): taking the chunk exhausts the record iterator.
+        """
         if self._records is None:
             self.before_first()
-            data = memoryview(self._data)
-            self._records = iter(())
-            return data if len(data) else None
-        return None
+        if self._chunk_given:
+            return None
+        self._chunk_given = True
+        self._records = iter(())
+        data = memoryview(self._data)
+        return data if len(data) else None
 
 
 def _line_spans(data: bytes) -> List[Tuple[int, int]]:
@@ -563,8 +571,13 @@ class IndexedRecordIOSplitter(InputSplitBase):
         ntotal = len(self.index)
         nstep = (ntotal + num_parts - 1) // num_parts
         if part_index * nstep >= ntotal:
+            # empty partition: clear all iteration state from any prior part
             self.offset_begin = self.offset_end = 0
             self.index_begin = self.index_end = 0
+            self.current_index = 0
+            self.permutation = []
+            self._overflow = b""
+            self._chunk = None
             self._close_fp()
             return
         self.index_begin = part_index * nstep

@@ -1,0 +1,12 @@
+"""Model layer: the linear learners the reference substrate was built to feed.
+
+dmlc-core itself contains no models, but its Row::SDot (data.h:146-161) and
+RowBlock design exist to serve linear learners (XGBoost's linear booster,
+wormhole's linear solvers). The flagship end-to-end slice here is therefore
+a jit/pjit logistic-regression / linear-regression SGD learner over the
+device pipeline — the SURVEY.md §7 "minimum slice" model.
+"""
+
+from dmlc_tpu.models.linear import LinearLearner, LinearParams
+
+__all__ = ["LinearLearner", "LinearParams"]

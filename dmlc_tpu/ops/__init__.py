@@ -1,0 +1,18 @@
+"""Device-side transforms: sparse layouts and kernels.
+
+The reference has no device math (its Row::SDot, data.h:146-161, runs on the
+CPU inside linear learners). On TPU the equivalent hot ops are the
+CSR->device-layout transforms and the sparse-dense products they feed; these
+live here as XLA-first implementations with a Pallas kernel for the ELL
+matvec.
+"""
+
+from dmlc_tpu.ops.sparse import (
+    EllBatch, block_to_bcoo, block_to_dense, block_to_ell,
+    ell_matvec, ell_matmul, segment_csr_matvec,
+)
+
+__all__ = [
+    "EllBatch", "block_to_bcoo", "block_to_dense", "block_to_ell",
+    "ell_matvec", "ell_matmul", "segment_csr_matvec",
+]

@@ -1,0 +1,154 @@
+"""Sparse batch layouts for TPU + the products over them.
+
+Three device layouts for a parsed RowBlock (host CSR):
+
+- **padded dense** ``[B, D]`` — right for low-dim dense-ish data (HIGGS,
+  Criteo after hashing): one bf16/f32 matmul on the MXU beats any sparse
+  gather at D up to a few thousand.
+- **ELL** ``indices/values [B, K]`` (rows padded to K nonzeros with a
+  sentinel) — right for high-dim sparse data (KDD2012): static shapes, XLA
+  turns the gather+reduce into vectorized ops; a Pallas kernel covers the
+  matvec when K is large.
+- **BCOO** (jax.experimental.sparse) — interop layout for downstream jax
+  code that wants a real sparse type.
+
+The reference's only sparse op is Row::SDot (data.h:146-161) feeding linear
+learners; ``ell_matvec`` is its batched TPU analog.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dmlc_tpu.data.row_block import RowBlock
+
+
+class EllBatch(NamedTuple):
+    """Row-padded sparse batch: the TPU-friendly static-shape layout.
+
+    indices: int32 [B, K] — feature ids, ``D`` (=num_col) marks padding
+    values:  float32 [B, K] — zeros at padding
+    label:   float32 [B]
+    weight:  float32 [B] — ones when the source had no weights
+    """
+
+    indices: jax.Array | np.ndarray
+    values: jax.Array | np.ndarray
+    label: jax.Array | np.ndarray
+    weight: jax.Array | np.ndarray
+
+    @property
+    def batch_size(self) -> int:
+        return self.indices.shape[0]
+
+    @property
+    def max_nnz(self) -> int:
+        return self.indices.shape[1]
+
+
+def _row_lengths(block: RowBlock) -> np.ndarray:
+    return np.diff(block.offset)
+
+
+def block_to_ell(
+    block: RowBlock,
+    num_col: int,
+    max_nnz: Optional[int] = None,
+    pad_rows_to: Optional[int] = None,
+) -> EllBatch:
+    """CSR -> ELL with numpy scatter (host side, zero Python loops).
+
+    Rows longer than ``max_nnz`` are truncated (callers pick K as the
+    dataset's true max row length to avoid that); short rows pad with
+    index=num_col, value=0. ``pad_rows_to`` pads the batch dimension with
+    empty zero-weight rows so every batch has one static shape — XLA then
+    compiles the downstream step exactly once.
+    """
+    n = len(block)
+    lens = _row_lengths(block)
+    k = int(max_nnz if max_nnz is not None else (lens.max() if n else 1))
+    k = max(k, 1)
+    rows_out = int(pad_rows_to if pad_rows_to is not None else n)
+    indices = np.full((rows_out, k), num_col, dtype=np.int32)
+    values = np.zeros((rows_out, k), dtype=np.float32)
+    if n:
+        nnz = len(block.index)
+        rows_all = np.repeat(np.arange(n), lens)              # row of each entry
+        pos = np.arange(nnz) - np.repeat(block.offset[:-1], lens)  # slot within row
+        mask = pos < k                                        # truncate long rows
+        vals = block.value if block.value is not None else np.ones(nnz, np.float32)
+        indices[rows_all[mask], pos[mask]] = block.index[mask].astype(np.int32)
+        values[rows_all[mask], pos[mask]] = vals[mask]
+    label = np.zeros(rows_out, np.float32)
+    label[:n] = block.label
+    weight = np.zeros(rows_out, np.float32)
+    weight[:n] = block.weight if block.weight is not None else 1.0
+    return EllBatch(indices, values, label, weight)
+
+
+def block_to_dense(
+    block: RowBlock, num_col: int, pad_rows_to: Optional[int] = None
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """CSR -> padded dense [B, D] (+ label, weight), batch-padded like ELL."""
+    n = len(block)
+    rows_out = int(pad_rows_to if pad_rows_to is not None else n)
+    x = np.zeros((rows_out, num_col), dtype=np.float32)
+    if n:
+        lens = _row_lengths(block)
+        rows = np.repeat(np.arange(n), lens)
+        vals = block.value if block.value is not None else np.ones(len(block.index), np.float32)
+        keep = block.index < num_col
+        x[rows[keep], block.index[keep].astype(np.int64)] = vals[keep]
+    label = np.zeros(rows_out, np.float32)
+    label[:n] = block.label
+    weight = np.zeros(rows_out, np.float32)
+    weight[:n] = block.weight if block.weight is not None else 1.0
+    return x, label, weight
+
+
+def block_to_bcoo(block: RowBlock, num_col: int):
+    """CSR -> jax.experimental.sparse.BCOO (interop layout)."""
+    from jax.experimental import sparse as jsparse
+
+    lens = _row_lengths(block)
+    rows = np.repeat(np.arange(len(block)), lens)
+    coords = np.stack([rows, block.index.astype(np.int64)], axis=1)
+    vals = block.value if block.value is not None else np.ones(len(block.index), np.float32)
+    return jsparse.BCOO(
+        (jnp.asarray(vals), jnp.asarray(coords)), shape=(len(block), num_col)
+    )
+
+
+# ---------------- products ----------------
+
+def ell_matvec(weights: jax.Array, batch: EllBatch) -> jax.Array:
+    """Batched sparse dot: out[b] = sum_k w[idx[b,k]] * val[b,k].
+
+    The TPU analog of Row::SDot (data.h:146-161). ``weights`` is [D+1]; the
+    final slot is the padding sink (index=num_col) and must be 0 — callers
+    keep a D+1 parameter vector and simply never touch the last slot.
+    """
+    gathered = jnp.take(weights, batch.indices, axis=0)  # [B, K]
+    return jnp.sum(gathered * batch.values, axis=-1)
+
+
+def ell_matmul(weights: jax.Array, batch: EllBatch) -> jax.Array:
+    """ELL x dense matrix: [B,K] sparse rows times [D+1, H] -> [B, H]."""
+    gathered = jnp.take(weights, batch.indices, axis=0)  # [B, K, H]
+    return jnp.einsum("bkh,bk->bh", gathered, batch.values)
+
+
+def segment_csr_matvec(
+    weights: jax.Array,
+    index: jax.Array,
+    value: jax.Array,
+    row_ids: jax.Array,
+    num_rows: int,
+) -> jax.Array:
+    """COO-style matvec via segment_sum, for when nnz varies too much for ELL."""
+    prod = jnp.take(weights, index, axis=0) * value
+    return jax.ops.segment_sum(prod, row_ids, num_segments=num_rows)

@@ -1,0 +1,88 @@
+"""jax.distributed bootstrap from the DMLC_* env contract.
+
+The reference tracker exports ``DMLC_TRACKER_URI/PORT``, ``DMLC_NUM_WORKER``,
+``DMLC_TASK_ID``, ``DMLC_ROLE`` ... to every worker (SURVEY.md §2.2 env
+contract; tracker.py:178-184, local.py:21-26). On TPU the data plane is XLA
+collectives, so the whole rank-brokering protocol collapses into
+``jax.distributed.initialize(coordinator_address, num_processes, process_id)``
+— this module performs that mapping so a binary launched by ``dmlc-submit``
+(any backend, including ``tpu-pod``) joins the pod with zero extra code.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import NamedTuple, Optional
+
+from dmlc_tpu.utils.check import DMLCError, get_logger
+
+
+class EnvContract(NamedTuple):
+    """Parsed DMLC_* environment (the de-facto wire API, SURVEY.md §2.2)."""
+
+    tracker_uri: Optional[str]
+    tracker_port: Optional[int]
+    num_worker: int
+    task_id: int
+    role: str
+    node_host: Optional[str]
+
+    @staticmethod
+    def from_env(env=None) -> "EnvContract":
+        e = os.environ if env is None else env
+        port = e.get("DMLC_TRACKER_PORT")
+        return EnvContract(
+            tracker_uri=e.get("DMLC_TRACKER_URI"),
+            tracker_port=int(port) if port else None,
+            num_worker=int(e.get("DMLC_NUM_WORKER", "1")),
+            task_id=int(e.get("DMLC_TASK_ID", "0")),
+            role=e.get("DMLC_ROLE", "worker"),
+            node_host=e.get("DMLC_NODE_HOST"),
+        )
+
+
+_INITIALIZED = False
+
+
+def init_from_env(
+    env=None,
+    *,
+    coordinator_port_offset: int = 1,
+    force: bool = False,
+) -> EnvContract:
+    """Initialize jax.distributed from the DMLC_* contract.
+
+    Mapping (SURVEY.md §5.8): ``DMLC_TRACKER_URI:PORT+offset`` ->
+    coordinator_address, ``DMLC_NUM_WORKER`` -> num_processes,
+    ``DMLC_TASK_ID`` -> process_id. Single-worker jobs skip initialization
+    (single-host JAX works without a coordinator).
+
+    The coordinator listens next to the tracker port (offset +1) so the two
+    control planes (tracker TCP rendezvous, JAX DCN coordination) coexist on
+    one head node.
+    """
+    global _INITIALIZED
+    contract = EnvContract.from_env(env)
+    if contract.num_worker <= 1:
+        return contract
+    if _INITIALIZED and not force:
+        return contract
+    if contract.tracker_uri is None or contract.tracker_port is None:
+        raise DMLCError(
+            "init_from_env: DMLC_TRACKER_URI/DMLC_TRACKER_PORT not set; "
+            "launch through dmlc-submit or set them explicitly"
+        )
+    import jax
+
+    coordinator = f"{contract.tracker_uri}:{contract.tracker_port + coordinator_port_offset}"
+    get_logger().info(
+        "jax.distributed.initialize(coordinator=%s, num_processes=%d, process_id=%d)",
+        coordinator, contract.num_worker, contract.task_id,
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=contract.num_worker,
+        process_id=contract.task_id,
+    )
+    _INITIALIZED = True
+    return contract

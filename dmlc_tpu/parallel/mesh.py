@@ -1,0 +1,80 @@
+"""Mesh construction + sharding helpers.
+
+The tracker's tree/ring topology maps (tracker.py:186-261) have no socket
+analog on TPU: the ICI torus plus XLA collectives replace them. What remains
+is (a) building the mesh, (b) placing per-host batches into a global sharded
+array — the TPU equivalent of per-rank InputSplit shards feeding one logical
+dataset (SURVEY.md §2.3 row 1).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    axes: Optional[Dict[str, int]] = None, *, devices=None
+) -> Mesh:
+    """Build a Mesh from an axis->size dict, e.g. ``{"data": 4, "model": 2}``.
+
+    Defaults to a 1-D data mesh over all devices. Axis sizes must multiply to
+    the device count; pass ``-1`` for one axis to infer it.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    ndev = len(devices)
+    if not axes:
+        axes = {"data": ndev}
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = ndev // known
+    total = int(np.prod(sizes))
+    if total != ndev:
+        raise ValueError(f"mesh axes {dict(zip(names, sizes))} != {ndev} devices")
+    dev_array = np.array(devices).reshape(sizes)
+    return Mesh(dev_array, axis_names=names)
+
+
+def data_sharding(mesh: Mesh, *, axis: str = "data", ndim: int = 1) -> NamedSharding:
+    """Batch-dim sharding over the data axis, rest replicated."""
+    spec = [axis] + [None] * (ndim - 1)
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def host_shard_info(
+    num_parts_hint: Optional[int] = None,
+) -> Tuple[int, int]:
+    """(part_index, num_parts) for this host's InputSplit shard.
+
+    Multi-host: each process reads its own partition
+    (``jax.process_index()/process_count()``), the direct analog of per-rank
+    ``InputSplit::Create(uri, rank, world)`` (src/io.cc:74-130).
+    """
+    if num_parts_hint is not None:
+        return 0, num_parts_hint
+    return jax.process_index(), jax.process_count()
+
+
+def local_batch_to_global(
+    mesh: Mesh, local_arrays, *, axis: str = "data"
+) -> Tuple[jax.Array, ...]:
+    """Assemble per-process host batches into global sharded jax.Arrays.
+
+    Uses ``jax.make_array_from_process_local_data``: each host contributes its
+    InputSplit shard; the result is one logical array sharded over ``axis``
+    across the pod — no host ever materializes the global batch.
+    """
+    out = []
+    for arr in local_arrays:
+        sharding = NamedSharding(mesh, P(axis, *([None] * (arr.ndim - 1))))
+        out.append(jax.make_array_from_process_local_data(sharding, np.asarray(arr)))
+    return tuple(out)

@@ -1,0 +1,282 @@
+"""Data layer tests: RowBlock, parsers, iterators.
+
+Parser tests follow the reference pattern of parsing in-memory corpora and
+asserting block contents (unittest_parser.cc: BOM, newline variants, NOEOL,
+delimiters, weight column, qid, indexing modes).
+"""
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.data import (
+    CSVParser, LibFMParser, LibSVMParser, RowBlock, RowBlockContainer,
+    create_parser, create_row_block_iter,
+)
+from dmlc_tpu.io import MemoryFileSystem, open_stream
+from dmlc_tpu.utils.check import DMLCError
+
+
+def _mem_corpus(name, data):
+    MemoryFileSystem.reset()
+    uri = f"mem://corpus/{name}"
+    with open_stream(uri, "w") as f:
+        f.write(data)
+    return uri
+
+
+def _parse_all(uri, type_, num_parts=1, **kw):
+    blocks = []
+    for part in range(num_parts):
+        p = create_parser(uri, part, num_parts, type_, threaded=False, **kw)
+        blocks.extend(list(p))
+        p.close()
+    return blocks
+
+
+def _merge(blocks):
+    c = RowBlockContainer()
+    for b in blocks:
+        c.push_block(b)
+    return c.to_block()
+
+
+# ---------------- RowBlock ----------------
+
+def test_row_block_basics():
+    blk = RowBlock(
+        offset=[0, 2, 3, 6],
+        label=[1.0, 0.0, 1.0],
+        index=np.array([0, 3, 1, 0, 2, 4], dtype=np.uint64),
+        value=np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0], dtype=np.float32),
+    )
+    assert len(blk) == 3
+    assert blk.num_nonzero == 6
+    assert blk.num_col == 5
+    row = blk[1]
+    assert row.label == 0.0 and list(row.index) == [1] and row.get_value(0) == 3.0
+    w = np.arange(5, dtype=np.float32)
+    assert blk[0].sdot(w) == pytest.approx(0 * 1 + 3 * 2)
+    sl = blk.slice(1, 3)
+    assert len(sl) == 2 and sl.num_nonzero == 4
+    dense = blk.to_dense()
+    assert dense.shape == (3, 5)
+    assert dense[2, 2] == 5.0 and dense[2, 4] == 6.0
+    assert blk.mem_cost_bytes() > 0
+
+
+def test_row_block_binary_features_and_save(tmp_path):
+    blk = RowBlock(
+        offset=[0, 1, 3], label=[1, 0],
+        index=np.array([2, 0, 1], dtype=np.uint32),
+    )
+    assert blk[0].get_value(0) == 1.0
+    assert blk[1].sdot(np.array([1.0, 2.0, 3.0], np.float32)) == 3.0
+    p = tmp_path / "blk.bin"
+    with open(p, "wb") as f:
+        blk.save(f)
+    with open(p, "rb") as f:
+        back = RowBlock.load(f)
+    np.testing.assert_array_equal(back.offset, blk.offset)
+    np.testing.assert_array_equal(back.index, blk.index)
+    assert back.value is None
+
+
+def test_row_block_validation():
+    with pytest.raises(DMLCError):
+        RowBlock(offset=[0, 1], label=[1, 2], index=np.array([0]))
+    with pytest.raises(DMLCError):
+        RowBlock(offset=[0, 2], label=[1], index=np.array([0]))
+
+
+# ---------------- libsvm parser ----------------
+
+LIBSVM_TEXT = b"""1 0:1.5 3:2.5 7:3
+0 1:0.5
+1 0:1 2:2 5:0.5
+0 7:4.5
+"""
+
+
+def test_libsvm_basic():
+    uri = _mem_corpus("a.libsvm", LIBSVM_TEXT)
+    blk = _merge(_parse_all(uri, "libsvm"))
+    assert len(blk) == 4
+    np.testing.assert_array_equal(blk.label, [1, 0, 1, 0])
+    np.testing.assert_array_equal(blk.offset, [0, 3, 4, 7, 8])
+    np.testing.assert_array_equal(blk.index, [0, 3, 7, 1, 0, 2, 5, 7])
+    np.testing.assert_allclose(blk.value, [1.5, 2.5, 3, 0.5, 1, 2, 0.5, 4.5])
+    assert blk.weight is None and blk.qid is None
+
+
+@pytest.mark.parametrize("num_parts", [2, 3])
+def test_libsvm_partitioned(num_parts):
+    lines = [f"{i % 2} {i % 11}:{i}.5 {(i + 3) % 11}:1" for i in range(200)]
+    uri = _mem_corpus("b.libsvm", "\n".join(lines).encode())
+    blk = _merge(_parse_all(uri, "libsvm", num_parts=num_parts))
+    assert len(blk) == 200
+    np.testing.assert_array_equal(blk.label, [i % 2 for i in range(200)])
+
+
+def test_libsvm_weights_qid_comments_bom():
+    text = (
+        b"\xef\xbb\xbf"
+        b"1:2.0 qid:3 0:1.5 # trailing comment\n"
+        b"# full comment line\n"
+        b"0:0.5 qid:4 2:2.5 5:1\n"
+    )
+    uri = _mem_corpus("c.libsvm", text)
+    blk = _merge(_parse_all(uri, "libsvm"))
+    assert len(blk) == 2
+    np.testing.assert_allclose(blk.label, [1, 0])
+    np.testing.assert_allclose(blk.weight, [2.0, 0.5])
+    np.testing.assert_array_equal(blk.qid, [3, 4])
+    np.testing.assert_array_equal(blk.index, [0, 2, 5])
+
+
+def test_libsvm_binary_features():
+    uri = _mem_corpus("d.libsvm", b"1 3 5 7\n0 2\n")
+    blk = _merge(_parse_all(uri, "libsvm"))
+    assert blk.value is None
+    np.testing.assert_array_equal(blk.index, [3, 5, 7, 2])
+    assert blk[0].sdot(np.ones(8, np.float32)) == 3.0
+
+
+def test_libsvm_indexing_modes():
+    text = b"1 1:1.0 4:2.0\n0 2:3.0\n"
+    # default 0-based: indices kept
+    uri = _mem_corpus("e.libsvm", text)
+    blk = _merge(_parse_all(uri, "libsvm"))
+    np.testing.assert_array_equal(blk.index, [1, 4, 2])
+    # explicit 1-based
+    blk1 = _merge(_parse_all(uri + "?indexing_mode=1", "libsvm"))
+    np.testing.assert_array_equal(blk1.index, [0, 3, 1])
+    # heuristic: min>0 -> treat as 1-based (libsvm_parser.h:159-168)
+    blkh = _merge(_parse_all(uri + "?indexing_mode=-1", "libsvm"))
+    np.testing.assert_array_equal(blkh.index, [0, 3, 1])
+    # heuristic with a 0 index present -> keep 0-based
+    uri0 = _mem_corpus("f.libsvm", b"1 0:1.0 4:2.0\n")
+    blk0 = _merge(_parse_all(uri0 + "?indexing_mode=-1", "libsvm"))
+    np.testing.assert_array_equal(blk0.index, [0, 4])
+
+
+def test_libsvm_via_format_arg_and_threaded():
+    uri = _mem_corpus("g.libsvm", LIBSVM_TEXT)
+    p = create_parser(uri + "?format=libsvm", 0, 1, "auto", threaded=True)
+    blocks = list(p)
+    p.close()
+    assert _merge(blocks).num_nonzero == 8
+
+
+# ---------------- csv parser ----------------
+
+def test_csv_basic():
+    uri = _mem_corpus("a.csv", b"1.0,2.0,3.0\n4.0,5.0,6.0\n")
+    blk = _merge(_parse_all(uri, "csv"))
+    assert len(blk) == 2
+    np.testing.assert_array_equal(blk.label, [0, 0])  # no label column -> 0
+    np.testing.assert_array_equal(blk.index, [0, 1, 2, 0, 1, 2])
+    np.testing.assert_allclose(blk.value, [1, 2, 3, 4, 5, 6])
+
+
+def test_csv_label_weight_columns():
+    uri = _mem_corpus("c.csv", b"7;1.5;2.5;0.9\n3;4.5;5.5;0.1\n")
+    blk = _merge(_parse_all(uri + "?label_column=0&weight_column=3&delimiter=;", "csv"))
+    np.testing.assert_allclose(blk.label, [7, 3])
+    np.testing.assert_allclose(blk.weight, [0.9, 0.1])
+    np.testing.assert_allclose(blk.value, [1.5, 2.5, 4.5, 5.5])
+    np.testing.assert_array_equal(blk.index, [0, 1, 0, 1])
+
+
+def test_csv_ragged_raises():
+    uri = _mem_corpus("d.csv", b"1,2,3\n4,5\n")
+    with pytest.raises(DMLCError, match="ragged"):
+        _parse_all(uri, "csv")
+
+
+def test_csv_int_dtype():
+    uri = _mem_corpus("e.csv", b"1,2\n3,4\n")
+    blk = _merge(_parse_all(uri + "?dtype=int64", "csv"))
+    np.testing.assert_allclose(blk.value, [1, 2, 3, 4])
+
+
+# ---------------- libfm parser ----------------
+
+def test_libfm_basic():
+    uri = _mem_corpus("a.libfm", b"1 0:3:1.5 2:7:2.5\n0 1:2:0.5\n")
+    blk = _merge(_parse_all(uri, "libfm"))
+    assert len(blk) == 2
+    np.testing.assert_array_equal(blk.field, [0, 2, 1])
+    np.testing.assert_array_equal(blk.index, [3, 7, 2])
+    np.testing.assert_allclose(blk.value, [1.5, 2.5, 0.5])
+
+
+def test_libfm_indexing_heuristic():
+    uri = _mem_corpus("b.libfm", b"1 1:1:0.5 2:4:1.5\n")
+    blk = _merge(_parse_all(uri + "?indexing_mode=-1", "libfm"))
+    np.testing.assert_array_equal(blk.field, [0, 1])
+    np.testing.assert_array_equal(blk.index, [0, 3])
+    with pytest.raises(DMLCError):
+        _parse_all(_mem_corpus("c.libfm", b"1 3:1.5\n"), "libfm")
+
+
+# ---------------- factory ----------------
+
+def test_parser_factory_unknown():
+    uri = _mem_corpus("x.txt", b"1 0:1\n")
+    with pytest.raises(DMLCError, match="unknown parser format"):
+        create_parser(uri, 0, 1, "parquet")
+
+
+# ---------------- row block iterators ----------------
+
+def test_basic_row_iter(tmp_path):
+    p = tmp_path / "train.libsvm"
+    lines = [f"{i % 2} 0:{i} {i % 5}:1.5" for i in range(100)]
+    p.write_text("\n".join(lines) + "\n")
+    it = create_row_block_iter(str(p), 0, 1, "libsvm", silent=True)
+    epochs = []
+    for _ in range(2):
+        blocks = list(it)
+        assert len(blocks) == 1 and len(blocks[0]) == 100
+        epochs.append(blocks[0])
+        it.before_first()
+    np.testing.assert_array_equal(epochs[0].index, epochs[1].index)
+    assert it.num_col == 5
+
+
+def test_disk_row_iter_cache(tmp_path):
+    data_p = tmp_path / "train.libsvm"
+    lines = [f"{i % 2} {i % 7}:{i}.25" for i in range(500)]
+    data_p.write_text("\n".join(lines) + "\n")
+    cache_p = tmp_path / "cache.bin"
+    uri = f"{data_p}#{cache_p}"
+    # small pages to force multiple pages
+    from dmlc_tpu.data.iterators import DiskRowIter
+    from dmlc_tpu.data.parsers import create_parser as _cp
+
+    it = DiskRowIter(_cp(str(data_p), 0, 1, "libsvm", threaded=False),
+                     str(cache_p), page_bytes=4096, silent=True)
+    rows = sum(len(b) for b in it)
+    assert rows == 500
+    it.before_first()
+    rows2 = sum(len(b) for b in it)
+    assert rows2 == 500
+    it.close()
+
+    # second open hits the cache without a parser
+    it2 = DiskRowIter(None, str(cache_p), silent=True)
+    assert sum(len(b) for b in it2) == 500
+    assert it2.num_col == 7
+    it2.close()
+
+
+def test_create_row_block_iter_cache_uri(tmp_path):
+    data_p = tmp_path / "t.libsvm"
+    data_p.write_text("1 0:1\n0 1:2\n")
+    uri = f"{data_p}#{tmp_path}/c.bin"
+    it = create_row_block_iter(uri, 0, 1, "libsvm", silent=True)
+    assert sum(len(b) for b in it) == 2
+    it.close()
+    it2 = create_row_block_iter(uri, 0, 1, "libsvm", silent=True)
+    assert sum(len(b) for b in it2) == 2
+    it2.close()

@@ -1,0 +1,211 @@
+"""JAX shim tests: sparse layouts, device pipeline, sharded linear learner.
+
+Runs on the 8-device virtual CPU mesh (conftest.py), per SURVEY.md §4(d).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dmlc_tpu.data import create_parser
+from dmlc_tpu.data.device import DeviceIter, rebatch_blocks
+from dmlc_tpu.data.row_block import RowBlock
+from dmlc_tpu.models import LinearLearner
+from dmlc_tpu.ops import (
+    block_to_bcoo, block_to_dense, block_to_ell, ell_matvec, segment_csr_matvec,
+)
+from dmlc_tpu.parallel import data_sharding, make_mesh
+
+
+def _block():
+    return RowBlock(
+        offset=[0, 2, 3, 6],
+        label=[1.0, 0.0, 1.0],
+        index=np.array([0, 3, 1, 0, 2, 4], dtype=np.uint64),
+        value=np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0], dtype=np.float32),
+        weight=np.array([1.0, 0.5, 2.0], dtype=np.float32),
+    )
+
+
+def test_devices_are_8():
+    assert len(jax.devices()) == 8
+
+
+# ---------------- layouts ----------------
+
+def test_block_to_ell_matches_dense():
+    blk = _block()
+    ncol = 5
+    ell = block_to_ell(blk, ncol)
+    assert ell.indices.shape == (3, 3)  # max row nnz = 3
+    dense = blk.to_dense(ncol)
+    w = np.arange(1, ncol + 1, dtype=np.float32)
+    want = dense @ w
+    wp = jnp.concatenate([jnp.asarray(w), jnp.zeros(1)])  # +pad sink
+    got = ell_matvec(wp, ell._replace(
+        indices=jnp.asarray(ell.indices), values=jnp.asarray(ell.values)))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+def test_block_to_ell_pad_and_truncate():
+    blk = _block()
+    ell = block_to_ell(blk, 5, max_nnz=2, pad_rows_to=6)
+    assert ell.indices.shape == (6, 2)
+    assert ell.weight[3:].sum() == 0.0       # padded rows carry zero weight
+    assert (ell.indices[3:] == 5).all()      # pad index = num_col
+    # truncation kept the first 2 entries of row 2
+    np.testing.assert_array_equal(ell.indices[2], [0, 2])
+
+
+def test_block_to_dense_pad():
+    x, y, w = block_to_dense(_block(), 5, pad_rows_to=4)
+    assert x.shape == (4, 5)
+    assert y[3] == 0 and w[3] == 0
+    assert x[0, 3] == 2.0
+
+
+def test_block_to_bcoo():
+    bc = block_to_bcoo(_block(), 5)
+    np.testing.assert_allclose(np.asarray(bc.todense()), _block().to_dense(5))
+
+
+def test_segment_csr_matvec():
+    blk = _block()
+    w = jnp.arange(1.0, 6.0)
+    rows = np.repeat(np.arange(3), np.diff(blk.offset))
+    got = segment_csr_matvec(
+        w, jnp.asarray(blk.index.astype(np.int32)), jnp.asarray(blk.value),
+        jnp.asarray(rows), 3)
+    want = blk.to_dense(5) @ np.arange(1.0, 6.0, dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+# ---------------- rebatching ----------------
+
+def test_rebatch_blocks_fixed_size():
+    blocks = [_block() for _ in range(5)]  # 15 rows total
+    out = list(rebatch_blocks(iter(blocks), 4))
+    assert [len(b) for b in out] == [4, 4, 4, 3]
+    # labels preserved in order
+    labels = np.concatenate([b.label for b in out])
+    np.testing.assert_array_equal(labels, np.tile([1, 0, 1], 5))
+    out2 = list(rebatch_blocks(iter(blocks), 4, drop_remainder=True))
+    assert [len(b) for b in out2] == [4, 4, 4]
+
+
+# ---------------- device iter ----------------
+
+def _libsvm_corpus(tmp_path, n=64, d=6):
+    rng = np.random.default_rng(0)
+    lines = []
+    for i in range(n):
+        nnz = rng.integers(1, d)
+        idx = sorted(rng.choice(d, size=nnz, replace=False))
+        feats = " ".join(f"{j}:{rng.normal():.4f}" for j in idx)
+        lines.append(f"{i % 2} {feats}")
+    p = tmp_path / "train.libsvm"
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+@pytest.mark.parametrize("layout", ["dense", "ell"])
+def test_device_iter_shapes_and_epochs(tmp_path, layout):
+    uri = _libsvm_corpus(tmp_path)
+    parser = create_parser(uri, 0, 1, "libsvm", threaded=False)
+    it = DeviceIter(parser, num_col=6, batch_size=16, layout=layout, max_nnz=6)
+    batches = list(it)
+    assert len(batches) == 4
+    if layout == "dense":
+        x, y, w = batches[0]
+        assert x.shape == (16, 6) and isinstance(x, jax.Array)
+    else:
+        assert batches[0].indices.shape[0] == 16
+    it.reset()
+    batches2 = list(it)
+    assert len(batches2) == 4
+    if layout == "dense":
+        np.testing.assert_allclose(np.asarray(batches[0][0]),
+                                   np.asarray(batches2[0][0]))
+    assert it.stats()["bytes_to_device"] > 0
+    it.close()
+
+
+def test_device_iter_sharded_over_mesh(tmp_path):
+    mesh = make_mesh({"data": 8})
+    uri = _libsvm_corpus(tmp_path)
+    parser = create_parser(uri, 0, 1, "libsvm", threaded=False)
+    it = DeviceIter(parser, num_col=6, batch_size=32, layout="dense", mesh=mesh)
+    x, y, w = next(iter(it))
+    assert x.shape == (32, 6)
+    assert x.sharding.spec == data_sharding(mesh, ndim=2).spec
+    # each device holds 4 rows
+    assert x.addressable_shards[0].data.shape == (4, 6)
+    it.close()
+
+
+# ---------------- linear learner ----------------
+
+def _separable_corpus(tmp_path, n=256, d=8):
+    rng = np.random.default_rng(1)
+    w_true = rng.normal(size=d)
+    lines = []
+    for _ in range(n):
+        x = rng.normal(size=d)
+        y = int(x @ w_true > 0)
+        feats = " ".join(f"{j}:{x[j]:.5f}" for j in range(d))
+        lines.append(f"{y} {feats}")
+    p = tmp_path / "sep.libsvm"
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+@pytest.mark.parametrize("layout", ["dense", "ell"])
+def test_linear_learner_learns(tmp_path, layout):
+    uri = _separable_corpus(tmp_path)
+    model = LinearLearner(num_col=8, objective="logistic", layout=layout,
+                          learning_rate=0.5)
+    parser = create_parser(uri, 0, 1, "libsvm", threaded=False)
+    it = DeviceIter(parser, num_col=model.device_num_col(), batch_size=64,
+                    layout=layout, max_nnz=8)
+    model.fit(it, epochs=15)
+    acc = model.accuracy(it)
+    assert acc > 0.9, f"layout={layout} acc={acc}"
+    it.close()
+
+
+def test_linear_learner_sharded_dp_matches_single(tmp_path):
+    uri = _separable_corpus(tmp_path)
+    mesh = make_mesh({"data": 8})
+
+    def run(mesh_arg):
+        model = LinearLearner(num_col=8, layout="dense", learning_rate=0.5,
+                              mesh=mesh_arg)
+        parser = create_parser(uri, 0, 1, "libsvm", threaded=False)
+        it = DeviceIter(parser, num_col=model.device_num_col(), batch_size=64,
+                        layout="dense", mesh=mesh_arg, drop_remainder=True)
+        model.fit(it, epochs=3)
+        it.close()
+        return np.asarray(model.params.weight)
+
+    w_single = run(None)
+    w_sharded = run(mesh)
+    # data-parallel grads psum to the same update as single-device
+    np.testing.assert_allclose(w_sharded, w_single, rtol=1e-4, atol=1e-5)
+
+
+def test_linear_learner_dp_tp_mesh(tmp_path):
+    # 4-way data x 2-way model sharding on the dense path
+    uri = _separable_corpus(tmp_path)
+    mesh = make_mesh({"data": 4, "model": 2})
+    model = LinearLearner(num_col=8, layout="dense", learning_rate=0.5,
+                          mesh=mesh, model_axis="model")
+    assert model.weight_dim == 10  # 8+1 rounded up to the model axis
+    parser = create_parser(uri, 0, 1, "libsvm", threaded=False)
+    it = DeviceIter(parser, num_col=model.device_num_col(), batch_size=64,
+                    layout="dense", mesh=mesh, drop_remainder=True,
+                    shardings=model.batch_shardings())
+    model.fit(it, epochs=3)
+    acc = model.accuracy(it)
+    assert acc > 0.8
+    it.close()

@@ -483,3 +483,60 @@ def test_empty_files_skipped(tmp_path):
     parts = _collect_all_parts(uri, 2)
     merged = [r for pt in parts for r in pt]
     assert merged == [b"x"]
+
+
+# ---------------- reset_partition reuse (regression: review findings) ----------------
+
+def test_reset_partition_reuse_no_stale_state(tmp_path):
+    # one split object reused across partitions, including empty ones
+    p = tmp_path / "r.txt"
+    p.write_bytes(b"a\nb\nc\n")
+    split = create_input_split(str(p), 0, 1, "text", threaded=False)
+    assert bytes(split.next_record()) == b"a"  # mid-iteration
+    split.reset_partition(7, 8)  # empty byte range
+    assert split.next_record() is None
+    split.reset_partition(0, 1)
+    assert [bytes(r) for r in split.iter_records()] == [b"a", b"b", b"c"]
+    split.close()
+
+
+def test_indexed_reset_partition_empty_after_use(tmp_path):
+    records = [f"r{i}".encode() for i in range(8)]
+    data_uri, idx_uri = _write_indexed(tmp_path, records)
+    split = create_input_split(
+        data_uri, 0, 1, "indexed_recordio", index_uri=idx_uri,
+        shuffle=True, seed=1, threaded=False,
+    )
+    assert split.next_record() is not None  # partially consumed
+    split.reset_partition(10, 16)  # out-of-range -> empty
+    assert split.next_record() is None
+    split.reset_partition(0, 1)
+    assert sorted(bytes(r) for r in split.iter_records()) == sorted(records)
+    split.close()
+
+
+def test_single_file_split_chunk_then_record():
+    import dmlc_tpu.io.input_split as isp
+    import tempfile, os as _os
+    with tempfile.NamedTemporaryFile("wb", suffix=".txt", delete=False) as f:
+        f.write(b"x\ny\n")
+        path = f.name
+    try:
+        s = isp.SingleFileSplit(path)
+        chunk = s.next_chunk()
+        assert bytes(chunk) == b"x\ny\n"
+        assert s.next_record() is None  # chunk consumed the stream
+        s.before_first()
+        assert bytes(s.next_record()) == b"x"
+    finally:
+        _os.unlink(path)
+
+
+def test_memfile_double_close():
+    MemoryFileSystem.reset()
+    f = open_stream("mem://b/x.txt", "w")
+    f.write(b"hi")
+    f.close()
+    f.close()  # idempotent
+    with open_stream("mem://b/x.txt") as g:
+        assert g.read() == b"hi"
