@@ -92,14 +92,45 @@ class LibFMParserParam(Parameter):
 
 class TextParserBase(Parser):
     """Pulls chunks from an InputSplit and parses each into a RowBlock
-    (analog of TextParserBase::FillData, text_parser.h:110-146)."""
+    (analog of TextParserBase::FillData, text_parser.h:110-146).
+
+    Each chunk goes through the C++ native core when available (threaded
+    scanner, dmlc_tpu/native) and falls back to the vectorized numpy engine
+    otherwise; both produce identical blocks.
+    """
 
     def __init__(self, source: InputSplit, index_dtype=np.uint64):
         self.source = source
         self.index_dtype = index_dtype
         self._bytes = 0
+        self._native = None  # tri-state: None=unprobed, False=off, True=on
+
+    def use_native(self) -> bool:
+        if self._native is None:
+            from dmlc_tpu import native
+
+            self._native = native.available() and self._native_supported()
+        return bool(self._native)
+
+    def _native_supported(self) -> bool:
+        return True
+
+    def parse_chunk_native(self, chunk: bytes) -> Optional[RowBlock]:
+        return None
 
     def parse_chunk(self, chunk: bytes) -> RowBlock:
+        if self.use_native():
+            block = self.parse_chunk_native(chunk)
+            if block is not None:
+                return block
+        try:
+            return self.parse_chunk_py(chunk)
+        except (ValueError, TypeError) as exc:
+            # numpy conversion failures (e.g. astype on a malformed token)
+            # surface as the same error type the native engine raises
+            raise DMLCError(f"{type(self).__name__}: malformed input: {exc}") from exc
+
+    def parse_chunk_py(self, chunk: bytes) -> RowBlock:
         raise NotImplementedError
 
     def next_block(self) -> Optional[RowBlock]:
@@ -108,7 +139,7 @@ class TextParserBase(Parser):
             if chunk is None:
                 return None
             self._bytes += len(chunk)
-            block = self.parse_chunk(bytes(chunk))
+            block = self.parse_chunk(_chunk_bytes(chunk))
             if len(block) > 0:
                 return block
 
@@ -121,6 +152,16 @@ class TextParserBase(Parser):
 
     def close(self) -> None:
         self.source.close()
+
+
+def _chunk_bytes(chunk) -> bytes:
+    """Chunk -> bytes without copying when it is a full-span view of bytes."""
+    if isinstance(chunk, bytes):
+        return chunk
+    if isinstance(chunk, memoryview) and isinstance(chunk.obj, bytes) \
+            and len(chunk) == len(chunk.obj):
+        return chunk.obj
+    return bytes(chunk)
 
 
 def _strip_comments(chunk: bytes) -> bytes:
@@ -169,7 +210,19 @@ class LibSVMParser(TextParserBase):
         self.param.init(dict(args or {}), allow_unknown=True)
         check(self.param.format == "libsvm", "LibSVMParser: format must be libsvm")
 
-    def parse_chunk(self, chunk: bytes) -> RowBlock:
+    def parse_chunk_native(self, chunk: bytes) -> Optional[RowBlock]:
+        from dmlc_tpu import native
+
+        d = native.parse_libsvm(chunk, indexing_mode=self.param.indexing_mode)
+        if d is None:
+            return None
+        return RowBlock(
+            offset=d["offset"], label=d["label"], index=d["index"],
+            value=d["value"], weight=d["weight"], qid=d["qid"],
+            hold=d["_owner"],
+        )
+
+    def parse_chunk_py(self, chunk: bytes) -> RowBlock:
         lines = _tokenize_lines(chunk)
         n = len(lines)
         label_toks = []
@@ -257,7 +310,24 @@ class CSVParser(TextParserBase):
         )
         self._dtype = np.dtype(self.param.dtype)
 
-    def parse_chunk(self, chunk: bytes) -> RowBlock:
+    def _native_supported(self) -> bool:
+        # the native csv scanner emits float32 cells only
+        return self.param.dtype == "float32"
+
+    def parse_chunk_native(self, chunk: bytes) -> Optional[RowBlock]:
+        from dmlc_tpu import native
+
+        out = native.parse_csv(chunk, delimiter=self.param.delimiter)
+        if out is None:
+            return None
+        cells, _owner = out
+        n, ncol = cells.shape
+        if n == 0:
+            return RowBlock(np.zeros(1, np.int64), np.empty(0, np.float32),
+                            np.empty(0, self.index_dtype))
+        return self._cells_to_block(cells, n, ncol)
+
+    def parse_chunk_py(self, chunk: bytes) -> RowBlock:
         if chunk.startswith(b"\xef\xbb\xbf"):
             chunk = chunk[3:]
         delim = self.param.delimiter.encode()
@@ -275,6 +345,11 @@ class CSVParser(TextParserBase):
                 f"csv: ragged chunk - expected {n}x{ncol} cells, got {len(tokens)}"
             )
         cells = tokens.astype(self._dtype).reshape(n, ncol)
+        return self._cells_to_block(cells, n, ncol)
+
+    def _cells_to_block(self, cells: np.ndarray, n: int, ncol: int) -> RowBlock:
+        """Dense cell matrix -> RowBlock with synthetic indices 0..k
+        (csv_parser.h:120-121); shared by the native and numpy paths."""
         lc, wc = self.param.label_column, self.param.weight_column
         check(lc < ncol, f"csv: label_column {lc} >= num columns {ncol}")
         check(wc < ncol, f"csv: weight_column {wc} >= num columns {ncol}")
@@ -301,7 +376,18 @@ class LibFMParser(TextParserBase):
         self.param.init(dict(args or {}), allow_unknown=True)
         check(self.param.format == "libfm", "LibFMParser: format must be libfm")
 
-    def parse_chunk(self, chunk: bytes) -> RowBlock:
+    def parse_chunk_native(self, chunk: bytes) -> Optional[RowBlock]:
+        from dmlc_tpu import native
+
+        d = native.parse_libfm(chunk, indexing_mode=self.param.indexing_mode)
+        if d is None:
+            return None
+        return RowBlock(
+            offset=d["offset"], label=d["label"], index=d["index"],
+            value=d["value"], field=d["field"], hold=d["_owner"],
+        )
+
+    def parse_chunk_py(self, chunk: bytes) -> RowBlock:
         lines = _tokenize_lines(chunk)
         n = len(lines)
         if n == 0:

@@ -65,7 +65,11 @@ class RowBlock:
         weight: Optional[np.ndarray] = None,
         qid: Optional[np.ndarray] = None,
         field: Optional[np.ndarray] = None,
+        hold=None,
     ):
+        # `hold` pins foreign buffer owners (the native core's malloc'd
+        # results) for as long as this block's views are alive
+        self.hold = hold
         self.offset = np.asarray(offset, dtype=np.int64)
         self.label = np.asarray(label, dtype=np.float32)
         self.index = np.asarray(index)
@@ -129,6 +133,7 @@ class RowBlock:
             weight=self.weight[begin:end] if self.weight is not None else None,
             qid=self.qid[begin:end] if self.qid is not None else None,
             field=self.field[s:e] if self.field is not None else None,
+            hold=self.hold,
         )
 
     def mem_cost_bytes(self) -> int:
@@ -183,11 +188,16 @@ class RowBlockContainer:
         self._weights: List[Optional[np.ndarray]] = []
         self._qids: List[Optional[np.ndarray]] = []
         self._fields: List[Optional[np.ndarray]] = []
+        self._holds: List = []  # buffer owners of pushed zero-copy views
         self.max_index = 0
 
     def push_block(self, block: RowBlock) -> None:
         if len(block) == 0:
             return
+        if block.hold is not None:
+            # the stored arrays are views over the block's foreign buffers;
+            # keep their owner alive for the container's lifetime
+            self._holds.append(block.hold)
         self._offsets.append(np.diff(block.offset))
         self._labels.append(block.label)
         self._indices.append(block.index)

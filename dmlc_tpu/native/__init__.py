@@ -1,0 +1,253 @@
+"""ctypes bindings for the C++ native core, built on demand with g++.
+
+No pybind11 in this image (see repo docs) — the C ABI in native/src/parse.cc
+is loaded with ctypes and arrays are wrapped as numpy views that own the
+malloc'd buffers via a finalizer (zero copies on the handoff).
+
+Falls back cleanly: ``available()`` is False when the toolchain or build is
+missing, and the Python parsers keep working.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import weakref
+from typing import Optional
+
+import numpy as np
+
+from dmlc_tpu.utils.check import DMLCError, get_logger
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "src", "parse.cc")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_SO_PATH = os.path.join(_BUILD_DIR, "libdmlc_tpu_native.so")
+_ABI_VERSION = 1
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+class _CsrBlockResult(ctypes.Structure):
+    _fields_ = [
+        ("n_rows", ctypes.c_int64),
+        ("nnz", ctypes.c_int64),
+        ("offset", ctypes.POINTER(ctypes.c_int64)),
+        ("label", ctypes.POINTER(ctypes.c_float)),
+        ("weight", ctypes.POINTER(ctypes.c_float)),
+        ("qid", ctypes.POINTER(ctypes.c_int64)),
+        ("index", ctypes.POINTER(ctypes.c_uint64)),
+        ("field", ctypes.POINTER(ctypes.c_uint64)),
+        ("value", ctypes.POINTER(ctypes.c_float)),
+        ("error", ctypes.c_char_p),
+    ]
+
+
+class _CsvResult(ctypes.Structure):
+    _fields_ = [
+        ("n_rows", ctypes.c_int64),
+        ("n_cols", ctypes.c_int64),
+        ("cells", ctypes.POINTER(ctypes.c_float)),
+        ("error", ctypes.c_char_p),
+    ]
+
+
+def _build() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+        "-march=native", "-o", _SO_PATH, _SRC,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        get_logger().warning("native build failed to run: %s", exc)
+        return False
+    if proc.returncode != 0:
+        get_logger().warning("native build failed:\n%s", proc.stderr[-2000:])
+        return False
+    return True
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if os.environ.get("DMLC_TPU_NO_NATIVE", "0") not in ("", "0"):
+            _build_failed = True
+            return None
+        need_build = not os.path.exists(_SO_PATH) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_SO_PATH)
+        )
+        if need_build and not _build():
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError as exc:
+            # stale/corrupt artifact: rebuild once before giving up
+            get_logger().warning("native load failed (%s); rebuilding", exc)
+            try:
+                os.unlink(_SO_PATH)
+            except OSError:
+                pass
+            if not _build():
+                _build_failed = True
+                return None
+            try:
+                lib = ctypes.CDLL(_SO_PATH)
+            except OSError as exc2:
+                get_logger().warning("native load failed after rebuild: %s", exc2)
+                _build_failed = True
+                return None
+        _declare(lib)
+        if lib.dmlc_native_abi_version() != _ABI_VERSION:
+            get_logger().warning("native ABI mismatch; rebuilding")
+            os.unlink(_SO_PATH)
+            if not _build():
+                _build_failed = True
+                return None
+            lib = ctypes.CDLL(_SO_PATH)
+            _declare(lib)
+            if lib.dmlc_native_abi_version() != _ABI_VERSION:
+                get_logger().warning("native ABI still mismatched after rebuild")
+                _build_failed = True
+                return None
+        _lib = lib
+        return _lib
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    lib.dmlc_parse_libsvm.restype = ctypes.POINTER(_CsrBlockResult)
+    lib.dmlc_parse_libsvm.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int]
+    lib.dmlc_parse_libfm.restype = ctypes.POINTER(_CsrBlockResult)
+    lib.dmlc_parse_libfm.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int]
+    lib.dmlc_parse_csv.restype = ctypes.POINTER(_CsvResult)
+    lib.dmlc_parse_csv.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_char]
+    # void* so finalizers never depend on ctypes class identity (which
+    # changes across importlib.reload) — they may fire at interpreter exit
+    lib.dmlc_free_block.argtypes = [ctypes.c_void_p]
+    lib.dmlc_free_csv.argtypes = [ctypes.c_void_p]
+    lib.dmlc_native_abi_version.restype = ctypes.c_int
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def default_nthread() -> int:
+    """min(user, cores/2) in the spirit of text_parser.h:33-34."""
+    env = os.environ.get("DMLC_TPU_PARSE_THREADS")
+    if env:
+        return max(1, int(env))
+    return max(2, (os.cpu_count() or 2) // 2)
+
+
+def _view(ptr, n, dtype):
+    """Zero-copy numpy view over a malloc'd buffer.
+
+    The buffer's lifetime is governed by the _Owner returned alongside the
+    views — every consumer (RowBlock carries it in ``hold``) must keep the
+    owner referenced for as long as the views live.
+    """
+    if not ptr or n == 0:
+        return None
+    arr = np.ctypeslib.as_array(ptr, shape=(n,))
+    return arr.view(dtype) if arr.dtype != dtype else arr
+
+
+class _Owner:
+    """Frees the C result when garbage collected."""
+
+    __slots__ = ("__weakref__",)
+
+    def __init__(self, lib, res, free_fn):
+        weakref.finalize(self, free_fn, lib, ctypes.cast(res, ctypes.c_void_p).value)
+
+
+def _free_block(lib, addr):
+    lib.dmlc_free_block(addr)
+
+
+def _free_csv(lib, addr):
+    lib.dmlc_free_csv(addr)
+
+
+def parse_libsvm(chunk: bytes, nthread: int = 0, indexing_mode: int = 0):
+    """Parse a libsvm chunk natively; returns dict of numpy arrays or None."""
+    lib = _load()
+    if lib is None:
+        return None
+    res = lib.dmlc_parse_libsvm(
+        chunk, len(chunk), nthread or default_nthread(), indexing_mode)
+    return _wrap_block(lib, res)
+
+
+def parse_libfm(chunk: bytes, nthread: int = 0, indexing_mode: int = 0):
+    lib = _load()
+    if lib is None:
+        return None
+    res = lib.dmlc_parse_libfm(
+        chunk, len(chunk), nthread or default_nthread(), indexing_mode)
+    return _wrap_block(lib, res)
+
+
+def _wrap_block(lib, res):
+    r = res.contents
+    if r.error:
+        msg = r.error.decode()
+        lib.dmlc_free_block(res)
+        raise DMLCError(msg)
+    owner = _Owner(lib, res, _free_block)
+    n, nnz = r.n_rows, r.nnz
+    out = {
+        "offset": _view(r.offset, n + 1, np.int64),
+        "label": _view(r.label, n, np.float32),
+        "weight": _view(r.weight, n, np.float32),
+        "qid": _view(r.qid, n, np.int64),
+        "index": _view(r.index, nnz, np.uint64),
+        "field": _view(r.field, nnz, np.uint64),
+        "value": _view(r.value, nnz, np.float32),
+        "_owner": owner,
+    }
+    if n == 0:
+        out["offset"] = np.zeros(1, np.int64)
+        out["label"] = np.empty(0, np.float32)
+    if out["index"] is None:
+        out["index"] = np.empty(0, np.uint64)
+    return out
+
+
+def parse_csv(chunk: bytes, delimiter: str = ",", nthread: int = 0):
+    """Parse a csv chunk natively -> (cells [n, ncol] float32, owner) or None.
+
+    The caller must keep ``owner`` referenced while using ``cells``.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    res = lib.dmlc_parse_csv(
+        chunk, len(chunk), nthread or default_nthread(),
+        delimiter.encode()[0] if delimiter else b","[0])
+    r = res.contents
+    if r.error:
+        msg = r.error.decode()
+        lib.dmlc_free_csv(res)
+        raise DMLCError(msg)
+    owner = _Owner(lib, res, _free_csv)
+    n, c = r.n_rows, r.n_cols
+    if n == 0 or c == 0:
+        return np.zeros((0, 0), np.float32), owner
+    cells = _view(r.cells, n * c, np.float32)
+    return cells.reshape(n, c), owner

@@ -99,10 +99,21 @@ def block_to_dense(
     x = np.zeros((rows_out, num_col), dtype=np.float32)
     if n:
         lens = _row_lengths(block)
-        rows = np.repeat(np.arange(n), lens)
         vals = block.value if block.value is not None else np.ones(len(block.index), np.float32)
-        keep = block.index < num_col
-        x[rows[keep], block.index[keep].astype(np.int64)] = vals[keep]
+        k = int(lens[0]) if n else 0
+        # fast path for dense-in-sparse data (HIGGS/CSV-shaped): every row has
+        # the same k features 0..k-1, so the values are already a dense matrix
+        if (
+            0 < k <= num_col
+            and len(block.index) == n * k
+            and bool((lens == k).all())
+            and bool((block.index.reshape(n, k) == np.arange(k, dtype=block.index.dtype)).all())
+        ):
+            x[:n, :k] = vals.reshape(n, k)
+        else:
+            rows = np.repeat(np.arange(n), lens)
+            keep = block.index < num_col
+            x[rows[keep], block.index[keep].astype(np.int64)] = vals[keep]
     label = np.zeros(rows_out, np.float32)
     label[:n] = block.label
     weight = np.zeros(rows_out, np.float32)

@@ -1,0 +1,494 @@
+// Multi-threaded chunk parsers for libsvm / csv / libfm -> CSR buffers.
+//
+// TPU-native rebuild of the reference parse hot path (src/data/
+// text_parser.h:110-146 chunk-splitting across threads + libsvm_parser.h /
+// csv_parser.h / libfm_parser.h ParseBlock scanners): a chunk of text is
+// split at line boundaries into nthread ranges, each range parsed into
+// per-thread CSR vectors, then the results are merged into one contiguous
+// malloc'd block handed to Python over a C ABI (ctypes — no pybind11 in
+// this image).
+//
+// Semantics intentionally identical to the Python engine in
+// dmlc_tpu/data/parsers.py (which mirrors the reference):
+//   libsvm: label[:weight] [qid:N] idx[:val]... , '#' comments, BOM skip,
+//           indexing_mode {-1,0,1} with the sklearn heuristic per chunk.
+//   csv:    single-char delimiter, dense cells; ragged rows -> error.
+//   libfm:  label field:idx:val triples; heuristic needs BOTH mins > 0.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "strtonum.h"
+
+namespace dmlc_tpu {
+
+struct CsrPart {
+  std::vector<int64_t> row_nnz;
+  std::vector<float> label;
+  std::vector<float> weight;   // empty or per-row
+  std::vector<int64_t> qid;    // empty or per-row
+  std::vector<uint64_t> index;
+  std::vector<uint64_t> field;  // libfm only
+  std::vector<float> value;    // empty (all-binary) or per-entry
+  uint64_t min_index = UINT64_MAX;
+  uint64_t min_field = UINT64_MAX;
+  std::string error;
+};
+
+// Split [begin, end) into n ranges at line boundaries.
+static std::vector<std::pair<const char*, const char*>> split_lines(
+    const char* begin, const char* end, int n) {
+  std::vector<std::pair<const char*, const char*>> out;
+  size_t total = static_cast<size_t>(end - begin);
+  size_t step = total / static_cast<size_t>(n) + 1;
+  const char* cur = begin;
+  for (int i = 0; i < n && cur < end; ++i) {
+    const char* stop = cur + step;
+    if (stop >= end) {
+      stop = end;
+    } else {
+      while (stop < end && *stop != '\n' && *stop != '\r') ++stop;
+      while (stop < end && (*stop == '\n' || *stop == '\r')) ++stop;
+    }
+    out.emplace_back(cur, stop);
+    cur = stop;
+  }
+  if (cur < end && !out.empty()) out.back().second = end;
+  return out;
+}
+
+static inline const char* line_end(const char* p, const char* end) {
+  while (p != end && *p != '\n' && *p != '\r') ++p;
+  return p;
+}
+
+// ---------------- libsvm ----------------
+
+static void parse_libsvm_range(const char* begin, const char* end, CsrPart* out) {
+  const char* p = begin;
+  while (p < end) {
+    const char* lend = line_end(p, end);
+    const char* q = p;
+    // strip comment
+    const char* hash = static_cast<const char*>(memchr(q, '#', lend - q));
+    const char* effective_end = hash ? hash : lend;
+    double label;
+    const char* after;
+    if (!parse_double(q, effective_end, &after, &label)) {
+      p = lend;
+      while (p < end && (*p == '\n' || *p == '\r')) ++p;
+      continue;  // blank/comment-only line
+    }
+    q = after;
+    bool has_weight = false;
+    double weight = 1.0;
+    if (q != effective_end && *q == ':') {
+      ++q;
+      if (!parse_double(q, effective_end, &after, &weight)) {
+        out->error = "libsvm: bad label:weight";
+        return;
+      }
+      q = after;
+      has_weight = true;
+    }
+    out->label.push_back(static_cast<float>(label));
+    if (has_weight) {
+      if (out->weight.size() != out->label.size() - 1) {
+        out->error = "libsvm: label:weight must be set on every row or none";
+        return;
+      }
+      out->weight.push_back(static_cast<float>(weight));
+    } else if (!out->weight.empty()) {
+      out->error = "libsvm: label:weight must be set on every row or none";
+      return;
+    }
+    // qid
+    while (q != effective_end && is_space(*q)) ++q;
+    if (effective_end - q >= 4 && memcmp(q, "qid:", 4) == 0) {
+      uint64_t qid;
+      if (!parse_uint(q + 4, effective_end, &after, &qid)) {
+        out->error = "libsvm: bad qid";
+        return;
+      }
+      if (out->qid.size() != out->label.size() - 1) {
+        out->error = "libsvm: qid must appear on every row or none";
+        return;
+      }
+      out->qid.push_back(static_cast<int64_t>(qid));
+      q = after;
+    } else if (!out->qid.empty()) {
+      out->error = "libsvm: qid must appear on every row or none";
+      return;
+    }
+    // features
+    int64_t nnz = 0;
+    while (true) {
+      uint64_t idx;
+      if (!parse_uint(q, effective_end, &after, &idx)) break;
+      q = after;
+      out->index.push_back(idx);
+      if (idx < out->min_index) out->min_index = idx;
+      ++nnz;
+      if (q != effective_end && *q == ':') {
+        double v;
+        ++q;
+        if (!parse_double(q, effective_end, &after, &v)) {
+          out->error = "libsvm: bad idx:value";
+          return;
+        }
+        q = after;
+        // lazily promote to valued mode: backfill 1.0 for prior binary entries
+        if (out->value.size() + 1 < out->index.size()) {
+          out->value.resize(out->index.size() - 1, 1.0f);
+        }
+        out->value.push_back(static_cast<float>(v));
+      } else if (!out->value.empty()) {
+        out->value.push_back(1.0f);
+      }
+    }
+    // anything left that is not whitespace is malformed — error rather than
+    // silently truncating the row (the fallback engine errors too)
+    while (q != effective_end && is_space(*q)) ++q;
+    if (q != effective_end) {
+      out->error = "libsvm: malformed feature token";
+      return;
+    }
+    out->row_nnz.push_back(nnz);
+    p = lend;
+    while (p < end && (*p == '\n' || *p == '\r')) ++p;
+  }
+  // if any entry anywhere had a value, sizes must match
+  if (!out->value.empty() && out->value.size() != out->index.size()) {
+    out->value.resize(out->index.size(), 1.0f);
+  }
+}
+
+// ---------------- libfm ----------------
+
+static void parse_libfm_range(const char* begin, const char* end, CsrPart* out) {
+  const char* p = begin;
+  while (p < end) {
+    const char* lend = line_end(p, end);
+    const char* q = p;
+    const char* hash = static_cast<const char*>(memchr(q, '#', lend - q));
+    const char* effective_end = hash ? hash : lend;
+    double label;
+    const char* after;
+    if (!parse_double(q, effective_end, &after, &label)) {
+      p = lend;
+      while (p < end && (*p == '\n' || *p == '\r')) ++p;
+      continue;
+    }
+    q = after;
+    out->label.push_back(static_cast<float>(label));
+    int64_t nnz = 0;
+    while (true) {
+      uint64_t fld, idx;
+      double v;
+      if (!parse_uint(q, effective_end, &after, &fld)) break;
+      q = after;
+      if (q == effective_end || *q != ':' ||
+          !parse_uint(q + 1, effective_end, &after, &idx)) {
+        out->error = "libfm: features must be field:index:value triples";
+        return;
+      }
+      q = after;
+      if (q == effective_end || *q != ':' ||
+          !parse_double(q + 1, effective_end, &after, &v)) {
+        out->error = "libfm: features must be field:index:value triples";
+        return;
+      }
+      q = after;
+      out->field.push_back(fld);
+      out->index.push_back(idx);
+      out->value.push_back(static_cast<float>(v));
+      if (idx < out->min_index) out->min_index = idx;
+      if (fld < out->min_field) out->min_field = fld;
+      ++nnz;
+    }
+    while (q != effective_end && is_space(*q)) ++q;
+    if (q != effective_end) {
+      out->error = "libfm: malformed feature token";
+      return;
+    }
+    out->row_nnz.push_back(nnz);
+    p = lend;
+    while (p < end && (*p == '\n' || *p == '\r')) ++p;
+  }
+}
+
+// ---------------- csv ----------------
+
+struct CsvPart {
+  std::vector<float> cells;
+  int64_t ncol = -1;
+  int64_t nrow = 0;
+  std::string error;
+};
+
+static void parse_csv_range(const char* begin, const char* end, char delim,
+                            CsvPart* out) {
+  const char* p = begin;
+  while (p < end) {
+    const char* lend = line_end(p, end);
+    if (lend == p) {
+      ++p;
+      continue;
+    }
+    int64_t cols = 0;
+    const char* q = p;
+    while (true) {
+      // leading space that is not itself the delimiter (tab can be one)
+      while (q != lend && is_space(*q) && *q != delim) ++q;
+      double v = 0.0;
+      const char* after;
+      if (q == lend || *q == delim) {
+        out->error = "csv: empty cell in row";
+        return;
+      }
+      if (!parse_double(q, lend, &after, &v)) {
+        out->error = "csv: unparseable cell in row";
+        return;
+      }
+      q = after;
+      out->cells.push_back(static_cast<float>(v));
+      ++cols;
+      while (q != lend && is_space(*q) && *q != delim) ++q;
+      if (q == lend) break;
+      if (*q == delim) { ++q; continue; }
+      out->error = "csv: unexpected character in row";
+      return;
+    }
+    if (out->ncol < 0) {
+      out->ncol = cols;
+    } else if (cols != out->ncol) {
+      out->error = "csv: ragged rows in chunk";
+      return;
+    }
+    ++out->nrow;
+    p = lend;
+    while (p < end && (*p == '\n' || *p == '\r')) ++p;
+  }
+}
+
+}  // namespace dmlc_tpu
+
+// ---------------- C ABI ----------------
+
+using namespace dmlc_tpu;
+
+extern "C" {
+
+// One parsed CSR block. Arrays are malloc'd; free with dmlc_free_block.
+struct CsrBlockResult {
+  int64_t n_rows;
+  int64_t nnz;
+  int64_t* offset;    // [n_rows + 1]
+  float* label;       // [n_rows]
+  float* weight;      // [n_rows] or null
+  int64_t* qid;       // [n_rows] or null
+  uint64_t* index;    // [nnz]
+  uint64_t* field;    // [nnz] or null (libfm)
+  float* value;       // [nnz] or null (all-binary)
+  char* error;        // null on success
+};
+
+static char* dup_error(const std::string& s) {
+  char* e = static_cast<char*>(malloc(s.size() + 1));
+  memcpy(e, s.c_str(), s.size() + 1);
+  return e;
+}
+
+static CsrBlockResult* merge_parts(std::vector<CsrPart>& parts, int indexing_mode,
+                                   bool heuristic_needs_field) {
+  auto* res = static_cast<CsrBlockResult*>(calloc(1, sizeof(CsrBlockResult)));
+  for (auto& part : parts) {
+    if (!part.error.empty()) {
+      res->error = dup_error(part.error);
+      return res;
+    }
+  }
+  int64_t n = 0, nnz = 0;
+  bool any_weight = false, any_qid = false, any_value = false, any_field = false;
+  uint64_t min_index = UINT64_MAX, min_field = UINT64_MAX;
+  for (auto& part : parts) {
+    n += static_cast<int64_t>(part.label.size());
+    nnz += static_cast<int64_t>(part.index.size());
+    any_weight |= !part.weight.empty();
+    any_qid |= !part.qid.empty();
+    any_value |= !part.value.empty();
+    any_field |= !part.field.empty();
+    if (part.min_index < min_index) min_index = part.min_index;
+    if (part.min_field < min_field) min_field = part.min_field;
+  }
+  // all-or-none consistency across thread ranges
+  for (auto& part : parts) {
+    if (!part.label.empty()) {
+      if (any_weight && part.weight.size() != part.label.size()) {
+        res->error = dup_error("libsvm: label:weight must be set on every row or none");
+        return res;
+      }
+      if (any_qid && part.qid.size() != part.label.size()) {
+        res->error = dup_error("libsvm: qid must appear on every row or none");
+        return res;
+      }
+    }
+    if (any_value && !part.index.empty() && part.value.empty()) {
+      part.value.resize(part.index.size(), 1.0f);
+    }
+  }
+  res->n_rows = n;
+  res->nnz = nnz;
+  res->offset = static_cast<int64_t*>(malloc((n + 1) * sizeof(int64_t)));
+  res->label = static_cast<float*>(malloc(n * sizeof(float)));
+  if (any_weight) res->weight = static_cast<float*>(malloc(n * sizeof(float)));
+  if (any_qid) res->qid = static_cast<int64_t*>(malloc(n * sizeof(int64_t)));
+  res->index = static_cast<uint64_t*>(malloc(nnz * sizeof(uint64_t)));
+  if (any_field) res->field = static_cast<uint64_t*>(malloc(nnz * sizeof(uint64_t)));
+  if (any_value) res->value = static_cast<float*>(malloc(nnz * sizeof(float)));
+  int64_t row = 0, ent = 0;
+  res->offset[0] = 0;
+  for (auto& part : parts) {
+    size_t pn = part.label.size();
+    if (pn) {
+      memcpy(res->label + row, part.label.data(), pn * sizeof(float));
+      if (any_weight) memcpy(res->weight + row, part.weight.data(), pn * sizeof(float));
+      if (any_qid) memcpy(res->qid + row, part.qid.data(), pn * sizeof(int64_t));
+      for (size_t i = 0; i < pn; ++i) {
+        res->offset[row + 1 + static_cast<int64_t>(i)] =
+            res->offset[row + static_cast<int64_t>(i)] + part.row_nnz[i];
+      }
+      row += static_cast<int64_t>(pn);
+    }
+    size_t pe = part.index.size();
+    if (pe) {
+      memcpy(res->index + ent, part.index.data(), pe * sizeof(uint64_t));
+      if (any_field) memcpy(res->field + ent, part.field.data(), pe * sizeof(uint64_t));
+      if (any_value) memcpy(res->value + ent, part.value.data(), pe * sizeof(float));
+      ent += static_cast<int64_t>(pe);
+    }
+  }
+  // indexing mode conversion (libsvm_parser.h:159-168 / libfm_parser.h:130-143)
+  bool convert = indexing_mode > 0;
+  if (indexing_mode < 0 && nnz > 0 && min_index > 0) {
+    convert = !heuristic_needs_field || min_field > 0;
+  }
+  if (convert) {
+    for (int64_t i = 0; i < nnz; ++i) res->index[i] -= 1;
+    if (res->field && heuristic_needs_field) {
+      for (int64_t i = 0; i < nnz; ++i) res->field[i] -= 1;
+    }
+  }
+  return res;
+}
+
+static const char* skip_bom(const char* data, const char** end) {
+  if (*end - data >= 3 && memcmp(data, "\xef\xbb\xbf", 3) == 0) return data + 3;
+  return data;
+}
+
+CsrBlockResult* dmlc_parse_libsvm(const char* data, int64_t len, int nthread,
+                                  int indexing_mode) {
+  const char* end = data + len;
+  data = skip_bom(data, &end);
+  if (nthread < 1) nthread = 1;
+  auto ranges = split_lines(data, end, nthread);
+  std::vector<CsrPart> parts(ranges.size());
+  std::vector<std::thread> threads;
+  for (size_t i = 1; i < ranges.size(); ++i) {
+    threads.emplace_back(parse_libsvm_range, ranges[i].first, ranges[i].second,
+                         &parts[i]);
+  }
+  if (!ranges.empty()) parse_libsvm_range(ranges[0].first, ranges[0].second, &parts[0]);
+  for (auto& t : threads) t.join();
+  return merge_parts(parts, indexing_mode, false);
+}
+
+CsrBlockResult* dmlc_parse_libfm(const char* data, int64_t len, int nthread,
+                                 int indexing_mode) {
+  const char* end = data + len;
+  data = skip_bom(data, &end);
+  if (nthread < 1) nthread = 1;
+  auto ranges = split_lines(data, end, nthread);
+  std::vector<CsrPart> parts(ranges.size());
+  std::vector<std::thread> threads;
+  for (size_t i = 1; i < ranges.size(); ++i) {
+    threads.emplace_back(parse_libfm_range, ranges[i].first, ranges[i].second,
+                         &parts[i]);
+  }
+  if (!ranges.empty()) parse_libfm_range(ranges[0].first, ranges[0].second, &parts[0]);
+  for (auto& t : threads) t.join();
+  return merge_parts(parts, indexing_mode, true);
+}
+
+// Dense CSV result: cells laid out row-major [n_rows, n_cols].
+struct CsvResult {
+  int64_t n_rows;
+  int64_t n_cols;
+  float* cells;
+  char* error;
+};
+
+CsvResult* dmlc_parse_csv(const char* data, int64_t len, int nthread, char delim) {
+  const char* end = data + len;
+  data = skip_bom(data, &end);
+  if (nthread < 1) nthread = 1;
+  auto ranges = split_lines(data, end, nthread);
+  std::vector<CsvPart> parts(ranges.size());
+  std::vector<std::thread> threads;
+  for (size_t i = 1; i < ranges.size(); ++i) {
+    threads.emplace_back(parse_csv_range, ranges[i].first, ranges[i].second,
+                         delim, &parts[i]);
+  }
+  if (!ranges.empty())
+    parse_csv_range(ranges[0].first, ranges[0].second, delim, &parts[0]);
+  for (auto& t : threads) t.join();
+  auto* res = static_cast<CsvResult*>(calloc(1, sizeof(CsvResult)));
+  int64_t ncol = -1, nrow = 0, ncell = 0;
+  for (auto& part : parts) {
+    if (!part.error.empty()) {
+      res->error = dup_error(part.error);
+      return res;
+    }
+    if (part.nrow == 0) continue;
+    if (ncol < 0) ncol = part.ncol;
+    if (part.ncol != ncol) {
+      res->error = dup_error("csv: ragged rows in chunk");
+      return res;
+    }
+    nrow += part.nrow;
+    ncell += static_cast<int64_t>(part.cells.size());
+  }
+  res->n_rows = nrow;
+  res->n_cols = ncol < 0 ? 0 : ncol;
+  res->cells = static_cast<float*>(malloc(ncell * sizeof(float)));
+  int64_t at = 0;
+  for (auto& part : parts) {
+    if (part.cells.empty()) continue;
+    memcpy(res->cells + at, part.cells.data(), part.cells.size() * sizeof(float));
+    at += static_cast<int64_t>(part.cells.size());
+  }
+  return res;
+}
+
+void dmlc_free_block(CsrBlockResult* r) {
+  if (!r) return;
+  free(r->offset); free(r->label); free(r->weight); free(r->qid);
+  free(r->index); free(r->field); free(r->value); free(r->error);
+  free(r);
+}
+
+void dmlc_free_csv(CsvResult* r) {
+  if (!r) return;
+  free(r->cells); free(r->error);
+  free(r);
+}
+
+int dmlc_native_abi_version() { return 1; }
+
+}  // extern "C"

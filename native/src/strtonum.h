@@ -1,0 +1,103 @@
+// Fast branch-light number parsing for the parser hot loops.
+//
+// TPU-native rebuild of the role of reference include/dmlc/strtonum.h
+// (strtof/strtod/ParsePair/ParseTriple, strtonum.h:99-304): written from
+// scratch — parse sign/digits/fraction/exponent with integer accumulation
+// and a power table, falling back to libc strtod for long mantissas where
+// float error could accumulate.
+#ifndef DMLC_TPU_NATIVE_STRTONUM_H_
+#define DMLC_TPU_NATIVE_STRTONUM_H_
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace dmlc_tpu {
+
+inline bool is_space(char c) { return c == ' ' || c == '\t'; }
+inline bool is_digit(char c) { return c >= '0' && c <= '9'; }
+
+// 10^k for k in [0, 22] exactly representable in double
+static const double kPow10[] = {
+    1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,  1e8,  1e9,  1e10,
+    1e11, 1e12, 1e13, 1e14, 1e15, 1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
+
+// Parse a double from [p, end); advances *out to one past the number.
+// Returns false if no number present.
+inline bool parse_double(const char* p, const char* end, const char** out,
+                         double* value) {
+  while (p != end && is_space(*p)) ++p;
+  if (p == end) return false;
+  bool neg = false;
+  if (*p == '-') { neg = true; ++p; }
+  else if (*p == '+') { ++p; }
+  const char* digits_begin = p;
+  uint64_t mant = 0;
+  int ndig = 0;
+  while (p != end && is_digit(*p)) {
+    if (ndig < 19) { mant = mant * 10 + (*p - '0'); ++ndig; }
+    ++p;
+  }
+  int int_digits_dropped = static_cast<int>(p - digits_begin) - ndig;
+  int frac = 0;
+  if (p != end && *p == '.') {
+    ++p;
+    while (p != end && is_digit(*p)) {
+      if (ndig < 19) { mant = mant * 10 + (*p - '0'); ++ndig; ++frac; }
+      ++p;
+    }
+  }
+  if (p == digits_begin || (frac == 0 && p == digits_begin + 1 && *digits_begin == '.')) {
+    // no digits at all (handles inf/nan via fallback below)
+    char* e = nullptr;
+    double v = strtod(digits_begin - (neg ? 1 : 0), &e);
+    if (e == digits_begin - (neg ? 1 : 0)) return false;
+    *value = v;
+    *out = e;
+    return true;
+  }
+  int exp10 = int_digits_dropped - frac;
+  if (p != end && (*p == 'e' || *p == 'E')) {
+    ++p;
+    bool eneg = false;
+    if (p != end && (*p == '-' || *p == '+')) { eneg = (*p == '-'); ++p; }
+    int ev = 0;
+    while (p != end && is_digit(*p)) { ev = ev * 10 + (*p - '0'); ++p; }
+    exp10 += eneg ? -ev : ev;
+  }
+  double v;
+  if (exp10 >= 0 && exp10 <= 22) {
+    v = static_cast<double>(mant) * kPow10[exp10];
+  } else if (exp10 < 0 && exp10 >= -22) {
+    v = static_cast<double>(mant) / kPow10[-exp10];
+  } else {
+    // rare: huge/tiny exponent — libc handles subnormals correctly
+    char buf[64];
+    size_t n = static_cast<size_t>(p - (digits_begin - (neg ? 1 : 0)));
+    if (n >= sizeof(buf)) n = sizeof(buf) - 1;
+    memcpy(buf, digits_begin - (neg ? 1 : 0), n);
+    buf[n] = '\0';
+    v = strtod(buf, nullptr);
+    *value = v;
+    *out = p;
+    return true;
+  }
+  *value = neg ? -v : v;
+  *out = p;
+  return true;
+}
+
+// Parse an unsigned integer; returns false if no digits.
+inline bool parse_uint(const char* p, const char* end, const char** out,
+                       uint64_t* value) {
+  while (p != end && is_space(*p)) ++p;
+  if (p == end || !is_digit(*p)) return false;
+  uint64_t v = 0;
+  while (p != end && is_digit(*p)) { v = v * 10 + (*p - '0'); ++p; }
+  *value = v;
+  *out = p;
+  return true;
+}
+
+}  // namespace dmlc_tpu
+#endif  // DMLC_TPU_NATIVE_STRTONUM_H_

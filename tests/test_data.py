@@ -280,3 +280,176 @@ def test_create_row_block_iter_cache_uri(tmp_path):
     it2 = create_row_block_iter(uri, 0, 1, "libsvm", silent=True)
     assert sum(len(b) for b in it2) == 2
     it2.close()
+
+
+# ---------------- native core parity ----------------
+
+native_mod = pytest.importorskip("dmlc_tpu.native")
+needs_native = pytest.mark.skipif(
+    not native_mod.available(), reason="native core unavailable")
+
+
+def _both_engines(parser, chunk):
+    got_native = parser.parse_chunk_native(chunk)
+    got_py = parser.parse_chunk_py(chunk)
+    assert got_native is not None
+    return got_native, got_py
+
+
+def _assert_blocks_equal(a, b):
+    np.testing.assert_array_equal(a.offset, b.offset)
+    np.testing.assert_allclose(a.label, b.label, rtol=1e-6)
+    np.testing.assert_array_equal(a.index, b.index)
+    for name in ("value", "weight"):
+        av, bv = getattr(a, name), getattr(b, name)
+        if av is None or bv is None:
+            # engines may differ on all-binary representation; normalize
+            nnz = a.num_nonzero if name == "value" else len(a)
+            av = av if av is not None else np.ones(nnz, np.float32)
+            bv = bv if bv is not None else np.ones(nnz, np.float32)
+        np.testing.assert_allclose(av, bv, rtol=1e-5)
+    if a.qid is not None or b.qid is not None:
+        np.testing.assert_array_equal(a.qid, b.qid)
+    if a.field is not None or b.field is not None:
+        np.testing.assert_array_equal(a.field, b.field)
+
+
+@needs_native
+@pytest.mark.parametrize("text,mode", [
+    (LIBSVM_TEXT, 0),
+    (b"1:2.0 qid:3 0:1.5 # comment\n# full comment\n0:0.5 qid:4 2:2.5 5:1\n", 0),
+    (b"1 3 5 7\n0 2\n", 0),
+    (b"\xef\xbb\xbf1 1:1.0 4:2.0\n0 2:3.0\n", -1),
+    (b"1 1:1.0 4:2.0\n0 2:3.0\n", 1),
+    (b"-1.5e-2 0:1e3 7:-2.5E-4\n1 0:0.125\n", 0),
+    (b"1 0:1\r\n0 1:2\r\n\r\n1 2:3\n", 0),
+])
+def test_native_libsvm_parity(text, mode):
+    from dmlc_tpu.data.parsers import LibSVMParser
+
+    p = LibSVMParser.__new__(LibSVMParser)
+    from dmlc_tpu.data.parsers import LibSVMParserParam
+    p.param = LibSVMParserParam(indexing_mode=mode)
+    p.index_dtype = np.uint64
+    a, b = _both_engines(p, text)
+    _assert_blocks_equal(a, b)
+
+
+@needs_native
+def test_native_libsvm_random_parity():
+    rng = np.random.default_rng(3)
+    lines = []
+    for i in range(500):
+        nnz = rng.integers(0, 30)
+        idx = np.sort(rng.choice(1000, size=nnz, replace=False))
+        feats = " ".join(f"{j}:{rng.normal():.6g}" for j in idx)
+        lines.append(f"{rng.normal():.4f} {feats}")
+    text = ("\n".join(lines) + "\n").encode()
+    from dmlc_tpu.data.parsers import LibSVMParser, LibSVMParserParam
+
+    p = LibSVMParser.__new__(LibSVMParser)
+    p.param = LibSVMParserParam()
+    p.index_dtype = np.uint64
+    a, b = _both_engines(p, text)
+    _assert_blocks_equal(a, b)
+
+
+@needs_native
+def test_native_csv_parity():
+    from dmlc_tpu.data.parsers import CSVParser, CSVParserParam
+
+    p = CSVParser.__new__(CSVParser)
+    p.param = CSVParserParam(label_column=0, weight_column=3, delimiter=";")
+    p.index_dtype = np.uint64
+    p._dtype = np.dtype("float32")
+    text = b"7;1.5;2.5;0.9\n3;4.5;5.5;0.1\n-1;0;2e2;1\n"
+    a, b = _both_engines(p, text)
+    _assert_blocks_equal(a, b)
+
+
+@needs_native
+def test_native_libfm_parity():
+    from dmlc_tpu.data.parsers import LibFMParser, LibFMParserParam
+
+    p = LibFMParser.__new__(LibFMParser)
+    p.param = LibFMParserParam(indexing_mode=-1)
+    p.index_dtype = np.uint64
+    text = b"1 1:3:1.5 2:7:2.5\n0 1:2:0.5\n"
+    a, b = _both_engines(p, text)
+    _assert_blocks_equal(a, b)
+
+
+@needs_native
+def test_native_error_paths():
+    from dmlc_tpu.data.parsers import LibFMParser, LibFMParserParam
+    from dmlc_tpu import native
+
+    with pytest.raises(DMLCError, match="triples"):
+        native.parse_libfm(b"1 3:1.5\n")
+    with pytest.raises(DMLCError, match="qid"):
+        native.parse_libsvm(b"1 qid:2 0:1\n0 1:1\n")
+
+
+@needs_native
+def test_native_buffer_ownership_survives_gc():
+    import gc
+    from dmlc_tpu import native
+
+    d = native.parse_libsvm(b"1 0:1.5 3:2.5\n0 2:0.5\n")
+    blk = RowBlock(offset=d["offset"], label=d["label"], index=d["index"],
+                   value=d["value"], hold=d["_owner"])
+    del d
+    gc.collect()
+    # views must still be valid: the block holds the owner
+    assert blk.num_nonzero == 3
+    np.testing.assert_allclose(blk.value, [1.5, 2.5, 0.5])
+    sl = blk.slice(1, 2)
+    del blk
+    gc.collect()
+    np.testing.assert_allclose(sl.value, [0.5])
+
+
+@needs_native
+def test_native_container_holds_buffers_alive():
+    import gc
+    from dmlc_tpu import native
+
+    c = RowBlockContainer()
+    for _ in range(30):
+        d = native.parse_libsvm(b"1 0:1.5 3:2.5\n0 2:0.5\n" * 20)
+        blk = RowBlock(offset=d["offset"], label=d["label"], index=d["index"],
+                       value=d["value"], hold=d["_owner"])
+        c.push_block(blk)
+        del d, blk
+    gc.collect()
+    merged = c.to_block()
+    assert len(merged) == 30 * 40
+    assert abs(float(merged.value.sum()) - 30 * 20 * 4.5) < 1e-3
+
+
+@needs_native
+def test_native_csv_tab_delimiter_and_bad_cells():
+    from dmlc_tpu import native
+
+    cells, _owner = native.parse_csv(b"1\t2.5\t3\n4\t5\t6\n", delimiter="\t")
+    np.testing.assert_allclose(cells, [[1, 2.5, 3], [4, 5, 6]])
+    with pytest.raises(DMLCError, match="empty cell"):
+        native.parse_csv(b"1,,2\n", delimiter=",")
+    with pytest.raises(DMLCError, match="unparseable|unexpected"):
+        native.parse_csv(b"1,abc,2\n", delimiter=",")
+
+
+@needs_native
+def test_both_engines_reject_malformed_features():
+    from dmlc_tpu.data.parsers import LibSVMParser, LibSVMParserParam
+    from dmlc_tpu import native
+
+    with pytest.raises(DMLCError, match="malformed"):
+        native.parse_libsvm(b"1 0:1 foo 2:3\n")
+    p = LibSVMParser.__new__(LibSVMParser)
+    p.param = LibSVMParserParam()
+    p.index_dtype = np.uint64
+    p._native = False
+    p._bytes = 0
+    with pytest.raises(DMLCError, match="malformed"):
+        p.parse_chunk(b"1 0:1 foo 2:3\n")
