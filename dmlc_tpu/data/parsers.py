@@ -158,8 +158,12 @@ def _chunk_bytes(chunk) -> bytes:
     """Chunk -> bytes without copying when it is a full-span view of bytes."""
     if isinstance(chunk, bytes):
         return chunk
-    if isinstance(chunk, memoryview) and isinstance(chunk.obj, bytes) \
-            and len(chunk) == len(chunk.obj):
+    if (
+        isinstance(chunk, memoryview)
+        and isinstance(chunk.obj, bytes)
+        and chunk.c_contiguous
+        and len(chunk) == len(chunk.obj)
+    ):
         return chunk.obj
     return bytes(chunk)
 

@@ -58,9 +58,11 @@ class _CsvResult(ctypes.Structure):
 
 def _build() -> bool:
     os.makedirs(_BUILD_DIR, exist_ok=True)
+    # no -march=native: the artifact may outlive the build host (shared FS,
+    # copied checkouts) and ISA-specific code would SIGILL with no fallback
     cmd = [
         "g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
-        "-march=native", "-o", _SO_PATH, _SRC,
+        "-o", _SO_PATH, _SRC,
     ]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
@@ -111,14 +113,19 @@ def _load() -> Optional[ctypes.CDLL]:
         _declare(lib)
         if lib.dmlc_native_abi_version() != _ABI_VERSION:
             get_logger().warning("native ABI mismatch; rebuilding")
-            os.unlink(_SO_PATH)
-            if not _build():
-                _build_failed = True
-                return None
-            lib = ctypes.CDLL(_SO_PATH)
-            _declare(lib)
-            if lib.dmlc_native_abi_version() != _ABI_VERSION:
-                get_logger().warning("native ABI still mismatched after rebuild")
+            try:
+                os.unlink(_SO_PATH)
+                if not _build():
+                    _build_failed = True
+                    return None
+                lib = ctypes.CDLL(_SO_PATH)
+                _declare(lib)
+                if lib.dmlc_native_abi_version() != _ABI_VERSION:
+                    get_logger().warning("native ABI still mismatched after rebuild")
+                    _build_failed = True
+                    return None
+            except OSError as exc:
+                get_logger().warning("native ABI rebuild failed: %s", exc)
                 _build_failed = True
                 return None
         _lib = lib

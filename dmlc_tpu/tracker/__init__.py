@@ -1,0 +1,13 @@
+"""Distributed launch + rank coordination (reference layer 7).
+
+A wire-compatible rebuild of the reference rabit tracker
+(tracker/dmlc_tracker/tracker.py): TCP rendezvous, rank assignment with
+allreduce tree + ring topology computation, peer brokering, recovery — plus
+the ``dmlc-submit`` launcher backends, extended with a ``tpu-pod`` backend
+that wires the same env contract into ``jax.distributed``.
+"""
+
+from dmlc_tpu.tracker.tracker import RabitTracker, PSTracker, submit
+from dmlc_tpu.tracker.client import WorkerClient
+
+__all__ = ["RabitTracker", "PSTracker", "submit", "WorkerClient"]

@@ -1,0 +1,138 @@
+"""Worker-side client for the rabit tracker protocol.
+
+The reference ships no Python client (workers are C++ rabit binaries); this
+client speaks the same wire protocol (tracker.py:58-136) so that
+
+- the tracker gets real in-process integration tests (the reference has
+  none — SURVEY.md §4 gap),
+- ``tpu-pod`` workers can fetch a stable rank assignment from the tracker
+  before handing coordination to ``jax.distributed``.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+from dmlc_tpu.tracker.tracker import MAGIC, Conn
+
+
+class Assignment(NamedTuple):
+    rank: int
+    parent: int
+    world_size: int
+    tree_neighbors: List[int]
+    ring_prev: int
+    ring_next: int
+    connected_peers: List[Tuple[str, int, int]]  # (host, port, rank) we dialed
+    num_incoming: int                            # peers that will dial us
+
+
+class WorkerClient:
+    """One worker's view of the tracker."""
+
+    def __init__(self, tracker_uri: str, tracker_port: int, jobid: str = "NULL"):
+        self.tracker_uri = tracker_uri
+        self.tracker_port = tracker_port
+        self.jobid = jobid
+        self.rank = -1
+        self._listen_sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._peer_socks: List[socket.socket] = []
+
+    # ---------------- protocol ----------------
+
+    def _hello(self, cmd: str, rank: int, world_size: int) -> Conn:
+        sock = socket.create_connection(
+            (self.tracker_uri, self.tracker_port), timeout=30)
+        conn = Conn(sock)
+        conn.send_int(MAGIC)
+        magic = conn.recv_int()
+        if magic != MAGIC:
+            raise ConnectionError(f"tracker: bad magic {magic:#x}")
+        conn.send_int(rank)
+        conn.send_int(world_size)
+        conn.send_str(self.jobid)
+        conn.send_str(cmd)
+        return conn
+
+    def _listen(self) -> int:
+        self._listen_sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listen_sock.bind(("", 0))
+        self._listen_sock.listen(16)
+        return self._listen_sock.getsockname()[1]
+
+    def _accept_incoming(self, count: int) -> None:
+        for _ in range(count):
+            try:
+                peer, _ = self._listen_sock.accept()
+                self._peer_socks.append(peer)
+            except OSError:
+                return
+
+    def start(self, world_size: int = -1, rank: int = -1,
+              cmd: str = "start") -> Assignment:
+        """Join the job; blocks until the tracker assigns a rank and all
+        outgoing peer links are dialed (tracker.py:81-136 client side)."""
+        port = self._listen() if self._listen_sock is None else \
+            self._listen_sock.getsockname()[1]
+        conn = self._hello(cmd, rank, world_size)
+        self.rank = conn.recv_int()
+        parent = conn.recv_int()
+        world = conn.recv_int()
+        num_nn = conn.recv_int()
+        neighbors = [conn.recv_int() for _ in range(num_nn)]
+        rprev = conn.recv_int()
+        rnext = conn.recv_int()
+        # brokering loop: we have nothing connected yet
+        conn.send_int(0)
+        nconn = conn.recv_int()
+        nwait = conn.recv_int()
+        peers: List[Tuple[str, int, int]] = []
+        for _ in range(nconn):
+            host = conn.recv_str()
+            pport = conn.recv_int()
+            prank = conn.recv_int()
+            peers.append((host, pport, prank))
+        for host, pport, _prank in peers:
+            self._peer_socks.append(
+                socket.create_connection((host, pport), timeout=30))
+        conn.send_int(0)  # no errors
+        conn.send_int(port)
+        conn.close()
+        if nwait > 0:
+            self._accept_thread = threading.Thread(
+                target=self._accept_incoming, args=(nwait,), daemon=True)
+            self._accept_thread.start()
+        return Assignment(self.rank, parent, world, neighbors, rprev, rnext,
+                          peers, nwait)
+
+    def recover(self, rank: int) -> Assignment:
+        """Rejoin after failure keeping the prior rank (tracker.py:288-301)."""
+        return self.start(world_size=-1, rank=rank, cmd="recover")
+
+    def print_to_tracker(self, message: str) -> None:
+        conn = self._hello("print", -1, -1)
+        conn.send_str(message)
+        conn.close()
+
+    def shutdown(self) -> None:
+        assert self.rank >= 0, "shutdown before rank assignment"
+        conn = self._hello("shutdown", self.rank, -1)
+        conn.close()
+        self.close()
+
+    def close(self) -> None:
+        for s in self._peer_socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._peer_socks = []
+        if self._listen_sock is not None:
+            try:
+                self._listen_sock.close()
+            except OSError:
+                pass
+            self._listen_sock = None

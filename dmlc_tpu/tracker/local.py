@@ -1,0 +1,71 @@
+"""Local multiprocess backend — analog of tracker/dmlc_tracker/local.py.
+
+Spawns worker/server subprocesses on this machine with the DMLC_* env
+contract; failed workers retry up to DMLC_NUM_ATTEMPT times
+(local.py:12-49).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+from typing import Dict, List
+
+from dmlc_tpu.utils.check import get_logger
+
+
+def exec_cmd(cmd: List[str], role: str, taskid: int, pass_env: Dict[str, str],
+             num_attempt: int = 1) -> None:
+    env = os.environ.copy()
+    env.update(pass_env)
+    env["DMLC_TASK_ID"] = str(taskid)
+    env["DMLC_ROLE"] = role
+    env["DMLC_JOB_CLUSTER"] = "local"
+    ntrial = 0
+    while True:
+        returncode = subprocess.call(cmd, env=env)
+        if returncode == 0:
+            return
+        ntrial += 1
+        if ntrial >= num_attempt:
+            raise RuntimeError(
+                f"local worker {role}:{taskid} failed with code {returncode} "
+                f"after {ntrial} attempt(s)")
+        env["DMLC_NUM_ATTEMPT"] = str(ntrial)
+        get_logger().warning(
+            "local worker %s:%d failed (code %d), retry %d/%d",
+            role, taskid, returncode, ntrial, num_attempt)
+
+
+def submit(args):
+    """Backend entry: returns the fun_submit callback for tracker.submit."""
+
+    def run(nworker: int, nserver: int, envs: Dict[str, str]):
+        pass_env = dict(envs)
+        pass_env.update(args.pass_envs)
+        threads = []
+        errors: List[BaseException] = []
+
+        def guarded(role: str, i: int) -> None:
+            try:
+                exec_cmd(args.command, role, i, pass_env, args.local_num_attempt)
+            except BaseException as exc:  # noqa: BLE001 - reported to launcher
+                errors.append(exc)
+
+        for i in range(nworker):
+            t = threading.Thread(target=guarded, args=("worker", i))
+            t.daemon = True
+            t.start()
+            threads.append(t)
+        for i in range(nserver):
+            t = threading.Thread(target=guarded, args=("server", i))
+            t.daemon = True
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        if errors:
+            raise RuntimeError(f"local job failed: {errors[0]}")
+
+    return run

@@ -1,0 +1,82 @@
+"""tpu-pod backend: the TPU-native launcher (BASELINE.json north star).
+
+The reference's YARN/MPI backends place processes and let rabit broker
+ranks over sockets. On a TPU pod slice the placement is per-host
+(one process per TPU-VM worker) and rank brokering is
+``jax.distributed.initialize`` — so this backend:
+
+1. starts the rabit tracker (rank-stable coordination + the env contract),
+2. launches one process per pod host — over ssh when a ``--host-file``
+   lists the TPU-VM workers, or locally (multi-process simulation /
+   single-host v5e) otherwise,
+3. exports ``DMLC_TRACKER_URI/PORT``, ``DMLC_NUM_WORKER``,
+   ``DMLC_TASK_ID``; workers call
+   :func:`dmlc_tpu.parallel.init_from_env`, which maps that contract onto
+   the JAX coordinator (coordinator = tracker host, port + 1), and their
+   InputSplit shard index is their process index (SURVEY.md §2.3 row 1).
+
+The job's data plane is XLA collectives over ICI — no peer sockets to
+broker, which is why this backend needs nothing beyond placement + env.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+from typing import Dict, List
+
+from dmlc_tpu.tracker.opts import read_host_file
+from dmlc_tpu.tracker.ssh import build_remote_command, build_ssh_argv, parse_host
+from dmlc_tpu.utils.check import get_logger
+
+
+def worker_env(envs: Dict[str, str], task_id: int) -> Dict[str, str]:
+    env = dict(envs)
+    env["DMLC_ROLE"] = "worker"
+    env["DMLC_TASK_ID"] = str(task_id)
+    env["DMLC_JOB_CLUSTER"] = "tpu-pod"
+    # jax.distributed.initialize args are derived from DMLC_TRACKER_URI/PORT
+    # by dmlc_tpu.parallel.init_from_env; nothing else to export.
+    return env
+
+
+def submit(args):
+    hosts: List[str] = []
+    if args.host_file:
+        hosts = read_host_file(args.host_file)
+
+    def run(nworker: int, nserver: int, envs: Dict[str, str]):
+        assert nserver == 0, "tpu-pod jobs are allreduce-style (no PS role)"
+        threads = []
+        base = dict(envs)
+        base.update(args.pass_envs)
+        if hosts:
+            assert len(hosts) >= nworker, (
+                f"tpu-pod: host file lists {len(hosts)} hosts < {nworker} workers")
+            for i in range(nworker):
+                host, port = parse_host(hosts[i])
+                env = worker_env(base, i)
+                remote = build_remote_command(
+                    args.command, env, host, args.sync_dst_dir or os.getcwd())
+                argv = build_ssh_argv(host, port, remote)
+                t = threading.Thread(target=subprocess.check_call, args=(argv,))
+                t.daemon = True
+                t.start()
+                threads.append(t)
+        else:
+            get_logger().info(
+                "tpu-pod: no --host-file, launching %d local processes", nworker)
+            for i in range(nworker):
+                env = os.environ.copy()
+                env.update(worker_env(base, i))
+                t = threading.Thread(
+                    target=subprocess.check_call,
+                    kwargs={"args": args.command, "env": env})
+                t.daemon = True
+                t.start()
+                threads.append(t)
+        for t in threads:
+            t.join()
+
+    return run

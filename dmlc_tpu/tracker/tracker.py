@@ -1,0 +1,460 @@
+"""Rabit-compatible rank-coordination tracker.
+
+Wire-compatible with the reference tracker protocol
+(tracker/dmlc_tracker/tracker.py) so existing rabit/ps-lite clients can
+rendezvous against it:
+
+- framing: native-endian int32 + length-prefixed strings (tracker.py:24-47),
+- handshake: magic ``0xff99`` both ways (tracker.py:50, 64-66),
+- worker hello: ``rank, world_size, jobid, cmd`` with
+  cmd in {start, print, shutdown, recover} (tracker.py:67-70, 278-301),
+- rank assignment: rank, parent, world, tree neighbors, ring prev/next,
+  then the connect-brokering loop (goodset -> conset host/port/rank,
+  wait_accept bookkeeping) (tracker.py:81-136),
+- topology: binary-heap tree + node-sharing ring + link map
+  (tracker.py:166-261),
+- lazy world size from the first worker, batch rank assignment once all
+  pending workers arrived (tracker.py:290-326), rank-stable ``recover``
+  (tracker.py:288-301).
+
+On TPU the data plane is XLA collectives, so these topologies exist for
+rabit-client compatibility; the ``tpu-pod`` backend instead maps the same
+env contract onto ``jax.distributed`` (dmlc_tpu/parallel/distributed.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+MAGIC = 0xFF99
+
+logger = logging.getLogger("dmlc_tpu.tracker")
+
+
+class Conn:
+    """Framed socket: native int32 + length-prefixed utf-8 strings."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+
+    def recvall(self, nbytes: int) -> bytes:
+        chunks = []
+        nread = 0
+        while nread < nbytes:
+            chunk = self.sock.recv(min(nbytes - nread, 4096))
+            if not chunk:
+                raise ConnectionError("tracker: peer closed mid-message")
+            nread += len(chunk)
+            chunks.append(chunk)
+        return b"".join(chunks)
+
+    def recv_int(self) -> int:
+        return struct.unpack("@i", self.recvall(4))[0]
+
+    def send_int(self, value: int) -> None:
+        self.sock.sendall(struct.pack("@i", value))
+
+    def send_str(self, value: str) -> None:
+        data = value.encode()
+        self.send_int(len(data))
+        self.sock.sendall(data)
+
+    def recv_str(self) -> str:
+        return self.recvall(self.recv_int()).decode()
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# ---------------- topology (tracker.py:166-261) ----------------
+
+def tree_neighbors(rank: int, n: int) -> List[int]:
+    """Binary-heap neighbors of ``rank`` in an n-node tree."""
+    r = rank + 1
+    out = []
+    if r > 1:
+        out.append(r // 2 - 1)
+    if r * 2 - 1 < n:
+        out.append(r * 2 - 1)
+    if r * 2 < n:
+        out.append(r * 2)
+    return out
+
+
+def get_tree(n: int) -> Tuple[Dict[int, List[int]], Dict[int, int]]:
+    tree_map = {r: tree_neighbors(r, n) for r in range(n)}
+    parent_map = {r: (r + 1) // 2 - 1 for r in range(n)}
+    return tree_map, parent_map
+
+
+def get_star(n: int) -> Tuple[Dict[int, List[int]], Dict[int, int]]:
+    tree_map = {r: ([0] if r != 0 else list(range(1, n))) for r in range(n)}
+    parent_map = {r: (0 if r != 0 else -1) for r in range(n)}
+    return tree_map, parent_map
+
+
+def find_share_ring(tree_map, parent_map, root: int) -> List[int]:
+    """DFS order that shares links with the tree (tracker.py:202-219)."""
+    children = set(tree_map[root]) - {parent_map[root]}
+    if not children:
+        return [root]
+    out = [root]
+    for i, child in enumerate(children):
+        sub = find_share_ring(tree_map, parent_map, child)
+        if i == len(children) - 1:
+            sub.reverse()
+        out += sub
+    return out
+
+
+def get_ring(tree_map, parent_map) -> Dict[int, Tuple[int, int]]:
+    order = find_share_ring(tree_map, parent_map, 0)
+    n = len(tree_map)
+    assert len(order) == n
+    ring = {}
+    for i in range(n):
+        ring[order[i]] = (order[(i - 1) % n], order[(i + 1) % n])
+    return ring
+
+
+def get_link_map(n: int):
+    """Tree + parent + ring maps with ranks renumbered along the ring
+    (tracker.py:236-261)."""
+    tree_map, parent_map = get_tree(n)
+    ring_map = get_ring(tree_map, parent_map)
+    rmap = {0: 0}
+    k = 0
+    for i in range(n - 1):
+        k = ring_map[k][1]
+        rmap[k] = i + 1
+    ring2 = {rmap[k]: (rmap[a], rmap[b]) for k, (a, b) in ring_map.items()}
+    tree2 = {rmap[k]: [rmap[x] for x in v] for k, v in tree_map.items()}
+    parent2 = {rmap[k]: (rmap[v] if k != 0 else -1) for k, v in parent_map.items()}
+    return tree2, parent2, ring2
+
+
+# ---------------- worker bookkeeping ----------------
+
+class WorkerEntry:
+    """One accepted connection — analog of SlaveEntry (tracker.py:58-136)."""
+
+    def __init__(self, sock: socket.socket, addr):
+        self.conn = Conn(sock)
+        self.host = socket.getaddrinfo(addr[0], None)[0][4][0]
+        magic = self.conn.recv_int()
+        if magic != MAGIC:
+            raise ConnectionError(f"invalid magic {magic:#x} from {self.host}")
+        self.conn.send_int(MAGIC)
+        self.rank = self.conn.recv_int()
+        self.world_size = self.conn.recv_int()
+        self.jobid = self.conn.recv_str()
+        self.cmd = self.conn.recv_str()
+        self.wait_accept = 0
+        self.port: Optional[int] = None
+
+    def decide_rank(self, job_map: Dict[str, int]) -> int:
+        if self.rank >= 0:
+            return self.rank
+        if self.jobid != "NULL" and self.jobid in job_map:
+            return job_map[self.jobid]
+        return -1
+
+    def assign_rank(self, rank, wait_conn, tree_map, parent_map, ring_map):
+        """Send topology + broker peer connections (tracker.py:81-136)."""
+        self.rank = rank
+        conn = self.conn
+        nnset = set(tree_map[rank])
+        rprev, rnext = ring_map[rank]
+        conn.send_int(rank)
+        conn.send_int(parent_map[rank])
+        conn.send_int(len(tree_map))
+        conn.send_int(len(nnset))
+        for r in nnset:
+            conn.send_int(r)
+        if rprev not in (-1, rank):
+            nnset.add(rprev)
+            conn.send_int(rprev)
+        else:
+            conn.send_int(-1)
+        if rnext not in (-1, rank):
+            nnset.add(rnext)
+            conn.send_int(rnext)
+        else:
+            conn.send_int(-1)
+        while True:
+            ngood = conn.recv_int()
+            goodset = {conn.recv_int() for _ in range(ngood)}
+            assert goodset.issubset(nnset), (goodset, nnset)
+            badset = nnset - goodset
+            conset = [r for r in badset if r in wait_conn]
+            conn.send_int(len(conset))
+            conn.send_int(len(badset) - len(conset))
+            for r in conset:
+                conn.send_str(wait_conn[r].host)
+                conn.send_int(wait_conn[r].port)
+                conn.send_int(r)
+            nerr = conn.recv_int()
+            if nerr != 0:
+                continue
+            self.port = conn.recv_int()
+            done = []
+            for r in conset:
+                wait_conn[r].wait_accept -= 1
+                if wait_conn[r].wait_accept == 0:
+                    done.append(r)
+            for r in done:
+                wait_conn.pop(r, None)
+            self.wait_accept = len(badset) - len(conset)
+            return done
+
+
+class RabitTracker:
+    """The rendezvous server (tracker.py:138-349)."""
+
+    def __init__(self, host_ip: str, num_workers: int,
+                 port: int = 9091, port_end: int = 9999):
+        family = socket.getaddrinfo(host_ip, None)[0][0]
+        sock = socket.socket(family, socket.SOCK_STREAM)
+        if port_end <= port:
+            port_end = port + 908  # keep the reference's default span width
+        bound = False
+        for p in range(port, port_end):
+            try:
+                sock.bind((host_ip, p))
+                self.port = p
+                bound = True
+                break
+            except OSError as exc:
+                if exc.errno in (98, 48):  # EADDRINUSE linux/mac
+                    continue
+                raise
+        if not bound:
+            raise OSError(f"tracker: no free port in [{port}, {port_end})")
+        sock.listen(256)
+        self.sock = sock
+        self.host_ip = host_ip
+        self.num_workers = num_workers
+        self.thread: Optional[threading.Thread] = None
+        self.start_time: Optional[float] = None
+        self.end_time: Optional[float] = None
+        logger.info("tracker listening on %s:%d", host_ip, self.port)
+
+    def worker_envs(self) -> Dict[str, str]:
+        """Env contract for workers (slave_envs, tracker.py:178-184)."""
+        return {
+            "DMLC_TRACKER_URI": self.host_ip,
+            "DMLC_TRACKER_PORT": str(self.port),
+        }
+
+    def _accept_loop(self, num_workers: int, master_ip: Optional[str] = None):
+        shutdown: Dict[int, WorkerEntry] = {}
+        wait_conn: Dict[int, WorkerEntry] = {}
+        job_map: Dict[str, int] = {}
+        pending: List[WorkerEntry] = []
+        tree_map = None
+        parent_map = ring_map = None
+        todo_nodes: List[int] = []
+
+        while len(shutdown) != num_workers:
+            fd, addr = self.sock.accept()
+            try:
+                worker = WorkerEntry(fd, addr)
+            except (ConnectionError, AssertionError) as exc:
+                logger.warning("tracker: rejected connection: %s", exc)
+                fd.close()
+                continue
+            if worker.cmd == "print":
+                logger.info("%s", worker.conn.recv_str().strip())
+                continue
+            if worker.cmd == "shutdown":
+                assert worker.rank >= 0 and worker.rank not in shutdown
+                assert worker.rank not in wait_conn
+                shutdown[worker.rank] = worker
+                logger.debug("shutdown from rank %d", worker.rank)
+                continue
+            assert worker.cmd in ("start", "recover"), worker.cmd
+            if tree_map is None:
+                assert worker.cmd == "start"
+                if worker.world_size > 0:
+                    # lazy world size from the first worker (tracker.py:290-293)
+                    num_workers = worker.world_size
+                    self.num_workers = num_workers
+                tree_map, parent_map, ring_map = get_link_map(num_workers)
+                todo_nodes = list(range(num_workers))
+            else:
+                assert worker.world_size in (-1, num_workers)
+            if worker.cmd == "recover":
+                assert worker.rank >= 0
+            rank = worker.decide_rank(job_map)
+            if rank == -1:
+                assert todo_nodes
+                pending.append(worker)
+                if len(pending) == len(todo_nodes):
+                    # batch assignment; optionally pin rank 0 to the master
+                    if master_ip:
+                        for i, w in enumerate(pending):
+                            if w.host == master_ip:
+                                pending.insert(0, pending.pop(i))
+                                break
+                    for w in pending:
+                        r = todo_nodes.pop(0)
+                        if w.jobid != "NULL":
+                            job_map[w.jobid] = r
+                        w.assign_rank(r, wait_conn, tree_map, parent_map, ring_map)
+                        if w.wait_accept > 0:
+                            wait_conn[r] = w
+                        logger.debug("%s from %s -> rank %d", w.cmd, w.host, w.rank)
+                    pending = []
+                if not todo_nodes:
+                    logger.info("@tracker all %d nodes started", num_workers)
+                    self.start_time = time.time()
+            else:
+                worker.assign_rank(rank, wait_conn, tree_map, parent_map, ring_map)
+                if worker.wait_accept > 0:
+                    wait_conn[rank] = worker
+                logger.debug("%s from rank %d", worker.cmd, worker.rank)
+        self.end_time = time.time()
+        if self.start_time is not None:
+            logger.info("@tracker %.3f secs between node start and job finish",
+                        self.end_time - self.start_time)
+
+    def start(self, num_workers: Optional[int] = None,
+              master_ip: Optional[str] = None) -> None:
+        n = num_workers if num_workers is not None else self.num_workers
+        self.thread = threading.Thread(
+            target=self._accept_loop, args=(n, master_ip), daemon=True
+        )
+        self.thread.start()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.time() + timeout
+        while self.thread is not None and self.thread.is_alive():
+            self.thread.join(0.1)
+            if deadline is not None and time.time() > deadline:
+                raise TimeoutError("tracker: join timed out")
+
+    def alive(self) -> bool:
+        return self.thread is not None and self.thread.is_alive()
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class PSTracker:
+    """Parameter-server bootstrap: export scheduler env + run the scheduler
+    locally (tracker.py:351-401). Rank brokering is done by ps-lite itself."""
+
+    def __init__(self, host_ip: str, cmd: Optional[str] = None,
+                 port: int = 9091, port_end: int = 9999,
+                 envs: Optional[Dict[str, str]] = None):
+        self.host_ip = host_ip
+        self.cmd = cmd
+        self.envs = dict(envs or {})
+        if cmd:
+            # probe a free port the same way the reference does
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            for p in range(port, port_end):
+                try:
+                    sock.bind(("", p))
+                    self.port = p
+                    break
+                except OSError:
+                    continue
+            sock.close()
+            self.thread = threading.Thread(target=self._run_scheduler, daemon=True)
+            self.thread.start()
+        else:
+            self.thread = None
+
+    def _run_scheduler(self) -> None:
+        import os
+        import subprocess
+
+        env = os.environ.copy()
+        env.update(self.envs)
+        env["DMLC_ROLE"] = "scheduler"
+        env.update(self.worker_envs())
+        subprocess.check_call(self.cmd, shell=True, env=env)
+
+    def worker_envs(self) -> Dict[str, str]:
+        if self.cmd:
+            return {
+                "DMLC_PS_ROOT_URI": self.host_ip,
+                "DMLC_PS_ROOT_PORT": str(self.port),
+            }
+        return {}
+
+    def join(self) -> None:
+        if self.thread is not None:
+            self.thread.join()
+
+    def alive(self) -> bool:
+        return self.thread is not None and self.thread.is_alive()
+
+
+def get_host_ip(host_ip: Optional[str] = None) -> str:
+    """Best-effort local IP discovery (tracker.py submit's hostIP handling)."""
+    if host_ip is None or host_ip == "auto":
+        host_ip = "ip"
+    if host_ip == "dns":
+        return socket.getfqdn()
+    if host_ip == "ip":
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            s.connect(("10.255.255.255", 1))
+            ip = s.getsockname()[0]
+            s.close()
+            return ip
+        except OSError:
+            return "127.0.0.1"
+    return host_ip
+
+
+def submit(num_workers: int, num_servers: int, fun_submit,
+           host_ip: Optional[str] = None, pscmd: Optional[str] = None):
+    """Start the right tracker, call the backend launcher, wait
+    (tracker.py:425-448)."""
+    ip = get_host_ip(host_ip)
+    envs = {"DMLC_NUM_WORKER": str(num_workers),
+            "DMLC_NUM_SERVER": str(num_servers)}
+    rabit: Optional[RabitTracker] = None
+    pserver: Optional[PSTracker] = None
+    if num_servers == 0:
+        rabit = RabitTracker(ip, num_workers)
+        envs.update(rabit.worker_envs())
+        rabit.start(num_workers)
+    else:
+        pserver = PSTracker(ip, pscmd, envs=envs)
+        envs.update(pserver.worker_envs())
+    try:
+        fun_submit(num_workers, num_servers, envs)
+    except BaseException:
+        if rabit is not None:
+            rabit.close()
+        raise
+    if num_servers == 0:
+        # all worker processes have exited; if the tracker is still waiting
+        # for shutdown commands the job died mid-flight — fail fast instead
+        # of blocking on accept forever (the reference hangs here; SURVEY.md
+        # §5.3 "no heartbeat/timeout detection")
+        try:
+            rabit.join(timeout=10.0)
+        except TimeoutError:
+            rabit.close()
+            raise RuntimeError(
+                "tracker: worker processes exited but not all ranks sent "
+                "shutdown — distributed job failed") from None
+        rabit.close()
+    else:
+        pserver.join()

@@ -40,6 +40,13 @@ struct CsrPart {
   std::string error;
 };
 
+// Clamp the thread count so small chunks don't pay thread spawn overhead:
+// one thread per 512 KB, at least one.
+static int clamp_threads(int nthread, size_t len) {
+  int by_size = static_cast<int>(len / (512 * 1024)) + 1;
+  return nthread < by_size ? nthread : by_size;
+}
+
 // Split [begin, end) into n ranges at line boundaries.
 static std::vector<std::pair<const char*, const char*>> split_lines(
     const char* begin, const char* end, int n) {
@@ -397,6 +404,7 @@ CsrBlockResult* dmlc_parse_libsvm(const char* data, int64_t len, int nthread,
   const char* end = data + len;
   data = skip_bom(data, &end);
   if (nthread < 1) nthread = 1;
+  nthread = clamp_threads(nthread, static_cast<size_t>(end - data));
   auto ranges = split_lines(data, end, nthread);
   std::vector<CsrPart> parts(ranges.size());
   std::vector<std::thread> threads;
@@ -414,6 +422,7 @@ CsrBlockResult* dmlc_parse_libfm(const char* data, int64_t len, int nthread,
   const char* end = data + len;
   data = skip_bom(data, &end);
   if (nthread < 1) nthread = 1;
+  nthread = clamp_threads(nthread, static_cast<size_t>(end - data));
   auto ranges = split_lines(data, end, nthread);
   std::vector<CsrPart> parts(ranges.size());
   std::vector<std::thread> threads;
@@ -438,6 +447,7 @@ CsvResult* dmlc_parse_csv(const char* data, int64_t len, int nthread, char delim
   const char* end = data + len;
   data = skip_bom(data, &end);
   if (nthread < 1) nthread = 1;
+  nthread = clamp_threads(nthread, static_cast<size_t>(end - data));
   auto ranges = split_lines(data, end, nthread);
   std::vector<CsvPart> parts(ranges.size());
   std::vector<std::thread> threads;

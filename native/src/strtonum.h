@@ -58,12 +58,20 @@ inline bool parse_double(const char* p, const char* end, const char** out,
   }
   int exp10 = int_digits_dropped - frac;
   if (p != end && (*p == 'e' || *p == 'E')) {
+    const char* before_exp = p;
     ++p;
     bool eneg = false;
     if (p != end && (*p == '-' || *p == '+')) { eneg = (*p == '-'); ++p; }
     int ev = 0;
-    while (p != end && is_digit(*p)) { ev = ev * 10 + (*p - '0'); ++p; }
-    exp10 += eneg ? -ev : ev;
+    int edig = 0;
+    while (p != end && is_digit(*p)) { ev = ev * 10 + (*p - '0'); ++p; ++edig; }
+    if (edig == 0) {
+      // '3e' / '2e+': the marker is not part of the number — leave it for the
+      // caller's trailing-garbage check (parity with the fallback engine)
+      p = before_exp;
+    } else {
+      exp10 += eneg ? -ev : ev;
+    }
   }
   double v;
   if (exp10 >= 0 && exp10 <= 22) {
