@@ -205,12 +205,15 @@ def create_row_block_iter(
     path is partition-qualified ``.splitN.partK`` (uri_spec.h:47-53).
     """
     spec = URISpec(uri, part_index, num_parts)
+    # the cache here is the parsed-page cache (DiskRowIter); strip it before
+    # the parser so the split layer does not also chunk-cache to the same path
+    parser_uri = uri.split("#", 1)[0]
     if spec.cache_file is None:
-        parser = create_parser(uri, part_index, num_parts, type_,
+        parser = create_parser(parser_uri, part_index, num_parts, type_,
                                index_dtype=index_dtype, **parser_kw)
         return BasicRowIter(parser, silent=silent)
     if os.path.exists(spec.cache_file):
         return DiskRowIter(None, spec.cache_file, silent=silent)
-    parser = create_parser(uri, part_index, num_parts, type_,
+    parser = create_parser(parser_uri, part_index, num_parts, type_,
                            index_dtype=index_dtype, **parser_kw)
     return DiskRowIter(parser, spec.cache_file, silent=silent)

@@ -513,6 +513,12 @@ def create_parser(
         raise DMLCError(
             f"unknown parser format {type_!r}; known: {list(PARSER_REGISTRY.list_names())}"
         )
+    # a `#cachefile` suffix activates the chunk cache at the split layer
+    # (create_input_split re-derives the partition-qualified name); the
+    # row-block page cache of create_row_block_iter is a separate concern
+    split_uri = spec.uri
+    if "#" in uri:
+        split_uri = f"{spec.uri}#{uri.split('#', 1)[1]}"
     return entry.body(
-        spec.uri, spec.args, part_index, num_parts, index_dtype, threaded, **split_kw
+        split_uri, spec.args, part_index, num_parts, index_dtype, threaded, **split_kw
     )

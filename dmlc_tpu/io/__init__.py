@@ -18,6 +18,8 @@ from dmlc_tpu.io.input_split import (
     InputSplit, LineSplitter, RecordIOSplitter, IndexedRecordIOSplitter,
     ThreadedInputSplit, create_input_split,
 )
+from dmlc_tpu.io.cached_split import CachedInputSplit
+from dmlc_tpu.io import http_filesys as _http_filesys  # registers http/cloud slots
 
 __all__ = [
     "URI", "URISpec", "FileInfo", "FileSystem", "LocalFileSystem",

@@ -1,0 +1,161 @@
+"""Chunk-cache decorator for input splits.
+
+Equivalent of reference src/io/cached_input_split.h: the first pass serves
+chunks while writing them to a local cache file (``[u64 size][bytes]``
+frames, InitPreprocIter, cached_input_split.h:148-164); later passes stream
+straight from the cache (InitCachedIter, cached_input_split.h:166-189),
+skipping filesystem/remote reads entirely. Selected by a ``#cachefile`` URI
+suffix (src/io.cc:119-123) with the partition-qualified ``.splitN.partK``
+name from URISpec.
+
+Improvement over the reference: the cache is written to ``<file>.tmp`` and
+renamed on completion, so a crashed first pass can never leave a truncated
+cache that later passes would read as valid.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, Optional
+
+from dmlc_tpu.io.input_split import InputSplit, InputSplitBase, _Chunk
+from dmlc_tpu.io.threaded_iter import ThreadedIter
+from dmlc_tpu.utils.check import DMLCError, check
+
+
+class CachedInputSplit(InputSplit):
+    """Serve-and-cache on the first pass, cache-only afterwards.
+
+    ``base`` may be a live InputSplitBase or a zero-arg factory for one; the
+    factory is only invoked when the cache is missing, so a warm cache never
+    touches the source filesystem (the files may be gone or remote).
+    """
+
+    def __init__(self, base, cache_file: str, capacity: int = 16,
+                 splitter_cls=None):
+        self._base_factory = base if callable(base) else (lambda: base)
+        self._base: Optional[InputSplitBase] = base if not callable(base) else None
+        self._splitter_cls = splitter_cls or (type(self._base) if self._base else None)
+        check(self._splitter_cls is not None,
+              "CachedInputSplit: a factory base requires splitter_cls for "
+              "cache-only record extraction")
+        self._detached: Optional[InputSplitBase] = None
+        self.cache_file = cache_file
+        self._tmp_file = cache_file + ".tmp"
+        self._capacity = capacity
+        self._chunk: Optional[_Chunk] = None
+        self._iter: Optional[ThreadedIter] = None
+        self._mode = "cached" if os.path.exists(cache_file) else "preproc"
+        self._start_iter()
+
+    @property
+    def base(self) -> InputSplitBase:
+        if self._base is None:
+            self._base = self._base_factory()
+        return self._base
+
+    def _extractor(self) -> InputSplitBase:
+        """Record extraction without touching the source filesystem.
+
+        extract_next_record is stateless by design (operates only on the
+        chunk), so a detached instance created without __init__ suffices in
+        cache-only mode.
+        """
+        if self._base is not None:
+            return self._base
+        if self._detached is None:
+            self._detached = object.__new__(self._splitter_cls)
+        return self._detached
+
+    # ---------------- producers ----------------
+
+    def _preproc_chunks(self) -> Iterator[bytes]:
+        """First pass: pull from base, tee every chunk to the cache file."""
+        with open(self._tmp_file, "wb") as fo:
+            while True:
+                chunk = self.base.next_chunk()
+                if chunk is None:
+                    break
+                data = bytes(chunk) if not isinstance(chunk, bytes) else chunk
+                fo.write(struct.pack("<Q", len(data)))
+                fo.write(data)
+                yield data
+        os.replace(self._tmp_file, self.cache_file)
+        self._mode = "cached"
+
+    def _cached_chunks(self) -> Iterator[bytes]:
+        with open(self.cache_file, "rb") as fi:
+            while True:
+                header = fi.read(8)
+                if not header:
+                    return
+                check(len(header) == 8,
+                      f"{self.cache_file} has invalid cache file format")
+                (size,) = struct.unpack("<Q", header)
+                data = fi.read(size)
+                check(len(data) == size,
+                      f"{self.cache_file} has invalid cache file format")
+                yield data
+
+    def _start_iter(self) -> None:
+        if self._iter is not None:
+            self._iter.destroy()
+        factory = self._preproc_chunks if self._mode == "preproc" else self._cached_chunks
+        self._iter = ThreadedIter.from_factory(factory, max_capacity=self._capacity)
+
+    # ---------------- consumer ----------------
+
+    def next_chunk(self) -> Optional[memoryview]:
+        if self._chunk is not None and not self._chunk.exhausted:
+            out = self._chunk.data[self._chunk.pos:]
+            self._chunk = None
+            return out
+        data = self._iter.next()
+        return memoryview(data) if data is not None else None
+
+    def next_record(self) -> Optional[memoryview]:
+        while True:
+            if self._chunk is not None:
+                rec = self._extractor().extract_next_record(self._chunk)
+                if rec is not None:
+                    return rec
+            data = self._iter.next()
+            if data is None:
+                return None
+            self._chunk = _Chunk(data)
+
+    def before_first(self) -> None:
+        self._chunk = None
+        if self._mode == "preproc":
+            # first pass was interrupted mid-write: drop the partial cache
+            # and restart the pass (the tmp/rename protocol keeps the real
+            # cache file untouched)
+            self._iter.destroy()
+            try:
+                os.remove(self._tmp_file)
+            except OSError:
+                pass
+            self.base.before_first()
+            self._start_iter()
+        else:
+            self._start_iter()
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        raise DMLCError(
+            "CachedInputSplit does not support reset_partition; the cache is "
+            "bound to one partition (cached_input_split.h:87-89)")
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        if self._base is not None:
+            self._base.hint_chunk_size(chunk_size)
+
+    def close(self) -> None:
+        if self._iter is not None:
+            self._iter.destroy()
+        if self._base is not None:
+            self._base.close()
+        try:
+            os.remove(self._tmp_file)
+        except OSError:
+            pass

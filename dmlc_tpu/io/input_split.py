@@ -33,7 +33,7 @@ from typing import BinaryIO, Iterator, List, Optional, Tuple
 from dmlc_tpu.io import recordio as rio
 from dmlc_tpu.io.filesystem import FileSystem, get_filesystem
 from dmlc_tpu.io.threaded_iter import ThreadedIter
-from dmlc_tpu.io.uri import URI
+from dmlc_tpu.io.uri import URI, URISpec
 from dmlc_tpu.utils.check import DMLCError, check
 
 _EOL = (0x0A, 0x0D)  # '\n', '\r'
@@ -831,6 +831,11 @@ def create_input_split(
     check(part_index < num_parts, f"part_index {part_index} >= num_parts {num_parts}")
     if uri == "stdin" or type_ == "stdin":
         return SingleFileSplit(uri)
+    # URI sugar: `real#cachefile` selects the chunk-cache decorator with a
+    # partition-qualified cache name (src/io.cc:81-88, 119-123)
+    spec = URISpec(uri, part_index, num_parts)
+    uri = spec.uri
+    cache_file = spec.cache_file
     fs = get_filesystem(uri)
 
     def make_raw() -> InputSplitBase:
@@ -853,9 +858,23 @@ def create_input_split(
         return ThreadedInputSplit(base) if threaded else base
 
     if num_shuffle_parts > 0:
+        check(cache_file is None,
+              "cachefile and num_shuffle_parts cannot be combined")
         return ShuffledInputSplit(
             make_base, part_index, num_parts, num_shuffle_parts, seed=seed
         )
+    if cache_file is not None:
+        from dmlc_tpu.io.cached_split import CachedInputSplit
+
+        def make_partitioned() -> InputSplitBase:
+            b = make_raw()
+            b.reset_partition(part_index, num_parts)
+            return b
+
+        cls = {"text": LineSplitter, "line": LineSplitter,
+               "recordio": RecordIOSplitter}.get(type_)
+        check(cls is not None, f"cachefile not supported for type {type_!r}")
+        return CachedInputSplit(make_partitioned, cache_file, splitter_cls=cls)
     base = make_raw()
     base.reset_partition(part_index, num_parts)
     return ThreadedInputSplit(base) if threaded else base

@@ -1,0 +1,35 @@
+"""Parameter system demo — analog of reference example/parameter.cc.
+
+Run: python examples/parameter_demo.py size=7 name=gemfield nhidden=32
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dmlc_tpu import Parameter
+from dmlc_tpu.utils.params import field
+
+
+class MyParam(Parameter):
+    size = field(int, default=100, lower_bound=0, help="Dataset size.")
+    name = field(str, default="hello", help="A name.")
+    ratio = field(float, default=0.5, lower_bound=0.0, upper_bound=1.0,
+                  help="A bounded ratio.")
+    # alias, like DMLC_DECLARE_ALIAS (example/parameter.cc:30)
+    num_hidden = field(int, default=0, aliases=["nhidden"], help="Hidden units.")
+
+
+def main() -> None:
+    kwargs = dict(arg.split("=", 1) for arg in sys.argv[1:] if "=" in arg)
+    param = MyParam()
+    unknown = param.init(kwargs, allow_unknown=True)
+    print(MyParam.doc())
+    print("\nparsed :", param.to_dict())
+    print("unknown:", unknown)
+    print("json   :", param.save_json())
+
+
+if __name__ == "__main__":
+    main()
