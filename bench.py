@@ -57,19 +57,30 @@ def make_corpus() -> str:
     return CORPUS
 
 
+# 1MB chunks measured fastest for the async pipeline (fine-grained quanta
+# interleave parse/convert/transfer best; larger chunks lump the stages and
+# stall the device) and equal-or-better for the baseline
+CHUNK_BYTES = 1 << 20
+REPS = 3  # best-of, to tame shared-host + tunnel noise
+
+
 def host_only_mb_per_sec(path: str, size_mb: float) -> float:
     """Single-threaded parse to RowBlocks on the host (the CPU reference)."""
     from dmlc_tpu.data import create_parser
 
-    parser = create_parser(path, 0, 1, "libsvm", threaded=False)
-    t0 = time.monotonic()
-    rows = 0
-    for block in parser:
-        rows += len(block)
-    dt = time.monotonic() - t0
-    parser.close()
-    log(f"bench: host-only parse {rows} rows in {dt:.2f}s = {size_mb/dt:.1f} MB/s")
-    return size_mb / dt
+    best = float("inf")
+    for _ in range(REPS):
+        parser = create_parser(path, 0, 1, "libsvm", threaded=False,
+                               chunk_bytes=CHUNK_BYTES)
+        t0 = time.monotonic()
+        rows = 0
+        for block in parser:
+            rows += len(block)
+        dt = time.monotonic() - t0
+        parser.close()
+        best = min(best, dt)
+        log(f"bench: host-only parse {rows} rows in {dt:.2f}s = {size_mb/dt:.1f} MB/s")
+    return size_mb / best
 
 
 def into_hbm_mb_per_sec(path: str, size_mb: float):
@@ -81,27 +92,41 @@ def into_hbm_mb_per_sec(path: str, size_mb: float):
 
     dev = jax.devices()[0]
     log(f"bench: device = {dev}")
-    parser = create_parser(path, 0, 1, "libsvm", threaded=True)
-    it = DeviceIter(parser, num_col=NUM_COL, batch_size=BATCH, layout="dense",
-                    prefetch=2)
-    t0 = time.monotonic()
-    nbatches = 0
-    last = None
-    for batch in it:
-        last = batch
-        nbatches += 1
-    # ensure all transfers have actually landed in HBM
-    if last is not None:
-        jax.block_until_ready(last)
-    dt = time.monotonic() - t0
-    stats = it.stats()
-    it.close()
-    log(
-        f"bench: into-HBM {nbatches} batches in {dt:.2f}s = {size_mb/dt:.1f} MB/s, "
-        f"device bytes {stats['bytes_to_device']/2**20:.1f} MB, "
-        f"host stall {stats['stall_seconds']:.2f}s"
-    )
-    return size_mb / dt, stats
+    # warm up the transfer path (backend init + first-DMA setup) so the timed
+    # region measures the steady-state pipeline, matching the host-only
+    # baseline which pays no device-init cost
+    import numpy as np
+
+    jax.block_until_ready(
+        jax.device_put(np.zeros((BATCH, NUM_COL), np.float32), dev))
+    best = float("inf")
+    stats = None
+    for _ in range(REPS):
+        parser = create_parser(path, 0, 1, "libsvm", threaded=True,
+                               chunk_bytes=CHUNK_BYTES)
+        it = DeviceIter(parser, num_col=NUM_COL, batch_size=BATCH,
+                        layout="dense", prefetch=2)
+        t0 = time.monotonic()
+        nbatches = 0
+        last = None
+        for batch in it:
+            last = batch
+            nbatches += 1
+        # ensure all transfers have actually landed in HBM
+        if last is not None:
+            jax.block_until_ready(last)
+        dt = time.monotonic() - t0
+        if dt < best:
+            best = dt
+            stats = it.stats()
+        it.close()
+        log(
+            f"bench: into-HBM {nbatches} batches in {dt:.2f}s = "
+            f"{size_mb/dt:.1f} MB/s, "
+            f"device bytes {it.bytes_to_device/2**20:.1f} MB, "
+            f"host stall {it.stall_seconds:.2f}s"
+        )
+    return size_mb / best, stats
 
 
 def main() -> None:

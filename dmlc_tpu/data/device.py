@@ -23,7 +23,7 @@ import jax
 import numpy as np
 
 from dmlc_tpu.data.parsers import Parser
-from dmlc_tpu.data.row_block import RowBlock, RowBlockContainer
+from dmlc_tpu.data.row_block import DenseBlock, RowBlock, RowBlockContainer
 from dmlc_tpu.io.threaded_iter import ThreadedIter
 from dmlc_tpu.ops.sparse import EllBatch, block_to_bcoo, block_to_dense, block_to_ell
 from dmlc_tpu.utils.check import DMLCError, check
@@ -97,6 +97,10 @@ class DeviceIter:
         self.stall_seconds = 0.0
         self.batches_fed = 0
         self.bytes_to_device = 0
+        if layout == "dense" and hasattr(source, "set_emit_dense"):
+            # ask the parser for HBM-ready dense batches (skips CSR); safe to
+            # ignore the answer — _host_batches_dense handles both kinds
+            source.set_emit_dense(num_col)
         self._host_iter = ThreadedIter.from_factory(
             self._host_batches, max_capacity=convert_ahead
         )
@@ -113,10 +117,55 @@ class DeviceIter:
             yield blk
 
     def _host_batches(self):
+        if self.layout == "dense":
+            yield from self._host_batches_dense()
+            return
         for block in rebatch_blocks(
             self._blocks(), self.batch_size, self.drop_remainder
         ):
             yield self._convert(block)
+
+    def _host_batches_dense(self):
+        """Dense layout fast path: convert each block to (x, y, w) immediately
+        (for dense-in-sparse data ``block_to_dense`` is a reshape view, no
+        scatter) and rebatch with one ``np.concatenate`` per emitted batch —
+        instead of merging CSR containers and re-slicing, which costs several
+        copies of all seven RowBlock arrays per batch on the host core."""
+        B = self.batch_size
+        parts: list = []  # [(x, y, w)] pending, total rows < B after drain
+        pending = 0
+        for block in self._blocks():
+            if isinstance(block, DenseBlock):
+                w = (block.weight if block.weight is not None
+                     else np.ones(len(block), np.float32))
+                parts.append((block.x, block.label, w))
+            else:
+                parts.append(block_to_dense(block, self.num_col, copy=False))
+            pending += len(parts[-1][1])
+            while pending >= B:
+                xs, ys, ws = zip(*parts)
+                x = np.concatenate(xs) if len(xs) > 1 else xs[0]
+                y = np.concatenate(ys) if len(ys) > 1 else ys[0]
+                w = np.concatenate(ws) if len(ws) > 1 else ws[0]
+                pos = 0
+                while pos + B <= len(y):
+                    yield ("dense", x[pos:pos + B], y[pos:pos + B], w[pos:pos + B])
+                    pos += B
+                parts = [(x[pos:], y[pos:], w[pos:])] if pos < len(y) else []
+                pending = len(y) - pos
+        if pending and not self.drop_remainder:
+            xs, ys, ws = zip(*parts)
+            x = np.concatenate(xs) if len(xs) > 1 else xs[0]
+            y = np.concatenate(ys) if len(ys) > 1 else ys[0]
+            w = np.concatenate(ws) if len(ws) > 1 else ws[0]
+            n = len(y)
+            xp = np.zeros((B, self.num_col), np.float32)
+            xp[:n] = x
+            yp = np.zeros(B, np.float32)
+            yp[:n] = y
+            wp = np.zeros(B, np.float32)
+            wp[:n] = w
+            yield ("dense", xp, yp, wp)
 
     def _convert(self, block: RowBlock):
         pad = self.batch_size if len(block) != self.batch_size else None
@@ -150,9 +199,9 @@ class DeviceIter:
             else:
                 out = local_batch_to_global(self.mesh, arrays, axis=self.data_axis)
         elif self.device is not None:
-            out = tuple(jax.device_put(a, self.device) for a in arrays)
+            out = tuple(jax.device_put(arrays, self.device))
         else:
-            out = tuple(jax.device_put(a) for a in arrays)
+            out = tuple(jax.device_put(arrays))
         if kind == "ell":
             return EllBatch(*out)
         return out  # (x, y, w)

@@ -26,7 +26,7 @@ from typing import Dict, Iterator, Optional
 
 import numpy as np
 
-from dmlc_tpu.data.row_block import RowBlock
+from dmlc_tpu.data.row_block import DenseBlock, RowBlock
 from dmlc_tpu.io.input_split import InputSplit, create_input_split
 from dmlc_tpu.io.threaded_iter import ThreadedIter
 from dmlc_tpu.io.uri import URISpec
@@ -99,11 +99,23 @@ class TextParserBase(Parser):
     otherwise; both produce identical blocks.
     """
 
+    # class-level defaults so partially-constructed instances (tests drive
+    # parse_chunk_* directly via __new__) behave
+    _emit_dense: Optional[int] = None
+    _native = None
+
     def __init__(self, source: InputSplit, index_dtype=np.uint64):
         self.source = source
         self.index_dtype = index_dtype
         self._bytes = 0
         self._native = None  # tri-state: None=unprobed, False=off, True=on
+        self._emit_dense: Optional[int] = None  # num_col when dense mode is on
+
+    def set_emit_dense(self, num_col: int) -> bool:
+        """Opt in to emitting DenseBlock batches straight from the scanner
+        (the TPU-first layout fast path). Returns False when this parser has
+        no dense scanner; callers then get RowBlocks as usual."""
+        return False
 
     def use_native(self) -> bool:
         if self._native is None:
@@ -214,9 +226,30 @@ class LibSVMParser(TextParserBase):
         self.param.init(dict(args or {}), allow_unknown=True)
         check(self.param.format == "libsvm", "LibSVMParser: format must be libsvm")
 
+    def set_emit_dense(self, num_col: int) -> bool:
+        if self.use_native():
+            self._emit_dense = int(num_col)
+            return True
+        return False
+
     def parse_chunk_native(self, chunk: bytes) -> Optional[RowBlock]:
         from dmlc_tpu import native
 
+        if self._emit_dense is not None:
+            try:
+                out = native.parse_libsvm_dense(
+                    chunk, self._emit_dense,
+                    indexing_mode=self.param.indexing_mode)
+            except DMLCError as exc:
+                if "libsvm-dense" not in str(exc):
+                    raise
+                # data the dense scanner can't express (qid rows):
+                # permanently fall back to the CSR path
+                self._emit_dense = None
+                out = None
+            if out is not None:
+                x, label, weight, owner = out
+                return DenseBlock(x, label, weight, hold=owner)
         d = native.parse_libsvm(chunk, indexing_mode=self.param.indexing_mode)
         if d is None:
             return None
@@ -318,18 +351,44 @@ class CSVParser(TextParserBase):
         # the native csv scanner emits float32 cells only
         return self.param.dtype == "float32"
 
+    def set_emit_dense(self, num_col: int) -> bool:
+        if self._native_supported() and self.use_native():
+            self._emit_dense = int(num_col)
+            return True
+        return False
+
     def parse_chunk_native(self, chunk: bytes) -> Optional[RowBlock]:
         from dmlc_tpu import native
 
         out = native.parse_csv(chunk, delimiter=self.param.delimiter)
         if out is None:
             return None
-        cells, _owner = out
+        cells, owner = out
         n, ncol = cells.shape
         if n == 0:
             return RowBlock(np.zeros(1, np.int64), np.empty(0, np.float32),
                             np.empty(0, self.index_dtype))
+        if self._emit_dense is not None:
+            return self._cells_to_dense(cells, n, ncol, owner)
         return self._cells_to_block(cells, n, ncol)
+
+    def _cells_to_dense(self, cells: np.ndarray, n: int, ncol: int,
+                        owner) -> DenseBlock:
+        """Dense cell matrix -> DenseBlock; zero-copy when there are no
+        label/weight columns and the width already matches."""
+        lc, wc = self.param.label_column, self.param.weight_column
+        check(lc < ncol, f"csv: label_column {lc} >= num columns {ncol}")
+        check(wc < ncol, f"csv: weight_column {wc} >= num columns {ncol}")
+        num_col = int(self._emit_dense)
+        label = cells[:, lc].astype(np.float32) if lc >= 0 else np.zeros(n, np.float32)
+        weight = cells[:, wc].astype(np.float32) if wc >= 0 else None
+        if lc < 0 and wc < 0 and ncol == num_col:
+            return DenseBlock(cells, label, weight, hold=owner)
+        feat_cols = [c for c in range(ncol) if c != lc and c != wc]
+        k = min(len(feat_cols), num_col)
+        x = np.zeros((n, num_col), np.float32)
+        x[:, :k] = cells[:, feat_cols[:k]]
+        return DenseBlock(x, label, weight, hold=owner)
 
     def parse_chunk_py(self, chunk: bytes) -> RowBlock:
         if chunk.startswith(b"\xef\xbb\xbf"):
@@ -438,7 +497,18 @@ class ThreadedParser(Parser):
 
     def __init__(self, base: TextParserBase, capacity: int = 8):
         self.base = base
-        self._iter = ThreadedIter(self._produce, base.before_first, max_capacity=capacity)
+        self._capacity = capacity
+        # the producer thread starts on first pull, not construction, so
+        # callers can still configure the base (e.g. set_emit_dense) without
+        # racing blocks already in flight
+        self._iter: Optional[ThreadedIter] = None
+
+    def _ensure_iter(self) -> ThreadedIter:
+        if self._iter is None:
+            self._iter = ThreadedIter(
+                self._produce, self.base.before_first,
+                max_capacity=self._capacity)
+        return self._iter
 
     def _produce(self, cell):
         block = self.base.next_block()
@@ -446,11 +516,18 @@ class ThreadedParser(Parser):
             return False, None
         return True, block
 
+    def set_emit_dense(self, num_col: int) -> bool:
+        if self._iter is not None:
+            # producer already running: flipping modes mid-stream would mix
+            # block kinds racily, so decline — callers handle RowBlocks too
+            return False
+        return self.base.set_emit_dense(num_col)
+
     def next_block(self) -> Optional[RowBlock]:
-        return self._iter.next()
+        return self._ensure_iter().next()
 
     def before_first(self) -> None:
-        self._iter.before_first()
+        self._ensure_iter().before_first()
 
     @property
     def bytes_read(self) -> int:
@@ -458,10 +535,11 @@ class ThreadedParser(Parser):
 
     @property
     def stall_seconds(self) -> float:
-        return self._iter.stall_seconds
+        return self._iter.stall_seconds if self._iter is not None else 0.0
 
     def close(self) -> None:
-        self._iter.destroy()
+        if self._iter is not None:
+            self._iter.destroy()
         self.base.close()
 
 

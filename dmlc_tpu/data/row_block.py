@@ -173,6 +173,27 @@ class RowBlock:
         )
 
 
+class DenseBlock:
+    """A parsed batch already in the dense device layout [n, num_col].
+
+    Emitted by parsers in dense mode (``set_emit_dense``) — the TPU-first
+    fast path that skips CSR materialization entirely; the reference has no
+    analog (its parsers always build CSR RowBlocks, src/data/row_block.h).
+    """
+
+    __slots__ = ("x", "label", "weight", "hold")
+
+    def __init__(self, x: np.ndarray, label: np.ndarray,
+                 weight: Optional[np.ndarray] = None, hold=None):
+        self.x = x
+        self.label = label
+        self.weight = weight
+        self.hold = hold
+
+    def __len__(self) -> int:
+        return len(self.label)
+
+
 class RowBlockContainer:
     """Growable RowBlock accumulator — analog of src/data/row_block.h.
 

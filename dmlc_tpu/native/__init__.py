@@ -25,7 +25,7 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__f
 _SRC = os.path.join(_REPO_ROOT, "native", "src", "parse.cc")
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 _SO_PATH = os.path.join(_BUILD_DIR, "libdmlc_tpu_native.so")
-_ABI_VERSION = 1
+_ABI_VERSION = 2
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -43,6 +43,17 @@ class _CsrBlockResult(ctypes.Structure):
         ("index", ctypes.POINTER(ctypes.c_uint64)),
         ("field", ctypes.POINTER(ctypes.c_uint64)),
         ("value", ctypes.POINTER(ctypes.c_float)),
+        ("error", ctypes.c_char_p),
+    ]
+
+
+class _DenseResult(ctypes.Structure):
+    _fields_ = [
+        ("n_rows", ctypes.c_int64),
+        ("n_cols", ctypes.c_int64),
+        ("x", ctypes.POINTER(ctypes.c_float)),
+        ("label", ctypes.POINTER(ctypes.c_float)),
+        ("weight", ctypes.POINTER(ctypes.c_float)),
         ("error", ctypes.c_char_p),
     ]
 
@@ -110,8 +121,10 @@ def _load() -> Optional[ctypes.CDLL]:
                 get_logger().warning("native load failed after rebuild: %s", exc2)
                 _build_failed = True
                 return None
-        _declare(lib)
-        if lib.dmlc_native_abi_version() != _ABI_VERSION:
+        # version-check BEFORE declaring the full symbol table: a stale .so
+        # (e.g. a cached build dir with fresh mtimes) would otherwise raise
+        # AttributeError on symbols this ABI added, bypassing the rebuild
+        if not _abi_ok(lib):
             get_logger().warning("native ABI mismatch; rebuilding")
             try:
                 os.unlink(_SO_PATH)
@@ -119,8 +132,7 @@ def _load() -> Optional[ctypes.CDLL]:
                     _build_failed = True
                     return None
                 lib = ctypes.CDLL(_SO_PATH)
-                _declare(lib)
-                if lib.dmlc_native_abi_version() != _ABI_VERSION:
+                if not _abi_ok(lib):
                     get_logger().warning("native ABI still mismatched after rebuild")
                     _build_failed = True
                     return None
@@ -128,8 +140,20 @@ def _load() -> Optional[ctypes.CDLL]:
                 get_logger().warning("native ABI rebuild failed: %s", exc)
                 _build_failed = True
                 return None
+        _declare(lib)
         _lib = lib
         return _lib
+
+
+def _abi_ok(lib: ctypes.CDLL) -> bool:
+    """True when the .so exports the expected ABI version. Tolerates
+    binaries so old they predate the version symbol."""
+    try:
+        fn = lib.dmlc_native_abi_version
+    except AttributeError:
+        return False
+    fn.restype = ctypes.c_int
+    return fn() == _ABI_VERSION
 
 
 def _declare(lib: ctypes.CDLL) -> None:
@@ -142,6 +166,11 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.dmlc_parse_csv.restype = ctypes.POINTER(_CsvResult)
     lib.dmlc_parse_csv.argtypes = [
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_char]
+    lib.dmlc_parse_libsvm_dense.restype = ctypes.POINTER(_DenseResult)
+    lib.dmlc_parse_libsvm_dense.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int64,
+        ctypes.c_int]
+    lib.dmlc_free_dense.argtypes = [ctypes.c_void_p]
     # void* so finalizers never depend on ctypes class identity (which
     # changes across importlib.reload) — they may fire at interpreter exit
     lib.dmlc_free_block.argtypes = [ctypes.c_void_p]
@@ -161,17 +190,36 @@ def default_nthread() -> int:
     return max(2, (os.cpu_count() or 2) // 2)
 
 
-def _view(ptr, n, dtype):
-    """Zero-copy numpy view over a malloc'd buffer.
+class _HeldBuffer:
+    """Array-interface shim binding a raw pointer to its _Owner.
 
-    The buffer's lifetime is governed by the _Owner returned alongside the
-    views — every consumer (RowBlock carries it in ``hold``) must keep the
-    owner referenced for as long as the views live.
+    ``np.asarray`` on this object yields a zero-copy view whose ``base`` IS
+    this shim — so the owner (and thus the malloc'd buffer) stays alive for
+    as long as ANY derived view exists, including views JAX is still
+    transferring from. No consumer bookkeeping required.
     """
+
+    __slots__ = ("owner", "__array_interface__")
+
+    def __init__(self, addr: int, nbytes: int, owner):
+        self.owner = owner
+        self.__array_interface__ = {
+            "data": (addr, False),
+            "shape": (nbytes,),
+            "typestr": "|u1",
+            "version": 3,
+        }
+
+
+def _view(ptr, n, dtype, owner):
+    """Zero-copy numpy view over a malloc'd buffer; the view's base chain
+    pins ``owner`` so the buffer cannot be freed while any view lives."""
     if not ptr or n == 0:
         return None
-    arr = np.ctypeslib.as_array(ptr, shape=(n,))
-    return arr.view(dtype) if arr.dtype != dtype else arr
+    dtype = np.dtype(dtype)
+    addr = ctypes.cast(ptr, ctypes.c_void_p).value
+    raw = np.asarray(_HeldBuffer(addr, n * dtype.itemsize, owner))
+    return raw.view(dtype)
 
 
 class _Owner:
@@ -219,13 +267,13 @@ def _wrap_block(lib, res):
     owner = _Owner(lib, res, _free_block)
     n, nnz = r.n_rows, r.nnz
     out = {
-        "offset": _view(r.offset, n + 1, np.int64),
-        "label": _view(r.label, n, np.float32),
-        "weight": _view(r.weight, n, np.float32),
-        "qid": _view(r.qid, n, np.int64),
-        "index": _view(r.index, nnz, np.uint64),
-        "field": _view(r.field, nnz, np.uint64),
-        "value": _view(r.value, nnz, np.float32),
+        "offset": _view(r.offset, n + 1, np.int64, owner),
+        "label": _view(r.label, n, np.float32, owner),
+        "weight": _view(r.weight, n, np.float32, owner),
+        "qid": _view(r.qid, n, np.int64, owner),
+        "index": _view(r.index, nnz, np.uint64, owner),
+        "field": _view(r.field, nnz, np.uint64, owner),
+        "value": _view(r.value, nnz, np.float32, owner),
         "_owner": owner,
     }
     if n == 0:
@@ -234,6 +282,39 @@ def _wrap_block(lib, res):
     if out["index"] is None:
         out["index"] = np.empty(0, np.uint64)
     return out
+
+
+def _free_dense(lib, addr):
+    lib.dmlc_free_dense(addr)
+
+
+def parse_libsvm_dense(chunk: bytes, num_col: int, nthread: int = 0,
+                       indexing_mode: int = -1):
+    """Parse libsvm straight to the dense device layout.
+
+    Returns (x [n, num_col] float32, label, weight-or-None, owner) or None
+    when native is unavailable. Raises DMLCError for inputs the dense scanner
+    does not support (e.g. qid rows) — callers fall back to the CSR path.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    res = lib.dmlc_parse_libsvm_dense(
+        chunk, len(chunk), nthread or default_nthread(), num_col, indexing_mode)
+    r = res.contents
+    if r.error:
+        msg = r.error.decode()
+        lib.dmlc_free_dense(res)
+        raise DMLCError(msg)
+    owner = _Owner(lib, res, _free_dense)
+    n = r.n_rows
+    if n == 0:
+        return (np.zeros((0, num_col), np.float32),
+                np.empty(0, np.float32), None, owner)
+    x = _view(r.x, n * num_col, np.float32, owner).reshape(n, num_col)
+    label = _view(r.label, n, np.float32, owner)
+    weight = _view(r.weight, n, np.float32, owner)
+    return x, label, weight, owner
 
 
 def parse_csv(chunk: bytes, delimiter: str = ",", nthread: int = 0):
@@ -256,5 +337,5 @@ def parse_csv(chunk: bytes, delimiter: str = ",", nthread: int = 0):
     n, c = r.n_rows, r.n_cols
     if n == 0 or c == 0:
         return np.zeros((0, 0), np.float32), owner
-    cells = _view(r.cells, n * c, np.float32)
+    cells = _view(r.cells, n * c, np.float32, owner)
     return cells.reshape(n, c), owner

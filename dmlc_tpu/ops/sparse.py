@@ -91,12 +91,18 @@ def block_to_ell(
 
 
 def block_to_dense(
-    block: RowBlock, num_col: int, pad_rows_to: Optional[int] = None
+    block: RowBlock, num_col: int, pad_rows_to: Optional[int] = None,
+    copy: bool = True,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """CSR -> padded dense [B, D] (+ label, weight), batch-padded like ELL."""
+    """CSR -> padded dense [B, D] (+ label, weight), batch-padded like ELL.
+
+    With ``copy=False`` and dense-in-sparse data whose width equals
+    ``num_col`` exactly, ``x`` is returned as a zero-copy reshape view of the
+    parser's value array — callers must not mutate it.
+    """
     n = len(block)
     rows_out = int(pad_rows_to if pad_rows_to is not None else n)
-    x = np.zeros((rows_out, num_col), dtype=np.float32)
+    x = None
     if n:
         lens = _row_lengths(block)
         vals = block.value if block.value is not None else np.ones(len(block.index), np.float32)
@@ -109,11 +115,19 @@ def block_to_dense(
             and bool((lens == k).all())
             and bool((block.index.reshape(n, k) == np.arange(k, dtype=block.index.dtype)).all())
         ):
-            x[:n, :k] = vals.reshape(n, k)
+            if (not copy and k == num_col and rows_out == n
+                    and vals.dtype == np.float32):
+                x = vals.reshape(n, k)
+            else:
+                x = np.zeros((rows_out, num_col), dtype=np.float32)
+                x[:n, :k] = vals.reshape(n, k)
         else:
+            x = np.zeros((rows_out, num_col), dtype=np.float32)
             rows = np.repeat(np.arange(n), lens)
             keep = block.index < num_col
             x[rows[keep], block.index[keep].astype(np.int64)] = vals[keep]
+    if x is None:
+        x = np.zeros((rows_out, num_col), dtype=np.float32)
     label = np.zeros(rows_out, np.float32)
     label[:n] = block.label
     weight = np.zeros(rows_out, np.float32)

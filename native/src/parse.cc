@@ -229,6 +229,96 @@ static void parse_libfm_range(const char* begin, const char* end, CsrPart* out) 
   }
 }
 
+// ---------------- libsvm -> dense ----------------
+//
+// TPU-first fast path: parse straight into the row-major [n, num_col] device
+// layout, skipping CSR index/offset materialization (for HIGGS-shaped data
+// the uint64 index array alone is 2x the bytes of the values). Rows are
+// buffered with stride num_col+1 so the 1-based->0-based indexing decision
+// (which needs the global min index, libsvm_parser.h:159-168) reduces to a
+// column offset chosen at merge time.
+
+struct DensePart {
+  std::vector<float> x;       // [nrow, num_col + 1] row-major
+  std::vector<float> label;
+  std::vector<float> weight;  // empty or per-row
+  uint64_t min_index = UINT64_MAX;
+  std::string error;
+};
+
+static void parse_libsvm_dense_range(const char* begin, const char* end,
+                                     int64_t num_col, DensePart* out) {
+  const char* p = begin;
+  const size_t stride = static_cast<size_t>(num_col) + 1;
+  while (p < end) {
+    const char* lend = line_end(p, end);
+    const char* q = p;
+    const char* hash = static_cast<const char*>(memchr(q, '#', lend - q));
+    const char* effective_end = hash ? hash : lend;
+    double label;
+    const char* after;
+    if (!parse_double(q, effective_end, &after, &label)) {
+      p = lend;
+      while (p < end && (*p == '\n' || *p == '\r')) ++p;
+      continue;
+    }
+    q = after;
+    bool has_weight = false;
+    double weight = 1.0;
+    if (q != effective_end && *q == ':') {
+      ++q;
+      if (!parse_double(q, effective_end, &after, &weight)) {
+        out->error = "libsvm: bad label:weight";
+        return;
+      }
+      q = after;
+      has_weight = true;
+    }
+    out->label.push_back(static_cast<float>(label));
+    if (has_weight) {
+      if (out->weight.size() != out->label.size() - 1) {
+        out->error = "libsvm: label:weight must be set on every row or none";
+        return;
+      }
+      out->weight.push_back(static_cast<float>(weight));
+    } else if (!out->weight.empty()) {
+      out->error = "libsvm: label:weight must be set on every row or none";
+      return;
+    }
+    while (q != effective_end && is_space(*q)) ++q;
+    if (effective_end - q >= 4 && memcmp(q, "qid:", 4) == 0) {
+      // qid has no dense analog; signal the caller to use the CSR path
+      out->error = "libsvm-dense: qid not supported";
+      return;
+    }
+    size_t base = out->x.size();
+    out->x.resize(base + stride, 0.0f);
+    while (true) {
+      uint64_t idx;
+      if (!parse_uint(q, effective_end, &after, &idx)) break;
+      q = after;
+      if (idx < out->min_index) out->min_index = idx;
+      double v = 1.0;
+      if (q != effective_end && *q == ':') {
+        ++q;
+        if (!parse_double(q, effective_end, &after, &v)) {
+          out->error = "libsvm: bad idx:value";
+          return;
+        }
+        q = after;
+      }
+      if (idx < stride) out->x[base + idx] = static_cast<float>(v);
+    }
+    while (q != effective_end && is_space(*q)) ++q;
+    if (q != effective_end) {
+      out->error = "libsvm: malformed feature token";
+      return;
+    }
+    p = lend;
+    while (p < end && (*p == '\n' || *p == '\r')) ++p;
+  }
+}
+
 // ---------------- csv ----------------
 
 struct CsvPart {
@@ -435,6 +525,85 @@ CsrBlockResult* dmlc_parse_libfm(const char* data, int64_t len, int nthread,
   return merge_parts(parts, indexing_mode, true);
 }
 
+// Dense libsvm result: x laid out row-major [n_rows, n_cols].
+struct DenseResult {
+  int64_t n_rows;
+  int64_t n_cols;
+  float* x;       // [n_rows, n_cols]
+  float* label;   // [n_rows]
+  float* weight;  // [n_rows] or null
+  char* error;    // null on success
+};
+
+DenseResult* dmlc_parse_libsvm_dense(const char* data, int64_t len, int nthread,
+                                     int64_t num_col, int indexing_mode) {
+  const char* end = data + len;
+  data = skip_bom(data, &end);
+  if (nthread < 1) nthread = 1;
+  nthread = clamp_threads(nthread, static_cast<size_t>(end - data));
+  auto ranges = split_lines(data, end, nthread);
+  std::vector<DensePart> parts(ranges.size());
+  std::vector<std::thread> threads;
+  for (size_t i = 1; i < ranges.size(); ++i) {
+    threads.emplace_back(parse_libsvm_dense_range, ranges[i].first,
+                         ranges[i].second, num_col, &parts[i]);
+  }
+  if (!ranges.empty())
+    parse_libsvm_dense_range(ranges[0].first, ranges[0].second, num_col, &parts[0]);
+  for (auto& t : threads) t.join();
+
+  auto* res = static_cast<DenseResult*>(calloc(1, sizeof(DenseResult)));
+  res->n_cols = num_col;
+  int64_t n = 0;
+  bool any_weight = false;
+  uint64_t min_index = UINT64_MAX;
+  for (auto& part : parts) {
+    if (!part.error.empty()) {
+      res->error = dup_error(part.error);
+      return res;
+    }
+    n += static_cast<int64_t>(part.label.size());
+    any_weight |= !part.weight.empty();
+    if (part.min_index < min_index) min_index = part.min_index;
+  }
+  for (auto& part : parts) {
+    if (any_weight && !part.label.empty() &&
+        part.weight.size() != part.label.size()) {
+      res->error = dup_error("libsvm: label:weight must be set on every row or none");
+      return res;
+    }
+  }
+  // 1-based -> 0-based conversion becomes a column offset into the
+  // stride-(num_col+1) part buffers (libsvm_parser.h:159-168 heuristic)
+  bool convert = indexing_mode > 0 ||
+      (indexing_mode < 0 && min_index != UINT64_MAX && min_index > 0);
+  const size_t off = convert ? 1 : 0;
+  const size_t stride = static_cast<size_t>(num_col) + 1;
+  res->n_rows = n;
+  res->x = static_cast<float*>(malloc(static_cast<size_t>(n) * num_col * sizeof(float)));
+  res->label = static_cast<float*>(malloc(n * sizeof(float)));
+  if (any_weight) res->weight = static_cast<float*>(malloc(n * sizeof(float)));
+  int64_t row = 0;
+  for (auto& part : parts) {
+    size_t pn = part.label.size();
+    if (!pn) continue;
+    memcpy(res->label + row, part.label.data(), pn * sizeof(float));
+    if (any_weight) memcpy(res->weight + row, part.weight.data(), pn * sizeof(float));
+    for (size_t i = 0; i < pn; ++i) {
+      memcpy(res->x + (row + static_cast<int64_t>(i)) * num_col,
+             part.x.data() + i * stride + off, num_col * sizeof(float));
+    }
+    row += static_cast<int64_t>(pn);
+  }
+  return res;
+}
+
+void dmlc_free_dense(DenseResult* r) {
+  if (!r) return;
+  free(r->x); free(r->label); free(r->weight); free(r->error);
+  free(r);
+}
+
 // Dense CSV result: cells laid out row-major [n_rows, n_cols].
 struct CsvResult {
   int64_t n_rows;
@@ -499,6 +668,6 @@ void dmlc_free_csv(CsvResult* r) {
   free(r);
 }
 
-int dmlc_native_abi_version() { return 1; }
+int dmlc_native_abi_version() { return 2; }
 
 }  // extern "C"

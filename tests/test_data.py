@@ -453,3 +453,137 @@ def test_both_engines_reject_malformed_features():
     p._bytes = 0
     with pytest.raises(DMLCError, match="malformed"):
         p.parse_chunk(b"1 0:1 foo 2:3\n")
+
+
+# ---------------- dense-emit fast path ----------------
+
+@needs_native
+@pytest.mark.parametrize("mode", [-1, 0, 1])
+def test_native_dense_matches_csr_path(mode):
+    """parse_libsvm_dense must equal CSR parse + block_to_dense."""
+    from dmlc_tpu import native
+    from dmlc_tpu.data.row_block import RowBlock
+    from dmlc_tpu.ops.sparse import block_to_dense
+
+    rng = np.random.default_rng(11)
+    lines = []
+    lo = 1 if mode != 0 else 0
+    for _ in range(300):
+        nnz = int(rng.integers(0, 12))
+        idx = np.sort(rng.choice(np.arange(lo, 40 + lo), size=nnz, replace=False))
+        feats = " ".join(f"{j}:{rng.normal():.5g}" for j in idx)
+        lines.append(f"{int(rng.integers(0, 2))} {feats}")
+    text = ("\n".join(lines) + "\n").encode()
+    num_col = 40
+
+    x, y, w, _owner = native.parse_libsvm_dense(text, num_col, indexing_mode=mode)
+    d = native.parse_libsvm(text, indexing_mode=mode)
+    block = RowBlock(offset=d["offset"], label=d["label"], index=d["index"],
+                     value=d["value"], weight=d["weight"], qid=d["qid"],
+                     hold=d["_owner"])
+    xr, yr, wr = block_to_dense(block, num_col)
+    np.testing.assert_allclose(x, xr)
+    np.testing.assert_allclose(y, yr)
+    assert w is None  # no weights in corpus
+
+
+@needs_native
+def test_native_dense_weight_and_out_of_range():
+    from dmlc_tpu import native
+
+    x, y, w, _o = native.parse_libsvm_dense(
+        b"1:0.5 0:2 9:7\n0:2.0 1:4\n", 3, indexing_mode=0)
+    np.testing.assert_allclose(x, [[2, 0, 0], [0, 4, 0]])  # idx 9 dropped
+    np.testing.assert_allclose(w, [0.5, 2.0])
+
+
+@needs_native
+def test_parser_emit_dense_flows_to_device_iter(tmp_path):
+    """set_emit_dense produces DenseBlocks and DeviceIter consumes them."""
+    from dmlc_tpu.data import create_parser
+    from dmlc_tpu.data.row_block import DenseBlock
+
+    path = tmp_path / "d.libsvm"
+    rng = np.random.default_rng(5)
+    with open(path, "w") as f:
+        for _ in range(100):
+            feats = " ".join(f"{j}:{rng.normal():.4f}" for j in range(6))
+            f.write(f"{int(rng.integers(0, 2))} {feats}\n")
+    p = create_parser(str(path), 0, 1, "libsvm", threaded=True)
+    assert p.set_emit_dense(6)
+    blocks = list(iter(p.next_block, None))
+    p.close()
+    assert all(isinstance(b, DenseBlock) for b in blocks)
+    assert sum(len(b) for b in blocks) == 100
+
+    # full DeviceIter path on CPU fallback arrays
+    p = create_parser(str(path), 0, 1, "libsvm", threaded=True)
+    from dmlc_tpu.data.device import DeviceIter
+
+    it = DeviceIter(p, num_col=6, batch_size=32, layout="dense")
+    rows = 0
+    nb = 0
+    for x, y, w in it:
+        assert x.shape == (32, 6)
+        nb += 1
+        rows += int(np.asarray(y != 0).sum()) + int(np.asarray(y == 0).sum())
+    it.close()
+    assert nb == 4  # 100 rows -> 3 full + 1 padded batch of 32
+
+
+@needs_native
+def test_native_dense_qid_falls_back_to_csr(tmp_path):
+    from dmlc_tpu.data import create_parser
+    from dmlc_tpu.data.row_block import RowBlock
+
+    path = tmp_path / "q.libsvm"
+    with open(path, "w") as f:
+        for i in range(10):
+            f.write(f"1 qid:{i} 0:1 1:2\n")
+    p = create_parser(str(path), 0, 1, "libsvm", threaded=False)
+    p.set_emit_dense(2)
+    blocks = list(iter(p.next_block, None))
+    p.close()
+    assert all(isinstance(b, RowBlock) for b in blocks)
+    assert all(b.qid is not None for b in blocks)
+
+
+@needs_native
+def test_csv_emit_dense(tmp_path):
+    from dmlc_tpu.data import create_parser
+    from dmlc_tpu.data.row_block import DenseBlock
+
+    path = tmp_path / "d.csv"
+    rng = np.random.default_rng(7)
+    ref = rng.normal(size=(50, 5)).astype(np.float32)
+    with open(path, "w") as f:
+        for row in ref:
+            f.write(",".join(f"{v:.6f}" for v in row) + "\n")
+    # label_column=0 -> 4 feature columns
+    p = create_parser(str(path) + "?format=csv&label_column=0", 0, 1, "auto",
+                      threaded=False)
+    assert p.set_emit_dense(4)
+    blocks = list(iter(p.next_block, None))
+    p.close()
+    assert all(isinstance(b, DenseBlock) for b in blocks)
+    got_x = np.concatenate([b.x for b in blocks])
+    got_y = np.concatenate([b.label for b in blocks])
+    np.testing.assert_allclose(got_x, ref[:, 1:], rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(got_y, ref[:, 0], rtol=1e-4, atol=1e-6)
+
+
+@needs_native
+def test_view_owner_survives_gc():
+    """Views over native buffers must pin the owner via their base chain."""
+    import gc
+
+    from dmlc_tpu import native
+
+    x, y, w, owner = native.parse_libsvm_dense(b"1 0:5 1:6\n", 2, indexing_mode=0)
+    del owner, y, w
+    gc.collect()
+    np.testing.assert_allclose(x, [[5, 6]])
+    sl = x[0]  # derived view keeps the chain
+    del x
+    gc.collect()
+    np.testing.assert_allclose(sl, [5, 6])
