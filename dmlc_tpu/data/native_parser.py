@@ -1,0 +1,207 @@
+"""Fully-native streaming parser: the whole read->chunk->parse pipeline runs
+in C++ (native/src/reader.cc) with one GIL-releasing pull per parsed block.
+
+This is the TPU-first hot path for local text corpora: where the reference
+stacks ThreadedInputSplit (prefetch thread) + ThreadedParser (parse-ahead
+thread) + per-chunk parse threads in C++ (src/io/threaded_input_split.h,
+src/data/parser.h:70-126), this class delegates the identical pipeline to
+the native core, so on a TPU-VM host parsing overlaps JAX dispatch and
+host->HBM DMA without touching the GIL.
+
+``create_parser`` (dmlc_tpu.data.parsers) routes eligible URIs here: local
+filesystem, text formats (libsvm / csv / libfm), no cache or shuffle
+decorators. Everything else takes the Python engine, which shares chunk
+semantics with this path (both mirror input_split_base.cc).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dmlc_tpu.data.parsers import (
+    CSVParserParam,
+    LibFMParserParam,
+    LibSVMParserParam,
+    Parser,
+    csv_cells_to_block,
+    csv_cells_to_dense,
+)
+from dmlc_tpu.data.row_block import DenseBlock, RowBlock
+from dmlc_tpu.io.filesystem import LocalFileSystem, get_filesystem
+from dmlc_tpu.io.input_split import DEFAULT_CHUNK_BYTES, LineSplitter
+from dmlc_tpu.utils.check import DMLCError, check
+
+
+def list_partition_files(uri: str) -> Tuple[List[str], List[int]]:
+    """Expand a local URI (';' lists, dirs, regex basenames) to (paths, sizes)
+    using the same matching rules as the input-split engine."""
+    fs = get_filesystem(uri)
+    check(isinstance(fs, LocalFileSystem), "native reader requires local files")
+    lister = LineSplitter(fs, uri)
+    paths = [info.path.name for info in lister.files]
+    sizes = [info.size for info in lister.files]
+    return paths, sizes
+
+
+class NativeStreamParser(Parser):
+    """Parser facade over :class:`dmlc_tpu.native.Reader`.
+
+    The native reader owns partitioning (byte-range + record-boundary
+    adjustment), chunking, and multi-threaded parsing; this class wraps the
+    returned buffers zero-copy into RowBlock / DenseBlock.
+    """
+
+    def __init__(
+        self,
+        uri: str,
+        args: Optional[Dict[str, str]],
+        part_index: int,
+        num_parts: int,
+        fmt_name: str,
+        index_dtype=np.uint64,
+        chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+    ):
+        check(fmt_name in ("libsvm", "csv", "libfm"),
+              f"native reader does not support format {fmt_name!r}")
+        self.fmt_name = fmt_name
+        self.index_dtype = index_dtype
+        self.chunk_bytes = chunk_bytes
+        self.part_index = part_index
+        self.num_parts = num_parts
+        args = dict(args or {})
+        if fmt_name == "libsvm":
+            self.param = LibSVMParserParam()
+        elif fmt_name == "csv":
+            self.param = CSVParserParam()
+        else:
+            self.param = LibFMParserParam()
+        self.param.init(args, allow_unknown=True)
+        if fmt_name == "csv":
+            # the native csv scanner emits float32 cells only; a DMLCError
+            # here routes the caller to the Python engine, which supports
+            # int32/int64 and raises proper config errors
+            check(self.param.dtype == "float32",
+                  "native reader: csv dtype must be float32")
+            # mirror CSVParser.__init__'s config validation (parsers.py) so
+            # bad configs fail loudly instead of silently mis-parsing
+            check(len(self.param.delimiter) == 1,
+                  "CSVParser: delimiter must be one char")
+            check(
+                self.param.label_column != self.param.weight_column
+                or self.param.label_column < 0,
+                "CSVParser: label_column must differ from weight_column",
+            )
+        self.paths, self.sizes = list_partition_files(uri)
+        self._reader = None
+        self._emit_dense: Optional[int] = None
+        self._stall = 0.0
+
+    # ---------------- configuration ----------------
+
+    def set_emit_dense(self, num_col: int) -> bool:
+        """Emit DenseBlock batches straight from the native dense scanner.
+        Must be called before the first pull (the reader pipeline starts
+        lazily). libfm has no dense analog."""
+        if self._reader is not None or self.fmt_name == "libfm":
+            return False
+        self._emit_dense = int(num_col)
+        return True
+
+    # ---------------- pipeline ----------------
+
+    def _ensure_reader(self):
+        if self._reader is None:
+            from dmlc_tpu import native
+
+            if self.fmt_name == "libsvm":
+                fmt = (native.FMT_LIBSVM_DENSE if self._emit_dense is not None
+                       else native.FMT_LIBSVM)
+            elif self.fmt_name == "csv":
+                fmt = native.FMT_CSV
+            else:
+                fmt = native.FMT_LIBFM
+            indexing_mode = getattr(self.param, "indexing_mode", 0)
+            self._reader = native.Reader(
+                self.paths, self.sizes, self.part_index, self.num_parts,
+                fmt, num_col=self._emit_dense or 0,
+                indexing_mode=indexing_mode,
+                delimiter=getattr(self.param, "delimiter", ","),
+                chunk_bytes=self.chunk_bytes,
+            )
+        return self._reader
+
+    def next_block(self):
+        from dmlc_tpu import native
+
+        reader = self._ensure_reader()
+        t0 = time.monotonic()
+        out = reader.next()
+        self._stall += time.monotonic() - t0
+        if out is None:
+            return None
+        fmt, data = out
+        if fmt == native.FMT_LIBSVM_DENSE:
+            x, label, weight, owner = data
+            return DenseBlock(x, label, weight, hold=owner)
+        if fmt in (native.FMT_LIBSVM, native.FMT_LIBFM):
+            return RowBlock(
+                offset=data["offset"], label=data["label"],
+                index=data["index"], value=data["value"],
+                weight=data["weight"], qid=data["qid"],
+                field=data["field"], hold=data["_owner"],
+            )
+        cells, owner = data
+        n, ncol = cells.shape
+        if self._emit_dense is not None:
+            return csv_cells_to_dense(
+                cells, n, ncol, int(self._emit_dense),
+                self.param.label_column, self.param.weight_column, owner)
+        return csv_cells_to_block(
+            cells, n, ncol, self.param.label_column,
+            self.param.weight_column, self.index_dtype)
+
+    def before_first(self) -> None:
+        if self._reader is not None:
+            self._reader.before_first()
+
+    @property
+    def bytes_read(self) -> int:
+        return self._reader.bytes_read if self._reader is not None else 0
+
+    @property
+    def stall_seconds(self) -> float:
+        """Consumer-side wait on the native pipeline."""
+        return self._stall
+
+    def close(self) -> None:
+        if self._reader is not None:
+            self._reader.close()
+            self._reader = None
+
+
+def native_reader_eligible(uri: str, type_: str, threaded: bool,
+                           split_kw: Dict) -> bool:
+    """True when create_parser can route to the native stream parser."""
+    from dmlc_tpu import native
+
+    if not threaded or type_ not in ("libsvm", "csv", "libfm"):
+        return False
+    if "#" in uri:
+        return False  # cachefile decorator
+    for key in ("shuffle", "num_shuffle_parts", "index_uri"):
+        if split_kw.get(key):
+            return False
+    if split_kw.get("recurse_directories"):
+        return False
+    try:
+        fs = get_filesystem(uri.split("?", 1)[0])
+    except DMLCError:
+        return False
+    if not isinstance(fs, LocalFileSystem):
+        return False
+    if uri.split("?", 1)[0] in ("stdin",):
+        return False
+    return native.available()

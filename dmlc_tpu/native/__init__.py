@@ -22,10 +22,12 @@ import numpy as np
 from dmlc_tpu.utils.check import DMLCError, get_logger
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_SRC = os.path.join(_REPO_ROOT, "native", "src", "parse.cc")
+_SRC_DIR = os.path.join(_REPO_ROOT, "native", "src")
+_SRCS = [os.path.join(_SRC_DIR, f) for f in ("parse.cc", "reader.cc")]
+_HDRS = [os.path.join(_SRC_DIR, f) for f in ("api.h", "strtonum.h")]
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 _SO_PATH = os.path.join(_BUILD_DIR, "libdmlc_tpu_native.so")
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -73,8 +75,14 @@ def _build() -> bool:
     # copied checkouts) and ISA-specific code would SIGILL with no fallback
     cmd = [
         "g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
-        "-o", _SO_PATH, _SRC,
+        "-D_FILE_OFFSET_BITS=64",
     ]
+    san = os.environ.get("DMLC_TPU_SANITIZE", "")
+    if san:
+        # ASan/TSan toggle, mirroring the reference's DMLC_USE_SANITIZER
+        # CMake option (cmake/Sanitizer.cmake)
+        cmd += [f"-fsanitize={san}", "-g", "-fno-omit-frame-pointer"]
+    cmd += ["-o", _SO_PATH] + _SRCS
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
     except (OSError, subprocess.TimeoutExpired) as exc:
@@ -96,9 +104,10 @@ def _load() -> Optional[ctypes.CDLL]:
         if os.environ.get("DMLC_TPU_NO_NATIVE", "0") not in ("", "0"):
             _build_failed = True
             return None
-        need_build = not os.path.exists(_SO_PATH) or (
-            os.path.exists(_SRC)
-            and os.path.getmtime(_SRC) > os.path.getmtime(_SO_PATH)
+        so_mtime = os.path.getmtime(_SO_PATH) if os.path.exists(_SO_PATH) else -1
+        need_build = so_mtime < 0 or any(
+            os.path.exists(src) and os.path.getmtime(src) > so_mtime
+            for src in _SRCS + _HDRS
         )
         if need_build and not _build():
             _build_failed = True
@@ -176,6 +185,21 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.dmlc_free_block.argtypes = [ctypes.c_void_p]
     lib.dmlc_free_csv.argtypes = [ctypes.c_void_p]
     lib.dmlc_native_abi_version.restype = ctypes.c_int
+    lib.dmlc_reader_create.restype = ctypes.c_void_p
+    lib.dmlc_reader_create.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int32, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_char, ctypes.c_int32,
+        ctypes.c_int64, ctypes.c_int32]
+    lib.dmlc_reader_next.restype = ctypes.c_void_p
+    lib.dmlc_reader_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32)]
+    lib.dmlc_reader_before_first.argtypes = [ctypes.c_void_p]
+    lib.dmlc_reader_bytes_read.restype = ctypes.c_int64
+    lib.dmlc_reader_bytes_read.argtypes = [ctypes.c_void_p]
+    lib.dmlc_reader_error.restype = ctypes.c_char_p
+    lib.dmlc_reader_error.argtypes = [ctypes.c_void_p]
+    lib.dmlc_reader_destroy.argtypes = [ctypes.c_void_p]
 
 
 def available() -> bool:
@@ -301,6 +325,10 @@ def parse_libsvm_dense(chunk: bytes, num_col: int, nthread: int = 0,
         return None
     res = lib.dmlc_parse_libsvm_dense(
         chunk, len(chunk), nthread or default_nthread(), num_col, indexing_mode)
+    return _wrap_dense(lib, res, num_col)
+
+
+def _wrap_dense(lib, res, num_col: int):
     r = res.contents
     if r.error:
         msg = r.error.decode()
@@ -328,6 +356,10 @@ def parse_csv(chunk: bytes, delimiter: str = ",", nthread: int = 0):
     res = lib.dmlc_parse_csv(
         chunk, len(chunk), nthread or default_nthread(),
         delimiter.encode()[0] if delimiter else b","[0])
+    return _wrap_csv(lib, res)
+
+
+def _wrap_csv(lib, res):
     r = res.contents
     if r.error:
         msg = r.error.decode()
@@ -339,3 +371,86 @@ def parse_csv(chunk: bytes, delimiter: str = ",", nthread: int = 0):
         return np.zeros((0, 0), np.float32), owner
     cells = _view(r.cells, n * c, np.float32, owner)
     return cells.reshape(n, c), owner
+
+
+# ---------------- streaming reader ----------------
+
+FMT_LIBSVM = 0
+FMT_LIBSVM_DENSE = 1
+FMT_CSV = 2
+FMT_LIBFM = 3
+
+
+class Reader:
+    """Native read->chunk->parse pipeline over a byte-range partition.
+
+    Wraps reader.cc: a C++ producer thread loads record-aligned chunks of
+    this partition and parses them with worker threads; :meth:`next` blocks
+    (GIL released) until a parsed block is ready and wraps it zero-copy.
+    """
+
+    def __init__(self, paths, sizes, part_index: int, num_parts: int,
+                 fmt: int, num_col: int = 0, indexing_mode: int = 0,
+                 delimiter: str = ",", nthread: int = 0,
+                 chunk_bytes: int = 1 << 20, queue_depth: int = 4):
+        lib = _load()
+        if lib is None:
+            raise DMLCError("native core unavailable")
+        self._lib = lib
+        self._fmt = fmt
+        self._num_col = num_col
+        arr_p = (ctypes.c_char_p * len(paths))(
+            *[os.fsencode(p) for p in paths])
+        arr_s = (ctypes.c_int64 * len(sizes))(*sizes)
+        self._h = lib.dmlc_reader_create(
+            arr_p, arr_s, len(paths), part_index, num_parts, fmt, num_col,
+            indexing_mode, delimiter.encode()[0] if delimiter else b","[0],
+            nthread or default_nthread(), chunk_bytes, queue_depth)
+        self._check_error()
+
+    def _check_error(self) -> None:
+        err = self._lib.dmlc_reader_error(self._h)
+        if err:
+            raise DMLCError(err.decode())
+
+    def next(self):
+        """Next parsed block as ``(fmt, wrapped)`` where wrapped is:
+        FMT_LIBSVM/FMT_LIBFM -> dict of CSR arrays (like parse_libsvm);
+        FMT_LIBSVM_DENSE -> (x, label, weight, owner);
+        FMT_CSV -> (cells, owner). None at end of partition. ``fmt`` can
+        downgrade from FMT_LIBSVM_DENSE to FMT_LIBSVM mid-stream when the
+        dense scanner meets qid rows."""
+        if self._h is None:
+            return None
+        fmt = ctypes.c_int32(self._fmt)
+        ptr = self._lib.dmlc_reader_next(self._h, ctypes.byref(fmt))
+        if not ptr:
+            self._check_error()
+            return None
+        if fmt.value in (FMT_LIBSVM, FMT_LIBFM):
+            res = ctypes.cast(ptr, ctypes.POINTER(_CsrBlockResult))
+            return fmt.value, _wrap_block(self._lib, res)
+        if fmt.value == FMT_LIBSVM_DENSE:
+            res = ctypes.cast(ptr, ctypes.POINTER(_DenseResult))
+            return fmt.value, _wrap_dense(self._lib, res, self._num_col)
+        res = ctypes.cast(ptr, ctypes.POINTER(_CsvResult))
+        return fmt.value, _wrap_csv(self._lib, res)
+
+    def before_first(self) -> None:
+        if self._h is not None:
+            self._lib.dmlc_reader_before_first(self._h)
+
+    @property
+    def bytes_read(self) -> int:
+        return self._lib.dmlc_reader_bytes_read(self._h) if self._h is not None else 0
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.dmlc_reader_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,88 @@
+// Shared C ABI declarations for the native core (parse.cc + reader.cc).
+//
+// All result buffers are malloc'd and freed with the matching dmlc_free_*;
+// Python loads these via ctypes (no pybind11 in this image).
+
+#ifndef DMLC_TPU_NATIVE_API_H_
+#define DMLC_TPU_NATIVE_API_H_
+
+#include <cstdint>
+
+extern "C" {
+
+// One parsed CSR block (libsvm / libfm). Free with dmlc_free_block.
+struct CsrBlockResult {
+  int64_t n_rows;
+  int64_t nnz;
+  int64_t* offset;    // [n_rows + 1]
+  float* label;       // [n_rows]
+  float* weight;      // [n_rows] or null
+  int64_t* qid;       // [n_rows] or null
+  uint64_t* index;    // [nnz]
+  uint64_t* field;    // [nnz] or null (libfm)
+  float* value;       // [nnz] or null (all-binary)
+  char* error;        // null on success
+};
+
+// Dense libsvm result: x laid out row-major [n_rows, n_cols].
+struct DenseResult {
+  int64_t n_rows;
+  int64_t n_cols;
+  float* x;       // [n_rows, n_cols]
+  float* label;   // [n_rows]
+  float* weight;  // [n_rows] or null
+  char* error;    // null on success
+};
+
+// Dense CSV result: cells laid out row-major [n_rows, n_cols].
+struct CsvResult {
+  int64_t n_rows;
+  int64_t n_cols;
+  float* cells;
+  char* error;
+};
+
+CsrBlockResult* dmlc_parse_libsvm(const char* data, int64_t len, int nthread,
+                                  int indexing_mode);
+CsrBlockResult* dmlc_parse_libfm(const char* data, int64_t len, int nthread,
+                                 int indexing_mode);
+DenseResult* dmlc_parse_libsvm_dense(const char* data, int64_t len, int nthread,
+                                     int64_t num_col, int indexing_mode);
+CsvResult* dmlc_parse_csv(const char* data, int64_t len, int nthread, char delim);
+
+void dmlc_free_block(CsrBlockResult* r);
+void dmlc_free_dense(DenseResult* r);
+void dmlc_free_csv(CsvResult* r);
+
+int dmlc_native_abi_version();
+
+// ---------------- streaming reader (reader.cc) ----------------
+//
+// A native read->chunk->parse pipeline over a byte-range partition of local
+// text files: producer thread loads record-aligned chunks (the reference's
+// InputSplitBase/LineSplitter invariants), parses each with worker threads,
+// and queues parsed blocks for the consumer. Formats: 0=libsvm (CSR),
+// 1=libsvm dense, 2=csv, 3=libfm.
+
+void* dmlc_reader_create(const char** paths, const int64_t* sizes,
+                         int32_t nfiles, int64_t part_index, int64_t num_parts,
+                         int32_t format, int64_t num_col, int32_t indexing_mode,
+                         char delim, int32_t nthread, int64_t chunk_bytes,
+                         int32_t queue_depth);
+// Next parsed block; NULL at end-of-partition or on reader error (check
+// dmlc_reader_error). Parse errors ride the result's own error field.
+// Blocks with zero rows are never returned. `fmt_out` (may be NULL)
+// receives the format of THIS result: a reader created with format 1
+// (libsvm dense) downgrades permanently to format 0 (CSR) when it meets
+// data the dense scanner cannot express (qid rows), so the tag can differ
+// from the requested format.
+void* dmlc_reader_next(void* handle, int32_t* fmt_out);
+void dmlc_reader_before_first(void* handle);
+int64_t dmlc_reader_bytes_read(void* handle);
+// Non-NULL when the reader itself failed (open/seek/IO); owned by the handle.
+const char* dmlc_reader_error(void* handle);
+void dmlc_reader_destroy(void* handle);
+
+}  // extern "C"
+
+#endif  // DMLC_TPU_NATIVE_API_H_

@@ -23,6 +23,7 @@
 #include <thread>
 #include <vector>
 
+#include "api.h"
 #include "strtonum.h"
 
 namespace dmlc_tpu {
@@ -381,20 +382,6 @@ using namespace dmlc_tpu;
 
 extern "C" {
 
-// One parsed CSR block. Arrays are malloc'd; free with dmlc_free_block.
-struct CsrBlockResult {
-  int64_t n_rows;
-  int64_t nnz;
-  int64_t* offset;    // [n_rows + 1]
-  float* label;       // [n_rows]
-  float* weight;      // [n_rows] or null
-  int64_t* qid;       // [n_rows] or null
-  uint64_t* index;    // [nnz]
-  uint64_t* field;    // [nnz] or null (libfm)
-  float* value;       // [nnz] or null (all-binary)
-  char* error;        // null on success
-};
-
 static char* dup_error(const std::string& s) {
   char* e = static_cast<char*>(malloc(s.size() + 1));
   memcpy(e, s.c_str(), s.size() + 1);
@@ -525,16 +512,6 @@ CsrBlockResult* dmlc_parse_libfm(const char* data, int64_t len, int nthread,
   return merge_parts(parts, indexing_mode, true);
 }
 
-// Dense libsvm result: x laid out row-major [n_rows, n_cols].
-struct DenseResult {
-  int64_t n_rows;
-  int64_t n_cols;
-  float* x;       // [n_rows, n_cols]
-  float* label;   // [n_rows]
-  float* weight;  // [n_rows] or null
-  char* error;    // null on success
-};
-
 DenseResult* dmlc_parse_libsvm_dense(const char* data, int64_t len, int nthread,
                                      int64_t num_col, int indexing_mode) {
   const char* end = data + len;
@@ -604,14 +581,6 @@ void dmlc_free_dense(DenseResult* r) {
   free(r);
 }
 
-// Dense CSV result: cells laid out row-major [n_rows, n_cols].
-struct CsvResult {
-  int64_t n_rows;
-  int64_t n_cols;
-  float* cells;
-  char* error;
-};
-
 CsvResult* dmlc_parse_csv(const char* data, int64_t len, int nthread, char delim) {
   const char* end = data + len;
   data = skip_bom(data, &end);
@@ -668,6 +637,6 @@ void dmlc_free_csv(CsvResult* r) {
   free(r);
 }
 
-int dmlc_native_abi_version() { return 2; }
+int dmlc_native_abi_version() { return 3; }
 
 }  // extern "C"

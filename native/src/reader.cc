@@ -1,0 +1,472 @@
+// Native streaming reader: byte-range partitioned file reading + record
+// boundary chunking + threaded parse, all off the Python thread.
+//
+// TPU-native rebuild of the reference read pipeline (src/io/
+// input_split_base.cc + line_split.cc + threaded_input_split.h): one
+// producer thread loads record-aligned chunks of this partition and parses
+// each with the multi-threaded scanners in parse.cc, pushing parsed blocks
+// into a bounded queue. The Python consumer pulls fully-parsed blocks with a
+// single GIL-releasing ctypes call — so on a TPU-VM host the whole
+// read+scan+parse path runs concurrently with JAX dispatch and host->HBM
+// transfers.
+//
+// Partition invariants mirror the Python engine (dmlc_tpu/io/input_split.py)
+// and therefore the reference:
+//   - partition k of n owns bytes [k*step, (k+1)*step), step = ceil(total/n)
+//     over the concatenation of all files (ResetPartition,
+//     input_split_base.cc:30-64);
+//   - both ends advance to the next record head unless they sit exactly on a
+//     file boundary;
+//   - '\n' is injected at text-file joins (input_split_base.cc:196-199,
+//     PR#385) and when the final record lacks a newline
+//     (input_split_base.cc:235-242, PR#452).
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "api.h"
+
+namespace {
+
+constexpr int kFmtLibsvm = 0;
+constexpr int kFmtLibsvmDense = 1;
+constexpr int kFmtCsv = 2;
+constexpr int kFmtLibfm = 3;
+
+void free_result(int format, void* res) {
+  if (!res) return;
+  switch (format) {
+    case kFmtLibsvm:
+    case kFmtLibfm:
+      dmlc_free_block(static_cast<CsrBlockResult*>(res));
+      break;
+    case kFmtLibsvmDense:
+      dmlc_free_dense(static_cast<DenseResult*>(res));
+      break;
+    case kFmtCsv:
+      dmlc_free_csv(static_cast<CsvResult*>(res));
+      break;
+  }
+}
+
+int64_t result_rows(int format, void* res) {
+  switch (format) {
+    case kFmtLibsvm:
+    case kFmtLibfm:
+      return static_cast<CsrBlockResult*>(res)->n_rows;
+    case kFmtLibsvmDense:
+      return static_cast<DenseResult*>(res)->n_rows;
+    case kFmtCsv:
+      return static_cast<CsvResult*>(res)->n_rows;
+  }
+  return 0;
+}
+
+const char* result_error(int format, void* res) {
+  switch (format) {
+    case kFmtLibsvm:
+    case kFmtLibfm:
+      return static_cast<CsrBlockResult*>(res)->error;
+    case kFmtLibsvmDense:
+      return static_cast<DenseResult*>(res)->error;
+    case kFmtCsv:
+      return static_cast<CsvResult*>(res)->error;
+  }
+  return nullptr;
+}
+
+inline bool is_eol(char c) { return c == '\n' || c == '\r'; }
+
+class LineReader {
+ public:
+  LineReader(std::vector<std::string> paths, std::vector<int64_t> sizes,
+             int64_t part_index, int64_t num_parts, int format,
+             int64_t num_col, int indexing_mode, char delim, int nthread,
+             int64_t chunk_bytes, int queue_depth)
+      : paths_(std::move(paths)),
+        format_(format),
+        num_col_(num_col),
+        indexing_mode_(indexing_mode),
+        delim_(delim),
+        nthread_(nthread < 1 ? 1 : nthread),
+        chunk_bytes_(chunk_bytes < 4096 ? 4096 : chunk_bytes),
+        queue_depth_(queue_depth < 1 ? 1 : queue_depth) {
+    file_offset_.push_back(0);
+    for (int64_t s : sizes) file_offset_.push_back(file_offset_.back() + s);
+    reset_partition(part_index, num_parts);
+    if (error_.empty()) start();
+  }
+
+  ~LineReader() {
+    stop_and_join();
+    close_fp();
+  }
+
+  void* next(int32_t* fmt_out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_pop_.wait(lk, [&] { return !queue_.empty() || produce_done_; });
+    if (queue_.empty()) return nullptr;
+    auto item = queue_.front();
+    queue_.pop_front();
+    cv_push_.notify_one();
+    if (fmt_out) *fmt_out = item.first;
+    return item.second;
+  }
+
+  void before_first() {
+    stop_and_join();
+    offset_curr_ = offset_begin_;
+    overflow_.clear();
+    close_fp();
+    if (error_.empty()) start();
+  }
+
+  int64_t bytes_read() const { return bytes_read_.load(std::memory_order_relaxed); }
+
+  const char* error() const {
+    // set_error is set-once, so the pointer stays stable after return
+    std::lock_guard<std::mutex> lk(err_mu_);
+    return error_.empty() ? nullptr : error_.c_str();
+  }
+
+ private:
+  // ---------------- partitioning (create-time, mirrors ResetPartition) ----
+  void reset_partition(int64_t part_index, int64_t num_parts) {
+    int64_t ntotal = file_offset_.back();
+    int64_t nstep = (ntotal + num_parts - 1) / num_parts;
+    offset_begin_ = std::min(nstep * part_index, ntotal);
+    offset_end_ = std::min(nstep * (part_index + 1), ntotal);
+    offset_curr_ = offset_begin_;
+    if (offset_begin_ >= offset_end_) return;
+    size_t fbegin = file_of(offset_begin_);
+    size_t fend = file_of(offset_end_);
+    if (offset_end_ != file_offset_[fend]) {
+      offset_end_ += seek_record_begin(fend, offset_end_ - file_offset_[fend]);
+      if (!error_.empty()) return;
+    }
+    if (offset_begin_ != file_offset_[fbegin]) {
+      offset_begin_ +=
+          seek_record_begin(fbegin, offset_begin_ - file_offset_[fbegin]);
+      if (!error_.empty()) return;
+    }
+    offset_curr_ = offset_begin_;
+  }
+
+  // index of the file containing global offset `off` (last i with
+  // file_offset_[i] <= off), like bisect_right(...) - 1
+  size_t file_of(int64_t off) const {
+    size_t lo = 0, hi = file_offset_.size();
+    while (lo < hi) {
+      size_t mid = (lo + hi) / 2;
+      if (file_offset_[mid] <= off) lo = mid + 1; else hi = mid;
+    }
+    return lo - 1;
+  }
+
+  // Bytes from (file fidx, local offset) to the next record head: scan to the
+  // first EOL, then past the EOL run, within this one file
+  // (line_split.cc:9-26; the Python engine scans the same way).
+  int64_t seek_record_begin(size_t fidx, int64_t local_off) {
+    FILE* f = fopen(paths_[fidx].c_str(), "rb");
+    if (!f) {
+      error_ = "cannot open " + paths_[fidx];
+      return 0;
+    }
+    if (fseeko(f, static_cast<off_t>(local_off), SEEK_SET) != 0) {
+      error_ = "seek failed in " + paths_[fidx];
+      fclose(f);
+      return 0;
+    }
+    int64_t nstep = 0;
+    char buf[512];
+    bool in_run = false;
+    while (true) {
+      size_t r = fread(buf, 1, sizeof(buf), f);
+      if (r == 0) break;
+      for (size_t i = 0; i < r; ++i) {
+        if (!in_run) {
+          ++nstep;
+          if (is_eol(buf[i])) in_run = true;
+        } else {
+          if (is_eol(buf[i])) {
+            ++nstep;
+          } else {
+            fclose(f);
+            return nstep;
+          }
+        }
+      }
+    }
+    fclose(f);
+    return nstep;
+  }
+
+  // ---------------- reading (producer thread) ----------------
+
+  void close_fp() {
+    if (fp_) {
+      fclose(fp_);
+      fp_ = nullptr;
+    }
+  }
+
+  bool open_file(size_t fidx, int64_t local_off) {
+    close_fp();
+    fp_ = fopen(paths_[fidx].c_str(), "rb");
+    if (!fp_) {
+      set_error("cannot open " + paths_[fidx]);
+      return false;
+    }
+    if (local_off && fseeko(fp_, static_cast<off_t>(local_off), SEEK_SET) != 0) {
+      set_error("seek failed in " + paths_[fidx]);
+      return false;
+    }
+    file_ptr_ = fidx;
+    return true;
+  }
+
+  // Read up to `size` payload bytes across file joins, injecting '\n' at
+  // joins (Read, input_split_base.cc:177-219). Appends to `out`.
+  bool read_bytes(int64_t size, std::string* out) {
+    size = std::min(size, offset_end_ - offset_curr_);
+    if (size <= 0) return true;
+    if (!fp_) {
+      size_t fidx = file_of(offset_curr_);
+      if (!open_file(fidx, offset_curr_ - file_offset_[fidx])) return false;
+    }
+    int64_t nleft = size;
+    size_t base = out->size();
+    out->resize(base + static_cast<size_t>(size));
+    char* dst = &(*out)[base];
+    while (nleft > 0) {
+      size_t got = fread(dst, 1, static_cast<size_t>(nleft), fp_);
+      if (got > 0) {
+        dst += got;
+        nleft -= static_cast<int64_t>(got);
+        offset_curr_ += static_cast<int64_t>(got);
+        bytes_read_.fetch_add(static_cast<int64_t>(got),
+                              std::memory_order_relaxed);
+        continue;
+      }
+      if (ferror(fp_)) {
+        set_error("read failed in " + paths_[file_ptr_]);
+        return false;
+      }
+      // file exhausted: newline injection at the join (PR#385)
+      *dst++ = '\n';
+      nleft -= 1;
+      bytes_read_.fetch_add(1, std::memory_order_relaxed);
+      if (offset_curr_ != file_offset_[file_ptr_ + 1]) {
+        set_error("file offset not calculated correctly");
+        return false;
+      }
+      if (file_ptr_ + 1 >= paths_.size()) break;
+      if (!open_file(file_ptr_ + 1, 0)) return false;
+    }
+    out->resize(static_cast<size_t>(dst - out->data()));
+    return true;
+  }
+
+  // One chunk of whole records into `chunk`; false at EOF/error
+  // (ReadChunk + Chunk::Load grow loop, input_split_base.cc:221-277).
+  bool load_chunk(std::string* chunk) {
+    int64_t size = chunk_bytes_;
+    while (true) {
+      if (static_cast<int64_t>(overflow_.size()) >= size) {
+        size *= 2;
+        continue;
+      }
+      size_t olen = overflow_.size();
+      chunk->assign(overflow_);
+      overflow_.clear();
+      if (!read_bytes(size - static_cast<int64_t>(olen), chunk)) return false;
+      if (chunk->empty()) return false;  // EOF
+      if (chunk->size() == olen) {
+        // final record of the partition lacked a newline (PR#452)
+        chunk->push_back('\n');
+      }
+      // cut after the last EOL (find_last_record_begin, line_split.cc:27-34)
+      size_t cut = chunk->size();
+      while (cut > 0 && !is_eol((*chunk)[cut - 1])) --cut;
+      if (cut == 0) {
+        overflow_.swap(*chunk);
+        size *= 2;
+        continue;
+      }
+      overflow_.assign(*chunk, cut, chunk->npos);
+      chunk->resize(cut);
+      return true;
+    }
+  }
+
+  void* parse_chunk(const std::string& chunk) {
+    switch (format_) {
+      case kFmtLibsvm:
+        return dmlc_parse_libsvm(chunk.data(),
+                                 static_cast<int64_t>(chunk.size()), nthread_,
+                                 indexing_mode_);
+      case kFmtLibsvmDense:
+        return dmlc_parse_libsvm_dense(chunk.data(),
+                                       static_cast<int64_t>(chunk.size()),
+                                       nthread_, num_col_, indexing_mode_);
+      case kFmtCsv:
+        return dmlc_parse_csv(chunk.data(),
+                              static_cast<int64_t>(chunk.size()), nthread_,
+                              delim_);
+      case kFmtLibfm:
+        return dmlc_parse_libfm(chunk.data(),
+                                static_cast<int64_t>(chunk.size()), nthread_,
+                                indexing_mode_);
+    }
+    set_error("unknown format");
+    return nullptr;
+  }
+
+  void produce_loop() {
+    std::string chunk;
+    while (!stop_requested()) {
+      chunk.clear();
+      if (!load_chunk(&chunk)) break;  // EOF or IOerror
+      void* res = parse_chunk(chunk);
+      if (!res) break;
+      if (format_ == kFmtLibsvmDense) {
+        const char* err = result_error(format_, res);
+        if (err && strstr(err, "libsvm-dense")) {
+          // data the dense scanner can't express (qid rows): permanently
+          // downgrade to the CSR path and re-parse this chunk
+          free_result(format_, res);
+          format_ = kFmtLibsvm;
+          res = parse_chunk(chunk);
+          if (!res) break;
+        }
+      }
+      if (result_rows(format_, res) == 0 && !result_error(format_, res)) {
+        free_result(format_, res);  // blank/comment-only chunk
+        continue;
+      }
+      bool had_error = result_error(format_, res) != nullptr;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_push_.wait(lk, [&] {
+          return static_cast<int>(queue_.size()) < queue_depth_ || stop_;
+        });
+        if (stop_) {
+          free_result(format_, res);
+          // a consumer may be blocked in next(): mark done so it wakes
+          produce_done_ = true;
+          cv_pop_.notify_all();
+          return;
+        }
+        queue_.emplace_back(format_, res);
+      }
+      cv_pop_.notify_one();
+      if (had_error) break;  // parse error rides the queued result
+    }
+    std::lock_guard<std::mutex> lk(mu_);
+    produce_done_ = true;
+    cv_pop_.notify_all();
+  }
+
+  // ---------------- lifecycle ----------------
+
+  void start() {
+    stop_ = false;
+    produce_done_ = false;
+    producer_ = std::thread([this] { produce_loop(); });
+  }
+
+  void stop_and_join() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+      cv_push_.notify_all();
+    }
+    if (producer_.joinable()) producer_.join();
+    for (auto& item : queue_) free_result(item.first, item.second);
+    queue_.clear();
+    stop_ = false;
+    produce_done_ = false;
+  }
+
+  bool stop_requested() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return stop_;
+  }
+
+  void set_error(std::string msg) {
+    std::lock_guard<std::mutex> lk(err_mu_);
+    if (error_.empty()) error_ = std::move(msg);
+  }
+
+  std::vector<std::string> paths_;
+  std::vector<int64_t> file_offset_;
+  int format_;
+  int64_t num_col_;
+  int indexing_mode_;
+  char delim_;
+  int nthread_;
+  int64_t chunk_bytes_;
+  int queue_depth_;
+
+  int64_t offset_begin_ = 0, offset_end_ = 0, offset_curr_ = 0;
+  size_t file_ptr_ = 0;
+  FILE* fp_ = nullptr;
+  std::string overflow_;
+
+  std::thread producer_;
+  std::mutex mu_;
+  std::condition_variable cv_push_, cv_pop_;
+  std::deque<std::pair<int, void*>> queue_;
+  bool stop_ = false;
+  bool produce_done_ = false;
+  std::atomic<int64_t> bytes_read_{0};
+  mutable std::mutex err_mu_;
+  std::string error_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dmlc_reader_create(const char** paths, const int64_t* sizes,
+                         int32_t nfiles, int64_t part_index, int64_t num_parts,
+                         int32_t format, int64_t num_col, int32_t indexing_mode,
+                         char delim, int32_t nthread, int64_t chunk_bytes,
+                         int32_t queue_depth) {
+  std::vector<std::string> p(paths, paths + nfiles);
+  std::vector<int64_t> s(sizes, sizes + nfiles);
+  return new LineReader(std::move(p), std::move(s), part_index, num_parts,
+                        format, num_col, indexing_mode, delim, nthread,
+                        chunk_bytes, queue_depth);
+}
+
+void* dmlc_reader_next(void* handle, int32_t* fmt_out) {
+  return static_cast<LineReader*>(handle)->next(fmt_out);
+}
+
+void dmlc_reader_before_first(void* handle) {
+  static_cast<LineReader*>(handle)->before_first();
+}
+
+int64_t dmlc_reader_bytes_read(void* handle) {
+  return static_cast<LineReader*>(handle)->bytes_read();
+}
+
+const char* dmlc_reader_error(void* handle) {
+  return static_cast<LineReader*>(handle)->error();
+}
+
+void dmlc_reader_destroy(void* handle) {
+  delete static_cast<LineReader*>(handle);
+}
+
+}  // extern "C"
