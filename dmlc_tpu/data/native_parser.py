@@ -66,6 +66,12 @@ class NativeStreamParser(Parser):
     ):
         check(fmt_name in ("libsvm", "csv", "libfm"),
               f"native reader does not support format {fmt_name!r}")
+        # same partition validation as the Python engine (create_input_split):
+        # num_parts=0 would SIGFPE in the native byte-range divide, and an
+        # out-of-range part would silently yield an empty stream
+        check(num_parts >= 1, f"num_parts must be >= 1, got {num_parts}")
+        check(0 <= part_index < num_parts,
+              f"part_index {part_index} out of range for {num_parts} parts")
         self.fmt_name = fmt_name
         self.index_dtype = index_dtype
         self.chunk_bytes = chunk_bytes

@@ -245,9 +245,7 @@ class LibSVMParser(TextParserBase):
                 out = native.parse_libsvm_dense(
                     chunk, self._emit_dense,
                     indexing_mode=self.param.indexing_mode)
-            except DMLCError as exc:
-                if "libsvm-dense" not in str(exc):
-                    raise
+            except native.NeedsCsrError:
                 # data the dense scanner can't express (qid rows):
                 # permanently fall back to the CSR path
                 self._emit_dense = None

@@ -828,7 +828,9 @@ def create_input_split(
     chunk-shuffle decorator when num_shuffle_parts > 0
     (input_split_shuffle.h InputSplit::Create overload).
     """
-    check(part_index < num_parts, f"part_index {part_index} >= num_parts {num_parts}")
+    check(num_parts >= 1, f"num_parts must be >= 1, got {num_parts}")
+    check(0 <= part_index < num_parts,
+          f"part_index {part_index} out of range for {num_parts} parts")
     if uri == "stdin" or type_ == "stdin":
         return SingleFileSplit(uri)
     # URI sugar: `real#cachefile` selects the chunk-cache decorator with a

@@ -21,13 +21,19 @@ import numpy as np
 
 from dmlc_tpu.utils.check import DMLCError, get_logger
 
+
+class NeedsCsrError(DMLCError):
+    """Input the dense scanner can't express (e.g. qid rows) — explicit
+    signal (DenseResult.needs_csr) for callers to fall back to CSR, so no
+    routing ever depends on error-message wording."""
+
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _SRC_DIR = os.path.join(_REPO_ROOT, "native", "src")
 _SRCS = [os.path.join(_SRC_DIR, f) for f in ("parse.cc", "reader.cc")]
 _HDRS = [os.path.join(_SRC_DIR, f) for f in ("api.h", "strtonum.h")]
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 _SO_PATH = os.path.join(_BUILD_DIR, "libdmlc_tpu_native.so")
-_ABI_VERSION = 3
+_ABI_VERSION = 4
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -57,6 +63,7 @@ class _DenseResult(ctypes.Structure):
         ("label", ctypes.POINTER(ctypes.c_float)),
         ("weight", ctypes.POINTER(ctypes.c_float)),
         ("error", ctypes.c_char_p),
+        ("needs_csr", ctypes.c_int32),
     ]
 
 
@@ -332,8 +339,9 @@ def _wrap_dense(lib, res, num_col: int):
     r = res.contents
     if r.error:
         msg = r.error.decode()
+        needs_csr = bool(r.needs_csr)
         lib.dmlc_free_dense(res)
-        raise DMLCError(msg)
+        raise NeedsCsrError(msg) if needs_csr else DMLCError(msg)
     owner = _Owner(lib, res, _free_dense)
     n = r.n_rows
     if n == 0:
@@ -406,6 +414,9 @@ class Reader:
             arr_p, arr_s, len(paths), part_index, num_parts, fmt, num_col,
             indexing_mode, delimiter.encode()[0] if delimiter else b","[0],
             nthread or default_nthread(), chunk_bytes, queue_depth)
+        if not self._h:
+            raise DMLCError(
+                "native reader creation failed (out of memory or threads)")
         self._check_error()
 
     def _check_error(self) -> None:

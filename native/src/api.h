@@ -32,6 +32,9 @@ struct DenseResult {
   float* label;   // [n_rows]
   float* weight;  // [n_rows] or null
   char* error;    // null on success
+  int32_t needs_csr;  // 1 = data needs the CSR path (e.g. qid rows); error is
+                      // also set. Explicit flag so callers never route on
+                      // error-message wording.
 };
 
 // Dense CSV result: cells laid out row-major [n_rows, n_cols].

@@ -75,19 +75,57 @@ static inline const char* line_end(const char* p, const char* end) {
   return p;
 }
 
+// SIMD line scan: memchr for '\n' (and '\r' only when the range has any —
+// one flag check instead of a scalar byte loop re-touching every line).
+// The scalar pre-scan was ~1 cyc/byte, a full second pass over the chunk.
+static inline const char* line_end_fast(const char* p, const char* end,
+                                        bool has_cr) {
+  const char* nl =
+      static_cast<const char*>(memchr(p, '\n', static_cast<size_t>(end - p)));
+  const char* stop = nl ? nl : end;
+  if (has_cr) {
+    const char* cr = static_cast<const char*>(
+        memchr(p, '\r', static_cast<size_t>(stop - p)));
+    if (cr) return cr;
+  }
+  return stop;
+}
+
 // ---------------- libsvm ----------------
 
+// Count bytes equal to `c` in [p, end) via SIMD memchr hops — ~0.1 cyc/byte,
+// repaid many times over by reserving the output vectors (push_back growth
+// re-copies multi-MB index/value arrays several times otherwise).
+static inline size_t count_byte(const char* p, const char* end, char c) {
+  size_t n = 0;
+  while ((p = static_cast<const char*>(memchr(p, c, end - p))) != nullptr) {
+    ++n;
+    ++p;
+  }
+  return n;
+}
+
 static void parse_libsvm_range(const char* begin, const char* end, CsrPart* out) {
+  const bool has_cr =
+      memchr(begin, '\r', static_cast<size_t>(end - begin)) != nullptr;
   const char* p = begin;
+  {
+    size_t rows = count_byte(begin, end, '\n') + 1;
+    size_t entries = count_byte(begin, end, ':');  // upper bound (+weights/qids)
+    out->row_nnz.reserve(rows);
+    out->label.reserve(rows);
+    out->index.reserve(entries);
+    out->value.reserve(entries);
+  }
   while (p < end) {
-    const char* lend = line_end(p, end);
+    const char* lend = line_end_fast(p, end, has_cr);
     const char* q = p;
     // strip comment
     const char* hash = static_cast<const char*>(memchr(q, '#', lend - q));
     const char* effective_end = hash ? hash : lend;
     double label;
     const char* after;
-    if (!parse_double(q, effective_end, &after, &label)) {
+    if (!parse_value(q, effective_end, &after, &label)) {
       p = lend;
       while (p < end && (*p == '\n' || *p == '\r')) ++p;
       continue;  // blank/comment-only line
@@ -97,7 +135,7 @@ static void parse_libsvm_range(const char* begin, const char* end, CsrPart* out)
     double weight = 1.0;
     if (q != effective_end && *q == ':') {
       ++q;
-      if (!parse_double(q, effective_end, &after, &weight)) {
+      if (!parse_value(q, effective_end, &after, &weight)) {
         out->error = "libsvm: bad label:weight";
         return;
       }
@@ -145,7 +183,7 @@ static void parse_libsvm_range(const char* begin, const char* end, CsrPart* out)
       if (q != effective_end && *q == ':') {
         double v;
         ++q;
-        if (!parse_double(q, effective_end, &after, &v)) {
+        if (!parse_value(q, effective_end, &after, &v)) {
           out->error = "libsvm: bad idx:value";
           return;
         }
@@ -179,15 +217,26 @@ static void parse_libsvm_range(const char* begin, const char* end, CsrPart* out)
 // ---------------- libfm ----------------
 
 static void parse_libfm_range(const char* begin, const char* end, CsrPart* out) {
+  const bool has_cr =
+      memchr(begin, '\r', static_cast<size_t>(end - begin)) != nullptr;
   const char* p = begin;
+  {
+    size_t rows = count_byte(begin, end, '\n') + 1;
+    size_t entries = count_byte(begin, end, ':') / 2 + 1;  // two ':' per triple
+    out->row_nnz.reserve(rows);
+    out->label.reserve(rows);
+    out->field.reserve(entries);
+    out->index.reserve(entries);
+    out->value.reserve(entries);
+  }
   while (p < end) {
-    const char* lend = line_end(p, end);
+    const char* lend = line_end_fast(p, end, has_cr);
     const char* q = p;
     const char* hash = static_cast<const char*>(memchr(q, '#', lend - q));
     const char* effective_end = hash ? hash : lend;
     double label;
     const char* after;
-    if (!parse_double(q, effective_end, &after, &label)) {
+    if (!parse_value(q, effective_end, &after, &label)) {
       p = lend;
       while (p < end && (*p == '\n' || *p == '\r')) ++p;
       continue;
@@ -207,7 +256,7 @@ static void parse_libfm_range(const char* begin, const char* end, CsrPart* out) 
       }
       q = after;
       if (q == effective_end || *q != ':' ||
-          !parse_double(q + 1, effective_end, &after, &v)) {
+          !parse_value(q + 1, effective_end, &after, &v)) {
         out->error = "libfm: features must be field:index:value triples";
         return;
       }
@@ -245,30 +294,42 @@ struct DensePart {
   std::vector<float> weight;  // empty or per-row
   uint64_t min_index = UINT64_MAX;
   std::string error;
+  bool needs_csr = false;  // data the dense layout can't express (qid rows)
 };
 
 static void parse_libsvm_dense_range(const char* begin, const char* end,
                                      int64_t num_col, DensePart* out) {
+  const bool has_cr =
+      memchr(begin, '\r', static_cast<size_t>(end - begin)) != nullptr;
   const char* p = begin;
   const size_t stride = static_cast<size_t>(num_col) + 1;
+  {
+    size_t rows = count_byte(begin, end, '\n') + 1;
+    // cap the up-front reservation (64 MB of floats): mostly-blank input
+    // with a huge num_col must not turn a hint into a multi-GB allocation
+    size_t cap = (size_t(1) << 24) / stride + 1;
+    out->x.reserve((rows < cap ? rows : cap) * stride);
+    out->label.reserve(rows);
+  }
+  // No per-line '#' pre-scan here (unlike the CSR scanners): a comment is
+  // caught where parsing stops, which keeps this loop single-pass.
   while (p < end) {
-    const char* lend = line_end(p, end);
+    const char* lend = line_end_fast(p, end, has_cr);
     const char* q = p;
-    const char* hash = static_cast<const char*>(memchr(q, '#', lend - q));
-    const char* effective_end = hash ? hash : lend;
     double label;
     const char* after;
-    if (!parse_double(q, effective_end, &after, &label)) {
-      p = lend;
+    if (!parse_value(q, lend, &after, &label)) {
+      p = lend;  // blank, comment-only, or garbage line: skip (parity with
+                 // the CSR scanner's failed-label skip)
       while (p < end && (*p == '\n' || *p == '\r')) ++p;
       continue;
     }
     q = after;
     bool has_weight = false;
     double weight = 1.0;
-    if (q != effective_end && *q == ':') {
+    if (q != lend && *q == ':') {
       ++q;
-      if (!parse_double(q, effective_end, &after, &weight)) {
+      if (!parse_value(q, lend, &after, &weight)) {
         out->error = "libsvm: bad label:weight";
         return;
       }
@@ -286,23 +347,24 @@ static void parse_libsvm_dense_range(const char* begin, const char* end,
       out->error = "libsvm: label:weight must be set on every row or none";
       return;
     }
-    while (q != effective_end && is_space(*q)) ++q;
-    if (effective_end - q >= 4 && memcmp(q, "qid:", 4) == 0) {
+    while (q != lend && is_space(*q)) ++q;
+    if (lend - q >= 4 && memcmp(q, "qid:", 4) == 0) {
       // qid has no dense analog; signal the caller to use the CSR path
       out->error = "libsvm-dense: qid not supported";
+      out->needs_csr = true;
       return;
     }
     size_t base = out->x.size();
     out->x.resize(base + stride, 0.0f);
     while (true) {
       uint64_t idx;
-      if (!parse_uint(q, effective_end, &after, &idx)) break;
+      if (!parse_uint(q, lend, &after, &idx)) break;
       q = after;
       if (idx < out->min_index) out->min_index = idx;
       double v = 1.0;
-      if (q != effective_end && *q == ':') {
+      if (q != lend && *q == ':') {
         ++q;
-        if (!parse_double(q, effective_end, &after, &v)) {
+        if (!parse_value(q, lend, &after, &v)) {
           out->error = "libsvm: bad idx:value";
           return;
         }
@@ -310,8 +372,8 @@ static void parse_libsvm_dense_range(const char* begin, const char* end,
       }
       if (idx < stride) out->x[base + idx] = static_cast<float>(v);
     }
-    while (q != effective_end && is_space(*q)) ++q;
-    if (q != effective_end) {
+    while (q != lend && is_space(*q)) ++q;
+    if (q != lend && *q != '#') {  // trailing comment is fine; garbage is not
       out->error = "libsvm: malformed feature token";
       return;
     }
@@ -331,9 +393,11 @@ struct CsvPart {
 
 static void parse_csv_range(const char* begin, const char* end, char delim,
                             CsvPart* out) {
+  const bool has_cr =
+      memchr(begin, '\r', static_cast<size_t>(end - begin)) != nullptr;
   const char* p = begin;
   while (p < end) {
-    const char* lend = line_end(p, end);
+    const char* lend = line_end_fast(p, end, has_cr);
     if (lend == p) {
       ++p;
       continue;
@@ -349,7 +413,7 @@ static void parse_csv_range(const char* begin, const char* end, char delim,
         out->error = "csv: empty cell in row";
         return;
       }
-      if (!parse_double(q, lend, &after, &v)) {
+      if (!parse_value(q, lend, &after, &v)) {
         out->error = "csv: unparseable cell in row";
         return;
       }
@@ -372,6 +436,37 @@ static void parse_csv_range(const char* begin, const char* end, char delim,
     p = lend;
     while (p < end && (*p == '\n' || *p == '\r')) ++p;
   }
+}
+
+// Run a range-parser body capturing any exception (bad_alloc on degenerate
+// input) into the part's error field — an exception escaping a worker thread
+// or the extern "C" boundary would std::terminate the embedding Python
+// process.
+template <typename Body>
+static void guard_into(std::string* err, Body body) {
+  try {
+    body();
+  } catch (const std::exception& ex) {
+    *err = std::string("parse failed: ") + ex.what();
+  } catch (...) {
+    *err = "parse failed: unknown error";
+  }
+}
+static void parse_libsvm_range_guarded(const char* b, const char* e,
+                                       CsrPart* out) {
+  guard_into(&out->error, [&] { parse_libsvm_range(b, e, out); });
+}
+static void parse_libfm_range_guarded(const char* b, const char* e,
+                                      CsrPart* out) {
+  guard_into(&out->error, [&] { parse_libfm_range(b, e, out); });
+}
+static void parse_libsvm_dense_range_guarded(const char* b, const char* e,
+                                             int64_t num_col, DensePart* out) {
+  guard_into(&out->error, [&] { parse_libsvm_dense_range(b, e, num_col, out); });
+}
+static void parse_csv_range_guarded(const char* b, const char* e, char delim,
+                                    CsvPart* out) {
+  guard_into(&out->error, [&] { parse_csv_range(b, e, delim, out); });
 }
 
 }  // namespace dmlc_tpu
@@ -486,10 +581,11 @@ CsrBlockResult* dmlc_parse_libsvm(const char* data, int64_t len, int nthread,
   std::vector<CsrPart> parts(ranges.size());
   std::vector<std::thread> threads;
   for (size_t i = 1; i < ranges.size(); ++i) {
-    threads.emplace_back(parse_libsvm_range, ranges[i].first, ranges[i].second,
-                         &parts[i]);
+    threads.emplace_back(parse_libsvm_range_guarded, ranges[i].first,
+                         ranges[i].second, &parts[i]);
   }
-  if (!ranges.empty()) parse_libsvm_range(ranges[0].first, ranges[0].second, &parts[0]);
+  if (!ranges.empty())
+    parse_libsvm_range_guarded(ranges[0].first, ranges[0].second, &parts[0]);
   for (auto& t : threads) t.join();
   return merge_parts(parts, indexing_mode, false);
 }
@@ -504,10 +600,11 @@ CsrBlockResult* dmlc_parse_libfm(const char* data, int64_t len, int nthread,
   std::vector<CsrPart> parts(ranges.size());
   std::vector<std::thread> threads;
   for (size_t i = 1; i < ranges.size(); ++i) {
-    threads.emplace_back(parse_libfm_range, ranges[i].first, ranges[i].second,
-                         &parts[i]);
+    threads.emplace_back(parse_libfm_range_guarded, ranges[i].first,
+                         ranges[i].second, &parts[i]);
   }
-  if (!ranges.empty()) parse_libfm_range(ranges[0].first, ranges[0].second, &parts[0]);
+  if (!ranges.empty())
+    parse_libfm_range_guarded(ranges[0].first, ranges[0].second, &parts[0]);
   for (auto& t : threads) t.join();
   return merge_parts(parts, indexing_mode, true);
 }
@@ -522,11 +619,12 @@ DenseResult* dmlc_parse_libsvm_dense(const char* data, int64_t len, int nthread,
   std::vector<DensePart> parts(ranges.size());
   std::vector<std::thread> threads;
   for (size_t i = 1; i < ranges.size(); ++i) {
-    threads.emplace_back(parse_libsvm_dense_range, ranges[i].first,
+    threads.emplace_back(parse_libsvm_dense_range_guarded, ranges[i].first,
                          ranges[i].second, num_col, &parts[i]);
   }
   if (!ranges.empty())
-    parse_libsvm_dense_range(ranges[0].first, ranges[0].second, num_col, &parts[0]);
+    parse_libsvm_dense_range_guarded(ranges[0].first, ranges[0].second,
+                                     num_col, &parts[0]);
   for (auto& t : threads) t.join();
 
   auto* res = static_cast<DenseResult*>(calloc(1, sizeof(DenseResult)));
@@ -537,6 +635,7 @@ DenseResult* dmlc_parse_libsvm_dense(const char* data, int64_t len, int nthread,
   for (auto& part : parts) {
     if (!part.error.empty()) {
       res->error = dup_error(part.error);
+      res->needs_csr = part.needs_csr ? 1 : 0;
       return res;
     }
     n += static_cast<int64_t>(part.label.size());
@@ -590,11 +689,12 @@ CsvResult* dmlc_parse_csv(const char* data, int64_t len, int nthread, char delim
   std::vector<CsvPart> parts(ranges.size());
   std::vector<std::thread> threads;
   for (size_t i = 1; i < ranges.size(); ++i) {
-    threads.emplace_back(parse_csv_range, ranges[i].first, ranges[i].second,
-                         delim, &parts[i]);
+    threads.emplace_back(parse_csv_range_guarded, ranges[i].first,
+                         ranges[i].second, delim, &parts[i]);
   }
   if (!ranges.empty())
-    parse_csv_range(ranges[0].first, ranges[0].second, delim, &parts[0]);
+    parse_csv_range_guarded(ranges[0].first, ranges[0].second, delim,
+                            &parts[0]);
   for (auto& t : threads) t.join();
   auto* res = static_cast<CsvResult*>(calloc(1, sizeof(CsvResult)));
   int64_t ncol = -1, nrow = 0, ncell = 0;
@@ -637,6 +737,6 @@ void dmlc_free_csv(CsvResult* r) {
   free(r);
 }
 
-int dmlc_native_abi_version() { return 3; }
+int dmlc_native_abi_version() { return 4; }
 
 }  // extern "C"

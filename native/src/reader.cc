@@ -103,7 +103,13 @@ class LineReader {
     file_offset_.push_back(0);
     for (int64_t s : sizes) file_offset_.push_back(file_offset_.back() + s);
     reset_partition(part_index, num_parts);
-    if (error_.empty()) start();
+    if (error_.empty()) {
+      start();
+    } else {
+      // never started: mark done so next() returns null (consumer then
+      // surfaces error()) instead of waiting on a producer that isn't there
+      produce_done_ = true;
+    }
   }
 
   ~LineReader() {
@@ -127,7 +133,14 @@ class LineReader {
     offset_curr_ = offset_begin_;
     overflow_.clear();
     close_fp();
-    if (error_.empty()) start();
+    if (error_.empty()) {
+      start();
+    } else {
+      // sticky error: stay stopped but unblock any next() caller
+      std::lock_guard<std::mutex> lk(mu_);
+      produce_done_ = true;
+      cv_pop_.notify_all();
+    }
   }
 
   int64_t bytes_read() const { return bytes_read_.load(std::memory_order_relaxed); }
@@ -339,8 +352,7 @@ class LineReader {
       void* res = parse_chunk(chunk);
       if (!res) break;
       if (format_ == kFmtLibsvmDense) {
-        const char* err = result_error(format_, res);
-        if (err && strstr(err, "libsvm-dense")) {
+        if (static_cast<DenseResult*>(res)->needs_csr) {
           // data the dense scanner can't express (qid rows): permanently
           // downgrade to the CSR path and re-parse this chunk
           free_result(format_, res);
@@ -381,7 +393,24 @@ class LineReader {
   void start() {
     stop_ = false;
     produce_done_ = false;
-    producer_ = std::thread([this] { produce_loop(); });
+    // guard the whole producer: an escaping exception (e.g. bad_alloc while
+    // regrowing chunk buffers for a pathological record) would
+    // std::terminate the embedding Python process
+    producer_ = std::thread([this] {
+      try {
+        produce_loop();
+      } catch (const std::exception& ex) {
+        set_error(std::string("reader failed: ") + ex.what());
+        std::lock_guard<std::mutex> lk(mu_);
+        produce_done_ = true;
+        cv_pop_.notify_all();
+      } catch (...) {
+        set_error("reader failed: unknown error");
+        std::lock_guard<std::mutex> lk(mu_);
+        produce_done_ = true;
+        cv_pop_.notify_all();
+      }
+    });
   }
 
   void stop_and_join() {
@@ -442,11 +471,17 @@ void* dmlc_reader_create(const char** paths, const int64_t* sizes,
                          int32_t format, int64_t num_col, int32_t indexing_mode,
                          char delim, int32_t nthread, int64_t chunk_bytes,
                          int32_t queue_depth) {
-  std::vector<std::string> p(paths, paths + nfiles);
-  std::vector<int64_t> s(sizes, sizes + nfiles);
-  return new LineReader(std::move(p), std::move(s), part_index, num_parts,
-                        format, num_col, indexing_mode, delim, nthread,
-                        chunk_bytes, queue_depth);
+  try {
+    std::vector<std::string> p(paths, paths + nfiles);
+    std::vector<int64_t> s(sizes, sizes + nfiles);
+    return new LineReader(std::move(p), std::move(s), part_index, num_parts,
+                          format, num_col, indexing_mode, delim, nthread,
+                          chunk_bytes, queue_depth);
+  } catch (...) {
+    // alloc/thread-spawn failure must not cross the extern "C" boundary
+    // (std::terminate); null tells the caller creation failed
+    return nullptr;
+  }
 }
 
 void* dmlc_reader_next(void* handle, int32_t* fmt_out) {

@@ -208,3 +208,48 @@ class TestErrorsAndRouting:
                                   {"indexing_mode": "-1"}))
         assert nat == ref
         assert nat[0][1] == (0, 2)
+
+    def test_partition_args_validated(self, tmp_path):
+        # num_parts=0 once SIGFPE'd in the native byte-range divide; out-of
+        # -range parts silently yielded an empty stream
+        f = tmp_path / "v.libsvm"
+        f.write_text("1 0:1.0\n")
+        for part, nparts in ((0, 0), (3, 2), (-1, 2)):
+            with pytest.raises(DMLCError):
+                create_parser(str(f), part, nparts, "libsvm")
+
+    def test_error_then_before_first_no_hang(self, tmp_path):
+        # a reader whose source vanishes mid-stream must raise on next() and
+        # keep raising (not deadlock) after before_first()
+        import os
+
+        f = tmp_path / "gone.libsvm"
+        f.write_text("1 0:1.0\n" * 100)
+        from dmlc_tpu.native import FMT_LIBSVM, Reader
+
+        r = Reader([str(f)], [600], 0, 1, FMT_LIBSVM)
+        assert r.next() is not None
+        os.remove(str(f))
+        for _ in range(2):
+            r.before_first()
+            with pytest.raises(DMLCError):
+                while r.next() is not None:
+                    pass
+        r.close()
+
+    def test_qid_downgrade_uses_flag(self, tmp_path):
+        # qid rows make the dense scanner raise NeedsCsrError (explicit flag,
+        # not error-string matching) and the parser fall back to CSR blocks
+        from dmlc_tpu import native as nat
+
+        with pytest.raises(nat.NeedsCsrError):
+            nat.parse_libsvm_dense(b"1 qid:3 0:1.0\n", 4)
+        f = tmp_path / "q.libsvm"
+        f.write_text("1 qid:3 0:1.0\n0 qid:4 1:2.0\n")
+        p = create_parser(str(f), 0, 1, "libsvm", threaded=True)
+        if hasattr(p, "set_emit_dense"):
+            p.set_emit_dense(4)
+        blocks = list(p)
+        p.close()
+        qids = [int(q) for b in blocks for q in b.qid]
+        assert qids == [3, 4]
