@@ -234,6 +234,24 @@ class DeviceIter:
         """New epoch: restart the host pipeline (upstream before_first)."""
         self._inflight.clear()
         self._host_iter.before_first()
+        self.batches_fed = 0
+
+    # -------- checkpoint / resume (SURVEY.md §5.4 addition) --------
+
+    def state_dict(self) -> dict:
+        """Mid-epoch resume point: batches delivered so far. Rebatching is
+        deterministic, so replaying that count on restore lands on the same
+        boundary. Transfers in flight (not yet handed out) are dropped and
+        re-issued on restore."""
+        return {"kind": "batches", "batches": self.batches_fed}
+
+    def load_state(self, state: dict) -> None:
+        n = int(state["batches"])
+        self.reset()
+        for _ in range(n):
+            if self._host_iter.next() is None:  # skip: no transfer issued
+                break
+        self.batches_fed = n
 
     def close(self) -> None:
         self._host_iter.destroy()

@@ -104,6 +104,7 @@ class NativeStreamParser(Parser):
         self._reader = None
         self._emit_dense: Optional[int] = None
         self._stall = 0.0
+        self._blocks_out = 0  # delivered blocks, for count-based resume
 
     # ---------------- configuration ----------------
 
@@ -148,6 +149,7 @@ class NativeStreamParser(Parser):
         self._stall += time.monotonic() - t0
         if out is None:
             return None
+        self._blocks_out += 1
         fmt, data = out
         if fmt == native.FMT_LIBSVM_DENSE:
             x, label, weight, owner = data
@@ -172,6 +174,23 @@ class NativeStreamParser(Parser):
     def before_first(self) -> None:
         if self._reader is not None:
             self._reader.before_first()
+        self._blocks_out = 0
+
+    # -------- checkpoint / resume (SURVEY.md §5.4 addition) --------
+
+    def state_dict(self) -> dict:
+        """Resume point at a block boundary. Chunking in the native reader is
+        deterministic, so a block count replays exactly."""
+        return {"kind": "blocks", "blocks": self._blocks_out}
+
+    def load_state(self, state: dict) -> None:
+        n = int(state["blocks"])
+        self.before_first()
+        reader = self._ensure_reader()
+        for _ in range(n):
+            if reader.next() is None:
+                break
+        self._blocks_out = n
 
     @property
     def bytes_read(self) -> int:

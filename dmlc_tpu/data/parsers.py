@@ -113,6 +113,7 @@ class TextParserBase(Parser):
         self.source = source
         self.index_dtype = index_dtype
         self._bytes = 0
+        self._chunks_in = 0  # chunks consumed, for count-based resume
         self._native = None  # tri-state: None=unprobed, False=off, True=on
         self._emit_dense: Optional[int] = None  # num_col when dense mode is on
 
@@ -156,12 +157,36 @@ class TextParserBase(Parser):
             if chunk is None:
                 return None
             self._bytes += len(chunk)
+            self._chunks_in += 1
             block = self.parse_chunk(_chunk_bytes(chunk))
             if len(block) > 0:
                 return block
 
     def before_first(self) -> None:
         self.source.before_first()
+        self._chunks_in = 0
+
+    # -------- checkpoint / resume (SURVEY.md §5.4 addition) --------
+
+    def state_dict(self) -> dict:
+        """Resume point at a block boundary. Byte-exact when the source is an
+        undecorated split (it carries the file offset); otherwise a chunk
+        count replayed on restore."""
+        if hasattr(self.source, "state_dict"):
+            return {"kind": "split", "split": self.source.state_dict(),
+                    "chunks": self._chunks_in}
+        return {"kind": "chunks", "chunks": self._chunks_in}
+
+    def load_state(self, state: dict) -> None:
+        if state.get("kind") == "split" and hasattr(self.source, "load_state"):
+            self.source.load_state(state["split"])
+            self._chunks_in = int(state["chunks"])
+            return
+        self.before_first()
+        for _ in range(int(state["chunks"])):
+            if self.source.next_chunk() is None:  # skip without parsing
+                break
+        self._chunks_in = int(state["chunks"])
 
     @property
     def bytes_read(self) -> int:
@@ -515,6 +540,7 @@ class ThreadedParser(Parser):
     def __init__(self, base: TextParserBase, capacity: int = 8):
         self.base = base
         self._capacity = capacity
+        self._delivered = 0
         # the producer thread starts on first pull, not construction, so
         # callers can still configure the base (e.g. set_emit_dense) without
         # racing blocks already in flight
@@ -541,10 +567,30 @@ class ThreadedParser(Parser):
         return self.base.set_emit_dense(num_col)
 
     def next_block(self) -> Optional[RowBlock]:
-        return self._ensure_iter().next()
+        block = self._ensure_iter().next()
+        if block is not None:
+            self._delivered += 1
+        return block
 
     def before_first(self) -> None:
         self._ensure_iter().before_first()
+        self._delivered = 0
+
+    def state_dict(self) -> dict:
+        # the base parser runs ahead of delivery, so its own position is not
+        # the consumer's; count delivered blocks and replay on restore
+        return {"kind": "blocks", "blocks": self._delivered}
+
+    def load_state(self, state: dict) -> None:
+        n = int(state["blocks"])
+        if self._iter is not None:
+            self._iter.destroy()
+            self._iter = None
+        self.base.before_first()
+        for _ in range(n):
+            if self.base.next_block() is None:
+                break
+        self._delivered = n
 
     @property
     def bytes_read(self) -> int:

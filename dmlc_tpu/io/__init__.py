@@ -20,6 +20,8 @@ from dmlc_tpu.io.input_split import (
 )
 from dmlc_tpu.io.cached_split import CachedInputSplit
 from dmlc_tpu.io import http_filesys as _http_filesys  # registers http/cloud slots
+from dmlc_tpu.io import s3_filesys as _s3_filesys  # replaces the s3:// slot
+from dmlc_tpu.io import gcs_filesys as _gcs_filesys  # replaces the gs:// slot
 
 __all__ = [
     "URI", "URISpec", "FileInfo", "FileSystem", "LocalFileSystem",

@@ -1,0 +1,241 @@
+"""GCS filesystem: bearer-token JSON/XML API client over urllib.
+
+The cloud-native member of the TPU rebuild (SURVEY.md §7: "local + GCS
+instead of S3/HDFS as the cloud-native member"): on a TPU-VM the metadata
+server hands out OAuth tokens, so no key material ships with the job.
+
+Design mirrors the reference's S3 client surface (src/io/s3_filesys.cc) with
+GCS auth:
+- reads: ``Range: bytes=N-M`` GETs on the media endpoint, buffered via the
+  shared HTTP block reader;
+- listing: JSON objects.list with prefix+delimiter and page tokens;
+- writes: single-shot media upload on close (multipart/resumable upload is
+  not needed below the write-buffer size the reference uses);
+- auth: ``GCS_OAUTH_TOKEN`` / ``GOOGLE_OAUTH_ACCESS_TOKEN`` env, else the
+  TPU-VM metadata server, else anonymous (public buckets).
+
+``GCS_ENDPOINT`` overrides the API base URL — the test seam for a local
+fake server, like ``S3_ENDPOINT`` in :mod:`dmlc_tpu.io.s3_filesys`.
+"""
+
+from __future__ import annotations
+
+import io as _pyio
+import json
+import os
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from dmlc_tpu.io.filesystem import (
+    DIR_TYPE, FILE_TYPE, FileInfo, FileSystem, register_filesystem,
+)
+from dmlc_tpu.io.http_filesys import HttpReadStream
+from dmlc_tpu.io.uri import URI
+from dmlc_tpu.utils.check import DMLCError, check
+
+_METADATA_TOKEN_URL = ("http://metadata.google.internal/computeMetadata/v1/"
+                       "instance/service-accounts/default/token")
+
+
+def _auth_token() -> Optional[str]:
+    tok = (os.environ.get("GCS_OAUTH_TOKEN")
+           or os.environ.get("GOOGLE_OAUTH_ACCESS_TOKEN"))
+    if tok:
+        return tok
+    # TPU-VM / GCE metadata server: cache the token until shortly before its
+    # expiry; cache a miss too (the probe hangs nowhere but costs a timeout)
+    global _metadata_token, _metadata_expiry
+    now = time.monotonic()
+    if now < _metadata_expiry:
+        return _metadata_token
+    req = urllib.request.Request(
+        _METADATA_TOKEN_URL, headers={"Metadata-Flavor": "Google"})
+    try:
+        with urllib.request.urlopen(req, timeout=1) as resp:
+            payload = json.loads(resp.read())
+        _metadata_token = payload.get("access_token")
+        # refresh 60s early; tokens default to ~3600s
+        _metadata_expiry = now + max(int(payload.get("expires_in", 300)) - 60, 30)
+    except (urllib.error.URLError, OSError, ValueError):
+        _metadata_token = None
+        _metadata_expiry = now + 300  # re-probe absent metadata every 5 min
+    return _metadata_token
+
+
+_metadata_token: Optional[str] = None
+_metadata_expiry = float("-inf")
+
+
+class GcsConfig:
+    def __init__(self) -> None:
+        self.endpoint = os.environ.get(
+            "GCS_ENDPOINT", "https://storage.googleapis.com")
+
+    def headers(self) -> Dict[str, str]:
+        tok = _auth_token()
+        return {"Authorization": f"Bearer {tok}"} if tok else {}
+
+    def media_url(self, bucket: str, key: str) -> str:
+        return (f"{self.endpoint}/storage/v1/b/{bucket}/o/"
+                f"{urllib.parse.quote(key, safe='')}?alt=media")
+
+    def meta_url(self, bucket: str, key: str) -> str:
+        return (f"{self.endpoint}/storage/v1/b/{bucket}/o/"
+                f"{urllib.parse.quote(key, safe='')}")
+
+    def list_url(self, bucket: str, query: Dict[str, str]) -> str:
+        return (f"{self.endpoint}/storage/v1/b/{bucket}/o?"
+                + urllib.parse.urlencode(sorted(query.items())))
+
+    def upload_url(self, bucket: str, key: str) -> str:
+        return (f"{self.endpoint}/upload/storage/v1/b/{bucket}/o?"
+                + urllib.parse.urlencode(
+                    {"uploadType": "media", "name": key}))
+
+
+def _parse_gs_uri(uri: URI) -> Tuple[str, str]:
+    return uri.host, uri.name.lstrip("/")
+
+
+class GcsReadStream(HttpReadStream):
+    """Range-GET reader with bearer auth."""
+
+    def __init__(self, cfg: GcsConfig, bucket: str, key: str, size: int):
+        self._cfg = cfg
+        super().__init__(cfg.media_url(bucket, key), size=size)
+
+    def _fetch(self, start: int, end: int) -> bytes:
+        headers = {"Range": f"bytes={start}-{end - 1}"}
+        headers.update(self._cfg.headers())
+        req = urllib.request.Request(self.url, headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                body = resp.read()
+                return body if resp.status == 206 else body[start:end]
+        except urllib.error.HTTPError as exc:
+            if exc.code == 416:
+                return b""
+            raise DMLCError(f"gcs read failed: {self.url}: {exc}") from exc
+        except urllib.error.URLError as exc:
+            raise DMLCError(f"gcs read failed: {self.url}: {exc}") from exc
+
+
+class GcsWriteStream(_pyio.RawIOBase):
+    """Buffer-and-upload writer (single media upload on close)."""
+
+    def __init__(self, cfg: GcsConfig, bucket: str, key: str):
+        super().__init__()
+        self._cfg = cfg
+        self._bucket = bucket
+        self._key = key
+        self._buf = bytearray()
+        self._done = False
+
+    def writable(self) -> bool:
+        return True
+
+    def write(self, b) -> int:
+        self._buf += bytes(b)
+        return len(b)
+
+    def close(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        url = self._cfg.upload_url(self._bucket, self._key)
+        headers = {"Content-Type": "application/octet-stream"}
+        headers.update(self._cfg.headers())
+        req = urllib.request.Request(
+            url, data=bytes(self._buf), method="POST", headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                check(resp.status in (200, 201),
+                      f"gcs upload failed: {resp.status}")
+        except urllib.error.URLError as exc:
+            raise DMLCError(
+                f"gcs upload failed: {self._bucket}/{self._key}: {exc}"
+            ) from exc
+        super().close()
+
+
+class GcsFileSystem(FileSystem):
+    """gs:// FileSystem over the JSON API."""
+
+    _instance: Optional["GcsFileSystem"] = None
+
+    def __init__(self, cfg: Optional[GcsConfig] = None):
+        self.cfg = cfg or GcsConfig()
+
+    @classmethod
+    def instance(cls, uri: Optional[URI] = None) -> "GcsFileSystem":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def _get_json(self, url: str) -> Tuple[int, dict]:
+        req = urllib.request.Request(url, headers=self.cfg.headers())
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as exc:
+            return exc.code, {}
+        except urllib.error.URLError as exc:
+            raise DMLCError(f"gcs request failed: {url}: {exc}") from exc
+
+    def get_path_info(self, path: URI) -> FileInfo:
+        bucket, key = _parse_gs_uri(path)
+        status, meta = self._get_json(self.cfg.meta_url(bucket, key))
+        if status == 200:
+            return FileInfo(path, int(meta.get("size", 0)), FILE_TYPE)
+        entries = self._list(bucket, key.rstrip("/") + "/", max_results=1,
+                             max_total=1)
+        if entries:
+            return FileInfo(path, 0, DIR_TYPE)
+        raise DMLCError(f"gcs path not found: {str(path)}")
+
+    def _list(self, bucket: str, prefix: str, max_results: int = 1000,
+              max_total: Optional[int] = None) -> List[Tuple[str, int, str]]:
+        out: List[Tuple[str, int, str]] = []
+        token: Optional[str] = None
+        while True:
+            query = {"prefix": prefix, "delimiter": "/",
+                     "maxResults": str(max_results)}
+            if token:
+                query["pageToken"] = token
+            status, data = self._get_json(self.cfg.list_url(bucket, query))
+            check(status == 200, f"gcs list failed: {status}")
+            for item in data.get("items", []):
+                out.append((item["name"], int(item.get("size", 0)), FILE_TYPE))
+            for p in data.get("prefixes", []):
+                out.append((p, 0, DIR_TYPE))
+            token = data.get("nextPageToken")
+            if not token or (max_total is not None and len(out) >= max_total):
+                return out
+
+    def list_directory(self, path: URI) -> List[FileInfo]:
+        bucket, key = _parse_gs_uri(path)
+        prefix = key.rstrip("/") + "/" if key else ""
+        return [
+            FileInfo(URI(f"gs://{bucket}/{k}"), size, typ)
+            for k, size, typ in self._list(bucket, prefix)
+        ]
+
+    def open(self, path: URI, mode: str):
+        bucket, key = _parse_gs_uri(path)
+        if "r" in mode:
+            info = self.get_path_info(path)
+            check(info.type == FILE_TYPE, f"not a file: {str(path)}")
+            return _pyio.BufferedReader(
+                GcsReadStream(self.cfg, bucket, key, info.size))
+        if "w" in mode:
+            return _pyio.BufferedWriter(GcsWriteStream(self.cfg, bucket, key))
+        raise DMLCError(f"unsupported gcs open mode {mode!r}")
+
+    def open_for_read(self, path: URI):
+        return self.open(path, "rb")
+
+
+register_filesystem("gs://", GcsFileSystem.instance)

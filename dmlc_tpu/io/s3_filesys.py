@@ -1,0 +1,433 @@
+"""S3 filesystem: AWS Signature V4 client over urllib.
+
+Parity with reference src/io/s3_filesys.cc (1309 LoC curl+openssl client):
+- SigV4 request signing (SignSig4, s3_filesys.cc:319) — implemented from the
+  published algorithm: canonical request -> string-to-sign -> HMAC chain;
+- range-GET read streams with restart-on-seek (CURLReadStreamBase::Read /
+  InitRequest ``Range: bytes=N-``, s3_filesys.cc:422-701), built on the
+  shared HTTP block reader;
+- ListObjectsV2 XML listing (XMLIter, s3_filesys.cc:27);
+- multipart-upload write streams (Init/Upload/Finish,
+  s3_filesys.cc:768-1010) with per-part retry (:789);
+- env config: ``S3_ACCESS_KEY_ID``/``AWS_ACCESS_KEY_ID``,
+  ``S3_SECRET_ACCESS_KEY``/``AWS_SECRET_ACCESS_KEY``, ``S3_SESSION_TOKEN``/
+  ``AWS_SESSION_TOKEN``, ``S3_ENDPOINT``, ``S3_REGION``, ``S3_VERIFY_SSL``,
+  ``DMLC_S3_WRITE_BUFFER_MB`` (s3_filesys.cc:781, 1151-1166).
+
+The endpoint override (``S3_ENDPOINT``) doubles as the test seam: the suite
+points it at an in-process fake S3 server, so signing, listing, reading and
+multipart writes are exercised without network egress.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import hashlib
+import hmac
+import io as _pyio
+import os
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+from typing import Dict, List, Optional, Tuple
+
+from dmlc_tpu.io.filesystem import (
+    DIR_TYPE, FILE_TYPE, FileInfo, FileSystem, register_filesystem,
+)
+from dmlc_tpu.io.http_filesys import HttpReadStream
+from dmlc_tpu.io.uri import URI
+from dmlc_tpu.utils.check import DMLCError, check
+
+_EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+
+# ---------------- SigV4 core (pure functions, golden-tested) ----------------
+
+def _uri_encode(s: str, encode_slash: bool = True) -> str:
+    """AWS canonical URI encoding: RFC3986 unreserved chars stay, space is
+    %20 (never '+'), '/' optionally preserved."""
+    safe = "-._~" + ("" if encode_slash else "/")
+    return urllib.parse.quote(s, safe=safe)
+
+
+def canonical_request(
+    method: str,
+    path: str,
+    query: Dict[str, str],
+    headers: Dict[str, str],
+    payload_sha256: str,
+) -> Tuple[str, str]:
+    """Build the canonical request; returns (canonical_request, signed_headers).
+
+    Mirrors the documented algorithm the reference implements in
+    SignSig4 (s3_filesys.cc:319).
+    """
+    cq = "&".join(
+        f"{_uri_encode(k)}={_uri_encode(str(v))}"
+        for k, v in sorted(query.items())
+    )
+    lower = {k.lower().strip(): " ".join(str(v).split())
+             for k, v in headers.items()}
+    signed_headers = ";".join(sorted(lower))
+    ch = "".join(f"{k}:{lower[k]}\n" for k in sorted(lower))
+    cr = "\n".join([
+        method.upper(),
+        _uri_encode(path, encode_slash=False) or "/",
+        cq,
+        ch,
+        signed_headers,
+        payload_sha256,
+    ])
+    return cr, signed_headers
+
+
+def signing_key(secret: str, date: str, region: str, service: str) -> bytes:
+    """HMAC chain: kSecret -> kDate -> kRegion -> kService -> kSigning."""
+    def h(key: bytes, msg: str) -> bytes:
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    return h(h(h(h(b"AWS4" + secret.encode(), date), region), service),
+             "aws4_request")
+
+
+def sign_v4(
+    method: str,
+    host: str,
+    path: str,
+    query: Dict[str, str],
+    headers: Dict[str, str],
+    payload_sha256: str,
+    access_key: str,
+    secret_key: str,
+    region: str,
+    service: str = "s3",
+    amz_date: Optional[str] = None,
+    session_token: Optional[str] = None,
+) -> Dict[str, str]:
+    """Return the headers (Authorization + x-amz-*) for a SigV4 request."""
+    if amz_date is None:
+        amz_date = _dt.datetime.now(_dt.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+    date = amz_date[:8]
+    hdrs = dict(headers)
+    hdrs["host"] = host
+    hdrs["x-amz-date"] = amz_date
+    hdrs["x-amz-content-sha256"] = payload_sha256
+    if session_token:
+        hdrs["x-amz-security-token"] = session_token
+    cr, signed_headers = canonical_request(
+        method, path, query, hdrs, payload_sha256)
+    scope = f"{date}/{region}/{service}/aws4_request"
+    sts = "\n".join([
+        "AWS4-HMAC-SHA256",
+        amz_date,
+        scope,
+        hashlib.sha256(cr.encode()).hexdigest(),
+    ])
+    sig = hmac.new(
+        signing_key(secret_key, date, region, service),
+        sts.encode(), hashlib.sha256).hexdigest()
+    hdrs["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={signed_headers}, Signature={sig}"
+    )
+    del hdrs["host"]  # urllib sets Host itself; it was only needed for signing
+    return hdrs
+
+
+# ---------------- credentials / endpoint config ----------------
+
+class S3Config:
+    """Env-sourced credentials and endpoint (s3_filesys.cc:1151-1166)."""
+
+    def __init__(self) -> None:
+        env = os.environ
+        self.access_key = env.get("S3_ACCESS_KEY_ID") or env.get("AWS_ACCESS_KEY_ID")
+        self.secret_key = (env.get("S3_SECRET_ACCESS_KEY")
+                           or env.get("AWS_SECRET_ACCESS_KEY"))
+        self.session_token = (env.get("S3_SESSION_TOKEN")
+                              or env.get("AWS_SESSION_TOKEN"))
+        self.region = env.get("S3_REGION") or env.get("AWS_REGION") or "us-east-1"
+        self.endpoint = env.get("S3_ENDPOINT")  # e.g. http://127.0.0.1:9999
+        self.verify_ssl = env.get("S3_VERIFY_SSL", "1") != "0"
+        self.write_buffer_mb = int(env.get("DMLC_S3_WRITE_BUFFER_MB", "8"))
+
+    def require_keys(self) -> None:
+        check(
+            bool(self.access_key) and bool(self.secret_key),
+            "S3 credentials missing: set S3_ACCESS_KEY_ID/S3_SECRET_ACCESS_KEY "
+            "(or AWS_*)",
+        )
+
+    def url_for(self, bucket: str, key: str) -> Tuple[str, str, str]:
+        """(base_url, host_header, canonical_path) for bucket/key.
+
+        The wire URL carries the same %-encoding the signature is computed
+        over (S3 recomputes the canonical request from the sent bytes, so
+        any mismatch is a SignatureDoesNotMatch)."""
+        path = "/" + key.lstrip("/")
+        enc_path = _uri_encode(path, encode_slash=False)
+        if self.endpoint:
+            # path-style addressing against a custom endpoint
+            parsed = urllib.parse.urlparse(self.endpoint)
+            host = parsed.netloc
+            base = f"{self.endpoint.rstrip('/')}/{bucket}{enc_path}"
+            return base, host, f"/{bucket}{path}"
+        host = f"{bucket}.s3.{self.region}.amazonaws.com"
+        return f"https://{host}{enc_path}", host, path
+
+
+def _parse_s3_uri(uri: URI) -> Tuple[str, str]:
+    """s3://bucket/key -> (bucket, key)."""
+    return uri.host, uri.name.lstrip("/")
+
+
+# ---------------- request helper ----------------
+
+def _request(
+    cfg: S3Config,
+    method: str,
+    bucket: str,
+    key: str,
+    query: Optional[Dict[str, str]] = None,
+    headers: Optional[Dict[str, str]] = None,
+    body: bytes = b"",
+    retries: int = 3,
+) -> Tuple[int, bytes, Dict[str, str]]:
+    """One signed S3 request with retry (reference retries 3x per part,
+    s3_filesys.cc:789)."""
+    cfg.require_keys()
+    query = dict(query or {})
+    url, host, path = cfg.url_for(bucket, key)
+    if query:
+        # same encoding as the canonical query string ('%20', never '+')
+        url += "?" + "&".join(
+            f"{_uri_encode(k)}={_uri_encode(str(v))}"
+            for k, v in sorted(query.items()))
+    payload_hash = hashlib.sha256(body).hexdigest() if body else _EMPTY_SHA256
+    last_exc: Optional[Exception] = None
+    for attempt in range(retries):
+        hdrs = sign_v4(
+            method, host, path, query, dict(headers or {}), payload_hash,
+            cfg.access_key, cfg.secret_key, cfg.region,
+            session_token=cfg.session_token,
+        )
+        req = urllib.request.Request(url, data=body or None, method=method,
+                                     headers=hdrs)
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, resp.read(), dict(resp.headers)
+        except urllib.error.HTTPError as exc:
+            if exc.code in (404, 403, 416):
+                return exc.code, exc.read(), dict(exc.headers)
+            last_exc = exc
+        except urllib.error.URLError as exc:
+            last_exc = exc
+        time.sleep(0.1 * (attempt + 1))
+    raise DMLCError(f"s3 {method} {bucket}/{key} failed: {last_exc}")
+
+
+# ---------------- streams ----------------
+
+class S3ReadStream(HttpReadStream):
+    """Signed range-GET reader (ReadStream, s3_filesys.cc:664-745)."""
+
+    def __init__(self, cfg: S3Config, bucket: str, key: str, size: int):
+        self._cfg = cfg
+        self._bucket = bucket
+        self._key = key
+        url, _, _ = cfg.url_for(bucket, key)
+        super().__init__(url, size=size)
+
+    def _fetch(self, start: int, end: int) -> bytes:
+        status, body, _ = _request(
+            self._cfg, "GET", self._bucket, self._key,
+            headers={"Range": f"bytes={start}-{end - 1}"},
+        )
+        if status == 416:
+            return b""
+        if status == 200:
+            return body[start:end]  # server ignored Range
+        if status == 206:
+            return body
+        raise DMLCError(f"s3 read failed: {self._bucket}/{self._key}: {status}")
+
+
+class S3WriteStream(_pyio.RawIOBase):
+    """Multipart-upload writer (WriteStream Init/Upload/Finish,
+    s3_filesys.cc:768-1010). Parts buffer to ``DMLC_S3_WRITE_BUFFER_MB``;
+    short final objects fall back to a single PUT."""
+
+    def __init__(self, cfg: S3Config, bucket: str, key: str):
+        super().__init__()
+        self._cfg = cfg
+        self._bucket = bucket
+        self._key = key
+        self._buf = bytearray()
+        self._part_bytes = cfg.write_buffer_mb << 20
+        self._upload_id: Optional[str] = None
+        self._etags: List[str] = []
+        self._closed = False
+
+    def writable(self) -> bool:
+        return True
+
+    def write(self, b) -> int:
+        self._buf += bytes(b)
+        while len(self._buf) >= self._part_bytes:
+            self._upload_part(bytes(self._buf[: self._part_bytes]))
+            del self._buf[: self._part_bytes]
+        return len(b)
+
+    def _init_multipart(self) -> None:
+        status, body, _ = _request(
+            self._cfg, "POST", self._bucket, self._key, query={"uploads": ""})
+        check(status == 200, f"s3 multipart init failed: {status}")
+        root = ET.fromstring(body)
+        node = root.find(".//{*}UploadId")
+        if node is None:
+            node = root.find(".//UploadId")
+        check(node is not None and node.text,
+              "s3 multipart init: no UploadId in response")
+        self._upload_id = node.text
+
+    def _upload_part(self, data: bytes) -> None:
+        if self._upload_id is None:
+            self._init_multipart()
+        part_number = len(self._etags) + 1
+        status, _, headers = _request(
+            self._cfg, "PUT", self._bucket, self._key,
+            query={"partNumber": str(part_number), "uploadId": self._upload_id},
+            body=data,
+        )
+        check(status == 200, f"s3 part {part_number} upload failed: {status}")
+        self._etags.append(headers.get("ETag", headers.get("Etag", "")))
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._upload_id is None:
+            # small object: single PUT
+            status, _, _ = _request(
+                self._cfg, "PUT", self._bucket, self._key, body=bytes(self._buf))
+            check(status == 200, f"s3 put failed: {status}")
+        else:
+            if self._buf:
+                self._upload_part(bytes(self._buf))
+                self._buf.clear()
+            parts = "".join(
+                f"<Part><PartNumber>{i + 1}</PartNumber><ETag>{etag}</ETag></Part>"
+                for i, etag in enumerate(self._etags)
+            )
+            body = (f"<CompleteMultipartUpload>{parts}"
+                    f"</CompleteMultipartUpload>").encode()
+            status, _, _ = _request(
+                self._cfg, "POST", self._bucket, self._key,
+                query={"uploadId": self._upload_id}, body=body)
+            check(status == 200, f"s3 multipart complete failed: {status}")
+        super().close()
+
+
+# ---------------- filesystem ----------------
+
+class S3FileSystem(FileSystem):
+    """s3:// FileSystem over the SigV4 client."""
+
+    _instance: Optional["S3FileSystem"] = None
+
+    def __init__(self, cfg: Optional[S3Config] = None):
+        self.cfg = cfg or S3Config()
+
+    @classmethod
+    def instance(cls, uri: Optional[URI] = None) -> "S3FileSystem":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def get_path_info(self, path: URI) -> FileInfo:
+        bucket, key = _parse_s3_uri(path)
+        status, _, headers = _request(self.cfg, "HEAD", bucket, key)
+        if status == 200:
+            return FileInfo(path, int(headers.get("Content-Length", 0)),
+                            FILE_TYPE)
+        # fall back: prefix listing decides directory-ness
+        entries = self._list(bucket, key.rstrip("/") + "/", max_keys=1,
+                             max_total=1)
+        if entries:
+            return FileInfo(path, 0, DIR_TYPE)
+        raise DMLCError(f"s3 path not found: {str(path)}")
+
+    def _list(self, bucket: str, prefix: str, max_keys: int = 1000,
+              max_total: Optional[int] = None) -> List[Tuple[str, int, str]]:
+        """(key, size, type) entries under prefix via ListObjectsV2."""
+        out: List[Tuple[str, int, str]] = []
+        token: Optional[str] = None
+        while True:
+            query = {
+                "list-type": "2",
+                "prefix": prefix,
+                "delimiter": "/",
+                "max-keys": str(max_keys),
+            }
+            if token:
+                query["continuation-token"] = token
+            status, body, _ = _request(self.cfg, "GET", bucket, "", query=query)
+            check(status == 200, f"s3 list failed: {status}")
+            root = ET.fromstring(body)
+
+            def _find_all(tag: str):
+                return root.findall(f".//{{*}}{tag}") or root.findall(f".//{tag}")
+
+            for node in _find_all("Contents"):
+                key_node = node.find("{*}Key")
+                if key_node is None:
+                    key_node = node.find("Key")
+                size_node = node.find("{*}Size")
+                if size_node is None:
+                    size_node = node.find("Size")
+                if key_node is None or not key_node.text:
+                    continue
+                out.append((key_node.text,
+                            int(size_node.text) if size_node is not None else 0,
+                            FILE_TYPE))
+            for node in _find_all("CommonPrefixes"):
+                p = node.find("{*}Prefix")
+                if p is None:
+                    p = node.find("Prefix")
+                if p is not None and p.text:
+                    out.append((p.text, 0, DIR_TYPE))
+            nxt = root.find(".//{*}NextContinuationToken")
+            if nxt is None:
+                nxt = root.find(".//NextContinuationToken")
+            if (nxt is None or not nxt.text
+                    or (max_total is not None and len(out) >= max_total)):
+                return out
+            token = nxt.text
+
+    def list_directory(self, path: URI) -> List[FileInfo]:
+        bucket, key = _parse_s3_uri(path)
+        prefix = key.rstrip("/") + "/" if key else ""
+        infos = []
+        for k, size, typ in self._list(bucket, prefix):
+            child = URI(f"s3://{bucket}/{k}")
+            infos.append(FileInfo(child, size, typ))
+        return infos
+
+    def open(self, path: URI, mode: str):
+        bucket, key = _parse_s3_uri(path)
+        if "r" in mode:
+            info = self.get_path_info(path)
+            check(info.type == FILE_TYPE, f"not a file: {str(path)}")
+            raw = S3ReadStream(self.cfg, bucket, key, info.size)
+            return _pyio.BufferedReader(raw)
+        if "w" in mode:
+            return _pyio.BufferedWriter(S3WriteStream(self.cfg, bucket, key))
+        raise DMLCError(f"unsupported s3 open mode {mode!r}")
+
+    def open_for_read(self, path: URI):
+        return self.open(path, "rb")
+
+
+register_filesystem("s3://", S3FileSystem.instance)

@@ -9,9 +9,16 @@ from dmlc_tpu.utils.registry import Registry
 from dmlc_tpu.utils.params import Parameter, field
 from dmlc_tpu.utils.config import Config
 from dmlc_tpu.utils.timer import Timer, get_time
+from dmlc_tpu.utils.concurrency import ConcurrentBlockingQueue, Spinlock
+from dmlc_tpu.utils.thread_group import (
+    ManagedThread, ShutdownToken, ThreadGroup, blocking_queue_thread,
+    timer_thread,
+)
 
 __all__ = [
     "DMLCError", "check", "check_eq", "check_ne", "check_lt", "check_le",
     "check_gt", "check_ge", "get_logger", "Registry", "Parameter", "field",
     "Config", "Timer", "get_time",
+    "ConcurrentBlockingQueue", "Spinlock", "ManagedThread", "ShutdownToken",
+    "ThreadGroup", "blocking_queue_thread", "timer_thread",
 ]

@@ -1,0 +1,103 @@
+"""Concurrency primitives: blocking queues with kill-signal semantics.
+
+Behavioral equivalent of reference include/dmlc/concurrency.h:
+``ConcurrentBlockingQueue`` (concurrency.h:69-141) in FIFO and priority
+modes, including ``signal_for_kill`` which wakes every blocked ``pop`` with
+an empty result so worker threads can exit, and ``size``/``resume`` to
+reuse the queue after a kill. A ``Spinlock`` (concurrency.h:25) makes no
+sense under the GIL, so ``threading.Lock`` is the exported alias — the
+reference itself documents its spinlock as a std::mutex drop-in.
+
+The vendored moodycamel lock-free queues (concurrentqueue.h,
+blockingconcurrentqueue.h) are a non-goal: their role (cross-thread
+hand-off) is covered by this module and :mod:`dmlc_tpu.io.threaded_iter`,
+and the native C++ core uses its own mutex+cv bounded queue
+(native/src/reader.cc).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from collections import deque
+from typing import Any, Generic, List, Optional, Tuple, TypeVar
+
+T = TypeVar("T")
+
+# GIL makes a user-space spinlock strictly worse than the built-in lock;
+# exported for API parity with dmlc::Spinlock call sites
+Spinlock = threading.Lock
+
+
+class ConcurrentBlockingQueue(Generic[T]):
+    """Thread-safe blocking queue, FIFO or priority ordered.
+
+    ``pop`` blocks until an item arrives or :meth:`signal_for_kill` is
+    called; after a kill every blocked and future ``pop`` returns ``None``
+    until :meth:`resume`. Matches ConcurrentBlockingQueue semantics
+    (concurrency.h:69-141) with ``type=kFIFO|kPriority``.
+    """
+
+    FIFO = "fifo"
+    PRIORITY = "priority"
+
+    def __init__(self, kind: str = FIFO):
+        if kind not in (self.FIFO, self.PRIORITY):
+            raise ValueError(f"unknown queue kind {kind!r}")
+        self._kind = kind
+        self._cv = threading.Condition()
+        self._fifo: deque = deque()
+        self._heap: List[Tuple[int, int, Any]] = []
+        # tie-breaker so equal priorities stay FIFO and items never compare
+        self._seq = itertools.count()
+        self._killed = False
+
+    def push(self, value: T, priority: int = 0) -> None:
+        with self._cv:
+            if self._kind == self.FIFO:
+                self._fifo.append(value)
+            else:
+                # max-priority first (reference pops highest priority)
+                heapq.heappush(self._heap, (-priority, next(self._seq), value))
+            self._cv.notify()
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[T]:
+        """Blocking pop; None on kill-signal (or timeout, if given)."""
+        with self._cv:
+            if not self._cv.wait_for(
+                lambda: self._killed or self._nonempty(), timeout
+            ):
+                return None
+            if self._killed:
+                return None
+            if self._kind == self.FIFO:
+                return self._fifo.popleft()
+            return heapq.heappop(self._heap)[2]
+
+    def try_pop(self) -> Optional[T]:
+        with self._cv:
+            if self._killed or not self._nonempty():
+                return None
+            if self._kind == self.FIFO:
+                return self._fifo.popleft()
+            return heapq.heappop(self._heap)[2]
+
+    def signal_for_kill(self) -> None:
+        """Wake all blocked pops with None (SignalForKill, concurrency.h:120)."""
+        with self._cv:
+            self._killed = True
+            self._cv.notify_all()
+
+    def resume(self) -> None:
+        """Clear the kill flag so the queue can be reused."""
+        with self._cv:
+            self._killed = False
+            self._cv.notify_all()
+
+    def size(self) -> int:
+        with self._cv:
+            return len(self._fifo) + len(self._heap)
+
+    def _nonempty(self) -> bool:
+        return bool(self._fifo) or bool(self._heap)

@@ -1,0 +1,189 @@
+"""Managed, named thread lifecycle: ThreadGroup / TimerThread / queue workers.
+
+Behavioral equivalent of reference include/dmlc/thread_group.h: a
+``ThreadGroup`` owns named threads (create/launch, thread_group.h:488-493),
+supports cooperative shutdown of one or all threads
+(request_shutdown_all, thread_group.h:443-451), and ships two managed
+worker shapes — ``BlockingQueueThread`` draining a
+:class:`~dmlc_tpu.utils.concurrency.ConcurrentBlockingQueue`
+(thread_group.h:530) and ``TimerThread`` firing a callback on a fixed
+period (thread_group.h:645).
+
+Threads here are cooperative: the run callable receives a
+:class:`ShutdownToken` and is expected to poll ``token.stopped`` (or use
+``token.wait(dt)`` as its sleep) — matching the reference's
+``request_shutdown`` + ``ThreadGroup::Thread::joinable`` contract rather
+than killing threads from outside.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from dmlc_tpu.utils.check import DMLCError
+from dmlc_tpu.utils.concurrency import ConcurrentBlockingQueue
+
+
+class ShutdownToken:
+    """Cooperative stop flag handed to every managed thread."""
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._hooks: list = []
+
+    @property
+    def stopped(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Sleep until shutdown is requested; True if it was."""
+        return self._event.wait(timeout)
+
+    def on_request(self, hook: Callable[[], None]) -> None:
+        """Run ``hook`` when shutdown is requested — the escape hatch for
+        threads parked in a blocking call the flag can't reach (fires
+        immediately if shutdown was already requested)."""
+        self._hooks.append(hook)
+        if self.stopped:
+            hook()
+
+    def request(self) -> None:
+        self._event.set()
+        for hook in self._hooks:
+            hook()
+
+
+class ManagedThread:
+    """A named thread owned by a ThreadGroup (ThreadGroup::Thread)."""
+
+    def __init__(self, name: str, target: Callable[[ShutdownToken], Any],
+                 daemon: bool = True):
+        self.name = name
+        self.token = ShutdownToken()
+        self._exc: Optional[BaseException] = None
+
+        def _run() -> None:
+            try:
+                target(self.token)
+            except BaseException as exc:  # surfaced on join()
+                self._exc = exc
+
+        self._thread = threading.Thread(target=_run, name=name, daemon=daemon)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def request_shutdown(self) -> None:
+        self.token.request()
+
+    @property
+    def joinable(self) -> bool:
+        return self._thread.is_alive()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Join; rethrows anything the thread body raised."""
+        self._thread.join(timeout)
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
+
+
+class ThreadGroup:
+    """Registry of named managed threads (thread_group.h:95-300).
+
+    ``create`` registers + starts a thread under a unique name; names of
+    finished threads can be reused. ``request_shutdown_all`` asks every
+    live thread to stop; ``join_all`` joins them (rethrowing the first
+    thread exception).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._threads: Dict[str, ManagedThread] = {}
+
+    def create(self, name: str, target: Callable[[ShutdownToken], Any],
+               daemon: bool = True) -> ManagedThread:
+        with self._lock:
+            old = self._threads.get(name)
+            if old is not None and old.joinable:
+                raise DMLCError(f"thread {name!r} is already running")
+            t = ManagedThread(name, target, daemon=daemon)
+            self._threads[name] = t
+        t.start()
+        return t
+
+    def get(self, name: str) -> Optional[ManagedThread]:
+        with self._lock:
+            return self._threads.get(name)
+
+    def request_shutdown_all(self) -> None:
+        with self._lock:
+            threads = list(self._threads.values())
+        for t in threads:
+            t.request_shutdown()
+
+    def join_all(self, timeout: Optional[float] = None) -> None:
+        with self._lock:
+            threads = list(self._threads.values())
+        first_exc: Optional[BaseException] = None
+        for t in threads:
+            try:
+                t.join(timeout)
+            except BaseException as exc:
+                if first_exc is None:
+                    first_exc = exc
+        if first_exc is not None:
+            raise first_exc
+
+    def __enter__(self) -> "ThreadGroup":
+        return self
+
+    def __exit__(self, *exc_info: Any) -> None:
+        self.request_shutdown_all()
+        self.join_all()
+
+
+def blocking_queue_thread(
+    group: ThreadGroup,
+    name: str,
+    queue: ConcurrentBlockingQueue,
+    on_item: Callable[[Any], None],
+) -> ManagedThread:
+    """Start a managed worker draining `queue` (BlockingQueueThread,
+    thread_group.h:530). Shutdown = token.request() + queue.signal_for_kill()
+    (pop then returns None and the loop exits)."""
+
+    def _run(token: ShutdownToken) -> None:
+        # a kill-signalled pop returns None immediately, so the shutdown
+        # hook below is what makes ThreadGroup.__exit__ joinable
+        token.on_request(queue.signal_for_kill)
+        while not token.stopped:
+            item = queue.pop()
+            if item is None:
+                return
+            on_item(item)
+
+    return group.create(name, _run)
+
+
+def timer_thread(
+    group: ThreadGroup,
+    name: str,
+    period_seconds: float,
+    callback: Callable[[], None],
+    run_first_immediately: bool = False,
+) -> ManagedThread:
+    """Start a managed periodic-callback thread (TimerThread,
+    thread_group.h:645). The period is the gap between callback *starts*;
+    shutdown interrupts the sleep immediately."""
+    if period_seconds <= 0:
+        raise DMLCError("timer period must be positive")
+
+    def _run(token: ShutdownToken) -> None:
+        if run_first_immediately and not token.stopped:
+            callback()
+        while not token.wait(period_seconds):
+            callback()
+
+    return group.create(name, _run)

@@ -1,0 +1,301 @@
+"""Tests for the concurrency toolkit (concurrency.h / thread_group.h
+analogs) and the checkpoint/resume capability (SURVEY.md §5.4)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.utils.check import DMLCError
+from dmlc_tpu.utils.concurrency import ConcurrentBlockingQueue
+from dmlc_tpu.utils.thread_group import (
+    ThreadGroup,
+    blocking_queue_thread,
+    timer_thread,
+)
+
+
+class TestConcurrentBlockingQueue:
+    def test_fifo_order(self):
+        q = ConcurrentBlockingQueue()
+        for i in range(5):
+            q.push(i)
+        assert [q.pop() for _ in range(5)] == [0, 1, 2, 3, 4]
+        assert q.size() == 0
+
+    def test_priority_order(self):
+        q = ConcurrentBlockingQueue(ConcurrentBlockingQueue.PRIORITY)
+        q.push("low", priority=1)
+        q.push("high", priority=9)
+        q.push("mid", priority=5)
+        q.push("high2", priority=9)  # FIFO among equal priorities
+        assert [q.pop() for _ in range(4)] == ["high", "high2", "mid", "low"]
+
+    def test_signal_for_kill_wakes_blocked_pop(self):
+        q = ConcurrentBlockingQueue()
+        got = []
+
+        def consumer():
+            got.append(q.pop())
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.05)
+        q.signal_for_kill()
+        t.join(2)
+        assert not t.is_alive()
+        assert got == [None]
+        # killed queue rejects pops until resume
+        q.push(7)
+        assert q.pop(timeout=0.1) is None
+        q.resume()
+        assert q.pop() == 7
+
+    def test_cross_thread_handoff(self):
+        q = ConcurrentBlockingQueue()
+        n = 500
+        out = []
+
+        def producer():
+            for i in range(n):
+                q.push(i)
+
+        def consumer():
+            for _ in range(n):
+                out.append(q.pop())
+
+        ts = [threading.Thread(target=producer), threading.Thread(target=consumer)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10)
+        assert out == list(range(n))
+
+
+class TestThreadGroup:
+    def test_create_join_and_exception_rethrow(self):
+        g = ThreadGroup()
+
+        def boom(token):
+            raise ValueError("producer exploded")
+
+        t = g.create("boom", boom)
+        with pytest.raises(ValueError, match="exploded"):
+            t.join(2)
+
+    def test_duplicate_running_name_rejected(self):
+        g = ThreadGroup()
+        release = threading.Event()
+        g.create("w", lambda token: release.wait(5))
+        with pytest.raises(DMLCError):
+            g.create("w", lambda token: None)
+        release.set()
+        g.join_all(2)
+        # finished name is reusable
+        g.create("w", lambda token: None).join(2)
+
+    def test_shutdown_all_stops_cooperative_threads(self):
+        g = ThreadGroup()
+        ticks = []
+        g.create("loop", lambda token: [ticks.append(1) or token.wait(0.01)
+                                        for _ in iter(lambda: token.stopped, True)])
+        time.sleep(0.05)
+        g.request_shutdown_all()
+        g.join_all(2)
+        assert ticks  # it ran
+
+    def test_timer_thread_fires_periodically(self):
+        g = ThreadGroup()
+        fired = []
+        t = timer_thread(g, "tick", 0.02, lambda: fired.append(time.monotonic()),
+                         run_first_immediately=True)
+        time.sleep(0.15)
+        t.request_shutdown()
+        t.join(2)
+        assert len(fired) >= 3
+
+    def test_blocking_queue_thread_drains_until_kill(self):
+        g = ThreadGroup()
+        q = ConcurrentBlockingQueue()
+        seen = []
+        t = blocking_queue_thread(g, "drain", q, seen.append)
+        for i in range(10):
+            q.push(i)
+        time.sleep(0.1)
+        t.request_shutdown()
+        q.signal_for_kill()
+        t.join(2)
+        assert seen == list(range(10))
+
+
+def _corpus(tmp_path, rows=400):
+    f = tmp_path / "ckpt.libsvm"
+    lines = [
+        f"{i % 2} " + " ".join(f"{j}:{(i * 7 + j) % 13}.5" for j in range(6))
+        for i in range(rows)
+    ]
+    f.write_text("\n".join(lines) + "\n")
+    return str(f)
+
+
+def _labels(blocks):
+    return [float(v) for b in blocks for v in np.asarray(b.label)]
+
+
+class TestCheckpointResume:
+    @pytest.mark.parametrize("threaded", [False, True])
+    def test_parser_resume_matches_uninterrupted(self, tmp_path, threaded):
+        from dmlc_tpu.data import create_parser
+
+        uri = _corpus(tmp_path)
+        kw = dict(chunk_bytes=4096)
+        full = create_parser(uri, 0, 1, "libsvm", threaded=threaded, **kw)
+        all_blocks = list(full)
+        full.close()
+
+        p = create_parser(uri, 0, 1, "libsvm", threaded=threaded, **kw)
+        first = [p.next_block() for _ in range(2)]
+        state = p.state_dict()
+        p.close()
+
+        q = create_parser(uri, 0, 1, "libsvm", threaded=threaded, **kw)
+        q.load_state(state)
+        rest = list(q)
+        q.close()
+        assert _labels(first) + _labels(rest) == _labels(all_blocks)
+
+    def test_split_byte_exact_state(self, tmp_path):
+        from dmlc_tpu.io.filesystem import get_filesystem
+        from dmlc_tpu.io.input_split import LineSplitter
+
+        uri = _corpus(tmp_path)
+        s = LineSplitter(get_filesystem(uri), uri)
+        s.reset_partition(0, 1)
+        s.hint_chunk_size(4096)
+        recs = []
+        for _ in range(10):
+            recs.append(bytes(s.next_record()))
+        state = s.state_dict()
+        rest_a = []
+        while True:
+            r = s.next_record()
+            if r is None:
+                break
+            rest_a.append(bytes(r))
+        s.close()
+
+        s2 = LineSplitter(get_filesystem(uri), uri)
+        s2.reset_partition(0, 1)
+        s2.hint_chunk_size(4096)
+        s2.load_state(state)
+        rest_b = []
+        while True:
+            r = s2.next_record()
+            if r is None:
+                break
+            rest_b.append(bytes(r))
+        s2.close()
+        assert rest_a == rest_b
+
+    def test_device_iter_resume(self, tmp_path):
+        import jax
+
+        from dmlc_tpu.data import create_parser
+        from dmlc_tpu.data.device import DeviceIter
+
+        uri = _corpus(tmp_path)
+
+        def batches(it):
+            return [np.asarray(b[0]) for b in it]
+
+        p = create_parser(uri, 0, 1, "libsvm", threaded=True, chunk_bytes=4096)
+        it = DeviceIter(p, num_col=6, batch_size=64, layout="dense")
+        full = batches(it)
+
+        it.reset()
+        consumed = [np.asarray(next(it)[0]) for _ in range(2)]
+        state = it.state_dict()
+        it.load_state(state)
+        rest = batches(it)
+        it.close()
+        np.testing.assert_array_equal(
+            np.concatenate(consumed + rest), np.concatenate(full)
+        )
+
+
+class TestReviewRegressions:
+    def test_group_exit_wakes_blocked_queue_worker(self):
+        # __exit__ must not deadlock while the worker is parked in pop()
+        g = ThreadGroup()
+        q = ConcurrentBlockingQueue()
+        seen = []
+        with g:
+            blocking_queue_thread(g, "w", q, seen.append)
+            q.push(1)
+            time.sleep(0.05)
+        assert seen == [1]  # drained, then shut down cleanly
+
+    def test_indexed_recordio_checkpoint(self, tmp_path):
+        from dmlc_tpu.io.filesystem import get_filesystem
+        from dmlc_tpu.io.input_split import IndexedRecordIOSplitter
+        from dmlc_tpu.io.recordio import write_indexed_recordio
+
+        rec = tmp_path / "d.rec"
+        idx = tmp_path / "d.idx"
+        payloads = [f"record-{i}".encode() * 3 for i in range(50)]
+        with open(rec, "wb") as rf, open(idx, "w") as xf:
+            write_indexed_recordio(rf, xf, payloads)
+        for shuffle in (False, True):
+            s = IndexedRecordIOSplitter(
+                get_filesystem(str(rec)), str(rec), str(idx),
+                batch_size=4, shuffle=shuffle, seed=7)
+            s.reset_partition(0, 1)
+            first = [bytes(s.next_record()) for _ in range(9)]
+            state = s.state_dict()
+            rest_a = [bytes(r) for r in s.iter_records()]
+            s.close()
+
+            s2 = IndexedRecordIOSplitter(
+                get_filesystem(str(rec)), str(rec), str(idx),
+                batch_size=4, shuffle=shuffle, seed=999)  # different seed
+            s2.reset_partition(0, 1)
+            s2.load_state(state)
+            rest_b = [bytes(r) for r in s2.iter_records()]
+            s2.close()
+            assert rest_a == rest_b, f"shuffle={shuffle}"
+            assert sorted(first + rest_a) == sorted(payloads)
+
+    def test_split_checkpoint_at_file_join(self, tmp_path):
+        # NOEOL file A + file B: a checkpoint taken exactly at the join must
+        # preserve the pending injected newline on resume
+        from dmlc_tpu.io.filesystem import get_filesystem
+        from dmlc_tpu.io.input_split import LineSplitter
+
+        a = tmp_path / "a.txt"
+        b = tmp_path / "b.txt"
+        a.write_bytes(b"a1\na2-noeol")  # no trailing newline
+        b.write_bytes(b"b1\nb2\n")
+        uri = f"{a};{b}"
+        s = LineSplitter(get_filesystem(str(a)), uri)
+        s.reset_partition(0, 1)
+        s.hint_chunk_size(4096)
+        # drive _read to exactly the end of file A
+        data = s._read(a.stat().st_size)
+        assert s.offset_curr == a.stat().st_size
+        state = s.state_dict()
+        s.close()
+
+        s2 = LineSplitter(get_filesystem(str(a)), uri)
+        s2.reset_partition(0, 1)
+        s2.load_state(state)
+        rest = b""
+        while True:
+            got = s2._read(10_000)
+            if not got:
+                break
+            rest += got
+        s2.close()
+        # resumed stream must start with the injected join newline, so the
+        # overall concatenation parses as a1, a2-noeol, b1, b2
+        assert (data + rest).split(b"\n") == [b"a1", b"a2-noeol", b"b1", b"b2", b""]

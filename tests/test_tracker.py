@@ -292,3 +292,42 @@ def test_submit_local_end_to_end(tmp_path):
         os.environ.update(env_backup)
     ranks = sorted(p.name for p in out_dir.glob("rank_*"))
     assert ranks == ["rank_0", "rank_1", "rank_2"]
+
+
+class TestLauncher:
+    def test_unpack_archives_with_alias(self, tmp_path):
+        import zipfile
+
+        from dmlc_tpu.tracker.launcher import unpack_archives
+
+        z = tmp_path / "code.zip"
+        with zipfile.ZipFile(z, "w") as zf:
+            zf.writestr("pkg/mod.py", "X = 1\n")
+        dirs = unpack_archives(f"{z}#libs", dest=str(tmp_path))
+        assert dirs == [str(tmp_path / "libs")]
+        assert (tmp_path / "libs" / "pkg" / "mod.py").read_text() == "X = 1\n"
+        # missing archives are skipped, not fatal
+        assert unpack_archives(str(tmp_path / "nope.zip")) == []
+
+    def test_build_env_maps_tracker_contract(self):
+        from dmlc_tpu.tracker.launcher import build_env
+
+        env = build_env({
+            "DMLC_TRACKER_URI": "10.0.0.1", "DMLC_TRACKER_PORT": "9091",
+            "DMLC_NUM_WORKER": "8", "DMLC_TASK_ID": "3",
+            "DMLC_EXTRA_PYTHONPATH": "/opt/extra",
+            "PYTHONPATH": "/base",
+        })
+        assert env["JAX_COORDINATOR_ADDRESS"] == "10.0.0.1:9091"
+        assert env["JAX_NUM_PROCESSES"] == "8"
+        assert env["JAX_PROCESS_ID"] == "3"
+        assert env["PYTHONPATH"] == "/opt/extra:/base"
+
+    def test_launcher_main_execs_command(self, tmp_path):
+        from dmlc_tpu.tracker.launcher import main
+
+        marker = tmp_path / "ran.txt"
+        rc = main(["python", "-c",
+                   f"open(r'{marker}', 'w').write('ok')"], use_exec=False)
+        assert rc == 0
+        assert marker.read_text() == "ok"
