@@ -98,9 +98,13 @@ class DeviceIter:
         self.batches_fed = 0
         self.bytes_to_device = 0
         if layout == "dense" and hasattr(source, "set_emit_dense"):
-            # ask the parser for HBM-ready dense batches (skips CSR); safe to
-            # ignore the answer — _host_batches_dense handles both kinds
-            source.set_emit_dense(num_col)
+            # ask the parser for HBM-ready dense batches (skips CSR), repacked
+            # to this batch size off-GIL when the native reader is in play;
+            # safe to ignore the answer — _host_batches_dense handles all kinds
+            try:
+                source.set_emit_dense(num_col, batch_rows=batch_size)
+            except TypeError:  # sources without the batch_rows extension
+                source.set_emit_dense(num_col)
         self._host_iter = ThreadedIter.from_factory(
             self._host_batches, max_capacity=convert_ahead
         )

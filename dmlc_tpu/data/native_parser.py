@@ -105,16 +105,21 @@ class NativeStreamParser(Parser):
         self._emit_dense: Optional[int] = None
         self._stall = 0.0
         self._blocks_out = 0  # delivered blocks, for count-based resume
+        self._batch_rows = 0
 
     # ---------------- configuration ----------------
 
-    def set_emit_dense(self, num_col: int) -> bool:
+    def set_emit_dense(self, num_col: int, batch_rows: int = 0) -> bool:
         """Emit DenseBlock batches straight from the native dense scanner.
-        Must be called before the first pull (the reader pipeline starts
-        lazily). libfm has no dense analog."""
+        With ``batch_rows``, the native reader additionally repacks rows
+        into exact [batch_rows, num_col] blocks off-GIL (the consumer can
+        then slice views instead of concatenating). Must be called before
+        the first pull (the reader pipeline starts lazily). libfm has no
+        dense analog."""
         if self._reader is not None or self.fmt_name == "libfm":
             return False
         self._emit_dense = int(num_col)
+        self._batch_rows = int(batch_rows)
         return True
 
     # ---------------- pipeline ----------------
@@ -137,6 +142,8 @@ class NativeStreamParser(Parser):
                 indexing_mode=indexing_mode,
                 delimiter=getattr(self.param, "delimiter", ","),
                 chunk_bytes=self.chunk_bytes,
+                batch_rows=(self._batch_rows
+                            if fmt == native.FMT_LIBSVM_DENSE else 0),
             )
         return self._reader
 

@@ -190,8 +190,8 @@ class GcsFileSystem(FileSystem):
         status, meta = self._get_json(self.cfg.meta_url(bucket, key))
         if status == 200:
             return FileInfo(path, int(meta.get("size", 0)), FILE_TYPE)
-        entries = self._list(bucket, key.rstrip("/") + "/", max_results=1,
-                             max_total=1)
+        prefix = key.rstrip("/") + "/" if key else ""
+        entries = self._list(bucket, prefix, max_results=1, max_total=1)
         if entries:
             return FileInfo(path, 0, DIR_TYPE)
         raise DMLCError(f"gcs path not found: {str(path)}")

@@ -352,9 +352,10 @@ class S3FileSystem(FileSystem):
         if status == 200:
             return FileInfo(path, int(headers.get("Content-Length", 0)),
                             FILE_TYPE)
-        # fall back: prefix listing decides directory-ness
-        entries = self._list(bucket, key.rstrip("/") + "/", max_keys=1,
-                             max_total=1)
+        # fall back: prefix listing decides directory-ness (bucket root
+        # lists with an empty prefix, not "/")
+        prefix = key.rstrip("/") + "/" if key else ""
+        entries = self._list(bucket, prefix, max_keys=1, max_total=1)
         if entries:
             return FileInfo(path, 0, DIR_TYPE)
         raise DMLCError(f"s3 path not found: {str(path)}")
@@ -380,22 +381,21 @@ class S3FileSystem(FileSystem):
             def _find_all(tag: str):
                 return root.findall(f".//{{*}}{tag}") or root.findall(f".//{tag}")
 
+            def _find(node, tag: str):
+                # namespaced first, bare fallback (test servers skip the ns)
+                found = node.find(f"{{*}}{tag}")
+                return found if found is not None else node.find(tag)
+
             for node in _find_all("Contents"):
-                key_node = node.find("{*}Key")
-                if key_node is None:
-                    key_node = node.find("Key")
-                size_node = node.find("{*}Size")
-                if size_node is None:
-                    size_node = node.find("Size")
+                key_node = _find(node, "Key")
+                size_node = _find(node, "Size")
                 if key_node is None or not key_node.text:
                     continue
                 out.append((key_node.text,
                             int(size_node.text) if size_node is not None else 0,
                             FILE_TYPE))
             for node in _find_all("CommonPrefixes"):
-                p = node.find("{*}Prefix")
-                if p is None:
-                    p = node.find("Prefix")
+                p = _find(node, "Prefix")
                 if p is not None and p.text:
                     out.append((p.text, 0, DIR_TYPE))
             nxt = root.find(".//{*}NextContinuationToken")

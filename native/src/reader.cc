@@ -91,7 +91,7 @@ class LineReader {
   LineReader(std::vector<std::string> paths, std::vector<int64_t> sizes,
              int64_t part_index, int64_t num_parts, int format,
              int64_t num_col, int indexing_mode, char delim, int nthread,
-             int64_t chunk_bytes, int queue_depth)
+             int64_t chunk_bytes, int queue_depth, int64_t batch_rows)
       : paths_(std::move(paths)),
         format_(format),
         num_col_(num_col),
@@ -99,7 +99,8 @@ class LineReader {
         delim_(delim),
         nthread_(nthread < 1 ? 1 : nthread),
         chunk_bytes_(chunk_bytes < 4096 ? 4096 : chunk_bytes),
-        queue_depth_(queue_depth < 1 ? 1 : queue_depth) {
+        queue_depth_(queue_depth < 1 ? 1 : queue_depth),
+        batch_rows_(batch_rows > 0 ? batch_rows : 0) {
     file_offset_.push_back(0);
     for (int64_t s : sizes) file_offset_.push_back(file_offset_.back() + s);
     reset_partition(part_index, num_parts);
@@ -133,6 +134,10 @@ class LineReader {
     offset_curr_ = offset_begin_;
     overflow_.clear();
     close_fp();
+    acc_x_.clear();
+    acc_label_.clear();
+    acc_weight_.clear();
+    acc_has_weight_ = false;
     if (error_.empty()) {
       start();
     } else {
@@ -353,9 +358,17 @@ class LineReader {
       if (!res) break;
       if (format_ == kFmtLibsvmDense) {
         if (static_cast<DenseResult*>(res)->needs_csr) {
-          // data the dense scanner can't express (qid rows): permanently
-          // downgrade to the CSR path and re-parse this chunk
+          // data the dense scanner can't express (qid rows): flush any
+          // batch-accumulated rows, then permanently downgrade to the CSR
+          // path and re-parse this chunk
           free_result(format_, res);
+          if (batch_rows_ > 0 && !acc_label_.empty()) {
+            DenseResult* tail = drain_accumulator(acc_label_.size());
+            if (!tail || !push_result(kFmtLibsvmDense, tail)) {
+              mark_done();
+              return;
+            }
+          }
           format_ = kFmtLibsvm;
           res = parse_chunk(chunk);
           if (!res) break;
@@ -366,26 +379,124 @@ class LineReader {
         continue;
       }
       bool had_error = result_error(format_, res) != nullptr;
-      {
-        std::unique_lock<std::mutex> lk(mu_);
-        cv_push_.wait(lk, [&] {
-          return static_cast<int>(queue_.size()) < queue_depth_ || stop_;
-        });
-        if (stop_) {
-          free_result(format_, res);
-          // a consumer may be blocked in next(): mark done so it wakes
-          produce_done_ = true;
-          cv_pop_.notify_all();
+      if (!had_error && format_ == kFmtLibsvmDense && batch_rows_ > 0) {
+        // repack into exact batch_rows_ blocks; full ones go to the queue
+        if (!accumulate_dense(static_cast<DenseResult*>(res))) {
+          mark_done();  // OOM (error set) or stop: never leave next() hanging
           return;
         }
-        queue_.emplace_back(format_, res);
+        continue;
       }
-      cv_pop_.notify_one();
+      if (had_error && format_ == kFmtLibsvmDense && batch_rows_ > 0 &&
+          !acc_label_.empty()) {
+        // deliver rows accumulated from earlier clean chunks BEFORE the
+        // error block, preserving non-batch-mode ordering
+        DenseResult* tail = drain_accumulator(acc_label_.size());
+        if (!tail || !push_result(format_, tail)) {
+          free_result(format_, res);
+          mark_done();
+          return;
+        }
+      }
+      if (!push_result(format_, res)) return;
       if (had_error) break;  // parse error rides the queued result
     }
+    if (format_ == kFmtLibsvmDense && batch_rows_ > 0 && !acc_label_.empty()) {
+      DenseResult* tail = drain_accumulator(acc_label_.size());
+      if (tail) push_result(format_, tail);
+    }
+    mark_done();
+  }
+
+  // Mark the pipeline finished so a blocked next() always wakes — every
+  // early exit from produce_loop must go through here (or push_result's
+  // stop path, which does the same).
+  void mark_done() {
     std::lock_guard<std::mutex> lk(mu_);
     produce_done_ = true;
     cv_pop_.notify_all();
+  }
+
+  // Blocking push honoring queue depth; false = stop requested.
+  bool push_result(int fmt, void* res) {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_push_.wait(lk, [&] {
+        return static_cast<int>(queue_.size()) < queue_depth_ || stop_;
+      });
+      if (stop_) {
+        free_result(fmt, res);
+        // a consumer may be blocked in next(): mark done so it wakes
+        produce_done_ = true;
+        cv_pop_.notify_all();
+        return false;
+      }
+      queue_.emplace_back(fmt, res);
+    }
+    cv_pop_.notify_one();
+    return true;
+  }
+
+  // Append a parsed dense chunk to the accumulator, emitting every complete
+  // batch. Consumes `res`. false = stop requested mid-emit.
+  bool accumulate_dense(DenseResult* res) {
+    const int64_t n = res->n_rows;
+    const size_t ncol = static_cast<size_t>(num_col_);
+    if (res->weight && !acc_has_weight_ && !acc_label_.empty()) {
+      acc_weight_.assign(acc_label_.size(), 1.0f);  // backfill earlier rows
+    }
+    if (res->weight) acc_has_weight_ = true;
+    acc_x_.insert(acc_x_.end(), res->x, res->x + n * static_cast<int64_t>(ncol));
+    acc_label_.insert(acc_label_.end(), res->label, res->label + n);
+    if (acc_has_weight_) {
+      if (res->weight) {
+        acc_weight_.insert(acc_weight_.end(), res->weight, res->weight + n);
+      } else {
+        acc_weight_.insert(acc_weight_.end(), static_cast<size_t>(n), 1.0f);
+      }
+    }
+    dmlc_free_dense(res);
+    while (static_cast<int64_t>(acc_label_.size()) >= batch_rows_) {
+      DenseResult* out = drain_accumulator(static_cast<size_t>(batch_rows_));
+      if (!out) return false;            // OOM (error already set)
+      if (!push_result(format_, out)) return false;  // stop requested
+    }
+    return true;
+  }
+
+  // Pop the first `rows` accumulated rows into a malloc'd DenseResult.
+  DenseResult* drain_accumulator(size_t rows) {
+    const size_t ncol = static_cast<size_t>(num_col_);
+    auto* out = static_cast<DenseResult*>(calloc(1, sizeof(DenseResult)));
+    out->n_rows = static_cast<int64_t>(rows);
+    out->n_cols = num_col_;
+    out->x = static_cast<float*>(malloc(rows * ncol * sizeof(float)));
+    out->label = static_cast<float*>(malloc(rows * sizeof(float)));
+    if (!out->x || !out->label) {
+      free(out->x);
+      free(out->label);
+      free(out);
+      set_error("reader: out of memory repacking batch");
+      return nullptr;
+    }
+    memcpy(out->x, acc_x_.data(), rows * ncol * sizeof(float));
+    memcpy(out->label, acc_label_.data(), rows * sizeof(float));
+    acc_x_.erase(acc_x_.begin(),
+                 acc_x_.begin() + static_cast<int64_t>(rows * ncol));
+    acc_label_.erase(acc_label_.begin(),
+                     acc_label_.begin() + static_cast<int64_t>(rows));
+    if (acc_has_weight_) {
+      out->weight = static_cast<float*>(malloc(rows * sizeof(float)));
+      if (!out->weight) {
+        dmlc_free_dense(out);
+        set_error("reader: out of memory repacking batch");
+        return nullptr;
+      }
+      memcpy(out->weight, acc_weight_.data(), rows * sizeof(float));
+      acc_weight_.erase(acc_weight_.begin(),
+                        acc_weight_.begin() + static_cast<int64_t>(rows));
+    }
+    return out;
   }
 
   // ---------------- lifecycle ----------------
@@ -451,6 +562,14 @@ class LineReader {
   FILE* fp_ = nullptr;
   std::string overflow_;
 
+  // dense batch repack (batch_rows_ > 0): rows accumulate here until a
+  // full [batch_rows_, num_col_] block can be emitted — the copy runs
+  // off-GIL in this producer thread, replacing the consumer-side
+  // np.concatenate per batch
+  int64_t batch_rows_ = 0;
+  std::vector<float> acc_x_, acc_label_, acc_weight_;
+  bool acc_has_weight_ = false;
+
   std::thread producer_;
   std::mutex mu_;
   std::condition_variable cv_push_, cv_pop_;
@@ -470,13 +589,13 @@ void* dmlc_reader_create(const char** paths, const int64_t* sizes,
                          int32_t nfiles, int64_t part_index, int64_t num_parts,
                          int32_t format, int64_t num_col, int32_t indexing_mode,
                          char delim, int32_t nthread, int64_t chunk_bytes,
-                         int32_t queue_depth) {
+                         int32_t queue_depth, int64_t batch_rows) {
   try {
     std::vector<std::string> p(paths, paths + nfiles);
     std::vector<int64_t> s(sizes, sizes + nfiles);
     return new LineReader(std::move(p), std::move(s), part_index, num_parts,
                           format, num_col, indexing_mode, delim, nthread,
-                          chunk_bytes, queue_depth);
+                          chunk_bytes, queue_depth, batch_rows);
   } catch (...) {
     // alloc/thread-spawn failure must not cross the extern "C" boundary
     // (std::terminate); null tells the caller creation failed

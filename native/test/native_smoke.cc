@@ -1,0 +1,96 @@
+// C++ smoke test for the native core, runnable under TSan/ASan
+// (the reference's CI runs its gtest binary under ThreadSanitizer,
+// scripts/travis/travis_script.sh:53-60; this is the equivalent seam for
+// the rebuilt core — the full behavioral suite lives in tests/ via pytest).
+
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "../src/api.h"
+
+static int failures = 0;
+#define CHECK_TRUE(cond)                                        \
+  do {                                                          \
+    if (!(cond)) {                                              \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__,        \
+                   __LINE__, #cond);                            \
+      ++failures;                                               \
+    }                                                           \
+  } while (0)
+
+int main() {
+  // libsvm CSR parse across threads
+  const char* text =
+      "1 0:1.5 3:2.5\n0 1:0.5\n1 2:3.0 4:4.5 5:1e-2\n";
+  CsrBlockResult* b =
+      dmlc_parse_libsvm(text, static_cast<int64_t>(strlen(text)), 2, 0);
+  CHECK_TRUE(b != nullptr);
+  CHECK_TRUE(b->error == nullptr);
+  CHECK_TRUE(b->n_rows == 3);
+  CHECK_TRUE(b->nnz == 6);
+  CHECK_TRUE(b->offset[3] == 6);
+  dmlc_free_block(b);
+
+  // dense scan + qid downgrade flag
+  DenseResult* d = dmlc_parse_libsvm_dense(text,
+                                           static_cast<int64_t>(strlen(text)),
+                                           2, 6, 0);
+  CHECK_TRUE(d != nullptr && d->error == nullptr && d->n_rows == 3);
+  CHECK_TRUE(d->x[0] == 1.5f && d->x[3] == 2.5f);
+  dmlc_free_dense(d);
+  const char* qid_text = "1 qid:3 0:1\n";
+  DenseResult* dq = dmlc_parse_libsvm_dense(
+      qid_text, static_cast<int64_t>(strlen(qid_text)), 1, 4, 0);
+  CHECK_TRUE(dq != nullptr && dq->needs_csr == 1);
+  dmlc_free_dense(dq);
+
+  // csv
+  const char* csv = "1,2.5,3\n4,5.5,6\n";
+  CsvResult* c = dmlc_parse_csv(csv, static_cast<int64_t>(strlen(csv)), 2, ',');
+  CHECK_TRUE(c != nullptr && c->error == nullptr);
+  CHECK_TRUE(c->n_rows == 2 && c->n_cols == 3 && c->cells[1] == 2.5f);
+  dmlc_free_csv(c);
+
+  // streaming reader over a temp file, exercised twice (before_first)
+  char path[] = "/tmp/dmlc_tpu_smoke_XXXXXX";
+  int fd = mkstemp(path);
+  CHECK_TRUE(fd >= 0);
+  FILE* f = fdopen(fd, "w");
+  for (int i = 0; i < 1000; ++i) std::fprintf(f, "%d 0:%d.5 1:2\n", i % 2, i);
+  fclose(f);
+  long size = 0;
+  {
+    FILE* g = fopen(path, "rb");
+    fseek(g, 0, SEEK_END);
+    size = ftell(g);
+    fclose(g);
+  }
+  const char* paths[] = {path};
+  int64_t sizes[] = {size};
+  void* r = dmlc_reader_create(paths, sizes, 1, 0, 1, /*fmt=*/0, 0, 0, ',',
+                               2, 4096, 2);
+  CHECK_TRUE(r != nullptr);
+  for (int pass = 0; pass < 2; ++pass) {
+    int64_t rows = 0;
+    while (true) {
+      int32_t fmt = 0;
+      void* res = dmlc_reader_next(r, &fmt);
+      if (!res) break;
+      CsrBlockResult* blk = static_cast<CsrBlockResult*>(res);
+      CHECK_TRUE(blk->error == nullptr);
+      rows += blk->n_rows;
+      dmlc_free_block(blk);
+    }
+    CHECK_TRUE(dmlc_reader_error(r) == nullptr);
+    CHECK_TRUE(rows == 1000);
+    dmlc_reader_before_first(r);
+  }
+  dmlc_reader_destroy(r);
+  remove(path);
+
+  CHECK_TRUE(dmlc_native_abi_version() == 4);
+  if (failures == 0) std::printf("native_smoke: all checks passed\n");
+  return failures == 0 ? 0 : 1;
+}

@@ -364,3 +364,13 @@ class TestGcsFileSystem:
             assert f.read(3) == b"gcs"
         infos = fs.list_directory(URI("gs://bkt/sub"))
         assert [i.size for i in infos] == [1200]
+
+
+class TestBucketRoot:
+    def test_s3_bucket_root_info_and_listing(self, fake_s3):
+        fake_s3.store[("bkt", "a.txt")] = b"abc"
+        fs = S3FileSystem(S3Config())
+        info = fs.get_path_info(URI("s3://bkt"))
+        assert info.type == "directory"
+        names = [str(i.path) for i in fs.list_directory(URI("s3://bkt"))]
+        assert names == ["s3://bkt/a.txt"]

@@ -253,3 +253,31 @@ class TestErrorsAndRouting:
         p.close()
         qids = [int(q) for b in blocks for q in b.qid]
         assert qids == [3, 4]
+
+    def test_batch_repack_error_after_clean_rows(self, tmp_path):
+        # rows parsed before an error chunk must be delivered BEFORE the
+        # error surfaces, matching non-batch ordering
+        import numpy as np
+
+        f = tmp_path / "err.libsvm"
+        good = "".join(f"1 0:{i}.5\n" for i in range(2000))  # several chunks
+        f.write_text(good + "0 bad$token\n")
+
+        def rows_before_error(batch_rows):
+            p = NativeStreamParser(str(f), {}, 0, 1, "libsvm",
+                                   chunk_bytes=4096)
+            p.set_emit_dense(4, batch_rows=batch_rows)
+            rows = 0
+            with pytest.raises(DMLCError):
+                while True:
+                    blk = p.next_block()
+                    if blk is None:
+                        break
+                    rows += len(blk)
+            p.close()
+            return rows
+
+        plain = rows_before_error(0)
+        batched = rows_before_error(64)
+        assert plain > 0
+        assert batched == plain  # same rows delivered ahead of the raise
