@@ -16,6 +16,7 @@ Layouts: 'dense' (padded [B, D], MXU-friendly), 'ell' (static-shape sparse),
 
 from __future__ import annotations
 
+import os
 from collections import deque
 from typing import Iterator, Optional, Tuple
 
@@ -97,6 +98,8 @@ class DeviceIter:
         self.stall_seconds = 0.0
         self.batches_fed = 0
         self.bytes_to_device = 0
+        # DMLC_TPU_TRACE=1 wraps each transfer in a profiler annotation
+        self._trace = os.environ.get("DMLC_TPU_TRACE", "0") == "1"
         if layout == "dense" and hasattr(source, "set_emit_dense"):
             # ask the parser for HBM-ready dense batches (skips CSR), repacked
             # to this batch size off-GIL when the native reader is in play;
@@ -184,6 +187,16 @@ class DeviceIter:
     # ---------------- device side ----------------
 
     def _put(self, host_batch):
+        # optional tracing hook (SURVEY.md §5.1): annotate transfers so they
+        # are attributable in a jax.profiler / Perfetto trace
+        if self._trace:
+            import jax.profiler
+
+            with jax.profiler.TraceAnnotation("dmlc_tpu.device_put"):
+                return self._put_inner(host_batch)
+        return self._put_inner(host_batch)
+
+    def _put_inner(self, host_batch):
         kind = host_batch[0]
         if kind == "bcoo":
             block = host_batch[1]

@@ -468,6 +468,10 @@ class LineReader {
   DenseResult* drain_accumulator(size_t rows) {
     const size_t ncol = static_cast<size_t>(num_col_);
     auto* out = static_cast<DenseResult*>(calloc(1, sizeof(DenseResult)));
+    if (!out) {
+      set_error("reader: out of memory repacking batch");
+      return nullptr;
+    }
     out->n_rows = static_cast<int64_t>(rows);
     out->n_cols = num_col_;
     out->x = static_cast<float*>(malloc(rows * ncol * sizeof(float)));

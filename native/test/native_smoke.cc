@@ -5,6 +5,7 @@
 
 #include <cassert>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 
@@ -70,7 +71,7 @@ int main() {
   const char* paths[] = {path};
   int64_t sizes[] = {size};
   void* r = dmlc_reader_create(paths, sizes, 1, 0, 1, /*fmt=*/0, 0, 0, ',',
-                               2, 4096, 2);
+                               2, 4096, 2, /*batch_rows=*/0);
   CHECK_TRUE(r != nullptr);
   for (int pass = 0; pass < 2; ++pass) {
     int64_t rows = 0;
@@ -90,7 +91,7 @@ int main() {
   dmlc_reader_destroy(r);
   remove(path);
 
-  CHECK_TRUE(dmlc_native_abi_version() == 4);
+  CHECK_TRUE(dmlc_native_abi_version() == 5);
   if (failures == 0) std::printf("native_smoke: all checks passed\n");
   return failures == 0 ? 0 : 1;
 }
