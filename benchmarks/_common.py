@@ -1,0 +1,54 @@
+"""Shared helpers for the benchmark suite (corpus synth + timing + output)."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CACHE_DIR = os.path.join(REPO, ".bench_cache")
+TARGET_MB = float(os.environ.get("DMLC_BENCH_MB", "32"))
+REPS = 3
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def emit(metric: str, value: float, unit: str, baseline: float) -> None:
+    """The ONE stdout JSON line, same schema as bench.py."""
+    print(json.dumps({
+        "metric": metric,
+        "value": round(value, 2),
+        "unit": unit,
+        "vs_baseline": round(value / baseline, 3) if baseline else 0.0,
+    }))
+
+
+def timed_best(fn, reps: int = REPS) -> float:
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.monotonic()
+        fn()
+        best = min(best, time.monotonic() - t0)
+    return best
+
+
+def synth_text(path: str, make_line, target_mb: float = TARGET_MB) -> str:
+    """Write `make_line(i) -> str` rows until ~target_mb; cached on disk."""
+    if os.path.exists(path) and os.path.getsize(path) >= target_mb * 0.95 * 2**20:
+        return path
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    written, i = 0, 0
+    with open(path, "w") as f:
+        target = target_mb * 2**20
+        while written < target:
+            chunk = "".join(make_line(j) for j in range(i, i + 2000))
+            f.write(chunk)
+            written += len(chunk)
+            i += 2000
+    return path

@@ -1,0 +1,45 @@
+"""BASELINE.md config 2: CSV parser + prefetch (Criteo-day0-shaped).
+
+Criteo rows: label + 13 integer + 26 categorical columns; synthesized here
+as 39 numeric columns. Metric: parse throughput with the threaded prefetch
+pipeline; baseline: the same parse single-threaded without prefetch.
+"""
+
+import os
+
+import numpy as np
+
+from _common import CACHE_DIR, TARGET_MB, emit, log, synth_text, timed_best
+
+NCOL = 39
+rng = np.random.default_rng(7)
+
+
+def _line(i: int) -> str:
+    vals = ",".join(f"{(i * 31 + j) % 1000}" for j in range(13))
+    cats = ",".join(f"{(i * 17 + j) % 100000}" for j in range(26))
+    return f"{i % 2},{vals},{cats}\n"
+
+
+def run() -> None:
+    from dmlc_tpu.data import create_parser
+
+    path = synth_text(os.path.join(CACHE_DIR, "criteo_like.csv"), _line)
+    size_mb = os.path.getsize(path) / 2**20
+    uri = path + "?format=csv&label_column=0"
+
+    def consume(threaded: bool) -> None:
+        p = create_parser(uri, 0, 1, threaded=threaded)
+        rows = sum(len(b) for b in p)
+        p.close()
+        assert rows > 0
+
+    base = timed_best(lambda: consume(False))
+    log(f"csv single-thread: {size_mb / base:.1f} MB/s")
+    t = timed_best(lambda: consume(True))
+    log(f"csv prefetch: {size_mb / t:.1f} MB/s")
+    emit("csv_prefetch_mb_per_sec", size_mb / t, "MB/s", size_mb / base)
+
+
+if __name__ == "__main__":
+    run()

@@ -1,0 +1,56 @@
+"""BASELINE.md config 4: libfm sparse -> device BCOO (KDD2012-track2-shaped).
+
+KDD2012 CTR rows: ~10 sparse features over a ~50M index space with field
+ids. Metric: end-to-end libfm parse -> BCOO batches resident on device;
+baseline: host-only parse of the same corpus.
+"""
+
+import os
+
+import jax
+
+from _common import CACHE_DIR, emit, log, synth_text, timed_best
+
+NNZ = 10
+
+
+def _line(i: int) -> str:
+    feats = " ".join(
+        f"{j}:{(i * 2654435761 + j * 40503) % 50_000_000}:1"
+        for j in range(NNZ))
+    return f"{i % 2} {feats}\n"
+
+
+def run() -> None:
+    from dmlc_tpu.data import create_parser
+    from dmlc_tpu.ops.sparse import block_to_bcoo
+
+    path = synth_text(os.path.join(CACHE_DIR, "kdd12_like.libfm"), _line)
+    size_mb = os.path.getsize(path) / 2**20
+    uri = path + "?format=libfm"
+
+    def host_only() -> None:
+        # same threading as the metric run, so vs_baseline isolates the
+        # BCOO-conversion + device-transfer cost
+        p = create_parser(uri, 0, 1, threaded=True)
+        rows = sum(len(b) for b in p)
+        p.close()
+        assert rows > 0
+
+    def to_device() -> None:
+        p = create_parser(uri, 0, 1, threaded=True)
+        last = None
+        for blk in p:
+            last = block_to_bcoo(blk, 50_000_000)
+        p.close()
+        jax.block_until_ready(last.data)
+
+    base = timed_best(host_only)
+    log(f"libfm host-only: {size_mb / base:.1f} MB/s")
+    t = timed_best(to_device)
+    log(f"libfm -> device BCOO: {size_mb / t:.1f} MB/s")
+    emit("libfm_bcoo_mb_per_sec", size_mb / t, "MB/s", size_mb / base)
+
+
+if __name__ == "__main__":
+    run()

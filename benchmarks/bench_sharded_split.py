@@ -1,0 +1,51 @@
+"""BASELINE.md config 5: sharded InputSplit across a pod.
+
+The real config is an 8-host v5e-64 launch; without multi-host hardware the
+same code path runs against a virtual 8-process layout: 8 partitions of one
+corpus consumed in-process (the reference tests distribution exactly this
+way, unittest_inputsplit.cc test_split_libsvm_distributed), with per-shard
+byte accounting. Metric: aggregate MB/s of all 8 shards parsed through the
+pipeline; baseline: 1-shard sequential parse.
+"""
+
+import os
+
+from _common import CACHE_DIR, emit, log, synth_text, timed_best
+
+NSHARD = 8
+NCOL = 28
+
+
+def _line(i: int) -> str:
+    feats = " ".join(f"{j}:{(i + j) % 97}.5" for j in range(NCOL))
+    return f"{i % 2} {feats}\n"
+
+
+def run() -> None:
+    from dmlc_tpu.data import create_parser
+
+    path = synth_text(os.path.join(CACHE_DIR, "pod_shard.libsvm"), _line)
+    size_mb = os.path.getsize(path) / 2**20
+
+    def consume(nshard: int) -> int:
+        # shards run back-to-back in one process (a real pod runs one per
+        # host); synchronous parsers avoid per-shard thread churn
+        rows = 0
+        for part in range(nshard):
+            p = create_parser(path, part, nshard, "libsvm", threaded=False)
+            rows += sum(len(b) for b in p)
+            p.close()
+        return rows
+
+    n1 = consume(1)
+    n8 = consume(NSHARD)
+    assert n1 == n8, (n1, n8)  # partition invariant: no loss, no duplication
+    base = timed_best(lambda: consume(1))
+    log(f"1-shard: {size_mb / base:.1f} MB/s ({n1} rows)")
+    t = timed_best(lambda: consume(NSHARD))
+    log(f"{NSHARD}-shard aggregate: {size_mb / t:.1f} MB/s")
+    emit("sharded_split_mb_per_sec", size_mb / t, "MB/s", size_mb / base)
+
+
+if __name__ == "__main__":
+    run()

@@ -173,6 +173,8 @@ class GcsFileSystem(FileSystem):
     def instance(cls, uri: Optional[URI] = None) -> "GcsFileSystem":
         if cls._instance is None:
             cls._instance = cls()
+        else:
+            cls._instance.cfg = GcsConfig()
         return cls._instance
 
     def _get_json(self, url: str) -> Tuple[int, dict]:
@@ -186,18 +188,22 @@ class GcsFileSystem(FileSystem):
             raise DMLCError(f"gcs request failed: {url}: {exc}") from exc
 
     def get_path_info(self, path: URI) -> FileInfo:
+        cfg = self.cfg  # snapshot across the HEAD + fallback listing
         bucket, key = _parse_gs_uri(path)
-        status, meta = self._get_json(self.cfg.meta_url(bucket, key))
+        status, meta = self._get_json(cfg.meta_url(bucket, key))
         if status == 200:
             return FileInfo(path, int(meta.get("size", 0)), FILE_TYPE)
         prefix = key.rstrip("/") + "/" if key else ""
-        entries = self._list(bucket, prefix, max_results=1, max_total=1)
+        entries = self._list(bucket, prefix, max_results=1, max_total=1,
+                             cfg=cfg)
         if entries:
             return FileInfo(path, 0, DIR_TYPE)
         raise DMLCError(f"gcs path not found: {str(path)}")
 
     def _list(self, bucket: str, prefix: str, max_results: int = 1000,
-              max_total: Optional[int] = None) -> List[Tuple[str, int, str]]:
+              max_total: Optional[int] = None,
+              cfg: Optional[GcsConfig] = None) -> List[Tuple[str, int, str]]:
+        cfg = cfg or self.cfg  # one snapshot for every page
         out: List[Tuple[str, int, str]] = []
         token: Optional[str] = None
         while True:
@@ -205,7 +211,7 @@ class GcsFileSystem(FileSystem):
                      "maxResults": str(max_results)}
             if token:
                 query["pageToken"] = token
-            status, data = self._get_json(self.cfg.list_url(bucket, query))
+            status, data = self._get_json(cfg.list_url(bucket, query))
             check(status == 200, f"gcs list failed: {status}")
             for item in data.get("items", []):
                 out.append((item["name"], int(item.get("size", 0)), FILE_TYPE))
@@ -224,14 +230,15 @@ class GcsFileSystem(FileSystem):
         ]
 
     def open(self, path: URI, mode: str):
+        cfg = self.cfg  # snapshot: stat + stream must share one config
         bucket, key = _parse_gs_uri(path)
         if "r" in mode:
             info = self.get_path_info(path)
             check(info.type == FILE_TYPE, f"not a file: {str(path)}")
             return _pyio.BufferedReader(
-                GcsReadStream(self.cfg, bucket, key, info.size))
+                GcsReadStream(cfg, bucket, key, info.size))
         if "w" in mode:
-            return _pyio.BufferedWriter(GcsWriteStream(self.cfg, bucket, key))
+            return _pyio.BufferedWriter(GcsWriteStream(cfg, bucket, key))
         raise DMLCError(f"unsupported gcs open mode {mode!r}")
 
     def open_for_read(self, path: URI):

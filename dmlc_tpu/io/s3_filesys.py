@@ -344,25 +344,32 @@ class S3FileSystem(FileSystem):
     def instance(cls, uri: Optional[URI] = None) -> "S3FileSystem":
         if cls._instance is None:
             cls._instance = cls()
+        else:
+            # refresh from env on every lookup: credentials/endpoint may
+            # rotate mid-process (env reads are trivia next to any request)
+            cls._instance.cfg = S3Config()
         return cls._instance
 
     def get_path_info(self, path: URI) -> FileInfo:
+        cfg = self.cfg  # snapshot: instance() may swap cfg concurrently
         bucket, key = _parse_s3_uri(path)
-        status, _, headers = _request(self.cfg, "HEAD", bucket, key)
+        status, _, headers = _request(cfg, "HEAD", bucket, key)
         if status == 200:
             return FileInfo(path, int(headers.get("Content-Length", 0)),
                             FILE_TYPE)
         # fall back: prefix listing decides directory-ness (bucket root
         # lists with an empty prefix, not "/")
         prefix = key.rstrip("/") + "/" if key else ""
-        entries = self._list(bucket, prefix, max_keys=1, max_total=1)
+        entries = self._list(bucket, prefix, max_keys=1, max_total=1, cfg=cfg)
         if entries:
             return FileInfo(path, 0, DIR_TYPE)
         raise DMLCError(f"s3 path not found: {str(path)}")
 
     def _list(self, bucket: str, prefix: str, max_keys: int = 1000,
-              max_total: Optional[int] = None) -> List[Tuple[str, int, str]]:
+              max_total: Optional[int] = None,
+              cfg: Optional[S3Config] = None) -> List[Tuple[str, int, str]]:
         """(key, size, type) entries under prefix via ListObjectsV2."""
+        cfg = cfg or self.cfg  # one snapshot for every page of the listing
         out: List[Tuple[str, int, str]] = []
         token: Optional[str] = None
         while True:
@@ -374,7 +381,7 @@ class S3FileSystem(FileSystem):
             }
             if token:
                 query["continuation-token"] = token
-            status, body, _ = _request(self.cfg, "GET", bucket, "", query=query)
+            status, body, _ = _request(cfg, "GET", bucket, "", query=query)
             check(status == 200, f"s3 list failed: {status}")
             root = ET.fromstring(body)
 
@@ -416,14 +423,15 @@ class S3FileSystem(FileSystem):
         return infos
 
     def open(self, path: URI, mode: str):
+        cfg = self.cfg  # snapshot: stat + stream must share one config
         bucket, key = _parse_s3_uri(path)
         if "r" in mode:
             info = self.get_path_info(path)
             check(info.type == FILE_TYPE, f"not a file: {str(path)}")
-            raw = S3ReadStream(self.cfg, bucket, key, info.size)
+            raw = S3ReadStream(cfg, bucket, key, info.size)
             return _pyio.BufferedReader(raw)
         if "w" in mode:
-            return _pyio.BufferedWriter(S3WriteStream(self.cfg, bucket, key))
+            return _pyio.BufferedWriter(S3WriteStream(cfg, bucket, key))
         raise DMLCError(f"unsupported s3 open mode {mode!r}")
 
     def open_for_read(self, path: URI):

@@ -37,7 +37,11 @@ def open_pipeline(path: str) -> DeviceIter:
 
 
 def main() -> None:
-    path = os.path.join(tempfile.mkdtemp(), "train.libsvm")
+    with tempfile.TemporaryDirectory() as tmp:
+        _run(os.path.join(tmp, "train.libsvm"))
+
+
+def _run(path: str) -> None:
     make_corpus(path)
 
     it = open_pipeline(path)
