@@ -7,12 +7,7 @@ pipeline; baseline: the same parse single-threaded without prefetch.
 
 import os
 
-import numpy as np
-
-from _common import CACHE_DIR, TARGET_MB, emit, log, synth_text, timed_best
-
-NCOL = 39
-rng = np.random.default_rng(7)
+from _common import CACHE_DIR, emit, log, synth_text, timed_best
 
 
 def _line(i: int) -> str:

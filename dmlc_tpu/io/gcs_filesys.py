@@ -177,8 +177,9 @@ class GcsFileSystem(FileSystem):
             cls._instance.cfg = GcsConfig()
         return cls._instance
 
-    def _get_json(self, url: str) -> Tuple[int, dict]:
-        req = urllib.request.Request(url, headers=self.cfg.headers())
+    def _get_json(self, url: str,
+                  cfg: Optional[GcsConfig] = None) -> Tuple[int, dict]:
+        req = urllib.request.Request(url, headers=(cfg or self.cfg).headers())
         try:
             with urllib.request.urlopen(req, timeout=60) as resp:
                 return resp.status, json.loads(resp.read() or b"{}")
@@ -187,10 +188,12 @@ class GcsFileSystem(FileSystem):
         except urllib.error.URLError as exc:
             raise DMLCError(f"gcs request failed: {url}: {exc}") from exc
 
-    def get_path_info(self, path: URI) -> FileInfo:
-        cfg = self.cfg  # snapshot across the HEAD + fallback listing
+    def get_path_info(self, path: URI,
+                      cfg: Optional[GcsConfig] = None) -> FileInfo:
+        if cfg is None:
+            cfg = self.cfg  # snapshot across the HEAD + fallback listing
         bucket, key = _parse_gs_uri(path)
-        status, meta = self._get_json(cfg.meta_url(bucket, key))
+        status, meta = self._get_json(cfg.meta_url(bucket, key), cfg=cfg)
         if status == 200:
             return FileInfo(path, int(meta.get("size", 0)), FILE_TYPE)
         prefix = key.rstrip("/") + "/" if key else ""
@@ -211,7 +214,8 @@ class GcsFileSystem(FileSystem):
                      "maxResults": str(max_results)}
             if token:
                 query["pageToken"] = token
-            status, data = self._get_json(cfg.list_url(bucket, query))
+            status, data = self._get_json(cfg.list_url(bucket, query),
+                                          cfg=cfg)
             check(status == 200, f"gcs list failed: {status}")
             for item in data.get("items", []):
                 out.append((item["name"], int(item.get("size", 0)), FILE_TYPE))
@@ -233,7 +237,7 @@ class GcsFileSystem(FileSystem):
         cfg = self.cfg  # snapshot: stat + stream must share one config
         bucket, key = _parse_gs_uri(path)
         if "r" in mode:
-            info = self.get_path_info(path)
+            info = self.get_path_info(path, cfg=cfg)
             check(info.type == FILE_TYPE, f"not a file: {str(path)}")
             return _pyio.BufferedReader(
                 GcsReadStream(cfg, bucket, key, info.size))

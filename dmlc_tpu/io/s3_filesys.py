@@ -350,8 +350,9 @@ class S3FileSystem(FileSystem):
             cls._instance.cfg = S3Config()
         return cls._instance
 
-    def get_path_info(self, path: URI) -> FileInfo:
-        cfg = self.cfg  # snapshot: instance() may swap cfg concurrently
+    def get_path_info(self, path: URI, cfg: Optional[S3Config] = None) -> FileInfo:
+        if cfg is None:
+            cfg = self.cfg  # snapshot: instance() may swap cfg concurrently
         bucket, key = _parse_s3_uri(path)
         status, _, headers = _request(cfg, "HEAD", bucket, key)
         if status == 200:
@@ -426,7 +427,7 @@ class S3FileSystem(FileSystem):
         cfg = self.cfg  # snapshot: stat + stream must share one config
         bucket, key = _parse_s3_uri(path)
         if "r" in mode:
-            info = self.get_path_info(path)
+            info = self.get_path_info(path, cfg=cfg)
             check(info.type == FILE_TYPE, f"not a file: {str(path)}")
             raw = S3ReadStream(cfg, bucket, key, info.size)
             return _pyio.BufferedReader(raw)

@@ -112,6 +112,42 @@ class WorkerClient:
         """Rejoin after failure keeping the prior rank (tracker.py:288-301)."""
         return self.start(world_size=-1, rank=rank, cmd="recover")
 
+    def heartbeat(self) -> None:
+        """One liveness ping (tracker-side SURVEY.md §5.3 failure detection);
+        requires an assigned rank."""
+        conn = self._hello("heartbeat", self.rank, -1)
+        conn.close()
+
+    def start_heartbeat(self, interval: float = 5.0):
+        """Ping the tracker every `interval` seconds from a managed thread
+        until :meth:`stop_heartbeat` (or close). Idempotent: a running
+        heartbeat thread is stopped (and, if stuck in a socket op, simply
+        superseded — names are unique). Returns the thread."""
+        from dmlc_tpu.utils.thread_group import ThreadGroup, timer_thread
+
+        self.stop_heartbeat()
+        if getattr(self, "_hb_group", None) is None:
+            self._hb_group = ThreadGroup()
+            self._hb_seq = 0
+        self._hb_seq += 1
+        self._hb_thread = timer_thread(
+            self._hb_group, f"heartbeat-{self._hb_seq}", interval,
+            self._safe_heartbeat, run_first_immediately=True)
+        return self._hb_thread
+
+    def _safe_heartbeat(self) -> None:
+        try:
+            self.heartbeat()
+        except OSError:
+            pass  # tracker gone; shutdown paths report the real error
+
+    def stop_heartbeat(self) -> None:
+        t = getattr(self, "_hb_thread", None)
+        if t is not None:
+            t.request_shutdown()
+            t.join(2)
+            self._hb_thread = None
+
     def print_to_tracker(self, message: str) -> None:
         conn = self._hello("print", -1, -1)
         conn.send_str(message)
@@ -124,6 +160,7 @@ class WorkerClient:
         self.close()
 
     def close(self) -> None:
+        self.stop_heartbeat()
         for s in self._peer_socks:
             try:
                 s.close()

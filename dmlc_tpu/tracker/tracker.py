@@ -25,6 +25,7 @@ env contract onto ``jax.distributed`` (dmlc_tpu/parallel/distributed.py).
 from __future__ import annotations
 
 import logging
+import os
 import socket
 import struct
 import threading
@@ -220,7 +221,9 @@ class RabitTracker:
     """The rendezvous server (tracker.py:138-349)."""
 
     def __init__(self, host_ip: str, num_workers: int,
-                 port: int = 9091, port_end: int = 9999):
+                 port: int = 9091, port_end: int = 9999,
+                 liveness_timeout: Optional[float] = None,
+                 on_worker_lost=None):
         family = socket.getaddrinfo(host_ip, None)[0][0]
         sock = socket.socket(family, socket.SOCK_STREAM)
         if port_end <= port:
@@ -245,7 +248,62 @@ class RabitTracker:
         self.thread: Optional[threading.Thread] = None
         self.start_time: Optional[float] = None
         self.end_time: Optional[float] = None
+        # liveness (SURVEY.md §5.3: the reference tracker blocks on accept
+        # with no failure detection): workers running our WorkerClient send
+        # periodic `heartbeat` commands — a new cmd legacy rabit clients
+        # simply never send, so the wire protocol stays compatible.
+        # Detection is opt-in per worker: only ranks that have heartbeat at
+        # least once are tracked, so a legacy client in the same job is
+        # never flagged. Tracked ranks silent for `liveness_timeout`
+        # seconds are reported via on_worker_lost.
+        self.liveness_timeout = liveness_timeout
+        self.on_worker_lost = on_worker_lost
+        self.last_seen: Dict[int, float] = {}
+        self.lost_workers: set = set()
+        self._shutdown_ranks: set = set()
+        self._liveness_lock = threading.Lock()
+        self._processing_since: Optional[float] = None
+        self._monitor = None
+        if liveness_timeout is not None:
+            from dmlc_tpu.utils.thread_group import ThreadGroup, timer_thread
+
+            self._monitor_group = ThreadGroup()
+            self._monitor = timer_thread(
+                self._monitor_group, "liveness",
+                max(liveness_timeout / 3.0, 0.05), self._check_liveness)
         logger.info("tracker listening on %s:%d", host_ip, self.port)
+
+    def _mark_alive(self, rank: int) -> None:
+        if rank < 0:
+            return
+        with self._liveness_lock:
+            self.last_seen[rank] = time.time()
+            self.lost_workers.discard(rank)
+
+    def _check_liveness(self) -> None:
+        if self.liveness_timeout is None:
+            return
+        now = time.time()
+        # suspend judgment while the single-threaded accept loop is busy
+        # (e.g. blocked brokering a recovery): heartbeats queue unprocessed
+        # in the TCP backlog and every healthy rank would look stale
+        busy_since = self._processing_since
+        if busy_since is not None and now - busy_since > 0.2:
+            return
+        newly_lost = []
+        with self._liveness_lock:
+            for rank, seen in self.last_seen.items():
+                if (rank in self._shutdown_ranks or rank in self.lost_workers):
+                    continue
+                if now - seen > self.liveness_timeout:
+                    self.lost_workers.add(rank)
+                    newly_lost.append(rank)
+        for rank in newly_lost:
+            logger.warning("tracker: worker rank %d missed heartbeats "
+                           "(last seen %.1fs ago)", rank,
+                           now - self.last_seen[rank])
+            if self.on_worker_lost is not None:
+                self.on_worker_lost(rank)
 
     def worker_envs(self) -> Dict[str, str]:
         """Env contract for workers (slave_envs, tracker.py:178-184)."""
@@ -264,7 +322,9 @@ class RabitTracker:
         todo_nodes: List[int] = []
 
         while len(shutdown) != num_workers:
+            self._processing_since = None
             fd, addr = self.sock.accept()
+            self._processing_since = time.time()
             try:
                 worker = WorkerEntry(fd, addr)
             except (ConnectionError, AssertionError) as exc:
@@ -274,10 +334,16 @@ class RabitTracker:
             if worker.cmd == "print":
                 logger.info("%s", worker.conn.recv_str().strip())
                 continue
+            if worker.cmd == "heartbeat":
+                self._mark_alive(worker.rank)
+                worker.conn.close()
+                continue
             if worker.cmd == "shutdown":
                 assert worker.rank >= 0 and worker.rank not in shutdown
                 assert worker.rank not in wait_conn
                 shutdown[worker.rank] = worker
+                with self._liveness_lock:
+                    self._shutdown_ranks.add(worker.rank)
                 logger.debug("shutdown from rank %d", worker.rank)
                 continue
             assert worker.cmd in ("start", "recover"), worker.cmd
@@ -345,6 +411,9 @@ class RabitTracker:
         return self.thread is not None and self.thread.is_alive()
 
     def close(self) -> None:
+        if self._monitor is not None:
+            self._monitor.request_shutdown()
+            self._monitor = None
         try:
             self.sock.close()
         except OSError:
@@ -431,7 +500,12 @@ def submit(num_workers: int, num_servers: int, fun_submit,
     rabit: Optional[RabitTracker] = None
     pserver: Optional[PSTracker] = None
     if num_servers == 0:
-        rabit = RabitTracker(ip, num_workers)
+        # DMLC_LIVENESS_TIMEOUT (seconds) arms heartbeat-based failure
+        # detection for workers using our WorkerClient; unset = off (legacy
+        # rabit clients send no heartbeats and must not be flagged)
+        lt = float(os.environ.get("DMLC_LIVENESS_TIMEOUT") or 0)
+        rabit = RabitTracker(ip, num_workers,
+                             liveness_timeout=lt if lt > 0 else None)
         envs.update(rabit.worker_envs())
         rabit.start(num_workers)
     else:

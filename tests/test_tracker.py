@@ -331,3 +331,68 @@ class TestLauncher:
                    f"open(r'{marker}', 'w').write('ok')"], use_exec=False)
         assert rc == 0
         assert marker.read_text() == "ok"
+
+
+class TestLiveness:
+    def test_silent_worker_flagged_heartbeater_not(self):
+        import time as _time
+
+        from dmlc_tpu.tracker.client import WorkerClient
+        from dmlc_tpu.tracker.tracker import RabitTracker
+
+        lost = []
+        tracker = RabitTracker("127.0.0.1", 2, liveness_timeout=0.6,
+                               on_worker_lost=lost.append)
+        tracker.start()
+        try:
+            a = WorkerClient("127.0.0.1", tracker.port, jobid="a")
+            b = WorkerClient("127.0.0.1", tracker.port, jobid="b")
+            ra = {}
+            import threading
+
+            ta = threading.Thread(
+                target=lambda: ra.setdefault("a", a.start(world_size=2)))
+            ta.start()
+            assn_b = b.start(world_size=2)
+            ta.join(5)
+            assn_a = ra["a"]
+            # detection is opt-in per worker: b heartbeats once (enrolling
+            # itself) then goes silent; a keeps heartbeating
+            a.start_heartbeat(interval=0.2)
+            b.heartbeat()
+            _time.sleep(1.5)
+            assert assn_b.rank in tracker.lost_workers
+            assert assn_a.rank not in tracker.lost_workers
+            assert lost == [assn_b.rank]
+            # b comes back (recover semantics revive liveness)
+            b.heartbeat()
+            _time.sleep(0.1)
+            assert assn_b.rank not in tracker.lost_workers
+            a.stop_heartbeat()
+            a.shutdown()
+            b.shutdown()
+            tracker.join(5)
+        finally:
+            a.close()
+            b.close()
+            tracker.close()
+
+    def test_never_heartbeating_worker_not_flagged(self):
+        # legacy rabit clients send no heartbeats and must never be flagged
+        import time as _time
+
+        from dmlc_tpu.tracker.client import WorkerClient
+        from dmlc_tpu.tracker.tracker import RabitTracker
+
+        tracker = RabitTracker("127.0.0.1", 1, liveness_timeout=0.3)
+        tracker.start()
+        try:
+            w = WorkerClient("127.0.0.1", tracker.port)
+            w.start(world_size=1)
+            _time.sleep(1.0)
+            assert tracker.lost_workers == set()
+            w.shutdown()
+            tracker.join(5)
+        finally:
+            w.close()
+            tracker.close()
