@@ -136,14 +136,18 @@ class NativeStreamParser(Parser):
             else:
                 fmt = native.FMT_LIBFM
             indexing_mode = getattr(self.param, "indexing_mode", 0)
+            repack = (fmt == native.FMT_LIBSVM_DENSE
+                      or (fmt == native.FMT_CSV
+                          and self._emit_dense is not None))
             self._reader = native.Reader(
                 self.paths, self.sizes, self.part_index, self.num_parts,
                 fmt, num_col=self._emit_dense or 0,
                 indexing_mode=indexing_mode,
                 delimiter=getattr(self.param, "delimiter", ","),
                 chunk_bytes=self.chunk_bytes,
-                batch_rows=(self._batch_rows
-                            if fmt == native.FMT_LIBSVM_DENSE else 0),
+                batch_rows=self._batch_rows if repack else 0,
+                label_col=getattr(self.param, "label_column", -1),
+                weight_col=getattr(self.param, "weight_column", -1),
             )
         return self._reader
 

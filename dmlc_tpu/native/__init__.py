@@ -33,7 +33,7 @@ _SRCS = [os.path.join(_SRC_DIR, f) for f in ("parse.cc", "reader.cc")]
 _HDRS = [os.path.join(_SRC_DIR, f) for f in ("api.h", "strtonum.h")]
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 _SO_PATH = os.path.join(_BUILD_DIR, "libdmlc_tpu_native.so")
-_ABI_VERSION = 5
+_ABI_VERSION = 6
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -197,7 +197,8 @@ def _declare(lib: ctypes.CDLL) -> None:
         ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
         ctypes.c_int32, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
         ctypes.c_int64, ctypes.c_int32, ctypes.c_char, ctypes.c_int32,
-        ctypes.c_int64, ctypes.c_int32, ctypes.c_int64]
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_int32]
     lib.dmlc_reader_next.restype = ctypes.c_void_p
     lib.dmlc_reader_next.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32)]
@@ -401,7 +402,8 @@ class Reader:
                  fmt: int, num_col: int = 0, indexing_mode: int = 0,
                  delimiter: str = ",", nthread: int = 0,
                  chunk_bytes: int = 1 << 20, queue_depth: int = 4,
-                 batch_rows: int = 0):
+                 batch_rows: int = 0, label_col: int = -1,
+                 weight_col: int = -1):
         lib = _load()
         if lib is None:
             raise DMLCError("native core unavailable")
@@ -415,7 +417,7 @@ class Reader:
             arr_p, arr_s, len(paths), part_index, num_parts, fmt, num_col,
             indexing_mode, delimiter.encode()[0] if delimiter else b","[0],
             nthread or default_nthread(), chunk_bytes, queue_depth,
-            batch_rows)
+            batch_rows, label_col, weight_col)
         if not self._h:
             raise DMLCError(
                 "native reader creation failed (out of memory or threads)")

@@ -40,6 +40,9 @@ class WorkerClient:
         self._listen_sock: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._peer_socks: List[socket.socket] = []
+        self._hb_group = None  # ThreadGroup, created on first start_heartbeat
+        self._hb_thread = None
+        self._hb_seq = 0
 
     # ---------------- protocol ----------------
 
@@ -126,9 +129,8 @@ class WorkerClient:
         from dmlc_tpu.utils.thread_group import ThreadGroup, timer_thread
 
         self.stop_heartbeat()
-        if getattr(self, "_hb_group", None) is None:
+        if self._hb_group is None:
             self._hb_group = ThreadGroup()
-            self._hb_seq = 0
         self._hb_seq += 1
         self._hb_thread = timer_thread(
             self._hb_group, f"heartbeat-{self._hb_seq}", interval,
@@ -142,10 +144,9 @@ class WorkerClient:
             pass  # tracker gone; shutdown paths report the real error
 
     def stop_heartbeat(self) -> None:
-        t = getattr(self, "_hb_thread", None)
-        if t is not None:
-            t.request_shutdown()
-            t.join(2)
+        if self._hb_thread is not None:
+            self._hb_thread.request_shutdown()
+            self._hb_thread.join(2)
             self._hb_thread = None
 
     def print_to_tracker(self, message: str) -> None:

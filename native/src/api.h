@@ -67,14 +67,17 @@ int dmlc_native_abi_version();
 // and queues parsed blocks for the consumer. Formats: 0=libsvm (CSR),
 // 1=libsvm dense, 2=csv, 3=libfm.
 
-// batch_rows > 0 (dense format only): repack parsed rows into exact
-// [batch_rows, num_col] blocks off the consumer thread (final block may be
-// short).
+// batch_rows > 0 (dense libsvm, or csv with num_col > 0): repack parsed
+// rows into exact [batch_rows, num_col] dense blocks off the consumer
+// thread (final block may be short). For csv, label_col/weight_col (-1 =
+// absent) are split out and the remaining cells padded/truncated to
+// num_col; results then carry format 1 (dense).
 void* dmlc_reader_create(const char** paths, const int64_t* sizes,
                          int32_t nfiles, int64_t part_index, int64_t num_parts,
                          int32_t format, int64_t num_col, int32_t indexing_mode,
                          char delim, int32_t nthread, int64_t chunk_bytes,
-                         int32_t queue_depth, int64_t batch_rows);
+                         int32_t queue_depth, int64_t batch_rows,
+                         int32_t label_col, int32_t weight_col);
 // Next parsed block; NULL at end-of-partition or on reader error (check
 // dmlc_reader_error). Parse errors ride the result's own error field.
 // Blocks with zero rows are never returned. `fmt_out` (may be NULL)

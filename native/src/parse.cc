@@ -737,6 +737,6 @@ void dmlc_free_csv(CsvResult* r) {
   free(r);
 }
 
-int dmlc_native_abi_version() { return 5; }
+int dmlc_native_abi_version() { return 6; }
 
 }  // extern "C"

@@ -91,7 +91,8 @@ class LineReader {
   LineReader(std::vector<std::string> paths, std::vector<int64_t> sizes,
              int64_t part_index, int64_t num_parts, int format,
              int64_t num_col, int indexing_mode, char delim, int nthread,
-             int64_t chunk_bytes, int queue_depth, int64_t batch_rows)
+             int64_t chunk_bytes, int queue_depth, int64_t batch_rows,
+             int32_t label_col, int32_t weight_col)
       : paths_(std::move(paths)),
         format_(format),
         num_col_(num_col),
@@ -100,7 +101,9 @@ class LineReader {
         nthread_(nthread < 1 ? 1 : nthread),
         chunk_bytes_(chunk_bytes < 4096 ? 4096 : chunk_bytes),
         queue_depth_(queue_depth < 1 ? 1 : queue_depth),
-        batch_rows_(batch_rows > 0 ? batch_rows : 0) {
+        batch_rows_(batch_rows > 0 ? batch_rows : 0),
+        label_col_(label_col),
+        weight_col_(weight_col) {
     file_offset_.push_back(0);
     for (int64_t s : sizes) file_offset_.push_back(file_offset_.back() + s);
     reset_partition(part_index, num_parts);
@@ -387,12 +390,40 @@ class LineReader {
         }
         continue;
       }
-      if (had_error && format_ == kFmtLibsvmDense && batch_rows_ > 0 &&
-          !acc_label_.empty()) {
+      if (!had_error && format_ == kFmtCsv && batch_rows_ > 0 &&
+          num_col_ > 0) {
+        // csv -> dense: split label/weight columns and feed the same
+        // batch accumulator (csv_cells_to_dense semantics, parsers.py)
+        DenseResult* dres = csv_to_dense(static_cast<CsvResult*>(res));
+        if (!dres) {
+          mark_done();
+          return;
+        }
+        if (dres->error) {  // config error (label_col out of range)
+          // deliver rows accumulated from earlier clean chunks BEFORE the
+          // error block (same ordering contract as the dense error path)
+          if (!acc_label_.empty()) {
+            DenseResult* tail = drain_accumulator(acc_label_.size());
+            if (!tail || !push_result(kFmtLibsvmDense, tail)) {
+              dmlc_free_dense(dres);
+              mark_done();
+              return;
+            }
+          }
+          push_result(kFmtLibsvmDense, dres);
+          break;
+        }
+        if (!accumulate_dense(dres)) {
+          mark_done();
+          return;
+        }
+        continue;
+      }
+      if (had_error && batch_rows_ > 0 && !acc_label_.empty()) {
         // deliver rows accumulated from earlier clean chunks BEFORE the
         // error block, preserving non-batch-mode ordering
         DenseResult* tail = drain_accumulator(acc_label_.size());
-        if (!tail || !push_result(format_, tail)) {
+        if (!tail || !push_result(kFmtLibsvmDense, tail)) {
           free_result(format_, res);
           mark_done();
           return;
@@ -401,9 +432,9 @@ class LineReader {
       if (!push_result(format_, res)) return;
       if (had_error) break;  // parse error rides the queued result
     }
-    if (format_ == kFmtLibsvmDense && batch_rows_ > 0 && !acc_label_.empty()) {
+    if (batch_rows_ > 0 && !acc_label_.empty()) {
       DenseResult* tail = drain_accumulator(acc_label_.size());
-      if (tail) push_result(format_, tail);
+      if (tail) push_result(kFmtLibsvmDense, tail);
     }
     mark_done();
   }
@@ -415,6 +446,51 @@ class LineReader {
     std::lock_guard<std::mutex> lk(mu_);
     produce_done_ = true;
     cv_pop_.notify_all();
+  }
+
+  // CSV cells [n, ncol] -> DenseResult with label/weight columns split out
+  // and features padded/truncated to num_col_ (csv_cells_to_dense,
+  // dmlc_tpu/data/parsers.py). Consumes `res`; null = OOM (error set).
+  DenseResult* csv_to_dense(CsvResult* res) {
+    const int64_t n = res->n_rows;
+    const int64_t ncol = res->n_cols;
+    auto* out = static_cast<DenseResult*>(calloc(1, sizeof(DenseResult)));
+    if (!out) {
+      dmlc_free_csv(res);
+      set_error("reader: out of memory converting csv");
+      return nullptr;
+    }
+    out->n_cols = num_col_;
+    if (label_col_ >= ncol || weight_col_ >= ncol) {
+      out->error = strdup("csv: label/weight column out of range");
+      dmlc_free_csv(res);
+      return out;
+    }
+    out->n_rows = n;
+    out->x = static_cast<float*>(
+        calloc(static_cast<size_t>(n) * num_col_, sizeof(float)));
+    out->label = static_cast<float*>(malloc(n * sizeof(float)));
+    if (weight_col_ >= 0)
+      out->weight = static_cast<float*>(malloc(n * sizeof(float)));
+    if (!out->x || !out->label || (weight_col_ >= 0 && !out->weight)) {
+      dmlc_free_dense(out);
+      dmlc_free_csv(res);
+      set_error("reader: out of memory converting csv");
+      return nullptr;
+    }
+    for (int64_t r = 0; r < n; ++r) {
+      const float* row = res->cells + r * ncol;
+      out->label[r] = label_col_ >= 0 ? row[label_col_] : 0.0f;
+      if (weight_col_ >= 0) out->weight[r] = row[weight_col_];
+      float* dst = out->x + r * num_col_;
+      int64_t k = 0;
+      for (int64_t c = 0; c < ncol && k < num_col_; ++c) {
+        if (c == label_col_ || c == weight_col_) continue;
+        dst[k++] = row[c];
+      }
+    }
+    dmlc_free_csv(res);
+    return out;
   }
 
   // Blocking push honoring queue depth; false = stop requested.
@@ -459,7 +535,7 @@ class LineReader {
     while (static_cast<int64_t>(acc_label_.size()) >= batch_rows_) {
       DenseResult* out = drain_accumulator(static_cast<size_t>(batch_rows_));
       if (!out) return false;            // OOM (error already set)
-      if (!push_result(format_, out)) return false;  // stop requested
+      if (!push_result(kFmtLibsvmDense, out)) return false;  // stop
     }
     return true;
   }
@@ -571,6 +647,8 @@ class LineReader {
   // off-GIL in this producer thread, replacing the consumer-side
   // np.concatenate per batch
   int64_t batch_rows_ = 0;
+  int32_t label_col_ = -1;   // csv->dense: label/weight column extraction
+  int32_t weight_col_ = -1;  // (csv_parser.h label_column/weight_column)
   std::vector<float> acc_x_, acc_label_, acc_weight_;
   bool acc_has_weight_ = false;
 
@@ -593,13 +671,15 @@ void* dmlc_reader_create(const char** paths, const int64_t* sizes,
                          int32_t nfiles, int64_t part_index, int64_t num_parts,
                          int32_t format, int64_t num_col, int32_t indexing_mode,
                          char delim, int32_t nthread, int64_t chunk_bytes,
-                         int32_t queue_depth, int64_t batch_rows) {
+                         int32_t queue_depth, int64_t batch_rows,
+                         int32_t label_col, int32_t weight_col) {
   try {
     std::vector<std::string> p(paths, paths + nfiles);
     std::vector<int64_t> s(sizes, sizes + nfiles);
     return new LineReader(std::move(p), std::move(s), part_index, num_parts,
                           format, num_col, indexing_mode, delim, nthread,
-                          chunk_bytes, queue_depth, batch_rows);
+                          chunk_bytes, queue_depth, batch_rows, label_col,
+                          weight_col);
   } catch (...) {
     // alloc/thread-spawn failure must not cross the extern "C" boundary
     // (std::terminate); null tells the caller creation failed

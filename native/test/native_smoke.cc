@@ -71,7 +71,8 @@ int main() {
   const char* paths[] = {path};
   int64_t sizes[] = {size};
   void* r = dmlc_reader_create(paths, sizes, 1, 0, 1, /*fmt=*/0, 0, 0, ',',
-                               2, 4096, 2, /*batch_rows=*/0);
+                               2, 4096, 2, /*batch_rows=*/0,
+                               /*label_col=*/-1, /*weight_col=*/-1);
   CHECK_TRUE(r != nullptr);
   for (int pass = 0; pass < 2; ++pass) {
     int64_t rows = 0;
@@ -91,7 +92,7 @@ int main() {
   dmlc_reader_destroy(r);
   remove(path);
 
-  CHECK_TRUE(dmlc_native_abi_version() == 5);
+  CHECK_TRUE(dmlc_native_abi_version() == 6);
   if (failures == 0) std::printf("native_smoke: all checks passed\n");
   return failures == 0 ? 0 : 1;
 }
