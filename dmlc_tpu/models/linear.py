@@ -31,7 +31,14 @@ class LinearParams(NamedTuple):
     bias: jax.Array    # scalar
 
 
-def init_params(weight_dim: int, dtype=jnp.float32) -> LinearParams:
+def init_params(weight_dim: int, num_class: int = 1,
+                dtype=jnp.float32) -> LinearParams:
+    if num_class > 1:
+        # multinomial: weight [W, C], per-class bias (softmax objective)
+        return LinearParams(
+            weight=jnp.zeros((weight_dim, num_class), dtype=dtype),
+            bias=jnp.zeros(num_class, dtype=dtype),
+        )
     return LinearParams(
         weight=jnp.zeros(weight_dim, dtype=dtype),
         bias=jnp.zeros((), dtype=dtype),
@@ -53,6 +60,10 @@ def _loss_from_margin(margin, label, weight, objective: str, l2: float, params):
         per = optax.sigmoid_binary_cross_entropy(margin, label)
     elif objective == "squared":
         per = 0.5 * (margin - label) ** 2
+    elif objective == "softmax":
+        # margin is [B, C]; labels are class ids carried in the float label
+        per = optax.softmax_cross_entropy_with_integer_labels(
+            margin, label.astype(jnp.int32))
     else:
         raise ValueError(f"unknown objective {objective!r}")
     den = jnp.maximum(weight.sum(), 1.0)
@@ -65,9 +76,13 @@ def _loss_from_margin(margin, label, weight, objective: str, l2: float, params):
 
 
 class LinearLearner:
-    """Logistic / least-squares learner with optax updates.
+    """Logistic / least-squares / multinomial-softmax learner with optax
+    updates (the learner family the reference's Row::SDot was built for,
+    data.h:146-161, widened to multi-class).
 
-    ``layout`` must match the DeviceIter layout ('dense' or 'ell').
+    ``layout`` must match the DeviceIter layout ('dense' or 'ell');
+    ``objective='softmax'`` needs ``num_class >= 2`` and the dense layout
+    (labels are integer class ids carried in the float label column).
     """
 
     def __init__(
@@ -81,8 +96,14 @@ class LinearLearner:
         mesh=None,
         data_axis: str = "data",
         model_axis: Optional[str] = None,
+        num_class: int = 1,
     ):
         check(layout in ("dense", "ell"), "LinearLearner: layout must be dense|ell")
+        check((objective == "softmax") == (num_class > 1),
+              "softmax objective iff num_class > 1")
+        check(num_class <= 1 or layout == "dense",
+              "softmax needs the dense layout")
+        self.num_class = num_class
         self.num_col = num_col
         self.objective = objective
         self.layout = layout
@@ -97,7 +118,7 @@ class LinearLearner:
             model_size = mesh.shape[model_axis]
         self.weight_dim = -(-(num_col + 1) // model_size) * model_size
         self.opt = optimizer or optax.sgd(learning_rate)
-        self.params = init_params(self.weight_dim)
+        self.params = init_params(self.weight_dim, num_class)
         self.opt_state = self.opt.init(self.params)
         self._step = self._build_step()
         self._predict = self._build_predict()
@@ -134,7 +155,10 @@ class LinearLearner:
         mesh = self.mesh
         if self.model_axis is not None:
             # feature-sharded weights (the TP analog for very wide models)
-            p_w = NamedSharding(mesh, P(self.model_axis))
+            if self.num_class > 1:
+                p_w = NamedSharding(mesh, P(self.model_axis, None))
+            else:
+                p_w = NamedSharding(mesh, P(self.model_axis))
         else:
             p_w = NamedSharding(mesh, P())
         p_scalar = NamedSharding(mesh, P())
@@ -215,7 +239,10 @@ class LinearLearner:
                 label, weight = np.asarray(batch.label), np.asarray(batch.weight)
             else:
                 label, weight = np.asarray(batch[1]), np.asarray(batch[2])
-            pred = (margin > 0).astype(np.float32)
+            if self.num_class > 1:
+                pred = margin.argmax(axis=-1).astype(np.float32)
+            else:
+                pred = (margin > 0).astype(np.float32)
             correct += float(((pred == label) * weight).sum())
             total += float(weight.sum())
         device_iter.reset()

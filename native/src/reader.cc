@@ -392,42 +392,23 @@ class LineReader {
       }
       if (!had_error && format_ == kFmtCsv && batch_rows_ > 0 &&
           num_col_ > 0) {
-        // csv -> dense: split label/weight columns and feed the same
-        // batch accumulator (csv_cells_to_dense semantics, parsers.py)
-        DenseResult* dres = csv_to_dense(static_cast<CsvResult*>(res));
-        if (!dres) {
+        // csv -> dense straight into the batch accumulator
+        DenseResult* cfg_err = nullptr;
+        if (!accumulate_csv(static_cast<CsvResult*>(res), &cfg_err)) {
           mark_done();
           return;
         }
-        if (dres->error) {  // config error (label_col out of range)
-          // deliver rows accumulated from earlier clean chunks BEFORE the
-          // error block (same ordering contract as the dense error path)
-          if (!acc_label_.empty()) {
-            DenseResult* tail = drain_accumulator(acc_label_.size());
-            if (!tail || !push_result(kFmtLibsvmDense, tail)) {
-              dmlc_free_dense(dres);
-              mark_done();
-              return;
-            }
-          }
-          push_result(kFmtLibsvmDense, dres);
+        if (cfg_err) {  // config error (label_col out of range)
+          push_error_after_flush(kFmtLibsvmDense, cfg_err);
           break;
-        }
-        if (!accumulate_dense(dres)) {
-          mark_done();
-          return;
         }
         continue;
       }
-      if (had_error && batch_rows_ > 0 && !acc_label_.empty()) {
+      if (had_error && batch_rows_ > 0) {
         // deliver rows accumulated from earlier clean chunks BEFORE the
         // error block, preserving non-batch-mode ordering
-        DenseResult* tail = drain_accumulator(acc_label_.size());
-        if (!tail || !push_result(kFmtLibsvmDense, tail)) {
-          free_result(format_, res);
-          mark_done();
-          return;
-        }
+        if (!push_error_after_flush(format_, res)) return;
+        break;
       }
       if (!push_result(format_, res)) return;
       if (had_error) break;  // parse error rides the queued result
@@ -446,51 +427,6 @@ class LineReader {
     std::lock_guard<std::mutex> lk(mu_);
     produce_done_ = true;
     cv_pop_.notify_all();
-  }
-
-  // CSV cells [n, ncol] -> DenseResult with label/weight columns split out
-  // and features padded/truncated to num_col_ (csv_cells_to_dense,
-  // dmlc_tpu/data/parsers.py). Consumes `res`; null = OOM (error set).
-  DenseResult* csv_to_dense(CsvResult* res) {
-    const int64_t n = res->n_rows;
-    const int64_t ncol = res->n_cols;
-    auto* out = static_cast<DenseResult*>(calloc(1, sizeof(DenseResult)));
-    if (!out) {
-      dmlc_free_csv(res);
-      set_error("reader: out of memory converting csv");
-      return nullptr;
-    }
-    out->n_cols = num_col_;
-    if (label_col_ >= ncol || weight_col_ >= ncol) {
-      out->error = strdup("csv: label/weight column out of range");
-      dmlc_free_csv(res);
-      return out;
-    }
-    out->n_rows = n;
-    out->x = static_cast<float*>(
-        calloc(static_cast<size_t>(n) * num_col_, sizeof(float)));
-    out->label = static_cast<float*>(malloc(n * sizeof(float)));
-    if (weight_col_ >= 0)
-      out->weight = static_cast<float*>(malloc(n * sizeof(float)));
-    if (!out->x || !out->label || (weight_col_ >= 0 && !out->weight)) {
-      dmlc_free_dense(out);
-      dmlc_free_csv(res);
-      set_error("reader: out of memory converting csv");
-      return nullptr;
-    }
-    for (int64_t r = 0; r < n; ++r) {
-      const float* row = res->cells + r * ncol;
-      out->label[r] = label_col_ >= 0 ? row[label_col_] : 0.0f;
-      if (weight_col_ >= 0) out->weight[r] = row[weight_col_];
-      float* dst = out->x + r * num_col_;
-      int64_t k = 0;
-      for (int64_t c = 0; c < ncol && k < num_col_; ++c) {
-        if (c == label_col_ || c == weight_col_) continue;
-        dst[k++] = row[c];
-      }
-    }
-    dmlc_free_csv(res);
-    return out;
   }
 
   // Blocking push honoring queue depth; false = stop requested.
@@ -513,6 +449,31 @@ class LineReader {
     return true;
   }
 
+  // Emit every complete batch sitting in the accumulator; false on stop/OOM.
+  bool emit_full_batches() {
+    while (static_cast<int64_t>(acc_label_.size()) >= batch_rows_) {
+      DenseResult* out = drain_accumulator(static_cast<size_t>(batch_rows_));
+      if (!out) return false;            // OOM (error already set)
+      if (!push_result(kFmtLibsvmDense, out)) return false;  // stop
+    }
+    return true;
+  }
+
+  // Deliver rows accumulated from earlier clean chunks, THEN the error
+  // result — the ordering contract shared by every error path in batch
+  // mode. false = stop/OOM (err_res freed, pipeline marked done).
+  bool push_error_after_flush(int fmt, void* err_res) {
+    if (!acc_label_.empty()) {
+      DenseResult* tail = drain_accumulator(acc_label_.size());
+      if (!tail || !push_result(kFmtLibsvmDense, tail)) {
+        free_result(fmt, err_res);
+        mark_done();
+        return false;
+      }
+    }
+    return push_result(fmt, err_res);
+  }
+
   // Append a parsed dense chunk to the accumulator, emitting every complete
   // batch. Consumes `res`. false = stop requested mid-emit.
   bool accumulate_dense(DenseResult* res) {
@@ -532,12 +493,53 @@ class LineReader {
       }
     }
     dmlc_free_dense(res);
-    while (static_cast<int64_t>(acc_label_.size()) >= batch_rows_) {
-      DenseResult* out = drain_accumulator(static_cast<size_t>(batch_rows_));
-      if (!out) return false;            // OOM (error already set)
-      if (!push_result(kFmtLibsvmDense, out)) return false;  // stop
+    return emit_full_batches();
+  }
+
+  // Append CSV cells straight into the batch accumulator (one copy: cells
+  // -> acc_*), splitting label/weight columns and padding/truncating
+  // features to num_col_ (csv_cells_to_dense semantics). Consumes `res`.
+  // A config error comes back via *err_out (a dense error result) with
+  // true returned; false = stop/OOM.
+  bool accumulate_csv(CsvResult* res, DenseResult** err_out) {
+    *err_out = nullptr;
+    const int64_t n = res->n_rows;
+    const int64_t ncol = res->n_cols;
+    if (label_col_ >= ncol || weight_col_ >= ncol) {
+      auto* out = static_cast<DenseResult*>(calloc(1, sizeof(DenseResult)));
+      if (!out) {
+        dmlc_free_csv(res);
+        set_error("reader: out of memory converting csv");
+        return false;
+      }
+      out->n_cols = num_col_;
+      out->error = strdup("csv: label/weight column out of range");
+      dmlc_free_csv(res);
+      *err_out = out;
+      return true;
     }
-    return true;
+    const bool has_w = weight_col_ >= 0;
+    if (has_w && !acc_has_weight_ && !acc_label_.empty()) {
+      acc_weight_.assign(acc_label_.size(), 1.0f);
+    }
+    if (has_w) acc_has_weight_ = true;
+    const size_t base = acc_x_.size();
+    acc_x_.resize(base + static_cast<size_t>(n) * num_col_, 0.0f);
+    acc_label_.reserve(acc_label_.size() + static_cast<size_t>(n));
+    for (int64_t r = 0; r < n; ++r) {
+      const float* row = res->cells + r * ncol;
+      acc_label_.push_back(label_col_ >= 0 ? row[label_col_] : 0.0f);
+      if (acc_has_weight_)
+        acc_weight_.push_back(has_w ? row[weight_col_] : 1.0f);
+      float* dst = acc_x_.data() + base + static_cast<size_t>(r) * num_col_;
+      int64_t k = 0;
+      for (int64_t c = 0; c < ncol && k < num_col_; ++c) {
+        if (c == label_col_ || c == weight_col_) continue;
+        dst[k++] = row[c];
+      }
+    }
+    dmlc_free_csv(res);
+    return emit_full_batches();
   }
 
   // Pop the first `rows` accumulated rows into a malloc'd DenseResult.

@@ -317,3 +317,42 @@ def test_pallas_ell_matvec_matches_xla():
                             block_b=64, interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_softmax_learner_sharded():
+    """Multinomial softmax on a 2D mesh (dp x tp), end-to-end data pipeline."""
+    import jax.numpy as jnp
+
+    from dmlc_tpu.models.linear import LinearLearner
+    from dmlc_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"data": 4, "model": 2})
+    model = LinearLearner(num_col=8, objective="softmax", num_class=3,
+                          mesh=mesh, model_axis="model", learning_rate=0.5)
+    rng = np.random.default_rng(1)
+    n = 64
+    X = rng.normal(size=(n, model.device_num_col())).astype(np.float32)
+    X[:, 8:] = 0
+    w_true = rng.normal(size=(8, 3))
+    y = (X[:, :8] @ w_true).argmax(-1).astype(np.float32)
+    ones = np.ones(n, np.float32)
+    batch = (jnp.asarray(X), jnp.asarray(y), jnp.asarray(ones))
+    first = float(model.step(batch))
+    for _ in range(40):
+        loss = float(model.step(batch))
+    assert loss < first
+    pred = np.asarray(model.predict(batch)).argmax(-1)
+    assert (pred == y).mean() > 0.9
+
+
+def test_softmax_config_validation():
+    from dmlc_tpu.models.linear import LinearLearner
+    from dmlc_tpu.utils.check import DMLCError
+
+    with pytest.raises(DMLCError):
+        LinearLearner(num_col=4, objective="softmax")  # num_class missing
+    with pytest.raises(DMLCError):
+        LinearLearner(num_col=4, num_class=3)  # non-softmax multi-class
+    with pytest.raises(DMLCError):
+        LinearLearner(num_col=4, objective="softmax", num_class=3,
+                      layout="ell")
