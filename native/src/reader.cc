@@ -34,6 +34,7 @@
 #include <vector>
 
 #include "api.h"
+#include "parse_internal.h"
 
 namespace {
 
@@ -119,6 +120,10 @@ class LineReader {
   ~LineReader() {
     stop_and_join();
     close_fp();
+    if (cur_) {
+      dmlc_free_dense(cur_);
+      cur_ = nullptr;
+    }
   }
 
   void* next(int32_t* fmt_out) {
@@ -137,10 +142,12 @@ class LineReader {
     offset_curr_ = offset_begin_;
     overflow_.clear();
     close_fp();
-    acc_x_.clear();
-    acc_label_.clear();
-    acc_weight_.clear();
-    acc_has_weight_ = false;
+    if (cur_) {
+      dmlc_free_dense(cur_);
+      cur_ = nullptr;
+    }
+    cur_rows_ = 0;
+    cur_has_weight_ = false;
     if (error_.empty()) {
       start();
     } else {
@@ -357,21 +364,24 @@ class LineReader {
     while (!stop_requested()) {
       chunk.clear();
       if (!load_chunk(&chunk)) break;  // EOF or IOerror
+      if (format_ == kFmtLibsvmDense && batch_rows_ > 0) {
+        // zero-merge path: per-thread part buffers are copied ONCE, straight
+        // into exact [batch_rows_, num_col_] output blocks
+        int r = process_dense_chunk(chunk);
+        if (r == kChunkFatal) {
+          mark_done();  // OOM (error set) or stop: never leave next() hanging
+          return;
+        }
+        if (r == kChunkErrorPushed) break;
+        continue;
+      }
       void* res = parse_chunk(chunk);
       if (!res) break;
       if (format_ == kFmtLibsvmDense) {
         if (static_cast<DenseResult*>(res)->needs_csr) {
-          // data the dense scanner can't express (qid rows): flush any
-          // batch-accumulated rows, then permanently downgrade to the CSR
-          // path and re-parse this chunk
+          // data the dense scanner can't express (qid rows): permanently
+          // downgrade to the CSR path and re-parse this chunk
           free_result(format_, res);
-          if (batch_rows_ > 0 && !acc_label_.empty()) {
-            DenseResult* tail = drain_accumulator(acc_label_.size());
-            if (!tail || !push_result(kFmtLibsvmDense, tail)) {
-              mark_done();
-              return;
-            }
-          }
           format_ = kFmtLibsvm;
           res = parse_chunk(chunk);
           if (!res) break;
@@ -382,17 +392,9 @@ class LineReader {
         continue;
       }
       bool had_error = result_error(format_, res) != nullptr;
-      if (!had_error && format_ == kFmtLibsvmDense && batch_rows_ > 0) {
-        // repack into exact batch_rows_ blocks; full ones go to the queue
-        if (!accumulate_dense(static_cast<DenseResult*>(res))) {
-          mark_done();  // OOM (error set) or stop: never leave next() hanging
-          return;
-        }
-        continue;
-      }
       if (!had_error && format_ == kFmtCsv && batch_rows_ > 0 &&
           num_col_ > 0) {
-        // csv -> dense straight into the batch accumulator
+        // csv -> dense straight into the output batch
         DenseResult* cfg_err = nullptr;
         if (!accumulate_csv(static_cast<CsvResult*>(res), &cfg_err)) {
           mark_done();
@@ -413,11 +415,82 @@ class LineReader {
       if (!push_result(format_, res)) return;
       if (had_error) break;  // parse error rides the queued result
     }
-    if (batch_rows_ > 0 && !acc_label_.empty()) {
-      DenseResult* tail = drain_accumulator(acc_label_.size());
-      if (tail) push_result(kFmtLibsvmDense, tail);
-    }
+    if (batch_rows_ > 0) flush_partial();
     mark_done();
+  }
+
+  enum { kChunkOk = 0, kChunkFatal = 1, kChunkErrorPushed = 2 };
+
+  // Parse one chunk through the internal DensePart API and append the rows
+  // directly to the in-progress output batch. Mirrors the merge semantics
+  // of dmlc_parse_libsvm_dense (first erroring part wins, all-or-none
+  // weights, per-chunk indexing heuristic) without materializing the merged
+  // intermediate.
+  int process_dense_chunk(const std::string& chunk) {
+    std::vector<dmlc_tpu::DensePart> parts;
+    dmlc_tpu::parse_libsvm_dense_chunk(chunk.data(),
+                                       static_cast<int64_t>(chunk.size()),
+                                       nthread_, num_col_, &parts);
+    for (auto& part : parts) {
+      if (part.error.empty()) continue;
+      if (part.needs_csr) {
+        // qid rows: flush, permanently downgrade to CSR, re-parse the chunk
+        if (!flush_partial()) return kChunkFatal;
+        format_ = kFmtLibsvm;
+        void* res = parse_chunk(chunk);
+        if (!res) return kChunkFatal;
+        if (result_rows(format_, res) == 0 && !result_error(format_, res)) {
+          free_result(format_, res);
+          return kChunkOk;
+        }
+        bool had_error = result_error(format_, res) != nullptr;
+        if (!push_result(format_, res)) return kChunkFatal;
+        return had_error ? kChunkErrorPushed : kChunkOk;
+      }
+      DenseResult* err = make_error_dense(part.error);
+      if (!err) {
+        set_error("reader: out of memory reporting parse error");
+        return kChunkFatal;
+      }
+      if (!push_error_after_flush(kFmtLibsvmDense, err)) return kChunkFatal;
+      return kChunkErrorPushed;
+    }
+    int64_t n = 0;
+    bool any_weight = false;
+    uint64_t min_index = UINT64_MAX;
+    for (auto& part : parts) {
+      n += static_cast<int64_t>(part.label.size());
+      any_weight |= !part.weight.empty();
+      if (part.min_index < min_index) min_index = part.min_index;
+    }
+    if (n == 0) return kChunkOk;  // blank/comment-only chunk
+    for (auto& part : parts) {
+      if (any_weight && !part.label.empty() &&
+          part.weight.size() != part.label.size()) {
+        DenseResult* err = make_error_dense(
+            "libsvm: label:weight must be set on every row or none");
+        if (!err) {
+          set_error("reader: out of memory reporting parse error");
+          return kChunkFatal;
+        }
+        if (!push_error_after_flush(kFmtLibsvmDense, err)) return kChunkFatal;
+        return kChunkErrorPushed;
+      }
+    }
+    // per-chunk 1-based -> 0-based heuristic -> column offset into the
+    // stride-(num_col_+1) part buffers (libsvm_parser.h:159-168)
+    bool convert = indexing_mode_ > 0 ||
+        (indexing_mode_ < 0 && min_index != UINT64_MAX && min_index > 0);
+    const size_t off = convert ? 1 : 0;
+    for (auto& part : parts) {
+      if (part.label.empty()) continue;
+      if (!append_rows(part.x.data(), off, part.label.data(),
+                       part.weight.empty() ? nullptr : part.weight.data(),
+                       part.label.size())) {
+        return kChunkFatal;
+      }
+    }
+    return kChunkOk;
   }
 
   // Mark the pipeline finished so a blocked next() always wakes — every
@@ -449,136 +522,196 @@ class LineReader {
     return true;
   }
 
-  // Emit every complete batch sitting in the accumulator; false on stop/OOM.
-  bool emit_full_batches() {
-    while (static_cast<int64_t>(acc_label_.size()) >= batch_rows_) {
-      DenseResult* out = drain_accumulator(static_cast<size_t>(batch_rows_));
-      if (!out) return false;            // OOM (error already set)
-      if (!push_result(kFmtLibsvmDense, out)) return false;  // stop
-    }
-    return true;
-  }
-
   // Deliver rows accumulated from earlier clean chunks, THEN the error
   // result — the ordering contract shared by every error path in batch
   // mode. false = stop/OOM (err_res freed, pipeline marked done).
   bool push_error_after_flush(int fmt, void* err_res) {
-    if (!acc_label_.empty()) {
-      DenseResult* tail = drain_accumulator(acc_label_.size());
-      if (!tail || !push_result(kFmtLibsvmDense, tail)) {
-        free_result(fmt, err_res);
-        mark_done();
-        return false;
-      }
+    if (!flush_partial()) {
+      free_result(fmt, err_res);
+      mark_done();
+      return false;
     }
     return push_result(fmt, err_res);
   }
 
-  // Append a parsed dense chunk to the accumulator, emitting every complete
-  // batch. Consumes `res`. false = stop requested mid-emit.
-  bool accumulate_dense(DenseResult* res) {
-    const int64_t n = res->n_rows;
-    const size_t ncol = static_cast<size_t>(num_col_);
-    if (res->weight && !acc_has_weight_ && !acc_label_.empty()) {
-      acc_weight_.assign(acc_label_.size(), 1.0f);  // backfill earlier rows
+  // A calloc'd DenseResult carrying only an error message; null on OOM.
+  DenseResult* make_error_dense(const std::string& msg) {
+    auto* out = static_cast<DenseResult*>(calloc(1, sizeof(DenseResult)));
+    if (!out) return nullptr;
+    out->n_cols = num_col_;
+    out->error = strdup(msg.c_str());
+    if (!out->error) {
+      free(out);
+      return nullptr;
     }
-    if (res->weight) acc_has_weight_ = true;
-    acc_x_.insert(acc_x_.end(), res->x, res->x + n * static_cast<int64_t>(ncol));
-    acc_label_.insert(acc_label_.end(), res->label, res->label + n);
-    if (acc_has_weight_) {
-      if (res->weight) {
-        acc_weight_.insert(acc_weight_.end(), res->weight, res->weight + n);
-      } else {
-        acc_weight_.insert(acc_weight_.end(), static_cast<size_t>(n), 1.0f);
-      }
-    }
-    dmlc_free_dense(res);
-    return emit_full_batches();
+    return out;
   }
 
-  // Append CSV cells straight into the batch accumulator (one copy: cells
-  // -> acc_*), splitting label/weight columns and padding/truncating
-  // features to num_col_ (csv_cells_to_dense semantics). Consumes `res`.
-  // A config error comes back via *err_out (a dense error result) with
-  // true returned; false = stop/OOM.
+  // A fresh full-size output batch; null on OOM.
+  DenseResult* alloc_batch() {
+    auto* out = static_cast<DenseResult*>(calloc(1, sizeof(DenseResult)));
+    if (!out) return nullptr;
+    out->n_cols = num_col_;
+    out->x = static_cast<float*>(
+        malloc(static_cast<size_t>(batch_rows_) * num_col_ * sizeof(float)));
+    out->label =
+        static_cast<float*>(malloc(static_cast<size_t>(batch_rows_) * sizeof(float)));
+    bool ok = out->x && out->label;
+    if (ok && cur_has_weight_) {
+      out->weight = static_cast<float*>(
+          malloc(static_cast<size_t>(batch_rows_) * sizeof(float)));
+      ok = out->weight != nullptr;
+    }
+    if (!ok) {
+      dmlc_free_dense(out);
+      return nullptr;
+    }
+    return out;
+  }
+
+  // Lazily allocate + backfill the weight column of the in-progress batch
+  // when the pipeline first sees weighted rows (earlier rows get 1.0,
+  // matching the old accumulator's backfill). false on OOM.
+  bool promote_weight() {
+    cur_has_weight_ = true;
+    if (cur_ && !cur_->weight) {
+      cur_->weight = static_cast<float*>(
+          malloc(static_cast<size_t>(batch_rows_) * sizeof(float)));
+      if (!cur_->weight) return false;
+      for (int64_t i = 0; i < cur_rows_; ++i) cur_->weight[i] = 1.0f;
+    }
+    return true;
+  }
+
+  // Emit the in-progress batch as-is (short final block). false = stop/OOM.
+  bool flush_partial() {
+    if (!cur_) return true;
+    if (cur_rows_ == 0) {
+      dmlc_free_dense(cur_);
+      cur_ = nullptr;
+      return true;
+    }
+    cur_->n_rows = cur_rows_;
+    DenseResult* out = cur_;
+    cur_ = nullptr;
+    cur_rows_ = 0;
+    return push_result(kFmtLibsvmDense, out);
+  }
+
+  // Copy n rows from a stride-(num_col_+1) part buffer (column offset `off`
+  // applying the indexing decision) straight into output batches, emitting
+  // each one as it fills. weight may be null (rows weigh 1.0 if the batch
+  // has a weight column). false = stop/OOM.
+  bool append_rows(const float* x, size_t off, const float* label,
+                   const float* weight, size_t n) {
+    const size_t ncol = static_cast<size_t>(num_col_);
+    const size_t stride = ncol + 1;
+    size_t done = 0;
+    while (done < n) {
+      if (!cur_) {
+        cur_ = alloc_batch();
+        if (!cur_) {
+          set_error("reader: out of memory repacking batch");
+          return false;
+        }
+      }
+      if (weight && !cur_has_weight_ && !promote_weight()) {
+        set_error("reader: out of memory repacking batch");
+        return false;
+      }
+      size_t space = static_cast<size_t>(batch_rows_ - cur_rows_);
+      size_t take = n - done < space ? n - done : space;
+      float* dst = cur_->x + static_cast<size_t>(cur_rows_) * ncol;
+      const float* src = x + done * stride + off;
+      for (size_t i = 0; i < take; ++i) {
+        memcpy(dst + i * ncol, src + i * stride, ncol * sizeof(float));
+      }
+      memcpy(cur_->label + cur_rows_, label + done, take * sizeof(float));
+      if (cur_has_weight_) {
+        if (weight) {
+          memcpy(cur_->weight + cur_rows_, weight + done, take * sizeof(float));
+        } else {
+          for (size_t i = 0; i < take; ++i) cur_->weight[cur_rows_ + i] = 1.0f;
+        }
+      }
+      cur_rows_ += static_cast<int64_t>(take);
+      done += take;
+      if (cur_rows_ == batch_rows_) {
+        cur_->n_rows = batch_rows_;
+        DenseResult* out = cur_;
+        cur_ = nullptr;
+        cur_rows_ = 0;
+        if (!push_result(kFmtLibsvmDense, out)) return false;  // stop
+      }
+    }
+    return true;
+  }
+
+  // Append CSV cells straight into the output batch (one copy: cells ->
+  // batch), splitting label/weight columns and padding/truncating features
+  // to num_col_ (csv_cells_to_dense semantics). Consumes `res`. A config
+  // error comes back via *err_out (a dense error result) with true
+  // returned; false = stop/OOM.
   bool accumulate_csv(CsvResult* res, DenseResult** err_out) {
     *err_out = nullptr;
     const int64_t n = res->n_rows;
     const int64_t ncol = res->n_cols;
     if (label_col_ >= ncol || weight_col_ >= ncol) {
-      auto* out = static_cast<DenseResult*>(calloc(1, sizeof(DenseResult)));
+      DenseResult* out = make_error_dense("csv: label/weight column out of range");
+      dmlc_free_csv(res);
       if (!out) {
-        dmlc_free_csv(res);
         set_error("reader: out of memory converting csv");
         return false;
       }
-      out->n_cols = num_col_;
-      out->error = strdup("csv: label/weight column out of range");
-      dmlc_free_csv(res);
       *err_out = out;
       return true;
     }
     const bool has_w = weight_col_ >= 0;
-    if (has_w && !acc_has_weight_ && !acc_label_.empty()) {
-      acc_weight_.assign(acc_label_.size(), 1.0f);
-    }
-    if (has_w) acc_has_weight_ = true;
-    const size_t base = acc_x_.size();
-    acc_x_.resize(base + static_cast<size_t>(n) * num_col_, 0.0f);
-    acc_label_.reserve(acc_label_.size() + static_cast<size_t>(n));
-    for (int64_t r = 0; r < n; ++r) {
-      const float* row = res->cells + r * ncol;
-      acc_label_.push_back(label_col_ >= 0 ? row[label_col_] : 0.0f);
-      if (acc_has_weight_)
-        acc_weight_.push_back(has_w ? row[weight_col_] : 1.0f);
-      float* dst = acc_x_.data() + base + static_cast<size_t>(r) * num_col_;
-      int64_t k = 0;
-      for (int64_t c = 0; c < ncol && k < num_col_; ++c) {
-        if (c == label_col_ || c == weight_col_) continue;
-        dst[k++] = row[c];
+    int64_t done = 0;
+    while (done < n) {
+      if (!cur_) {
+        cur_ = alloc_batch();
+        if (!cur_) {
+          dmlc_free_csv(res);
+          set_error("reader: out of memory repacking batch");
+          return false;
+        }
+      }
+      if (has_w && !cur_has_weight_ && !promote_weight()) {
+        dmlc_free_csv(res);
+        set_error("reader: out of memory repacking batch");
+        return false;
+      }
+      int64_t space = batch_rows_ - cur_rows_;
+      int64_t take = n - done < space ? n - done : space;
+      for (int64_t r = 0; r < take; ++r) {
+        const float* row = res->cells + (done + r) * ncol;
+        cur_->label[cur_rows_ + r] = label_col_ >= 0 ? row[label_col_] : 0.0f;
+        if (cur_has_weight_)
+          cur_->weight[cur_rows_ + r] = has_w ? row[weight_col_] : 1.0f;
+        float* dst = cur_->x + static_cast<size_t>(cur_rows_ + r) * num_col_;
+        int64_t k = 0;
+        for (int64_t c = 0; c < ncol && k < num_col_; ++c) {
+          if (c == label_col_ || c == weight_col_) continue;
+          dst[k++] = row[c];
+        }
+        while (k < num_col_) dst[k++] = 0.0f;  // batch x is malloc'd, not zeroed
+      }
+      cur_rows_ += take;
+      done += take;
+      if (cur_rows_ == batch_rows_) {
+        cur_->n_rows = batch_rows_;
+        DenseResult* out = cur_;
+        cur_ = nullptr;
+        cur_rows_ = 0;
+        if (!push_result(kFmtLibsvmDense, out)) {
+          dmlc_free_csv(res);
+          return false;
+        }
       }
     }
     dmlc_free_csv(res);
-    return emit_full_batches();
-  }
-
-  // Pop the first `rows` accumulated rows into a malloc'd DenseResult.
-  DenseResult* drain_accumulator(size_t rows) {
-    const size_t ncol = static_cast<size_t>(num_col_);
-    auto* out = static_cast<DenseResult*>(calloc(1, sizeof(DenseResult)));
-    if (!out) {
-      set_error("reader: out of memory repacking batch");
-      return nullptr;
-    }
-    out->n_rows = static_cast<int64_t>(rows);
-    out->n_cols = num_col_;
-    out->x = static_cast<float*>(malloc(rows * ncol * sizeof(float)));
-    out->label = static_cast<float*>(malloc(rows * sizeof(float)));
-    if (!out->x || !out->label) {
-      free(out->x);
-      free(out->label);
-      free(out);
-      set_error("reader: out of memory repacking batch");
-      return nullptr;
-    }
-    memcpy(out->x, acc_x_.data(), rows * ncol * sizeof(float));
-    memcpy(out->label, acc_label_.data(), rows * sizeof(float));
-    acc_x_.erase(acc_x_.begin(),
-                 acc_x_.begin() + static_cast<int64_t>(rows * ncol));
-    acc_label_.erase(acc_label_.begin(),
-                     acc_label_.begin() + static_cast<int64_t>(rows));
-    if (acc_has_weight_) {
-      out->weight = static_cast<float*>(malloc(rows * sizeof(float)));
-      if (!out->weight) {
-        dmlc_free_dense(out);
-        set_error("reader: out of memory repacking batch");
-        return nullptr;
-      }
-      memcpy(out->weight, acc_weight_.data(), rows * sizeof(float));
-      acc_weight_.erase(acc_weight_.begin(),
-                        acc_weight_.begin() + static_cast<int64_t>(rows));
-    }
-    return out;
+    return true;
   }
 
   // ---------------- lifecycle ----------------
@@ -644,15 +777,16 @@ class LineReader {
   FILE* fp_ = nullptr;
   std::string overflow_;
 
-  // dense batch repack (batch_rows_ > 0): rows accumulate here until a
-  // full [batch_rows_, num_col_] block can be emitted — the copy runs
+  // dense batch repack (batch_rows_ > 0): rows fill `cur_` (a full-size
+  // malloc'd output block) until it can be emitted — the single copy runs
   // off-GIL in this producer thread, replacing the consumer-side
   // np.concatenate per batch
   int64_t batch_rows_ = 0;
   int32_t label_col_ = -1;   // csv->dense: label/weight column extraction
   int32_t weight_col_ = -1;  // (csv_parser.h label_column/weight_column)
-  std::vector<float> acc_x_, acc_label_, acc_weight_;
-  bool acc_has_weight_ = false;
+  DenseResult* cur_ = nullptr;  // in-progress output batch (producer-owned)
+  int64_t cur_rows_ = 0;
+  bool cur_has_weight_ = false;
 
   std::thread producer_;
   std::mutex mu_;

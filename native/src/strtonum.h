@@ -103,13 +103,61 @@ inline bool parse_double(const char* p, const char* end, const char** out,
   return true;
 }
 
-// Lean fast path for the label/value hot loops: [sign] digits [. digits]
-// with no exponent and <=19 total digits — one pass, no per-digit cap
-// checks, fraction scaled by one multiply. Anything else (leading space,
-// exponent, inf/nan, huge mantissa) falls back to parse_double, so the
-// accepted grammar is identical.
-inline bool parse_value(const char* p, const char* end, const char** out,
-                        double* value) {
+// ---------------- SWAR digit-run primitives ----------------
+//
+// The scalar per-digit loops (mant = mant*10 + d) cost a dependent multiply
+// chain plus an unpredictable loop-exit branch per number — the dominant
+// cycles in the parser hot loops on real data (digit counts vary line to
+// line, so the exit mispredicts constantly). These read 8 bytes at once and
+// convert branch-free: one load, a byte-wise digit classification, a count
+// via ctz, and a fixed 2-multiply reduction.
+
+// 10^k as exact integers, k in [0, 8]
+static const uint64_t kPow10U[] = {1ull,      10ull,      100ull,
+                                   1000ull,   10000ull,   100000ull,
+                                   1000000ull, 10000000ull, 100000000ull};
+
+inline uint64_t load8(const char* p) {
+  uint64_t v;
+  memcpy(&v, p, 8);
+  return v;
+}
+
+// Number of leading ASCII-digit bytes (0..8) in the little-endian load.
+// Marker construction: t = val ^ 0x30.. maps digits to 0x00..0x09; adding
+// 0x76 sets the high bit for 0x0A..0x7F, and |t catches >=0x80. Byte-adds
+// can carry upward, but a carry out of byte k-1 implies byte k-1 is itself
+// a marker, so the LOWEST marker (the one ctz finds) is always genuine.
+inline int swar_digit_count(uint64_t val) {
+  uint64_t t = val ^ 0x3030303030303030ull;
+  uint64_t m = ((t + 0x7676767676767676ull) | t) & 0x8080808080808080ull;
+  return m ? static_cast<int>(__builtin_ctzll(m) >> 3) : 8;
+}
+
+// Value of the 8 ASCII digits in `val` (first char = low byte = most
+// significant digit): pairwise SWAR reduction, 2 multiplies total.
+inline uint32_t swar_parse8(uint64_t val) {
+  const uint64_t mask = 0x000000FF000000FFull;
+  const uint64_t mul1 = 0x000F424000000064ull;  // 100 + (1000000 << 32)
+  const uint64_t mul2 = 0x0000271000000001ull;  // 1 + (10000 << 32)
+  val -= 0x3030303030303030ull;
+  val = (val * 10) + (val >> 8);
+  val = (((val & mask) * mul1) + (((val >> 16) & mask) * mul2)) >> 32;
+  return static_cast<uint32_t>(val);
+}
+
+// Value of the first n (1..8) digits: shift them to the high (least
+// significant for swar_parse8) bytes and pad the front with ASCII zeros.
+inline uint32_t swar_value_full(uint64_t val, int n) {
+  uint64_t pad = (n < 8) ? (0x3030303030303030ull >> (n * 8)) : 0;
+  return swar_parse8((val << (((8 - n) * 8) & 63)) | pad);
+}
+
+// Scalar fallback for buffer tails (< 18 bytes headroom): [sign] digits
+// [. digits], no exponent, <=19 total digits; anything else falls through
+// to parse_double, so the accepted grammar is identical.
+inline bool parse_value_small(const char* p, const char* end, const char** out,
+                              double* value) {
   const char* p0 = p;
   if (p == end || is_space(*p)) return parse_double(p0, end, out, value);
   bool neg = false;
@@ -134,6 +182,102 @@ inline bool parse_value(const char* p, const char* end, const char** out,
   }
   double v = static_cast<double>(mant) * kPow10Inv[frac];
   *value = neg ? -v : v;
+  *out = p;
+  return true;
+}
+
+// Hottest-path value parse for in-line tokens. The dominant shape in ML
+// text corpora is "[-]d.ffffff" (one integer digit, short fraction): both
+// 8-byte loads are issued together up front, so classifying the fraction
+// does not wait on the integer part's digit count — that dependency chain
+// is what bounds a 1-core scan. Leading whitespace falls through to
+// parse_double (digit_count sees no digits), exponents / >8-digit runs /
+// inf / nan fall back likewise, keeping the accepted grammar identical.
+inline bool parse_value_hot(const char* p, const char* end, const char** out,
+                            double* value) {
+  // 19 bytes of headroom: sign + 8 digits + '.' + 8 digits consumed, plus
+  // one lookahead byte dereferenced after the run
+  if (end - p < 19) return parse_value_small(p, end, out, value);
+  const char* p0 = p;
+  unsigned neg = (*p == '-') ? 1u : 0u;
+  p += (neg | ((*p == '+') ? 1u : 0u));
+  uint64_t c1 = load8(p);
+  uint64_t cs = load8(p + 2);  // speculative fraction load for "d.ffffff"
+  unsigned d0 = static_cast<unsigned>(static_cast<unsigned char>(p[0])) - '0';
+  if (d0 <= 9 && p[1] == '.') {
+    int n2 = swar_digit_count(cs);
+    if (n2 == 8 && is_digit(p[10])) return parse_double(p0, end, out, value);
+    const char* q = p + 2 + n2;
+    if (*q == 'e' || *q == 'E') return parse_double(p0, end, out, value);
+    uint64_t mant =
+        static_cast<uint64_t>(d0) * kPow10U[n2] + (n2 ? swar_value_full(cs, n2) : 0);
+    int64_t sm = static_cast<int64_t>(
+        (mant ^ (0ull - static_cast<uint64_t>(neg))) + neg);
+    *value = static_cast<double>(sm) * kPow10Inv[n2];
+    *out = q;
+    return true;
+  }
+  int n1 = swar_digit_count(c1);
+  if (n1 == 0) return parse_double(p0, end, out, value);  // also ".5", inf, nan
+  if (n1 == 8 && is_digit(p[8])) return parse_double(p0, end, out, value);
+  uint64_t mant = swar_value_full(c1, n1);
+  p += n1;
+  int frac = 0;
+  if (*p == '.') {
+    ++p;
+    uint64_t c2 = load8(p);
+    int n2 = swar_digit_count(c2);
+    if (n2 == 8 && is_digit(p[8])) return parse_double(p0, end, out, value);
+    mant = mant * kPow10U[n2] + (n2 ? swar_value_full(c2, n2) : 0);
+    frac = n2;
+    p += n2;
+  }
+  if (*p == 'e' || *p == 'E') return parse_double(p0, end, out, value);
+  int64_t sm = static_cast<int64_t>(
+      (mant ^ (0ull - static_cast<uint64_t>(neg))) + neg);
+  *value = static_cast<double>(sm) * kPow10Inv[frac];
+  *out = p;
+  return true;
+}
+
+// Fast path for the label/value hot loops: SWAR digit runs, branch-free
+// sign application. Falls back to parse_value_small near the buffer end and
+// to parse_double for leading space / exponents / >8-digit runs, keeping
+// the accepted grammar identical to the scalar version.
+inline bool parse_value(const char* p, const char* end, const char** out,
+                        double* value) {
+  if (end - p < 19) return parse_value_small(p, end, out, value);
+  const char* p0 = p;
+  if (is_space(*p)) return parse_double(p0, end, out, value);
+  unsigned neg = (*p == '-') ? 1u : 0u;
+  p += (neg | ((*p == '+') ? 1u : 0u));
+  // int part scalar: labels/values have 1-2 int digits, where the SWAR
+  // machinery costs more than the loop. Capped at 9 so the scan stays
+  // within the 18-byte headroom; 9+ digit int parts take the slow path.
+  uint64_t mant = 0;
+  const char* d0 = p;
+  const char* ilim = p + 9;
+  while (p != ilim && is_digit(*p))
+    mant = mant * 10 + static_cast<uint64_t>(*p++ - '0');
+  int n1 = static_cast<int>(p - d0);
+  if (n1 > 8) return parse_double(p0, end, out, value);
+  int frac = 0;
+  if (*p == '.') {
+    ++p;
+    int n2 = swar_digit_count(load8(p));
+    if (n2) {
+      mant = mant * kPow10U[n2] + swar_value_full(load8(p), n2);
+      frac = n2;
+      p += n2;
+      if (n2 == 8 && is_digit(*p)) return parse_double(p0, end, out, value);
+    }
+  }
+  if (n1 + frac == 0 || (*p == 'e' || *p == 'E')) {
+    return parse_double(p0, end, out, value);
+  }
+  // branch-free sign: negate the (<= 10^16 < 2^62) mantissa as int64
+  int64_t sm = static_cast<int64_t>((mant ^ (0ull - neg)) + neg);
+  *value = static_cast<double>(sm) * kPow10Inv[frac];
   *out = p;
   return true;
 }

@@ -1,0 +1,62 @@
+// Single-core parse throughput harness for the native scanners.
+//
+// Usage: parse_bench <corpus.libsvm> [num_col] [reps]
+// Times dmlc_parse_libsvm_dense and dmlc_parse_libsvm (1 thread) over the
+// whole file, printing MB/s per rep — the number that bounds into-HBM
+// throughput on a 1-core bench host.
+
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "../src/api.h"
+
+static double now() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s corpus.libsvm [num_col] [reps]\n", argv[0]);
+    return 2;
+  }
+  int64_t num_col = argc > 2 ? atoll(argv[2]) : 28;
+  int reps = argc > 3 ? atoi(argv[3]) : 3;
+  FILE* f = fopen(argv[1], "rb");
+  if (!f) { perror("fopen"); return 1; }
+  fseek(f, 0, SEEK_END);
+  long len = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::string data(static_cast<size_t>(len), '\0');
+  if (fread(&data[0], 1, static_cast<size_t>(len), f) != static_cast<size_t>(len)) {
+    perror("fread"); return 1;
+  }
+  fclose(f);
+  double mb = static_cast<double>(len) / (1 << 20);
+  printf("corpus: %.1f MB, num_col=%lld\n", mb, (long long)num_col);
+
+  for (int r = 0; r < reps; ++r) {
+    double t0 = now();
+    DenseResult* res = dmlc_parse_libsvm_dense(data.data(), len, 1, num_col, -1);
+    double dt = now() - t0;
+    if (res->error) { fprintf(stderr, "dense error: %s\n", res->error); return 1; }
+    printf("dense  1-thread: %lld rows in %.3fs = %.1f MB/s\n",
+           (long long)res->n_rows, dt, mb / dt);
+    dmlc_free_dense(res);
+  }
+  for (int r = 0; r < reps; ++r) {
+    double t0 = now();
+    CsrBlockResult* res = dmlc_parse_libsvm(data.data(), len, 1, -1);
+    double dt = now() - t0;
+    if (res->error) { fprintf(stderr, "csr error: %s\n", res->error); return 1; }
+    printf("csr    1-thread: %lld rows in %.3fs = %.1f MB/s\n",
+           (long long)res->n_rows, dt, mb / dt);
+    dmlc_free_block(res);
+  }
+  return 0;
+}
