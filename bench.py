@@ -124,7 +124,8 @@ def into_hbm_mb_per_sec(path: str, size_mb: float):
             f"bench: into-HBM {nbatches} batches in {dt:.2f}s = "
             f"{size_mb/dt:.1f} MB/s, "
             f"device bytes {it.bytes_to_device/2**20:.1f} MB, "
-            f"host stall {it.stall_seconds:.2f}s"
+            f"stall {it.stall_seconds:.2f}s "
+            f"(host {it.host_stall_seconds:.2f}s)"
         )
     return size_mb / best, stats
 

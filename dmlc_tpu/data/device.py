@@ -95,7 +95,8 @@ class DeviceIter:
         self.prefetch = max(1, prefetch)
         self.drop_remainder = drop_remainder
         self.device = device
-        self.stall_seconds = 0.0
+        self.stall_seconds = 0.0        # consumer wait for a ready batch
+        self.host_stall_seconds = 0.0   # of which: waiting on host convert
         self.batches_fed = 0
         self.bytes_to_device = 0
         # DMLC_TPU_TRACE=1 wraps each transfer in a profiler annotation
@@ -234,17 +235,22 @@ class DeviceIter:
         return self
 
     def __next__(self):
+        # stall = wall time the consumer spends in here before a batch is
+        # available (covers host-parse waits AND device-side transfer setup
+        # — everything between "consumer wants a batch" and "batch handed
+        # out"); with the prefetch pipeline keeping up this is ~0
         t0 = get_time()
         self._fill()
         if not self._inflight:
             raise StopIteration
         out = self._inflight.popleft()
-        # issue the replacement transfer before handing the batch out
-        self._fill()
-        self.stall_seconds += self._host_iter.stall_seconds
+        self.stall_seconds += get_time() - t0
+        self.host_stall_seconds += self._host_iter.stall_seconds
         self._host_iter.stall_seconds = 0.0
         self.batches_fed += 1
-        _ = t0
+        # issue the replacement transfer before handing the batch out —
+        # pipeline work, not consumer stall, so outside the timed region
+        self._fill()
         return out
 
     def reset(self) -> None:
@@ -280,4 +286,5 @@ class DeviceIter:
             "batches": self.batches_fed,
             "bytes_to_device": self.bytes_to_device,
             "stall_seconds": self.stall_seconds,
+            "host_stall_seconds": self.host_stall_seconds,
         }

@@ -514,23 +514,24 @@ class RecordIOSplitter(InputSplitBase):
 
 class SingleFileSplit(InputSplit):
     """Line reading of a single file or stdin, no partitioning
-    (src/io/single_file_split.h)."""
+    (src/io/single_file_split.h).
 
-    def __init__(self, path: str):
+    Streams in bounded, record-aligned chunks — the reference buffers
+    incrementally (single_file_split.h:69-72) rather than slurping, so a
+    multi-GB file or stdin feed costs O(chunk_bytes) memory here too.
+    stdin is single-pass: a second epoch raises instead of silently
+    replaying partial data.
+    """
+
+    def __init__(self, path: str, chunk_bytes: int = 4 << 20):
         self.path = path
-        self._records: Optional[Iterator[memoryview]] = None
-        self._data: Optional[bytes] = None
-        self._chunk_given = False
-
-    def _load(self) -> None:
-        if self._data is None:
-            if self.path == "stdin":
-                import sys
-
-                self._data = sys.stdin.buffer.read()
-            else:
-                with get_filesystem(self.path).open_for_read(URI(self.path)) as f:
-                    self._data = f.read()
+        self.chunk_bytes = max(4096, int(chunk_bytes))
+        self._fp = None
+        self._overflow = b""
+        self._eof = True
+        self._started = False
+        self._stdin_consumed = False
+        self._records: Iterator[memoryview] = iter(())
 
     def reset_partition(self, part_index: int, num_parts: int) -> None:
         check(part_index == 0 and num_parts == 1,
@@ -538,32 +539,80 @@ class SingleFileSplit(InputSplit):
         self.before_first()
 
     def before_first(self) -> None:
-        self._load()
-        mv = memoryview(self._data)
-        self._records = iter(
-            [mv[s:e] for s, e in _line_spans(self._data)]
-        )
-        self._chunk_given = False
+        if self.path == "stdin":
+            import sys
+
+            check(not self._stdin_consumed,
+                  "SingleFileSplit: stdin is single-pass and cannot restart")
+            self._fp = sys.stdin.buffer
+        else:
+            if self._fp is not None:
+                self._fp.close()
+            self._fp = get_filesystem(self.path).open_for_read(URI(self.path))
+        self._overflow = b""
+        self._eof = False
+        self._started = True
+        self._records = iter(())
+
+    def _read_chunk(self) -> Optional[bytes]:
+        """Next record-aligned chunk of ~chunk_bytes, or None at EOF."""
+        if self._eof and not self._overflow:
+            return None
+        parts = [self._overflow]
+        got = len(self._overflow)
+        self._overflow = b""
+        target = self.chunk_bytes
+        while True:
+            while got < target and not self._eof:
+                data = self._fp.read(target - got)
+                if not data:
+                    self._eof = True
+                    break
+                if self.path == "stdin":
+                    self._stdin_consumed = True
+                parts.append(data)
+                got += len(data)
+            data = b"".join(parts)
+            if self._eof:
+                return data if data else None
+            # cut after the last EOL so the chunk holds whole records
+            cut = max(data.rfind(b"\n"), data.rfind(b"\r")) + 1
+            if cut > 0:
+                self._overflow = data[cut:]
+                return data[:cut]
+            # a single record longer than the chunk: keep growing
+            parts = [data]
+            target *= 2
 
     def next_record(self) -> Optional[memoryview]:
-        if self._records is None:
+        if not self._started:
             self.before_first()
-        return next(self._records, None)
+        rec = next(self._records, None)
+        while rec is None:
+            chunk = self._read_chunk()
+            if chunk is None:
+                return None
+            mv = memoryview(chunk)
+            self._records = iter([mv[s:e] for s, e in _line_spans(chunk)])
+            rec = next(self._records, None)
+        return rec
 
     def next_chunk(self) -> Optional[memoryview]:
-        """The whole file as one chunk, once per epoch.
-
-        Chunks and records draw from one shared stream (like every other
-        InputSplit): taking the chunk exhausts the record iterator.
+        """Successive record-aligned chunks, sharing the stream with
+        ``next_record`` (like every other InputSplit): records already
+        materialized from a partially-consumed chunk are dropped in favor
+        of the next chunk from the stream.
         """
-        if self._records is None:
+        if not self._started:
             self.before_first()
-        if self._chunk_given:
-            return None
-        self._chunk_given = True
         self._records = iter(())
-        data = memoryview(self._data)
-        return data if len(data) else None
+        chunk = self._read_chunk()
+        return memoryview(chunk) if chunk is not None else None
+
+    def close(self) -> None:
+        if self._fp is not None and self.path != "stdin":
+            self._fp.close()
+            self._fp = None
 
 
 def _line_spans(data: bytes) -> List[Tuple[int, int]]:

@@ -531,8 +531,8 @@ extern "C" {
 
 static char* dup_error(const std::string& s) {
   char* e = static_cast<char*>(malloc(s.size() + 1));
-  memcpy(e, s.c_str(), s.size() + 1);
-  return e;
+  if (e) memcpy(e, s.c_str(), s.size() + 1);
+  return e;  // null only under OOM; callers treat a null error as set-failed
 }
 
 static CsrBlockResult* merge_parts(std::vector<CsrPart>& parts, int indexing_mode,
@@ -582,6 +582,17 @@ static CsrBlockResult* merge_parts(std::vector<CsrPart>& parts, int indexing_mod
   res->index = static_cast<uint64_t*>(malloc(nnz * sizeof(uint64_t)));
   if (any_field) res->field = static_cast<uint64_t*>(malloc(nnz * sizeof(uint64_t)));
   if (any_value) res->value = static_cast<float*>(malloc(nnz * sizeof(float)));
+  // a failed allocation must come back as an error result, not a segfault
+  // in the embedding Python process
+  if (!res->offset || !res->label || (any_weight && !res->weight) ||
+      (any_qid && !res->qid) || !res->index || (any_field && !res->field) ||
+      (any_value && !res->value)) {
+    free(res->offset); free(res->label); free(res->weight); free(res->qid);
+    free(res->index); free(res->field); free(res->value);
+    memset(res, 0, sizeof(*res));
+    res->error = dup_error("parse: out of memory merging chunk");
+    return res;
+  }
   int64_t row = 0, ent = 0;
   res->offset[0] = 0;
   for (auto& part : parts) {
@@ -694,6 +705,13 @@ DenseResult* dmlc_parse_libsvm_dense(const char* data, int64_t len, int nthread,
   res->x = static_cast<float*>(malloc(static_cast<size_t>(n) * num_col * sizeof(float)));
   res->label = static_cast<float*>(malloc(n * sizeof(float)));
   if (any_weight) res->weight = static_cast<float*>(malloc(n * sizeof(float)));
+  if (!res->x || !res->label || (any_weight && !res->weight)) {
+    free(res->x); free(res->label); free(res->weight);
+    memset(res, 0, sizeof(*res));
+    res->n_cols = num_col;
+    res->error = dup_error("parse: out of memory merging chunk");
+    return res;
+  }
   int64_t row = 0;
   for (auto& part : parts) {
     size_t pn = part.label.size();
@@ -750,6 +768,11 @@ CsvResult* dmlc_parse_csv(const char* data, int64_t len, int nthread, char delim
   res->n_rows = nrow;
   res->n_cols = ncol < 0 ? 0 : ncol;
   res->cells = static_cast<float*>(malloc(ncell * sizeof(float)));
+  if (!res->cells && ncell > 0) {
+    memset(res, 0, sizeof(*res));
+    res->error = dup_error("parse: out of memory merging chunk");
+    return res;
+  }
   int64_t at = 0;
   for (auto& part : parts) {
     if (part.cells.empty()) continue;

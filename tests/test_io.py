@@ -532,6 +532,36 @@ def test_single_file_split_chunk_then_record():
         _os.unlink(path)
 
 
+def test_single_file_split_streams_in_bounded_chunks():
+    """A file larger than chunk_bytes is served in multiple record-aligned
+    chunks with no dropped/duplicated/split records (single_file_split.h
+    buffers incrementally; slurping the whole file would OOM on stdin)."""
+    import dmlc_tpu.io.input_split as isp
+    import tempfile, os as _os
+    lines = [f"row-{i:05d}" for i in range(500)]
+    with tempfile.NamedTemporaryFile("wb", suffix=".txt", delete=False) as f:
+        f.write(("\n".join(lines) + "\n").encode())
+        path = f.name
+    try:
+        s = isp.SingleFileSplit(path, chunk_bytes=4096)
+        got = []
+        while (rec := s.next_record()) is not None:
+            got.append(bytes(rec).decode())
+        assert got == lines
+        # chunk interface: multiple chunks, all record-aligned, re-parseable
+        s.before_first()
+        chunks = []
+        while (c := s.next_chunk()) is not None:
+            assert len(c) <= 8192
+            chunks.append(bytes(c))
+        assert len(chunks) > 1
+        reparsed = b"".join(chunks).decode().splitlines()
+        assert reparsed == lines
+        s.close()
+    finally:
+        _os.unlink(path)
+
+
 def test_memfile_double_close():
     MemoryFileSystem.reset()
     f = open_stream("mem://b/x.txt", "w")
