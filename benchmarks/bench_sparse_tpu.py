@@ -1,0 +1,122 @@
+"""A/B the sparse device layouts on the real chip (BASELINE config #4).
+
+Times the batched sparse matvec ``out[b] = sum_k w[idx[b,k]] * val[b,k]``
+— the inner op of every linear learner over libsvm/libfm data — across the
+three device layouts (dense, ELL, BCOO) and both ELL execution paths
+(XLA gather vs the Pallas one-hot kernel, ops/pallas_sparse.py), at:
+
+  - HIGGS-like shapes (D=28, K=28: dense data in sparse clothing),
+  - a mid-sparsity hashed-features shape (D=4096),
+  - KDD2012-like shapes (D=1M, K=16: truly sparse).
+
+Writes one JSON line per (shape, path) to stdout and the aggregate to
+``SPARSE_TPU_<tag>.json`` so the round's numbers are recorded in-repo.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dmlc_tpu.ops.pallas_sparse import ell_matvec_pallas
+from dmlc_tpu.ops.sparse import EllBatch, ell_matvec
+
+REPS = 50
+WARMUP = 3
+
+
+def time_op(fn, *args) -> float:
+    """Median-of-3 of REPS sequential dispatches (seconds per call)."""
+    for _ in range(WARMUP):
+        jax.block_until_ready(fn(*args))
+    samples = []
+    for _ in range(3):
+        t0 = time.monotonic()
+        out = None
+        for _ in range(REPS):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        samples.append((time.monotonic() - t0) / REPS)
+    return sorted(samples)[1]
+
+
+def bench_shape(name: str, B: int, K: int, D: int, results: list) -> None:
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=D).astype(np.float32))
+    idx_np = np.sort(
+        rng.integers(0, D, size=(B, K)).astype(np.int32), axis=1)
+    val_np = rng.normal(size=(B, K)).astype(np.float32)
+    idx, val = jnp.asarray(idx_np), jnp.asarray(val_np)
+    batch = EllBatch(idx, val, None, None)
+    flops = 2.0 * B * K
+
+    def record(path: str, sec: float) -> None:
+        row = {
+            "shape": name, "B": B, "K": K, "D": D, "path": path,
+            "usec_per_call": round(sec * 1e6, 2),
+            "gflops": round(flops / sec / 1e9, 2),
+        }
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    record("ell_xla_gather", time_op(jax.jit(ell_matvec), w, batch))
+    try:
+        record("ell_pallas", time_op(ell_matvec_pallas, w, idx, val))
+    except Exception as exc:  # noqa: BLE001 - record lowering failures
+        results.append({"shape": name, "path": "ell_pallas",
+                        "error": str(exc)[:200]})
+        print(f"# ell_pallas failed: {str(exc)[:120]}", flush=True)
+
+    # dense matmul reference (only sensible when a [B, D] dense fits)
+    if D <= 8192:
+        x = np.zeros((B, D), np.float32)
+        np.put_along_axis(x, idx_np, val_np, axis=1)
+        xd = jnp.asarray(x)
+        record("dense_matmul",
+               time_op(jax.jit(lambda a, b: a @ b), xd, w))
+
+    # BCOO (jax.experimental.sparse)
+    try:
+        from jax.experimental import sparse as jsparse
+
+        rows = np.repeat(np.arange(B), K).astype(np.int32)
+        coords = np.stack([rows, idx_np.reshape(-1)], axis=1)
+        mat = jsparse.BCOO(
+            (jnp.asarray(val_np.reshape(-1)), jnp.asarray(coords)),
+            shape=(B, D))
+
+        @jax.jit
+        def bcoo_mv(m, v):
+            return m @ v
+
+        record("bcoo_matvec", time_op(bcoo_mv, mat, w))
+    except Exception as exc:  # noqa: BLE001
+        results.append({"shape": name, "path": "bcoo", "error": str(exc)[:200]})
+        print(f"# bcoo failed: {str(exc)[:120]}", flush=True)
+
+
+def main() -> None:
+    dev = jax.devices()[0]
+    print(f"# device: {dev}", flush=True)
+    results: list = []
+    bench_shape("higgs_like", B=8192, K=28, D=28, results=results)
+    bench_shape("hashed_4k", B=8192, K=64, D=4096, results=results)
+    bench_shape("kdd_like", B=8192, K=16, D=1 << 20, results=results)
+    tag = os.environ.get("DMLC_BENCH_TAG", "r02")
+    out_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), f"SPARSE_TPU_{tag}.json")
+    with open(out_path, "w") as f:
+        json.dump({"device": str(dev), "results": results}, f, indent=1)
+    print(f"# wrote {out_path}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
