@@ -25,6 +25,7 @@ duplication — tested by looping all parts in-process (SURVEY.md §4).
 
 from __future__ import annotations
 
+import os
 import random
 import struct
 from bisect import bisect_right
@@ -972,6 +973,27 @@ def create_input_split(
     uri = spec.uri
     cache_file = spec.cache_file
     fs = get_filesystem(uri)
+    # hot path: native recordio pipeline (read + framing scan + multi-part
+    # reassembly in C++, off the GIL) for plain local .rec corpora
+    if (type_ == "recordio"
+            and os.environ.get("DMLC_TPU_NO_NATIVE_READER", "0") in ("", "0")
+            and spec.args.get("engine") != "python"):
+        from dmlc_tpu.io.native_recordio import (
+            NativeRecordIOSplit,
+            native_recordio_eligible,
+        )
+
+        if native_recordio_eligible(
+                uri, threaded, index_uri=index_uri, shuffle=shuffle,
+                num_shuffle_parts=num_shuffle_parts, cache_file=cache_file,
+                recurse_directories=recurse_directories):
+            try:
+                return NativeRecordIOSplit(
+                    uri, part_index, num_parts,
+                    recurse_directories=recurse_directories,
+                    chunk_bytes=chunk_bytes)
+            except DMLCError:
+                pass  # fall through to the Python engine
 
     def make_raw() -> InputSplitBase:
         if type_ in ("text", "line"):

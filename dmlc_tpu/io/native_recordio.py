@@ -1,0 +1,191 @@
+"""Native-backed RecordIO input split: read, framing scan, and multi-part
+reassembly all run in C++ off the GIL (native/src/reader.cc format 4/5,
+native/src/recordio.cc).
+
+This is the TPU-first hot path for local .rec corpora (BASELINE.md config
+#3, ImageNet-shaped): where the reference stacks a prefetch thread over the
+RecordIOSplitter's chunk scan (src/io/threaded_input_split.h over
+src/io/recordio_split.cc), this class delegates the identical pipeline to
+the native core — one GIL-releasing pull per batch of extracted records.
+
+``create_input_split`` routes eligible recordio URIs here (local files,
+threaded, no cache/shuffle decorators); everything else takes the Python
+engine, which shares partition semantics (both mirror input_split_base.cc +
+recordio_split.cc and are A/B-tested row-for-row in
+tests/test_native_reader.py).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from dmlc_tpu.io.filesystem import LocalFileSystem, get_filesystem
+from dmlc_tpu.io.input_split import (
+    DEFAULT_CHUNK_BYTES,
+    InputSplit,
+    RecordIOSplitter,
+)
+from dmlc_tpu.utils.check import DMLCError, check
+
+
+def native_recordio_eligible(uri: str, threaded: bool, *, index_uri=None,
+                             shuffle: bool = False, num_shuffle_parts: int = 0,
+                             cache_file=None,
+                             recurse_directories: bool = False) -> bool:
+    """True when create_input_split can route recordio to the native split."""
+    from dmlc_tpu import native
+
+    if not threaded or index_uri or shuffle or num_shuffle_parts or cache_file:
+        return False
+    try:
+        fs = get_filesystem(uri)
+    except DMLCError:
+        return False
+    if not isinstance(fs, LocalFileSystem):
+        return False
+    return native.available()
+
+
+class NativeRecordIOSplit(InputSplit):
+    """InputSplit facade over the native recordio reader.
+
+    Serves either records (extracted payloads, multi-part reassembled) or
+    raw record-aligned chunks — whichever the consumer asks for first; the
+    two modes map to distinct native stream formats, so mixing them within
+    one epoch raises instead of silently skipping data.
+    """
+
+    def __init__(self, uri: str, part_index: int, num_parts: int,
+                 recurse_directories: bool = False,
+                 chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                 queue_depth: int = 4):
+        check(num_parts >= 1, f"num_parts must be >= 1, got {num_parts}")
+        check(0 <= part_index < num_parts,
+              f"part_index {part_index} out of range for {num_parts} parts")
+        fs = get_filesystem(uri)
+        check(isinstance(fs, LocalFileSystem),
+              "native recordio split requires local files")
+        # reuse the engine's file matching (';' lists, dirs, regex basenames)
+        # AND its 4-byte alignment validation
+        lister = RecordIOSplitter(fs, uri, recurse_directories)
+        self.paths: List[str] = [info.path.name for info in lister.files]
+        self.sizes: List[int] = [info.size for info in lister.files]
+        self.part_index = part_index
+        self.num_parts = num_parts
+        self.chunk_bytes = chunk_bytes
+        self.queue_depth = queue_depth
+        self._mode: Optional[int] = None  # FMT_RECORDIO | FMT_RECORDIO_CHUNK
+        self._reader = None
+        self._payload: Optional[np.ndarray] = None
+        self._offsets: Optional[np.ndarray] = None
+        self._i = 0
+        self._records_out = 0
+
+    # ---------------- native reader lifecycle ----------------
+
+    def _ensure_reader(self, fmt: int):
+        from dmlc_tpu import native
+
+        if self._reader is None:
+            self._mode = fmt
+            self._reader = native.Reader(
+                self.paths, self.sizes, self.part_index, self.num_parts,
+                fmt, chunk_bytes=self.chunk_bytes,
+                queue_depth=self.queue_depth)
+        elif self._mode != fmt:
+            raise DMLCError(
+                "native recordio split: next_record and next_chunk cannot "
+                "be mixed within one epoch")
+        return self._reader
+
+    def _next_batch(self) -> bool:
+        nxt = self._reader.next()
+        if nxt is None:
+            return False
+        _, (payload, offsets) = nxt
+        self._payload, self._offsets, self._i = payload, offsets, 0
+        return True
+
+    # ---------------- InputSplit interface ----------------
+
+    def next_record(self) -> Optional[memoryview]:
+        from dmlc_tpu import native
+
+        self._ensure_reader(native.FMT_RECORDIO)
+        while (self._offsets is None
+               or self._i >= len(self._offsets) - 1):
+            if not self._next_batch():
+                return None
+        s = int(self._offsets[self._i])
+        e = int(self._offsets[self._i + 1])
+        self._i += 1
+        self._records_out += 1
+        return memoryview(self._payload)[s:e]
+
+    def next_chunk(self) -> Optional[memoryview]:
+        from dmlc_tpu import native
+
+        self._ensure_reader(native.FMT_RECORDIO_CHUNK)
+        if not self._next_batch():
+            return None
+        self._records_out += 1
+        return memoryview(self._payload)
+
+    def before_first(self) -> None:
+        if self._reader is not None:
+            self._reader.before_first()
+        self._payload = self._offsets = None
+        self._i = 0
+        self._records_out = 0
+        self._mode = None if self._reader is None else self._mode
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        check(num_parts >= 1, f"num_parts must be >= 1, got {num_parts}")
+        check(0 <= part_index < num_parts,
+              f"part_index {part_index} out of range for {num_parts} parts")
+        self.close()
+        self.part_index = part_index
+        self.num_parts = num_parts
+        self._mode = None
+        self._payload = self._offsets = None
+        self._i = 0
+        self._records_out = 0
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        if chunk_size > self.chunk_bytes:
+            self.chunk_bytes = chunk_size
+
+    @property
+    def bytes_read(self) -> int:
+        return self._reader.bytes_read if self._reader is not None else 0
+
+    # -------- checkpoint / resume (count-based, like NativeStreamParser) ----
+
+    def state_dict(self) -> dict:
+        return {"kind": "records", "records": self._records_out,
+                "mode": self._mode}
+
+    def load_state(self, state: dict) -> None:
+        check(state.get("kind") == "records", "incompatible split state")
+        self.before_first()
+        n = int(state["records"])
+        mode = state.get("mode")
+        for _ in range(n):
+            got = (self.next_chunk() if mode == _chunk_mode()
+                   else self.next_record())
+            if got is None:
+                break
+        self._records_out = n
+
+    def close(self) -> None:
+        if self._reader is not None:
+            self._reader.close()
+            self._reader = None
+
+
+def _chunk_mode() -> int:
+    from dmlc_tpu import native
+
+    return native.FMT_RECORDIO_CHUNK

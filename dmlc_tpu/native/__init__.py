@@ -29,11 +29,13 @@ class NeedsCsrError(DMLCError):
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _SRC_DIR = os.path.join(_REPO_ROOT, "native", "src")
-_SRCS = [os.path.join(_SRC_DIR, f) for f in ("parse.cc", "reader.cc")]
-_HDRS = [os.path.join(_SRC_DIR, f) for f in ("api.h", "strtonum.h")]
+_SRCS = [os.path.join(_SRC_DIR, f)
+         for f in ("parse.cc", "reader.cc", "recordio.cc")]
+_HDRS = [os.path.join(_SRC_DIR, f)
+         for f in ("api.h", "strtonum.h", "parse_internal.h")]
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 _SO_PATH = os.path.join(_BUILD_DIR, "libdmlc_tpu_native.so")
-_ABI_VERSION = 6
+_ABI_VERSION = 7
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -72,6 +74,16 @@ class _CsvResult(ctypes.Structure):
         ("n_rows", ctypes.c_int64),
         ("n_cols", ctypes.c_int64),
         ("cells", ctypes.POINTER(ctypes.c_float)),
+        ("error", ctypes.c_char_p),
+    ]
+
+
+class _RecordBatchResult(ctypes.Structure):
+    _fields_ = [
+        ("n_records", ctypes.c_int64),
+        ("data_len", ctypes.c_int64),
+        ("data", ctypes.POINTER(ctypes.c_char)),
+        ("offsets", ctypes.POINTER(ctypes.c_int64)),
         ("error", ctypes.c_char_p),
     ]
 
@@ -192,6 +204,9 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.dmlc_free_block.argtypes = [ctypes.c_void_p]
     lib.dmlc_free_csv.argtypes = [ctypes.c_void_p]
     lib.dmlc_native_abi_version.restype = ctypes.c_int
+    lib.dmlc_recordio_extract.restype = ctypes.POINTER(_RecordBatchResult)
+    lib.dmlc_recordio_extract.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.dmlc_free_records.argtypes = [ctypes.c_void_p]
     lib.dmlc_reader_create.restype = ctypes.c_void_p
     lib.dmlc_reader_create.argtypes = [
         ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
@@ -382,12 +397,50 @@ def _wrap_csv(lib, res):
     return cells.reshape(n, c), owner
 
 
+def _free_records(lib, addr):
+    lib.dmlc_free_records(addr)
+
+
+def recordio_extract(data) -> "tuple[np.ndarray, np.ndarray]":
+    """Extract all records from a span of RecordIO bytes (must start at a
+    record head and hold only whole records). Returns (payload u8 array,
+    offsets int64 [n+1]) — record i is ``payload[offsets[i]:offsets[i+1]]``.
+    Zero-copy over the native buffer. None when native is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    data = bytes(data) if not isinstance(data, bytes) else data
+    res = lib.dmlc_recordio_extract(data, len(data))
+    if not res:
+        raise DMLCError("recordio: out of memory")
+    return _wrap_records(lib, res)
+
+
+def _wrap_records(lib, res):
+    r = res.contents
+    if r.error:
+        msg = r.error.decode()
+        lib.dmlc_free_records(res)
+        raise DMLCError(msg)
+    owner = _Owner(lib, res, _free_records)
+    n = r.n_records
+    offsets = _view(r.offsets, n + 1, np.int64, owner)
+    payload = _view(r.data, r.data_len, np.uint8, owner)
+    if offsets is None:
+        offsets = np.zeros(1, np.int64)
+    if payload is None:
+        payload = np.empty(0, np.uint8)
+    return payload, offsets
+
+
 # ---------------- streaming reader ----------------
 
 FMT_LIBSVM = 0
 FMT_LIBSVM_DENSE = 1
 FMT_CSV = 2
 FMT_LIBFM = 3
+FMT_RECORDIO = 4
+FMT_RECORDIO_CHUNK = 5
 
 
 class Reader:
@@ -448,6 +501,9 @@ class Reader:
         if fmt.value == FMT_LIBSVM_DENSE:
             res = ctypes.cast(ptr, ctypes.POINTER(_DenseResult))
             return fmt.value, _wrap_dense(self._lib, res, self._num_col)
+        if fmt.value in (FMT_RECORDIO, FMT_RECORDIO_CHUNK):
+            res = ctypes.cast(ptr, ctypes.POINTER(_RecordBatchResult))
+            return fmt.value, _wrap_records(self._lib, res)
         res = ctypes.cast(ptr, ctypes.POINTER(_CsvResult))
         return fmt.value, _wrap_csv(self._lib, res)
 

@@ -45,6 +45,24 @@ struct CsvResult {
   char* error;
 };
 
+// A batch of RecordIO record payloads: record i is
+// data[offsets[i] : offsets[i+1]]. Free with dmlc_free_records.
+struct RecordBatchResult {
+  int64_t n_records;
+  int64_t data_len;   // == offsets[n_records]
+  char* data;         // concatenated payloads
+  int64_t* offsets;   // [n_records + 1]
+  char* error;        // null on success
+};
+
+// Extract every record from a span of RecordIO bytes that starts at a
+// record head and contains only whole records (recordio.cc:53-82 framing:
+// magic/lrecord cells, cflag 0|1|2|3 multi-part reassembly with the magic
+// re-inserted between parts). Pure function — safe to feed spans read from
+// any source (local chunk, cloud stream, indexed batch).
+RecordBatchResult* dmlc_recordio_extract(const char* data, int64_t len);
+void dmlc_free_records(RecordBatchResult* r);
+
 CsrBlockResult* dmlc_parse_libsvm(const char* data, int64_t len, int nthread,
                                   int indexing_mode);
 CsrBlockResult* dmlc_parse_libfm(const char* data, int64_t len, int nthread,
@@ -65,7 +83,9 @@ int dmlc_native_abi_version();
 // text files: producer thread loads record-aligned chunks (the reference's
 // InputSplitBase/LineSplitter invariants), parses each with worker threads,
 // and queues parsed blocks for the consumer. Formats: 0=libsvm (CSR),
-// 1=libsvm dense, 2=csv, 3=libfm.
+// 1=libsvm dense, 2=csv, 3=libfm, 4=recordio (binary records: 4-byte
+// partition alignment, magic-head boundary seeks, no newline injection at
+// file joins; results are RecordBatchResult).
 
 // batch_rows > 0 (dense libsvm, or csv with num_col > 0): repack parsed
 // rows into exact [batch_rows, num_col] dense blocks off the consumer

@@ -795,6 +795,6 @@ void dmlc_free_csv(CsvResult* r) {
   free(r);
 }
 
-int dmlc_native_abi_version() { return 6; }
+int dmlc_native_abi_version() { return 7; }
 
 }  // extern "C"

@@ -42,6 +42,8 @@ constexpr int kFmtLibsvm = 0;
 constexpr int kFmtLibsvmDense = 1;
 constexpr int kFmtCsv = 2;
 constexpr int kFmtLibfm = 3;
+constexpr int kFmtRecordIO = 4;
+constexpr int kFmtRecordIOChunk = 5;  // raw framed chunks, one per result
 
 void free_result(int format, void* res) {
   if (!res) return;
@@ -56,6 +58,10 @@ void free_result(int format, void* res) {
     case kFmtCsv:
       dmlc_free_csv(static_cast<CsvResult*>(res));
       break;
+    case kFmtRecordIO:
+    case kFmtRecordIOChunk:
+      dmlc_free_records(static_cast<RecordBatchResult*>(res));
+      break;
   }
 }
 
@@ -68,6 +74,9 @@ int64_t result_rows(int format, void* res) {
       return static_cast<DenseResult*>(res)->n_rows;
     case kFmtCsv:
       return static_cast<CsvResult*>(res)->n_rows;
+    case kFmtRecordIO:
+    case kFmtRecordIOChunk:
+      return static_cast<RecordBatchResult*>(res)->n_records;
   }
   return 0;
 }
@@ -81,11 +90,37 @@ const char* result_error(int format, void* res) {
       return static_cast<DenseResult*>(res)->error;
     case kFmtCsv:
       return static_cast<CsvResult*>(res)->error;
+    case kFmtRecordIO:
+    case kFmtRecordIOChunk:
+      return static_cast<RecordBatchResult*>(res)->error;
   }
   return nullptr;
 }
 
 inline bool is_eol(char c) { return c == '\n' || c == '\r'; }
+
+// ---------------- recordio framing helpers ----------------
+
+constexpr uint32_t kRecMagic = 0xced7230a;
+
+inline uint32_t load_u32(const char* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+
+// Offset of the LAST record head (aligned magic cell whose lrec has cflag
+// 0|1) in [d, d+size), or 0 if none — find_last_record_begin semantics
+// (recordio_split.cc FindLastRecordBegin / io/recordio.py find_record_heads).
+int64_t find_last_record_head(const char* d, int64_t size) {
+  for (int64_t i = ((size >> 2) << 2) - 8; i >= 0; i -= 4) {
+    if (load_u32(d + i) == kRecMagic &&
+        ((load_u32(d + i + 4) >> 29) & 7) <= 1) {
+      return i;
+    }
+  }
+  return 0;
+}
 
 class LineReader {
  public:
@@ -106,8 +141,13 @@ class LineReader {
         label_col_(label_col),
         weight_col_(weight_col) {
     file_offset_.push_back(0);
-    for (int64_t s : sizes) file_offset_.push_back(file_offset_.back() + s);
-    reset_partition(part_index, num_parts);
+    for (size_t i = 0; i < sizes.size(); ++i) {
+      if (format_ >= kFmtRecordIO && sizes[i] % 4 != 0) {
+        error_ = "recordio: file " + paths_[i] + " does not align by 4 bytes";
+      }
+      file_offset_.push_back(file_offset_.back() + sizes[i]);
+    }
+    if (error_.empty()) reset_partition(part_index, num_parts);
     if (error_.empty()) {
       start();
     } else {
@@ -167,10 +207,14 @@ class LineReader {
   }
 
  private:
+  bool is_text() const { return format_ < kFmtRecordIO; }
+
   // ---------------- partitioning (create-time, mirrors ResetPartition) ----
   void reset_partition(int64_t part_index, int64_t num_parts) {
     int64_t ntotal = file_offset_.back();
     int64_t nstep = (ntotal + num_parts - 1) / num_parts;
+    const int64_t align = is_text() ? 1 : 4;
+    nstep = ((nstep + align - 1) / align) * align;
     offset_begin_ = std::min(nstep * part_index, ntotal);
     offset_end_ = std::min(nstep * (part_index + 1), ntotal);
     offset_curr_ = offset_begin_;
@@ -200,9 +244,10 @@ class LineReader {
     return lo - 1;
   }
 
-  // Bytes from (file fidx, local offset) to the next record head: scan to the
-  // first EOL, then past the EOL run, within this one file
-  // (line_split.cc:9-26; the Python engine scans the same way).
+  // Bytes from (file fidx, local offset) to the next record head. Text:
+  // scan to the first EOL then past the EOL run (line_split.cc:9-26).
+  // RecordIO: scan 4-byte cells for magic + cflag 0|1 (recordio_split.cc:
+  // 9-25). Both mirror the Python engine exactly.
   int64_t seek_record_begin(size_t fidx, int64_t local_off) {
     FILE* f = fopen(paths_[fidx].c_str(), "rb");
     if (!f) {
@@ -215,6 +260,26 @@ class LineReader {
       return 0;
     }
     int64_t nstep = 0;
+    if (!is_text()) {
+      char cell[4];
+      while (fread(cell, 1, 4, f) == 4) {
+        nstep += 4;
+        if (load_u32(cell) == kRecMagic) {
+          char lrec[4];
+          if (fread(lrec, 1, 4, f) != 4) {
+            error_ = "invalid recordio format in " + paths_[fidx];
+            break;
+          }
+          nstep += 4;
+          if (((load_u32(lrec) >> 29) & 7) <= 1) {
+            fclose(f);
+            return nstep - 8;
+          }
+        }
+      }
+      fclose(f);
+      return nstep;  // EOF: no further head in this file
+    }
     char buf[512];
     bool in_run = false;
     while (true) {
@@ -289,10 +354,13 @@ class LineReader {
         set_error("read failed in " + paths_[file_ptr_]);
         return false;
       }
-      // file exhausted: newline injection at the join (PR#385)
-      *dst++ = '\n';
-      nleft -= 1;
-      bytes_read_.fetch_add(1, std::memory_order_relaxed);
+      // file exhausted: newline injection at text-file joins (PR#385);
+      // binary formats concatenate files without synthetic bytes
+      if (is_text()) {
+        *dst++ = '\n';
+        nleft -= 1;
+        bytes_read_.fetch_add(1, std::memory_order_relaxed);
+      }
       if (offset_curr_ != file_offset_[file_ptr_ + 1]) {
         set_error("file offset not calculated correctly");
         return false;
@@ -318,6 +386,22 @@ class LineReader {
       overflow_.clear();
       if (!read_bytes(size - static_cast<int64_t>(olen), chunk)) return false;
       if (chunk->empty()) return false;  // EOF
+      if (!is_text()) {
+        if (static_cast<int64_t>(chunk->size()) != size) {
+          return true;  // EOF tail: binary records are exactly complete
+        }
+        // cut at the LAST record head so the chunk ends on whole records
+        int64_t cut = find_last_record_head(
+            chunk->data(), static_cast<int64_t>(chunk->size()));
+        if (cut == 0) {
+          overflow_.swap(*chunk);
+          size *= 2;
+          continue;
+        }
+        overflow_.assign(*chunk, static_cast<size_t>(cut), chunk->npos);
+        chunk->resize(static_cast<size_t>(cut));
+        return true;
+      }
       if (chunk->size() == olen) {
         // final record of the partition lacked a newline (PR#452)
         chunk->push_back('\n');
@@ -354,6 +438,37 @@ class LineReader {
         return dmlc_parse_libfm(chunk.data(),
                                 static_cast<int64_t>(chunk.size()), nthread_,
                                 indexing_mode_);
+      case kFmtRecordIO: {
+        void* r = dmlc_recordio_extract(chunk.data(),
+                                        static_cast<int64_t>(chunk.size()));
+        if (!r) set_error("recordio: out of memory");
+        return r;
+      }
+      case kFmtRecordIOChunk: {
+        // raw record-aligned chunk as a single-record batch (NextChunk
+        // consumers re-frame it with RecordIOChunkReader themselves)
+        auto* r = static_cast<RecordBatchResult*>(
+            calloc(1, sizeof(RecordBatchResult)));
+        char* d = r ? static_cast<char*>(malloc(chunk.size() ? chunk.size() : 1))
+                    : nullptr;
+        auto* offs = r ? static_cast<int64_t*>(malloc(2 * sizeof(int64_t)))
+                       : nullptr;
+        if (!r || !d || !offs) {
+          free(d);
+          free(offs);
+          free(r);
+          set_error("recordio: out of memory");
+          return nullptr;
+        }
+        memcpy(d, chunk.data(), chunk.size());
+        r->n_records = 1;
+        r->data_len = static_cast<int64_t>(chunk.size());
+        r->data = d;
+        r->offsets = offs;
+        r->offsets[0] = 0;
+        r->offsets[1] = r->data_len;
+        return r;
+      }
     }
     set_error("unknown format");
     return nullptr;

@@ -1,0 +1,104 @@
+// RecordIO framing: record extraction with multi-part reassembly.
+//
+// TPU-native rebuild of the reference's recordio frame walk
+// (src/recordio.cc:53-82 NextRecord, recordio_split.cc:44-82 in-place
+// reassembly): wire format is [magic u32 LE][lrecord u32 LE][data][pad to
+// 4B], magic = 0xced7230a, lrecord = (cflag << 29) | length. cflag 0 is a
+// complete record; 1/2/3 are start/middle/end of a record whose payload
+// contained the magic cell at an aligned offset — the writer split it there
+// and dropped the cell, so the reader re-inserts the magic between parts.
+//
+// Semantics mirror dmlc_tpu/io/recordio.py extract_record exactly (both are
+// exercised by the same parity tests).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "api.h"
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+inline uint32_t load_u32(const char* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+
+RecordBatchResult* fail(RecordBatchResult* res, const char* msg) {
+  free(res->data);
+  free(res->offsets);
+  memset(res, 0, sizeof(*res));
+  res->error = strdup(msg);
+  return res;  // strdup OOM leaves error null: caller sees an empty batch
+}
+
+}  // namespace
+
+extern "C" {
+
+RecordBatchResult* dmlc_recordio_extract(const char* data, int64_t len) {
+  auto* res = static_cast<RecordBatchResult*>(calloc(1, sizeof(RecordBatchResult)));
+  if (!res) return nullptr;
+  // payload is strictly smaller than the framed bytes (every part drops an
+  // 8-byte header and re-adds at most 4 magic bytes), so `len` bounds the
+  // output; offsets are bounded by one record per 8 framed bytes
+  res->data = static_cast<char*>(malloc(len > 0 ? static_cast<size_t>(len) : 1));
+  int64_t max_records = len / 8 + 1;
+  res->offsets = static_cast<int64_t*>(
+      malloc(static_cast<size_t>(max_records + 1) * sizeof(int64_t)));
+  if (!res->data || !res->offsets) return fail(res, "recordio: out of memory");
+  int64_t pos = 0, w = 0, n = 0;
+  res->offsets[0] = 0;
+  while (pos < len) {
+    if (pos + 8 > len || load_u32(data + pos) != kMagic) {
+      return fail(res, "Invalid RecordIO Format");
+    }
+    uint32_t lrec = load_u32(data + pos + 4);
+    uint32_t cflag = (lrec >> 29) & 7;
+    uint32_t length = lrec & ((1u << 29) - 1);
+    int64_t cursor = pos + 8 + ((static_cast<int64_t>(length) + 3) & ~int64_t(3));
+    if (cursor > len) return fail(res, "Invalid RecordIO Format");
+    memcpy(res->data + w, data + pos + 8, length);
+    w += length;
+    if (cflag != 0) {
+      if (cflag != 1) return fail(res, "Invalid RecordIO Format");
+      while (cflag != 3) {
+        if (cursor + 8 > len || load_u32(data + cursor) != kMagic) {
+          return fail(res, "Invalid RecordIO Format");
+        }
+        lrec = load_u32(data + cursor + 4);
+        cflag = (lrec >> 29) & 7;
+        length = lrec & ((1u << 29) - 1);
+        int64_t next = cursor + 8 + ((static_cast<int64_t>(length) + 3) & ~int64_t(3));
+        if (cursor + 8 + static_cast<int64_t>(length) > len || next > len) {
+          return fail(res, "Invalid RecordIO Format");
+        }
+        // re-insert the magic the writer dropped between parts
+        memcpy(res->data + w, &kMagic, 4);
+        w += 4;
+        memcpy(res->data + w, data + cursor + 8, length);
+        w += length;
+        cursor = next;
+      }
+    }
+    res->offsets[++n] = w;
+    pos = cursor;
+  }
+  res->n_records = n;
+  res->data_len = w;
+  return res;
+}
+
+void dmlc_free_records(RecordBatchResult* r) {
+  if (!r) return;
+  free(r->data);
+  free(r->offsets);
+  free(r->error);
+  free(r);
+}
+
+}  // extern "C"
