@@ -100,12 +100,17 @@ class NativeStreamParser(Parser):
                 or self.param.label_column < 0,
                 "CSVParser: label_column must differ from weight_column",
             )
-        self.paths, self.sizes = list_partition_files(uri)
+        self._init_source(uri)
         self._reader = None
         self._emit_dense: Optional[int] = None
         self._stall = 0.0
         self._blocks_out = 0  # delivered blocks, for count-based resume
         self._batch_rows = 0
+
+    def _init_source(self, uri: str) -> None:
+        """Resolve the byte source. Base class: local files, listed with the
+        engine's matching rules (the native reader reads them itself)."""
+        self.paths, self.sizes = list_partition_files(uri)
 
     # ---------------- configuration ----------------
 
@@ -124,31 +129,39 @@ class NativeStreamParser(Parser):
 
     # ---------------- pipeline ----------------
 
+    def _stream_config(self):
+        """(fmt, kwargs) shared by the pull-mode Reader and the push-mode
+        Feeder — one place for format selection and repack policy."""
+        from dmlc_tpu import native
+
+        if self.fmt_name == "libsvm":
+            fmt = (native.FMT_LIBSVM_DENSE if self._emit_dense is not None
+                   else native.FMT_LIBSVM)
+        elif self.fmt_name == "csv":
+            fmt = native.FMT_CSV
+        else:
+            fmt = native.FMT_LIBFM
+        repack = (fmt == native.FMT_LIBSVM_DENSE
+                  or (fmt == native.FMT_CSV and self._emit_dense is not None))
+        kwargs = dict(
+            num_col=self._emit_dense or 0,
+            indexing_mode=getattr(self.param, "indexing_mode", 0),
+            delimiter=getattr(self.param, "delimiter", ","),
+            chunk_bytes=self.chunk_bytes,
+            batch_rows=self._batch_rows if repack else 0,
+            label_col=getattr(self.param, "label_column", -1),
+            weight_col=getattr(self.param, "weight_column", -1),
+        )
+        return fmt, kwargs
+
     def _ensure_reader(self):
         if self._reader is None:
             from dmlc_tpu import native
 
-            if self.fmt_name == "libsvm":
-                fmt = (native.FMT_LIBSVM_DENSE if self._emit_dense is not None
-                       else native.FMT_LIBSVM)
-            elif self.fmt_name == "csv":
-                fmt = native.FMT_CSV
-            else:
-                fmt = native.FMT_LIBFM
-            indexing_mode = getattr(self.param, "indexing_mode", 0)
-            repack = (fmt == native.FMT_LIBSVM_DENSE
-                      or (fmt == native.FMT_CSV
-                          and self._emit_dense is not None))
+            fmt, kwargs = self._stream_config()
             self._reader = native.Reader(
                 self.paths, self.sizes, self.part_index, self.num_parts,
-                fmt, num_col=self._emit_dense or 0,
-                indexing_mode=indexing_mode,
-                delimiter=getattr(self.param, "delimiter", ","),
-                chunk_bytes=self.chunk_bytes,
-                batch_rows=self._batch_rows if repack else 0,
-                label_col=getattr(self.param, "label_column", -1),
-                weight_col=getattr(self.param, "weight_column", -1),
-            )
+                fmt, **kwargs)
         return self._reader
 
     def next_block(self):
@@ -218,26 +231,124 @@ class NativeStreamParser(Parser):
             self._reader = None
 
 
-def native_reader_eligible(uri: str, type_: str, threaded: bool,
-                           split_kw: Dict) -> bool:
-    """True when create_parser can route to the native stream parser."""
+def _native_eligible(uri: str, type_: str, threaded: bool, split_kw: Dict,
+                     want_local: bool) -> bool:
+    """Shared native-routing predicate; want_local picks pull-mode (local
+    files, the Reader) vs push-mode (remote streams, the Feeder)."""
     from dmlc_tpu import native
 
     if not threaded or type_ not in ("libsvm", "csv", "libfm"):
         return False
-    if "#" in uri:
-        return False  # cachefile decorator
+    if "#" in uri or "engine=python" in uri:
+        return False  # cachefile decorator / explicit engine opt-out
     for key in ("shuffle", "num_shuffle_parts", "index_uri"):
         if split_kw.get(key):
             return False
     if split_kw.get("recurse_directories"):
         return False
+    base = uri.split("?", 1)[0]
+    if base in ("stdin",):
+        return False
     try:
-        fs = get_filesystem(uri.split("?", 1)[0])
+        fs = get_filesystem(base)
     except DMLCError:
         return False
-    if not isinstance(fs, LocalFileSystem):
-        return False
-    if uri.split("?", 1)[0] in ("stdin",):
+    if isinstance(fs, LocalFileSystem) != want_local:
         return False
     return native.available()
+
+
+def native_reader_eligible(uri: str, type_: str, threaded: bool,
+                           split_kw: Dict) -> bool:
+    """True when create_parser can route to the native stream parser."""
+    return _native_eligible(uri, type_, threaded, split_kw, want_local=True)
+
+
+class NativeFeedParser(NativeStreamParser):
+    """Remote corpora through the native pipeline (BASELINE config #2-style
+    cloud streams): a Python feed thread range-reads this partition through
+    the FileSystem layer (S3 / GCS / HTTP / anything registered) and pushes
+    raw bytes into the C++ chunk feeder (reader.cc push mode), which owns
+    record-aligned chunking, threaded parsing, and batch repack — so remote
+    corpora get the same off-GIL parse path as local files instead of the
+    single-threaded Python engine.
+
+    Partitioning (byte ranges, record-boundary adjustment, newline
+    injection at text file joins) stays with the Python input-split engine,
+    which already speaks every filesystem; the feed thread streams exactly
+    this partition's bytes (InputSplitBase._read).
+    """
+
+    FEED_CHUNK = 1 << 20
+
+    def _init_source(self, uri: str) -> None:
+        self.uri = uri
+        self.paths = self.sizes = None
+        self._feed_thread = None
+
+    def _make_split(self):
+        from dmlc_tpu.io.input_split import LineSplitter
+
+        split = LineSplitter(get_filesystem(self.uri), self.uri)
+        split.reset_partition(self.part_index, self.num_parts)
+        return split
+
+    def _start_feed(self) -> None:
+        import threading
+
+        feeder = self._reader
+        split = self._make_split()
+
+        def run() -> None:
+            try:
+                while True:
+                    data = split._read(self.FEED_CHUNK)
+                    if not data or not feeder.push(data):
+                        break
+                feeder.finish()
+            except Exception as exc:  # noqa: BLE001
+                # a mid-stream remote failure must NOT look like EOF: record
+                # it so the consumer's next() raises after the queue drains
+                feeder.fail(f"feed failed: {exc}")
+            finally:
+                try:
+                    split.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        self._feed_thread = threading.Thread(
+            target=run, name="dmlc-feed", daemon=True)
+        self._feed_thread.start()
+
+    def _stop_feed(self) -> None:
+        if self._feed_thread is not None:
+            if self._reader is not None:
+                self._reader.abort()
+            self._feed_thread.join()
+            self._feed_thread = None
+
+    def _ensure_reader(self):
+        if self._reader is None:
+            from dmlc_tpu import native
+
+            fmt, kwargs = self._stream_config()
+            self._reader = native.Feeder(fmt, **kwargs)
+            self._start_feed()
+        return self._reader
+
+    def before_first(self) -> None:
+        if self._reader is not None:
+            self._stop_feed()
+            self._reader.before_first()
+            self._start_feed()
+        self._blocks_out = 0
+
+    def close(self) -> None:
+        self._stop_feed()
+        super().close()
+
+
+def native_feed_eligible(uri: str, type_: str, threaded: bool,
+                         split_kw: Dict) -> bool:
+    """True when create_parser can route a REMOTE uri to the chunk feeder."""
+    return _native_eligible(uri, type_, threaded, split_kw, want_local=False)

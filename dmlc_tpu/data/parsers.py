@@ -664,6 +664,16 @@ def create_parser(
                 )
             except DMLCError:
                 pass  # fall back to the Python engine
+        elif _np_mod.native_feed_eligible(uri, type_, threaded, split_kw):
+            # remote corpora: Python range-reads feed the C++ chunk-parser
+            try:
+                return _np_mod.NativeFeedParser(
+                    spec.uri, spec.args, part_index, num_parts, type_,
+                    index_dtype=index_dtype,
+                    chunk_bytes=split_kw.get("chunk_bytes", DEFAULT_CHUNK_BYTES),
+                )
+            except DMLCError:
+                pass  # fall back to the Python engine
     entry = PARSER_REGISTRY.find(type_)
     if entry is None:
         raise DMLCError(

@@ -35,7 +35,7 @@ _HDRS = [os.path.join(_SRC_DIR, f)
          for f in ("api.h", "strtonum.h", "parse_internal.h")]
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 _SO_PATH = os.path.join(_BUILD_DIR, "libdmlc_tpu_native.so")
-_ABI_VERSION = 7
+_ABI_VERSION = 8
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -223,6 +223,26 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.dmlc_reader_error.restype = ctypes.c_char_p
     lib.dmlc_reader_error.argtypes = [ctypes.c_void_p]
     lib.dmlc_reader_destroy.argtypes = [ctypes.c_void_p]
+    lib.dmlc_feeder_create.restype = ctypes.c_void_p
+    lib.dmlc_feeder_create.argtypes = [
+        ctypes.c_int32, ctypes.c_int64, ctypes.c_int32, ctypes.c_char,
+        ctypes.c_int32, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_int32]
+    lib.dmlc_feeder_push.restype = ctypes.c_int32
+    lib.dmlc_feeder_push.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.dmlc_feeder_finish.argtypes = [ctypes.c_void_p]
+    lib.dmlc_feeder_abort.argtypes = [ctypes.c_void_p]
+    lib.dmlc_feeder_fail.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.dmlc_feeder_next.restype = ctypes.c_void_p
+    lib.dmlc_feeder_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32)]
+    lib.dmlc_feeder_before_first.argtypes = [ctypes.c_void_p]
+    lib.dmlc_feeder_bytes_read.restype = ctypes.c_int64
+    lib.dmlc_feeder_bytes_read.argtypes = [ctypes.c_void_p]
+    lib.dmlc_feeder_error.restype = ctypes.c_char_p
+    lib.dmlc_feeder_error.argtypes = [ctypes.c_void_p]
+    lib.dmlc_feeder_destroy.argtypes = [ctypes.c_void_p]
 
 
 def available() -> bool:
@@ -443,6 +463,21 @@ FMT_RECORDIO = 4
 FMT_RECORDIO_CHUNK = 5
 
 
+def _wrap_stream_result(lib, ptr, fmt_value, num_col):
+    """Wrap a dmlc_reader_next/dmlc_feeder_next result by format tag."""
+    if fmt_value in (FMT_LIBSVM, FMT_LIBFM):
+        return fmt_value, _wrap_block(
+            lib, ctypes.cast(ptr, ctypes.POINTER(_CsrBlockResult)))
+    if fmt_value == FMT_LIBSVM_DENSE:
+        return fmt_value, _wrap_dense(
+            lib, ctypes.cast(ptr, ctypes.POINTER(_DenseResult)), num_col)
+    if fmt_value in (FMT_RECORDIO, FMT_RECORDIO_CHUNK):
+        return fmt_value, _wrap_records(
+            lib, ctypes.cast(ptr, ctypes.POINTER(_RecordBatchResult)))
+    return fmt_value, _wrap_csv(
+        lib, ctypes.cast(ptr, ctypes.POINTER(_CsvResult)))
+
+
 class Reader:
     """Native read->chunk->parse pipeline over a byte-range partition.
 
@@ -495,17 +530,7 @@ class Reader:
         if not ptr:
             self._check_error()
             return None
-        if fmt.value in (FMT_LIBSVM, FMT_LIBFM):
-            res = ctypes.cast(ptr, ctypes.POINTER(_CsrBlockResult))
-            return fmt.value, _wrap_block(self._lib, res)
-        if fmt.value == FMT_LIBSVM_DENSE:
-            res = ctypes.cast(ptr, ctypes.POINTER(_DenseResult))
-            return fmt.value, _wrap_dense(self._lib, res, self._num_col)
-        if fmt.value in (FMT_RECORDIO, FMT_RECORDIO_CHUNK):
-            res = ctypes.cast(ptr, ctypes.POINTER(_RecordBatchResult))
-            return fmt.value, _wrap_records(self._lib, res)
-        res = ctypes.cast(ptr, ctypes.POINTER(_CsvResult))
-        return fmt.value, _wrap_csv(self._lib, res)
+        return _wrap_stream_result(self._lib, ptr, fmt.value, self._num_col)
 
     def before_first(self) -> None:
         if self._h is not None:
@@ -518,6 +543,89 @@ class Reader:
     def close(self) -> None:
         if self._h is not None:
             self._lib.dmlc_reader_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class Feeder:
+    """Push-mode native pipeline: the caller streams raw partition bytes in
+    (from ANY filesystem — S3/GCS/HTTP range reads) and pulls parsed blocks
+    out; chunking at record boundaries, threaded parsing, and batch repack
+    run in C++ exactly as in :class:`Reader`.
+
+    Contract: one feed thread calls ``push`` repeatedly then ``finish``;
+    ``push`` blocks (GIL released) for backpressure. Before ``before_first``
+    or ``close``, call ``abort`` and JOIN the feed thread.
+    """
+
+    def __init__(self, fmt: int, num_col: int = 0, indexing_mode: int = 0,
+                 delimiter: str = ",", nthread: int = 0,
+                 chunk_bytes: int = 1 << 20, queue_depth: int = 4,
+                 batch_rows: int = 0, label_col: int = -1,
+                 weight_col: int = -1):
+        lib = _load()
+        if lib is None:
+            raise DMLCError("native core unavailable")
+        self._lib = lib
+        self._fmt = fmt
+        self._num_col = num_col
+        self._h = lib.dmlc_feeder_create(
+            fmt, num_col, indexing_mode,
+            delimiter.encode()[0] if delimiter else b","[0],
+            nthread or default_nthread(), chunk_bytes, queue_depth,
+            batch_rows, label_col, weight_col)
+        if not self._h:
+            raise DMLCError("native feeder creation failed")
+
+    def push(self, data) -> bool:
+        """Feed bytes; False when the pipeline stopped (error/abort)."""
+        if self._h is None:
+            return False
+        return self._lib.dmlc_feeder_push(self._h, bytes(data), len(data)) == 0
+
+    def finish(self) -> None:
+        if self._h is not None:
+            self._lib.dmlc_feeder_finish(self._h)
+
+    def abort(self) -> None:
+        if self._h is not None:
+            self._lib.dmlc_feeder_abort(self._h)
+
+    def fail(self, msg: str) -> None:
+        """Record a feed-side failure and end the stream; the consumer's
+        next() raises once queued results drain."""
+        if self._h is not None:
+            self._lib.dmlc_feeder_fail(self._h, msg.encode()[:512])
+
+    def next(self):
+        if self._h is None:
+            return None
+        fmt = ctypes.c_int32(self._fmt)
+        ptr = self._lib.dmlc_feeder_next(self._h, ctypes.byref(fmt))
+        if not ptr:
+            err = self._lib.dmlc_feeder_error(self._h)
+            if err:
+                raise DMLCError(err.decode())
+            return None
+        return _wrap_stream_result(self._lib, ptr, fmt.value, self._num_col)
+
+    def before_first(self) -> None:
+        if self._h is not None:
+            self._lib.dmlc_feeder_before_first(self._h)
+
+    @property
+    def bytes_read(self) -> int:
+        return (self._lib.dmlc_feeder_bytes_read(self._h)
+                if self._h is not None else 0)
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.dmlc_feeder_destroy(self._h)
             self._h = None
 
     def __del__(self):

@@ -112,6 +112,42 @@ int64_t dmlc_reader_bytes_read(void* handle);
 const char* dmlc_reader_error(void* handle);
 void dmlc_reader_destroy(void* handle);
 
+// ---------------- push-mode reader (chunk feeder) ----------------
+//
+// Same chunk->parse->queue pipeline, but bytes are PUSHED by the caller
+// instead of read from local files — the path by which remote streams
+// (S3/GCS/HTTP range reads in Python) reach the native parser. The caller
+// owns partitioning (byte range + record-boundary adjustment + newline
+// injection at text file joins, which the Python input-split engine
+// already does for every filesystem); the feeder owns record-aligned
+// chunking, threaded parsing, and batch repack. Push blocks (GIL released
+// via ctypes) when the internal byte queue is full — natural backpressure.
+
+void* dmlc_feeder_create(int32_t format, int64_t num_col,
+                         int32_t indexing_mode, char delim, int32_t nthread,
+                         int64_t chunk_bytes, int32_t queue_depth,
+                         int64_t batch_rows, int32_t label_col,
+                         int32_t weight_col);
+// 0 = accepted; -1 = reader stopped/failed (check dmlc_feeder_error).
+int32_t dmlc_feeder_push(void* handle, const char* data, int64_t len);
+// Signal end of input: the pipeline flushes its tail and then next()
+// returns NULL at end of stream.
+void dmlc_feeder_finish(void* handle);
+// Unblock + fail any in-flight push and drain the pipeline to EOF. The
+// caller MUST abort and join its feed thread before calling
+// dmlc_feeder_before_first or dmlc_feeder_destroy.
+void dmlc_feeder_abort(void* handle);
+// Record a feed-side failure (remote read error in the feeding thread) and
+// end the stream; queued results drain, then next() returns NULL with the
+// error set.
+void dmlc_feeder_fail(void* handle, const char* msg);
+void* dmlc_feeder_next(void* handle, int32_t* fmt_out);
+// Reset for a new epoch: the caller must re-feed from the start.
+void dmlc_feeder_before_first(void* handle);
+int64_t dmlc_feeder_bytes_read(void* handle);
+const char* dmlc_feeder_error(void* handle);
+void dmlc_feeder_destroy(void* handle);
+
 }  // extern "C"
 
 #endif  // DMLC_TPU_NATIVE_API_H_

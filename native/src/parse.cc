@@ -795,6 +795,6 @@ void dmlc_free_csv(CsvResult* r) {
   free(r);
 }
 
-int dmlc_native_abi_version() { return 7; }
+int dmlc_native_abi_version() { return 8; }
 
 }  // extern "C"

@@ -157,6 +157,64 @@ class LineReader {
     }
   }
 
+  // Push-mode constructor: bytes arrive via push() instead of local files.
+  LineReader(int format, int64_t num_col, int indexing_mode, char delim,
+             int nthread, int64_t chunk_bytes, int queue_depth,
+             int64_t batch_rows, int32_t label_col, int32_t weight_col)
+      : format_(format),
+        num_col_(num_col),
+        indexing_mode_(indexing_mode),
+        delim_(delim),
+        nthread_(nthread < 1 ? 1 : nthread),
+        chunk_bytes_(chunk_bytes < 4096 ? 4096 : chunk_bytes),
+        queue_depth_(queue_depth < 1 ? 1 : queue_depth),
+        batch_rows_(batch_rows > 0 ? batch_rows : 0),
+        label_col_(label_col),
+        weight_col_(weight_col),
+        push_mode_(true) {
+    file_offset_.push_back(0);
+    start();
+  }
+
+  // Feed bytes into the pipeline; blocks while the byte queue is full
+  // (backpressure against a fast remote stream). -1 = stopped/failed.
+  int32_t push(const char* data, int64_t len) {
+    if (len <= 0) return 0;
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_feed_space_.wait(lk, [&] {
+      return feed_bytes_ < kFeedCap || stop_ || produce_done_ || feed_abort_;
+    });
+    if (stop_ || produce_done_ || feed_done_ || feed_abort_) return -1;
+    feed_q_.emplace_back(data, static_cast<size_t>(len));
+    feed_bytes_ += static_cast<size_t>(len);
+    cv_feed_data_.notify_all();
+    return 0;
+  }
+
+  void finish() {
+    std::lock_guard<std::mutex> lk(mu_);
+    feed_done_ = true;
+    cv_feed_data_.notify_all();
+  }
+
+  // Record a feed-side failure (e.g. a remote read error in the feeding
+  // thread) and end the stream: already-parsed blocks still drain, then
+  // next() returns NULL with the error set — never a silent truncation.
+  void fail_feed(const char* msg) {
+    set_error(msg && *msg ? msg : "feed failed");
+    finish();
+  }
+
+  // Unblock and fail any pusher and let the producer drain to EOF — the
+  // caller MUST abort + join its feed thread before before_first()/destroy
+  // (a pusher blocked inside a freed reader would be use-after-free).
+  void abort_feed() {
+    std::lock_guard<std::mutex> lk(mu_);
+    feed_abort_ = true;
+    cv_feed_space_.notify_all();
+    cv_feed_data_.notify_all();
+  }
+
   ~LineReader() {
     stop_and_join();
     close_fp();
@@ -182,6 +240,11 @@ class LineReader {
     offset_curr_ = offset_begin_;
     overflow_.clear();
     close_fp();
+    feed_q_.clear();
+    feed_off_ = 0;
+    feed_bytes_ = 0;
+    feed_done_ = false;
+    feed_abort_ = false;
     if (cur_) {
       dmlc_free_dense(cur_);
       cur_ = nullptr;
@@ -372,6 +435,34 @@ class LineReader {
     return true;
   }
 
+  // Pull up to `size` bytes from the push queue into `out`; blocks until
+  // enough data, finish(), or stop. A short fill means end of feed.
+  bool read_bytes_push(int64_t size, std::string* out) {
+    int64_t got = 0;
+    while (got < size) {
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_feed_data_.wait(lk, [&] {
+        return !feed_q_.empty() || feed_done_ || feed_abort_ || stop_;
+      });
+      if (stop_) return false;
+      if (feed_q_.empty()) break;  // feed finished/aborted: EOF
+      std::string& front = feed_q_.front();
+      int64_t avail = static_cast<int64_t>(front.size() - feed_off_);
+      int64_t take = std::min(size - got, avail);
+      out->append(front, feed_off_, static_cast<size_t>(take));
+      feed_off_ += static_cast<size_t>(take);
+      feed_bytes_ -= static_cast<size_t>(take);
+      got += take;
+      if (feed_off_ == front.size()) {
+        feed_q_.pop_front();
+        feed_off_ = 0;
+      }
+      cv_feed_space_.notify_all();
+    }
+    bytes_read_.fetch_add(got, std::memory_order_relaxed);
+    return true;
+  }
+
   // One chunk of whole records into `chunk`; false at EOF/error
   // (ReadChunk + Chunk::Load grow loop, input_split_base.cc:221-277).
   bool load_chunk(std::string* chunk) {
@@ -384,7 +475,10 @@ class LineReader {
       size_t olen = overflow_.size();
       chunk->assign(overflow_);
       overflow_.clear();
-      if (!read_bytes(size - static_cast<int64_t>(olen), chunk)) return false;
+      bool ok = push_mode_
+          ? read_bytes_push(size - static_cast<int64_t>(olen), chunk)
+          : read_bytes(size - static_cast<int64_t>(olen), chunk);
+      if (!ok) return false;
       if (chunk->empty()) return false;  // EOF
       if (!is_text()) {
         if (static_cast<int64_t>(chunk->size()) != size) {
@@ -615,6 +709,7 @@ class LineReader {
     std::lock_guard<std::mutex> lk(mu_);
     produce_done_ = true;
     cv_pop_.notify_all();
+    cv_feed_space_.notify_all();  // unblock a pusher: the stream is over
   }
 
   // Blocking push honoring queue depth; false = stop requested.
@@ -842,14 +937,10 @@ class LineReader {
         produce_loop();
       } catch (const std::exception& ex) {
         set_error(std::string("reader failed: ") + ex.what());
-        std::lock_guard<std::mutex> lk(mu_);
-        produce_done_ = true;
-        cv_pop_.notify_all();
+        mark_done();
       } catch (...) {
         set_error("reader failed: unknown error");
-        std::lock_guard<std::mutex> lk(mu_);
-        produce_done_ = true;
-        cv_pop_.notify_all();
+        mark_done();
       }
     });
   }
@@ -859,6 +950,8 @@ class LineReader {
       std::lock_guard<std::mutex> lk(mu_);
       stop_ = true;
       cv_push_.notify_all();
+      cv_feed_data_.notify_all();
+      cv_feed_space_.notify_all();
     }
     if (producer_.joinable()) producer_.join();
     for (auto& item : queue_) free_result(item.first, item.second);
@@ -903,9 +996,19 @@ class LineReader {
   int64_t cur_rows_ = 0;
   bool cur_has_weight_ = false;
 
+  // push-mode feed queue (remote streams pushed from Python)
+  bool push_mode_ = false;
+  static constexpr size_t kFeedCap = 32 << 20;  // backpressure bound
+  std::deque<std::string> feed_q_;
+  size_t feed_off_ = 0;    // consumed prefix of feed_q_.front()
+  size_t feed_bytes_ = 0;  // unconsumed bytes across the queue
+  bool feed_done_ = false;
+  bool feed_abort_ = false;
+
   std::thread producer_;
   std::mutex mu_;
   std::condition_variable cv_push_, cv_pop_;
+  std::condition_variable cv_feed_data_, cv_feed_space_;
   std::deque<std::pair<int, void*>> queue_;
   bool stop_ = false;
   bool produce_done_ = false;
@@ -955,6 +1058,56 @@ const char* dmlc_reader_error(void* handle) {
 }
 
 void dmlc_reader_destroy(void* handle) {
+  delete static_cast<LineReader*>(handle);
+}
+
+void* dmlc_feeder_create(int32_t format, int64_t num_col,
+                         int32_t indexing_mode, char delim, int32_t nthread,
+                         int64_t chunk_bytes, int32_t queue_depth,
+                         int64_t batch_rows, int32_t label_col,
+                         int32_t weight_col) {
+  try {
+    return new LineReader(format, num_col, indexing_mode, delim, nthread,
+                          chunk_bytes, queue_depth, batch_rows, label_col,
+                          weight_col);
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+int32_t dmlc_feeder_push(void* handle, const char* data, int64_t len) {
+  return static_cast<LineReader*>(handle)->push(data, len);
+}
+
+void dmlc_feeder_abort(void* handle) {
+  static_cast<LineReader*>(handle)->abort_feed();
+}
+
+void dmlc_feeder_fail(void* handle, const char* msg) {
+  static_cast<LineReader*>(handle)->fail_feed(msg);
+}
+
+void dmlc_feeder_finish(void* handle) {
+  static_cast<LineReader*>(handle)->finish();
+}
+
+void* dmlc_feeder_next(void* handle, int32_t* fmt_out) {
+  return static_cast<LineReader*>(handle)->next(fmt_out);
+}
+
+void dmlc_feeder_before_first(void* handle) {
+  static_cast<LineReader*>(handle)->before_first();
+}
+
+int64_t dmlc_feeder_bytes_read(void* handle) {
+  return static_cast<LineReader*>(handle)->bytes_read();
+}
+
+const char* dmlc_feeder_error(void* handle) {
+  return static_cast<LineReader*>(handle)->error();
+}
+
+void dmlc_feeder_destroy(void* handle) {
   delete static_cast<LineReader*>(handle);
 }
 
