@@ -22,6 +22,7 @@ from dmlc_tpu.io.cached_split import CachedInputSplit
 from dmlc_tpu.io import http_filesys as _http_filesys  # registers http/cloud slots
 from dmlc_tpu.io import s3_filesys as _s3_filesys  # replaces the s3:// slot
 from dmlc_tpu.io import gcs_filesys as _gcs_filesys  # replaces the gs:// slot
+from dmlc_tpu.io import hdfs_filesys as _hdfs_filesys  # replaces the hdfs:// slot
 
 __all__ = [
     "URI", "URISpec", "FileInfo", "FileSystem", "LocalFileSystem",

@@ -1,0 +1,195 @@
+"""HDFS filesystem via WebHDFS/HttpFS: pure-Python, no JNI.
+
+The reference's HDFS client (src/io/hdfs_filesys.cc:10-193) links libhdfs
+and a JVM — the wrong trade on a TPU-VM, where shipping a Hadoop runtime
+for input streaming is pure overhead. WebHDFS exposes the same namenode
+semantics over REST (Hadoop ships it on the namenode HTTP port, and HttpFS
+speaks the identical protocol through a gateway), so this client covers the
+reference's capability surface with urllib alone:
+
+- reads: ``op=OPEN&offset=N&length=M`` range reads through the shared
+  buffered HTTP reader — the analog of the chunked ``hdfsRead``/``hdfsPread``
+  loop (hdfs_filesys.cc:31-58); the namenode's 307 redirect to a datanode is
+  followed automatically;
+- metadata: ``op=GETFILESTATUS`` / ``op=LISTSTATUS``
+  (hdfs_filesys.cc GetPathInfo/ListDirectory);
+- writes: ``op=CREATE`` two-step (namenode hands out the datanode location,
+  payload is PUT there on close), buffered like the reference's write path;
+- auth: ``user.name`` from ``HADOOP_USER_NAME``/``USER``, or a delegation
+  token from ``HDFS_DELEGATION_TOKEN`` (kerberized clusters mint one with
+  ``hdfs fetchdt``).
+
+URI forms: ``hdfs://namenode:9870/path`` (port = the namenode's HTTP port;
+default 9870 when omitted). ``HDFS_WEBHDFS_ENDPOINT`` overrides the whole
+endpoint — the hermetic-test seam, like ``S3_ENDPOINT``/``GCS_ENDPOINT``.
+"""
+
+from __future__ import annotations
+
+import io as _pyio
+import json
+import os
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional
+
+from dmlc_tpu.io.filesystem import (
+    DIR_TYPE, FILE_TYPE, FileInfo, FileSystem, register_filesystem,
+)
+from dmlc_tpu.io.http_filesys import HttpReadStream
+from dmlc_tpu.io.uri import URI
+from dmlc_tpu.utils.check import DMLCError, check
+
+_DEFAULT_HTTP_PORT = 9870  # namenode HTTP (Hadoop 3 default)
+
+
+class HdfsConfig:
+    def __init__(self, uri: Optional[URI] = None) -> None:
+        endpoint = os.environ.get("HDFS_WEBHDFS_ENDPOINT")
+        if endpoint:
+            self.endpoint = endpoint.rstrip("/")
+        else:
+            check(uri is not None and uri.host,
+                  "hdfs:// URI needs a namenode host (hdfs://host[:port]/path)"
+                  " or HDFS_WEBHDFS_ENDPOINT")
+            host, _, port = uri.host.partition(":")
+            self.endpoint = f"http://{host}:{port or _DEFAULT_HTTP_PORT}"
+        self.user = os.environ.get("HADOOP_USER_NAME") or os.environ.get("USER")
+        self.delegation = os.environ.get("HDFS_DELEGATION_TOKEN")
+
+    def url(self, path: str, op: str, **params: str) -> str:
+        query: Dict[str, str] = {"op": op}
+        if self.delegation:
+            query["delegation"] = self.delegation
+        elif self.user:
+            query["user.name"] = self.user
+        query.update(params)
+        if not path.startswith("/"):
+            path = "/" + path
+        return (f"{self.endpoint}/webhdfs/v1"
+                f"{urllib.parse.quote(path)}?"
+                + urllib.parse.urlencode(sorted(query.items())))
+
+
+def _request(url: str, method: str = "GET", data: Optional[bytes] = None,
+             timeout: int = 60):
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        return urllib.request.urlopen(req, timeout=timeout)
+    except urllib.error.HTTPError as exc:
+        # webhdfs errors carry a RemoteException JSON body
+        try:
+            detail = json.loads(exc.read()).get("RemoteException", {})
+            msg = detail.get("message", str(exc))
+        except Exception:  # noqa: BLE001 - non-JSON error body
+            msg = str(exc)
+        raise DMLCError(f"webhdfs {method} failed ({exc.code}): {msg}") from exc
+    except urllib.error.URLError as exc:
+        raise DMLCError(f"webhdfs unreachable: {exc.reason}") from exc
+
+
+class HdfsReadStream(HttpReadStream):
+    """Buffered range reader over ``op=OPEN`` — the pread analog
+    (hdfs_filesys.cc:46-58); short reads are absorbed by the buffer loop."""
+
+    def __init__(self, cfg: HdfsConfig, path: str, size: int):
+        self._cfg = cfg
+        self._path = path
+        super().__init__(cfg.url(path, "OPEN"), size=size)
+
+    def _fetch(self, start: int, end: int) -> bytes:
+        url = self._cfg.url(self._path, "OPEN", offset=str(start),
+                            length=str(end - start))
+        with _request(url) as resp:
+            return resp.read()
+
+
+class HdfsWriteStream(_pyio.RawIOBase):
+    """Buffer-then-PUT writer: op=CREATE against the namenode, payload to
+    the returned datanode location on close (the two-step WebHDFS create)."""
+
+    def __init__(self, cfg: HdfsConfig, path: str, overwrite: bool = True):
+        self._cfg = cfg
+        self._path = path
+        self._overwrite = "true" if overwrite else "false"
+        self._buf = bytearray()
+        self._closed = False
+
+    def writable(self) -> bool:
+        return True
+
+    def write(self, b) -> int:
+        self._buf += bytes(b)
+        return len(b)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        url = self._cfg.url(self._path, "CREATE",
+                            overwrite=self._overwrite, noredirect="true")
+        with _request(url, method="PUT") as resp:
+            body = resp.read()
+            location = resp.headers.get("Location")
+        if not location and body:
+            try:
+                location = json.loads(body).get("Location")
+            except ValueError:
+                location = None
+        check(location is not None,
+              "webhdfs CREATE returned no datanode location")
+        with _request(location, method="PUT", data=bytes(self._buf)):
+            pass
+        self._buf = bytearray()
+        super().close()
+
+
+def _info_from_status(base: URI, name: str, st: Dict) -> FileInfo:
+    kind = FILE_TYPE if st.get("type") == "FILE" else DIR_TYPE
+    path = base if not name else URI(str(base).rstrip("/") + "/" + name)
+    return FileInfo(path, int(st.get("length", 0)), kind)
+
+
+class HdfsFileSystem(FileSystem):
+    """WebHDFS-backed FileSystem (capability parity with
+    src/io/hdfs_filesys.cc, minus the JVM)."""
+
+    def __init__(self, cfg: HdfsConfig):
+        self.cfg = cfg
+
+    @classmethod
+    def instance(cls, uri: URI) -> "HdfsFileSystem":
+        return cls(HdfsConfig(uri))
+
+    def get_path_info(self, path: URI) -> FileInfo:
+        url = self.cfg.url(path.name, "GETFILESTATUS")
+        with _request(url) as resp:
+            st = json.loads(resp.read())["FileStatus"]
+        return _info_from_status(path, "", st)
+
+    def list_directory(self, path: URI) -> List[FileInfo]:
+        url = self.cfg.url(path.name, "LISTSTATUS")
+        with _request(url) as resp:
+            statuses = json.loads(resp.read())["FileStatuses"]["FileStatus"]
+        return [_info_from_status(path, st.get("pathSuffix", ""), st)
+                for st in statuses]
+
+    def open(self, path: URI, mode: str):
+        if mode == "r":
+            size = self.get_path_info(path).size
+            return _pyio.BufferedReader(
+                HdfsReadStream(self.cfg, path.name, size))
+        if mode in ("w", "a"):
+            # append maps to CREATE-overwrite for parity with the reference's
+            # O_WRONLY semantics (hdfs_filesys.cc Open: append unsupported
+            # without dfs.support.append; we take the same stance)
+            if mode == "a":
+                raise DMLCError(
+                    "webhdfs append not supported; write whole objects")
+            return _pyio.BufferedWriter(HdfsWriteStream(self.cfg, path.name))
+        raise DMLCError(f"unsupported hdfs open mode {mode!r}")
+
+
+register_filesystem("hdfs://", HdfsFileSystem.instance)
+register_filesystem("viewfs://", HdfsFileSystem.instance)

@@ -503,3 +503,177 @@ class TestNativeChunkFeeder:
             for _ in p:
                 pass
         p.close()
+
+
+# ---------------- fake WebHDFS server ----------------
+
+
+class _FakeWebHdfsHandler(http.server.BaseHTTPRequestHandler):
+    """Minimal WebHDFS namenode+datanode in one: OPEN with offset/length,
+    GETFILESTATUS, LISTSTATUS, two-step CREATE."""
+
+    store: dict = {}
+    users_seen: list = []
+
+    def log_message(self, *a):  # noqa: D102 - quiet
+        pass
+
+    def _parse(self):
+        parsed = urllib.parse.urlparse(self.path)
+        qs = dict(urllib.parse.parse_qsl(parsed.query))
+        assert parsed.path.startswith("/webhdfs/v1") or parsed.path.startswith(
+            "/data"), parsed.path
+        path = parsed.path[len("/webhdfs/v1"):] if parsed.path.startswith(
+            "/webhdfs/v1") else parsed.path
+        if "user.name" in qs:
+            type(self).users_seen.append(qs["user.name"])
+        return path, qs
+
+    def _json(self, code, obj, headers=None):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("Content-Type", "application/json")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        path, qs = self._parse()
+        op = qs.get("op")
+        if op == "OPEN":
+            if path not in self.store:
+                self._json(404, {"RemoteException": {
+                    "message": f"File does not exist: {path}"}})
+                return
+            data = self.store[path]
+            off = int(qs.get("offset", 0))
+            length = int(qs.get("length", len(data) - off))
+            body = data[off:off + length]
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if op == "GETFILESTATUS":
+            if path in self.store:
+                self._json(200, {"FileStatus": {
+                    "type": "FILE", "length": len(self.store[path])}})
+                return
+            if any(k.startswith(path.rstrip("/") + "/") for k in self.store):
+                self._json(200, {"FileStatus": {"type": "DIRECTORY",
+                                                "length": 0}})
+                return
+            self._json(404, {"RemoteException": {
+                "message": f"File does not exist: {path}"}})
+            return
+        if op == "LISTSTATUS":
+            prefix = path.rstrip("/") + "/"
+            names = sorted({k[len(prefix):].split("/", 1)[0]
+                            for k in self.store if k.startswith(prefix)})
+            statuses = []
+            for n in names:
+                full = prefix + n
+                if full in self.store:
+                    statuses.append({"pathSuffix": n, "type": "FILE",
+                                     "length": len(self.store[full])})
+                else:
+                    statuses.append({"pathSuffix": n, "type": "DIRECTORY",
+                                     "length": 0})
+            self._json(200, {"FileStatuses": {"FileStatus": statuses}})
+            return
+        self._json(400, {"RemoteException": {"message": f"bad op {op}"}})
+
+    def do_PUT(self):
+        path, qs = self._parse()
+        if qs.get("op") == "CREATE" and not path.startswith("/data"):
+            host = self.headers.get("Host")
+            self._json(200, {
+                "Location": f"http://{host}/data{path}?op=CREATE"},
+                headers={"Location":
+                         f"http://{host}/data{path}?op=CREATE"})
+            return
+        if path.startswith("/data"):
+            real = path[len("/data"):]
+            n = int(self.headers.get("Content-Length", 0))
+            self.store[real] = self.rfile.read(n)
+            self.send_response(201)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self._json(400, {"RemoteException": {"message": "bad PUT"}})
+
+
+@pytest.fixture()
+def fake_webhdfs(monkeypatch):
+    _FakeWebHdfsHandler.store = {}
+    _FakeWebHdfsHandler.users_seen = []
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                             _FakeWebHdfsHandler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    port = server.server_address[1]
+    monkeypatch.setenv("HDFS_WEBHDFS_ENDPOINT", f"http://127.0.0.1:{port}")
+    monkeypatch.setenv("HADOOP_USER_NAME", "tester")
+    yield _FakeWebHdfsHandler
+    server.shutdown()
+    server.server_close()
+
+
+class TestHdfsFileSystem:
+    """WebHDFS client vs a hermetic fake server — same pattern as the S3
+    suite (reference capability: src/io/hdfs_filesys.cc)."""
+
+    def _fs(self):
+        from dmlc_tpu.io.hdfs_filesys import HdfsConfig, HdfsFileSystem
+
+        return HdfsFileSystem(HdfsConfig())
+
+    def test_read_with_ranges_and_seek(self, fake_webhdfs):
+        payload = bytes(range(256)) * 300
+        fake_webhdfs.store["/corp/data.bin"] = payload
+        fs = self._fs()
+        with fs.open_for_read(URI("hdfs://nn/corp/data.bin")) as f:
+            assert f.read(10) == payload[:10]
+            f.seek(70000)
+            assert f.read(100) == payload[70000:70100]
+            f.seek(0)
+            assert f.read() == payload
+        assert "tester" in fake_webhdfs.users_seen
+
+    def test_status_list_and_missing(self, fake_webhdfs):
+        fake_webhdfs.store["/d/a.txt"] = b"xx"
+        fake_webhdfs.store["/d/sub/b.txt"] = b"yyy"
+        fs = self._fs()
+        info = fs.get_path_info(URI("hdfs://nn/d/a.txt"))
+        assert info.size == 2 and info.type == "file"
+        names = sorted(str(i.path) for i in fs.list_directory(URI("hdfs://nn/d")))
+        assert names == ["hdfs://nn/d/a.txt", "hdfs://nn/d/sub"]
+        rec = fs.list_directory_recursive(URI("hdfs://nn/d"))
+        assert sorted(str(i.path) for i in rec) == [
+            "hdfs://nn/d/a.txt", "hdfs://nn/d/sub/b.txt"]
+        with pytest.raises(DMLCError, match="does not exist"):
+            fs.get_path_info(URI("hdfs://nn/missing"))
+
+    def test_two_step_write(self, fake_webhdfs):
+        fs = self._fs()
+        with fs.open(URI("hdfs://nn/out/file.bin"), "w") as f:
+            f.write(b"hello ")
+            f.write(b"hdfs")
+        assert fake_webhdfs.store["/out/file.bin"] == b"hello hdfs"
+
+    def test_libsvm_corpus_streamed_from_hdfs(self, fake_webhdfs):
+        """End-to-end: remote hdfs corpus through create_parser — routes to
+        the native chunk feeder and matches ground truth."""
+        from dmlc_tpu.data import create_parser
+
+        lines = "".join(f"{i % 2} 0:{i}.5 1:2.0\n" for i in range(400))
+        fake_webhdfs.store["/corp/p0.libsvm"] = lines.encode()
+        fake_webhdfs.store["/corp/p1.libsvm"] = lines.encode()
+        total = 0
+        for part in range(2):
+            p = create_parser("hdfs://nn/corp", part, 2, "libsvm")
+            total += sum(len(b) for b in p)
+            p.close()
+        assert total == 800
