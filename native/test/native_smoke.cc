@@ -92,7 +92,7 @@ int main() {
   dmlc_reader_destroy(r);
   remove(path);
 
-  CHECK_TRUE(dmlc_native_abi_version() == 6);
+  CHECK_TRUE(dmlc_native_abi_version() == 8);
   if (failures == 0) std::printf("native_smoke: all checks passed\n");
   return failures == 0 ? 0 : 1;
 }

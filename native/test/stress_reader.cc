@@ -1,0 +1,268 @@
+// Threaded stress for the native core, built to run under TSan/ASan
+// (native/run_sanitizers.sh): exercises exactly the code the sanitizers
+// earn their keep on — the reader's producer/consumer handoff, epoch
+// resets racing the producer, early destruction with results in flight,
+// the push-mode feeder's pusher/producer/consumer triangle including
+// abort, and the multi-threaded chunk parsers.
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "../src/api.h"
+
+namespace {
+
+int failures = 0;
+
+#define CHECK_TRUE(cond, msg)                          \
+  do {                                                 \
+    if (!(cond)) {                                     \
+      fprintf(stderr, "FAIL: %s (%s:%d)\n", msg,       \
+              __FILE__, __LINE__);                     \
+      ++failures;                                      \
+    }                                                  \
+  } while (0)
+
+std::string write_libsvm(const char* path, int rows) {
+  FILE* f = fopen(path, "wb");
+  for (int i = 0; i < rows; ++i) {
+    fprintf(f, "%d", i % 2);
+    for (int j = 0; j < 16; ++j) fprintf(f, " %d:%d.%06d", j, i % 3, j * 7);
+    fputc('\n', f);
+  }
+  fclose(f);
+  return path;
+}
+
+std::string write_recordio(const char* path, int recs) {
+  // complete records only (cflag 0): payload without aligned magic cells
+  FILE* f = fopen(path, "wb");
+  const uint32_t magic = 0xced7230a;
+  for (int i = 0; i < recs; ++i) {
+    uint32_t len = 64 + (i % 160);
+    std::string payload(len, static_cast<char>('a' + i % 26));
+    uint32_t lrec = len;  // cflag 0
+    fwrite(&magic, 4, 1, f);
+    fwrite(&lrec, 4, 1, f);
+    fwrite(payload.data(), 1, len, f);
+    static const char pad[4] = {0, 0, 0, 0};
+    fwrite(pad, 1, (4 - len % 4) % 4, f);
+  }
+  fclose(f);
+  return path;
+}
+
+int64_t fsize(const std::string& p) {
+  FILE* f = fopen(p.c_str(), "rb");
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fclose(f);
+  return n;
+}
+
+void drain_reader(void* h, int fmt_hint, int64_t* rows_out) {
+  int64_t rows = 0;
+  while (true) {
+    int32_t fmt = fmt_hint;
+    void* res = dmlc_reader_next(h, &fmt);
+    if (!res) break;
+    switch (fmt) {
+      case 0:
+      case 3: {
+        auto* r = static_cast<CsrBlockResult*>(res);
+        CHECK_TRUE(!r->error, "csr block error");
+        rows += r->n_rows;
+        dmlc_free_block(r);
+        break;
+      }
+      case 1: {
+        auto* r = static_cast<DenseResult*>(res);
+        CHECK_TRUE(!r->error, "dense block error");
+        rows += r->n_rows;
+        dmlc_free_dense(r);
+        break;
+      }
+      case 4: {
+        auto* r = static_cast<RecordBatchResult*>(res);
+        CHECK_TRUE(!r->error, "record batch error");
+        rows += r->n_records;
+        dmlc_free_records(r);
+        break;
+      }
+      default: {
+        auto* r = static_cast<CsvResult*>(res);
+        rows += r->n_rows;
+        dmlc_free_csv(r);
+      }
+    }
+  }
+  *rows_out = rows;
+}
+
+void stress_pull_reader(const std::string& p1, const std::string& p2) {
+  const char* paths[2] = {p1.c_str(), p2.c_str()};
+  int64_t sizes[2] = {fsize(p1), fsize(p2)};
+  // multi-epoch with batch repack, consumer on another thread
+  void* h = dmlc_reader_create(paths, sizes, 2, 0, 1, /*fmt dense*/ 1,
+                               /*num_col*/ 16, -1, ',', 4, 1 << 16, 4,
+                               /*batch_rows*/ 100, -1, -1);
+  CHECK_TRUE(h != nullptr, "reader create");
+  for (int epoch = 0; epoch < 3; ++epoch) {
+    int64_t rows = 0;
+    std::thread consumer(drain_reader, h, 1, &rows);
+    consumer.join();
+    CHECK_TRUE(rows == 4000, "dense rows per epoch");
+    dmlc_reader_before_first(h);
+  }
+  dmlc_reader_destroy(h);
+
+  // early destruction with the queue full (stop path racing the producer)
+  for (int i = 0; i < 8; ++i) {
+    void* h2 = dmlc_reader_create(paths, sizes, 2, 0, 1, 0, 0, -1, ',', 4,
+                                  1 << 14, 2, 0, -1, -1);
+    int32_t fmt = 0;
+    void* res = dmlc_reader_next(h2, &fmt);
+    if (res) dmlc_free_block(static_cast<CsrBlockResult*>(res));
+    dmlc_reader_destroy(h2);  // producer mid-flight
+  }
+
+  // partitioned, concurrent readers
+  std::vector<std::thread> ts;
+  std::atomic<int64_t> total{0};
+  for (int part = 0; part < 4; ++part) {
+    ts.emplace_back([&, part] {
+      void* hp = dmlc_reader_create(paths, sizes, 2, part, 4, 0, 0, -1, ',',
+                                    2, 1 << 14, 2, 0, -1, -1);
+      int64_t rows = 0;
+      drain_reader(hp, 0, &rows);
+      total += rows;
+      dmlc_reader_destroy(hp);
+    });
+  }
+  for (auto& t : ts) t.join();
+  CHECK_TRUE(total.load() == 4000, "partitioned row total");
+}
+
+void stress_feeder(const std::string& p1) {
+  FILE* f = fopen(p1.c_str(), "rb");
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::string data(static_cast<size_t>(n), '\0');
+  if (fread(&data[0], 1, static_cast<size_t>(n), f) != static_cast<size_t>(n))
+    abort();
+  fclose(f);
+
+  for (int epoch = 0; epoch < 2; ++epoch) {
+    void* h = dmlc_feeder_create(1, 16, -1, ',', 4, 1 << 14, 2, 128, -1, -1);
+    CHECK_TRUE(h != nullptr, "feeder create");
+    std::thread pusher([&] {
+      size_t at = 0;
+      while (at < data.size()) {
+        size_t take = std::min<size_t>(7919, data.size() - at);
+        if (dmlc_feeder_push(h, data.data() + at, take) != 0) break;
+        at += take;
+      }
+      dmlc_feeder_finish(h);
+    });
+    int64_t rows = 0;
+    while (true) {
+      int32_t fmt = 1;
+      void* res = dmlc_feeder_next(h, &fmt);
+      if (!res) break;
+      auto* r = static_cast<DenseResult*>(res);
+      rows += r->n_rows;
+      dmlc_free_dense(r);
+    }
+    pusher.join();
+    CHECK_TRUE(rows == 2000, "feeder rows");
+    dmlc_feeder_destroy(h);
+  }
+
+  // abort racing an active pusher
+  for (int i = 0; i < 8; ++i) {
+    void* h = dmlc_feeder_create(0, 0, -1, ',', 2, 1 << 12, 1, 0, -1, -1);
+    std::thread pusher([&] {
+      size_t at = 0;
+      while (at < data.size()) {
+        size_t take = std::min<size_t>(4096, data.size() - at);
+        if (dmlc_feeder_push(h, data.data() + at, take) != 0) return;
+        at += take;
+      }
+      dmlc_feeder_finish(h);
+    });
+    int32_t fmt = 0;
+    void* res = dmlc_feeder_next(h, &fmt);
+    if (res) dmlc_free_block(static_cast<CsrBlockResult*>(res));
+    dmlc_feeder_abort(h);
+    pusher.join();
+    dmlc_feeder_destroy(h);
+  }
+}
+
+void stress_recordio(const std::string& rec1, const std::string& rec2) {
+  const char* paths[2] = {rec1.c_str(), rec2.c_str()};
+  int64_t sizes[2] = {fsize(rec1), fsize(rec2)};
+  std::vector<std::thread> ts;
+  std::atomic<int64_t> total{0};
+  for (int part = 0; part < 3; ++part) {
+    ts.emplace_back([&, part] {
+      void* h = dmlc_reader_create(paths, sizes, 2, part, 3, 4, 0, -1, ',',
+                                   2, 1 << 14, 2, 0, -1, -1);
+      int64_t recs = 0;
+      drain_reader(h, 4, &recs);
+      total += recs;
+      dmlc_reader_destroy(h);
+    });
+  }
+  for (auto& t : ts) t.join();
+  CHECK_TRUE(total.load() == 1200, "recordio record total");
+}
+
+void stress_parse_threads() {
+  std::string blob;
+  for (int i = 0; i < 20000; ++i) {
+    char line[256];
+    snprintf(line, sizeof(line), "%d 0:1.5 3:2.25 9:%d.125\n", i % 2, i % 17);
+    blob += line;
+  }
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 4; ++t) {
+    ts.emplace_back([&] {
+      CsrBlockResult* r = dmlc_parse_libsvm(
+          blob.data(), static_cast<int64_t>(blob.size()), 4, -1);
+      CHECK_TRUE(!r->error && r->n_rows == 20000, "parallel parse");
+      dmlc_free_block(r);
+    });
+  }
+  for (auto& t : ts) t.join();
+}
+
+}  // namespace
+
+int main() {
+  char tmpl[] = "/tmp/dmlc_stress_XXXXXX";
+  if (!mkdtemp(tmpl)) return 2;
+  std::string dir(tmpl);
+  auto p1 = write_libsvm((dir + "/a.libsvm").c_str(), 2000);
+  auto p2 = write_libsvm((dir + "/b.libsvm").c_str(), 2000);
+  auto r1 = write_recordio((dir + "/a.rec").c_str(), 600);
+  auto r2 = write_recordio((dir + "/b.rec").c_str(), 600);
+
+  stress_pull_reader(p1, p2);
+  stress_feeder(p1);
+  stress_recordio(r1, r2);
+  stress_parse_threads();
+
+  if (failures) {
+    fprintf(stderr, "stress: %d failures\n", failures);
+    return 1;
+  }
+  printf("stress: OK\n");
+  return 0;
+}
