@@ -42,8 +42,11 @@ def main(argv: Optional[List[str]] = None) -> None:
         from dmlc_tpu.tracker import tpu_pod as backend
     elif args.cluster == "yarn":
         raise SystemExit(
-            "dmlc-submit: the yarn backend's Java ApplicationMaster is not "
-            "bundled yet; use ssh/slurm/kubernetes/tpu-pod")
+            "dmlc-submit: yarn is a documented non-goal (PARITY.md): the "
+            "ApplicationMaster protocol is JVM-only protobuf RPC with no "
+            "REST surface, and TPU fleets are provisioned via GKE/TPU pod "
+            "tooling instead. Use --cluster kubernetes or --cluster tpu-pod "
+            "(same DMLC_* env contract).")
     else:  # pragma: no cover - argparse enforces choices
         raise SystemExit(f"dmlc-submit: unknown cluster {args.cluster!r}")
     fun_submit = backend.submit(args)
