@@ -136,15 +136,25 @@ def block_to_dense(
 
 
 def block_to_bcoo(block: RowBlock, num_col: int):
-    """CSR -> jax.experimental.sparse.BCOO (interop layout)."""
+    """CSR -> jax.experimental.sparse.BCOO (interop layout).
+
+    Coordinates go to the device as int32 whenever the shape fits (any
+    realistic corpus: num_col < 2^31): for KDD-shaped data the coordinate
+    array dominates transfer bytes, so halving its width roughly halves
+    host->HBM traffic for the whole batch.
+    """
     from jax.experimental import sparse as jsparse
 
+    n = len(block)
+    nnz = len(block.index)
+    idx_dtype = np.int32 if max(n, num_col) < (1 << 31) else np.int64
     lens = _row_lengths(block)
-    rows = np.repeat(np.arange(len(block)), lens)
-    coords = np.stack([rows, block.index.astype(np.int64)], axis=1)
-    vals = block.value if block.value is not None else np.ones(len(block.index), np.float32)
+    coords = np.empty((nnz, 2), idx_dtype)
+    coords[:, 0] = np.repeat(np.arange(n, dtype=idx_dtype), lens)
+    coords[:, 1] = block.index
+    vals = block.value if block.value is not None else np.ones(nnz, np.float32)
     return jsparse.BCOO(
-        (jnp.asarray(vals), jnp.asarray(coords)), shape=(len(block), num_col)
+        (jnp.asarray(vals), jnp.asarray(coords)), shape=(n, num_col)
     )
 
 

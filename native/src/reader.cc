@@ -21,6 +21,11 @@
 //     PR#385) and when the final record lacks a newline
 //     (input_split_base.cc:235-242, PR#452).
 
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
 #include <algorithm>
 #include <atomic>
 #include <condition_variable>
@@ -148,6 +153,7 @@ class LineReader {
       file_offset_.push_back(file_offset_.back() + sizes[i]);
     }
     if (error_.empty()) reset_partition(part_index, num_parts);
+    if (error_.empty()) try_mmap();
     if (error_.empty()) {
       start();
     } else {
@@ -218,6 +224,10 @@ class LineReader {
   ~LineReader() {
     stop_and_join();
     close_fp();
+    if (map_base_) {
+      munmap(const_cast<char*>(map_base_), map_len_);
+      map_base_ = nullptr;
+    }
     if (cur_) {
       dmlc_free_dense(cur_);
       cur_ = nullptr;
@@ -238,6 +248,7 @@ class LineReader {
   void before_first() {
     stop_and_join();
     offset_curr_ = offset_begin_;
+    view_cur_ = view_begin_;
     overflow_.clear();
     close_fp();
     feed_q_.clear();
@@ -515,26 +526,22 @@ class LineReader {
   }
 
   void* parse_chunk(const std::string& chunk) {
+    return parse_chunk(chunk.data(), static_cast<int64_t>(chunk.size()));
+  }
+
+  void* parse_chunk(const char* data, int64_t len) {
     switch (format_) {
       case kFmtLibsvm:
-        return dmlc_parse_libsvm(chunk.data(),
-                                 static_cast<int64_t>(chunk.size()), nthread_,
-                                 indexing_mode_);
+        return dmlc_parse_libsvm(data, len, nthread_, indexing_mode_);
       case kFmtLibsvmDense:
-        return dmlc_parse_libsvm_dense(chunk.data(),
-                                       static_cast<int64_t>(chunk.size()),
-                                       nthread_, num_col_, indexing_mode_);
+        return dmlc_parse_libsvm_dense(data, len, nthread_, num_col_,
+                                       indexing_mode_);
       case kFmtCsv:
-        return dmlc_parse_csv(chunk.data(),
-                              static_cast<int64_t>(chunk.size()), nthread_,
-                              delim_);
+        return dmlc_parse_csv(data, len, nthread_, delim_);
       case kFmtLibfm:
-        return dmlc_parse_libfm(chunk.data(),
-                                static_cast<int64_t>(chunk.size()), nthread_,
-                                indexing_mode_);
+        return dmlc_parse_libfm(data, len, nthread_, indexing_mode_);
       case kFmtRecordIO: {
-        void* r = dmlc_recordio_extract(chunk.data(),
-                                        static_cast<int64_t>(chunk.size()));
+        void* r = dmlc_recordio_extract(data, len);
         if (!r) set_error("recordio: out of memory");
         return r;
       }
@@ -543,7 +550,7 @@ class LineReader {
         // consumers re-frame it with RecordIOChunkReader themselves)
         auto* r = static_cast<RecordBatchResult*>(
             calloc(1, sizeof(RecordBatchResult)));
-        char* d = r ? static_cast<char*>(malloc(chunk.size() ? chunk.size() : 1))
+        char* d = r ? static_cast<char*>(malloc(len ? static_cast<size_t>(len) : 1))
                     : nullptr;
         auto* offs = r ? static_cast<int64_t*>(malloc(2 * sizeof(int64_t)))
                        : nullptr;
@@ -554,9 +561,9 @@ class LineReader {
           set_error("recordio: out of memory");
           return nullptr;
         }
-        memcpy(d, chunk.data(), chunk.size());
+        memcpy(d, data, static_cast<size_t>(len));
         r->n_records = 1;
-        r->data_len = static_cast<int64_t>(chunk.size());
+        r->data_len = len;
         r->data = d;
         r->offsets = offs;
         r->offsets[0] = 0;
@@ -568,7 +575,80 @@ class LineReader {
     return nullptr;
   }
 
+  // ---------------- mmap fast path ----------------
+  //
+  // When the whole partition lies inside ONE local file (the common case:
+  // a single big corpus, any partition not crossing a file join), chunking
+  // reduces to pointer arithmetic over a read-only mapping: no fread copy,
+  // no chunk-buffer assembly — the scanners read the page cache directly.
+  // Chunk boundary rules are identical to the buffered path (cut after the
+  // last EOL / at the last record head; EOF tail taken whole; the scanners
+  // handle a final line without a trailing newline).
+
+  void try_mmap() {
+    if (push_mode_ || offset_begin_ >= offset_end_) return;
+    const char* env = getenv("DMLC_TPU_NO_MMAP");
+    if (env && *env && strcmp(env, "0") != 0) return;
+    size_t f = file_of(offset_begin_);
+    if (offset_end_ > file_offset_[f + 1]) return;  // crosses a file join
+    int fd = ::open(paths_[f].c_str(), O_RDONLY);
+    if (fd < 0) return;
+    struct stat st;
+    if (fstat(fd, &st) != 0 || st.st_size <= 0) {
+      ::close(fd);
+      return;
+    }
+    void* base = mmap(nullptr, static_cast<size_t>(st.st_size), PROT_READ,
+                      MAP_PRIVATE, fd, 0);
+    ::close(fd);
+    if (base == MAP_FAILED) return;
+    map_base_ = static_cast<const char*>(base);
+    map_len_ = static_cast<size_t>(st.st_size);
+    madvise(base, map_len_, MADV_SEQUENTIAL);
+    view_begin_ = offset_begin_ - file_offset_[f];
+    view_end_ = std::min<int64_t>(offset_end_ - file_offset_[f],
+                                  static_cast<int64_t>(map_len_));
+    view_cur_ = view_begin_;
+  }
+
+  // Next record-aligned window of the mapping; false at partition end.
+  bool next_view(const char** p, int64_t* n) {
+    if (view_cur_ >= view_end_) return false;
+    int64_t size = chunk_bytes_;
+    const char* b = map_base_ + view_cur_;
+    const int64_t remain = view_end_ - view_cur_;
+    while (true) {
+      if (size >= remain) {  // EOF tail: records are exactly complete
+        *p = b;
+        *n = remain;
+        view_cur_ = view_end_;
+        bytes_read_.fetch_add(remain, std::memory_order_relaxed);
+        return true;
+      }
+      int64_t cut;
+      if (is_text()) {
+        cut = size;
+        while (cut > 0 && !is_eol(b[cut - 1])) --cut;
+      } else {
+        cut = find_last_record_head(b, size);
+      }
+      if (cut == 0) {
+        size *= 2;  // a single record larger than the window
+        continue;
+      }
+      *p = b;
+      *n = cut;
+      view_cur_ += cut;
+      bytes_read_.fetch_add(cut, std::memory_order_relaxed);
+      return true;
+    }
+  }
+
   void produce_loop() {
+    if (map_base_) {
+      produce_loop_mmap();
+      return;
+    }
     std::string chunk;
     while (!stop_requested()) {
       chunk.clear();
@@ -628,6 +708,59 @@ class LineReader {
     mark_done();
   }
 
+  // Same control flow as produce_loop, over zero-copy views of the mapping.
+  void produce_loop_mmap() {
+    const char* data;
+    int64_t len;
+    while (!stop_requested()) {
+      if (!next_view(&data, &len)) break;  // partition exhausted
+      if (format_ == kFmtLibsvmDense && batch_rows_ > 0) {
+        int r = process_dense_chunk(data, len);
+        if (r == kChunkFatal) {
+          mark_done();
+          return;
+        }
+        if (r == kChunkErrorPushed) break;
+        continue;
+      }
+      void* res = parse_chunk(data, len);
+      if (!res) break;
+      if (format_ == kFmtLibsvmDense &&
+          static_cast<DenseResult*>(res)->needs_csr) {
+        free_result(format_, res);
+        format_ = kFmtLibsvm;
+        res = parse_chunk(data, len);
+        if (!res) break;
+      }
+      if (result_rows(format_, res) == 0 && !result_error(format_, res)) {
+        free_result(format_, res);
+        continue;
+      }
+      bool had_error = result_error(format_, res) != nullptr;
+      if (!had_error && format_ == kFmtCsv && batch_rows_ > 0 &&
+          num_col_ > 0) {
+        DenseResult* cfg_err = nullptr;
+        if (!accumulate_csv(static_cast<CsvResult*>(res), &cfg_err)) {
+          mark_done();
+          return;
+        }
+        if (cfg_err) {
+          push_error_after_flush(kFmtLibsvmDense, cfg_err);
+          break;
+        }
+        continue;
+      }
+      if (had_error && batch_rows_ > 0) {
+        if (!push_error_after_flush(format_, res)) return;
+        break;
+      }
+      if (!push_result(format_, res)) return;
+      if (had_error) break;
+    }
+    if (batch_rows_ > 0) flush_partial();
+    mark_done();
+  }
+
   enum { kChunkOk = 0, kChunkFatal = 1, kChunkErrorPushed = 2 };
 
   // Parse one chunk through the internal DensePart API and append the rows
@@ -636,17 +769,20 @@ class LineReader {
   // weights, per-chunk indexing heuristic) without materializing the merged
   // intermediate.
   int process_dense_chunk(const std::string& chunk) {
+    return process_dense_chunk(chunk.data(), static_cast<int64_t>(chunk.size()));
+  }
+
+  int process_dense_chunk(const char* cdata, int64_t clen) {
     std::vector<dmlc_tpu::DensePart> parts;
-    dmlc_tpu::parse_libsvm_dense_chunk(chunk.data(),
-                                       static_cast<int64_t>(chunk.size()),
-                                       nthread_, num_col_, &parts);
+    dmlc_tpu::parse_libsvm_dense_chunk(cdata, clen, nthread_, num_col_,
+                                       &parts);
     for (auto& part : parts) {
       if (part.error.empty()) continue;
       if (part.needs_csr) {
         // qid rows: flush, permanently downgrade to CSR, re-parse the chunk
         if (!flush_partial()) return kChunkFatal;
         format_ = kFmtLibsvm;
-        void* res = parse_chunk(chunk);
+        void* res = parse_chunk(cdata, clen);
         if (!res) return kChunkFatal;
         if (result_rows(format_, res) == 0 && !result_error(format_, res)) {
           free_result(format_, res);
@@ -995,6 +1131,11 @@ class LineReader {
   DenseResult* cur_ = nullptr;  // in-progress output batch (producer-owned)
   int64_t cur_rows_ = 0;
   bool cur_has_weight_ = false;
+
+  // mmap fast path (single-file partitions)
+  const char* map_base_ = nullptr;
+  size_t map_len_ = 0;
+  int64_t view_begin_ = 0, view_cur_ = 0, view_end_ = 0;
 
   // push-mode feed queue (remote streams pushed from Python)
   bool push_mode_ = false;

@@ -218,11 +218,12 @@ class TestErrorsAndRouting:
             with pytest.raises(DMLCError):
                 create_parser(str(f), part, nparts, "libsvm")
 
-    def test_error_then_before_first_no_hang(self, tmp_path):
-        # a reader whose source vanishes mid-stream must raise on next() and
-        # keep raising (not deadlock) after before_first()
+    def test_error_then_before_first_no_hang(self, tmp_path, monkeypatch):
+        # buffered path: a reader whose source vanishes mid-stream must raise
+        # on next() and keep raising (not deadlock) after before_first()
         import os
 
+        monkeypatch.setenv("DMLC_TPU_NO_MMAP", "1")
         f = tmp_path / "gone.libsvm"
         f.write_text("1 0:1.0\n" * 100)
         from dmlc_tpu.native import FMT_LIBSVM, Reader
@@ -235,6 +236,28 @@ class TestErrorsAndRouting:
             with pytest.raises(DMLCError):
                 while r.next() is not None:
                     pass
+        r.close()
+
+    def test_mmap_path_snapshots_across_unlink(self, tmp_path):
+        # mmap path (single-file partition): the mapping pins the inode, so
+        # deleting the source mid-stream still serves every epoch — snapshot
+        # semantics, immune to file replacement during training
+        import os
+
+        f = tmp_path / "snap.libsvm"
+        f.write_text("1 0:1.0\n" * 100)
+        size = os.path.getsize(str(f))
+        from dmlc_tpu.native import FMT_LIBSVM, Reader
+
+        r = Reader([str(f)], [size], 0, 1, FMT_LIBSVM)
+        assert r.next() is not None
+        os.remove(str(f))
+        for _ in range(2):
+            r.before_first()
+            rows = 0
+            while (out := r.next()) is not None:
+                rows += len(out[1]["label"])
+            assert rows == 100
         r.close()
 
     def test_qid_downgrade_uses_flag(self, tmp_path):
