@@ -1,0 +1,146 @@
+"""Multi-process jax.distributed rendezvous through the tpu-pod local path.
+
+SURVEY.md §4(d) prescribes multi-process CPU-backend tests; the reference
+exercises its control plane with real sockets on every job
+(tracker/dmlc_tracker/tracker.py:263-335 accept loop, :81-136 rank
+brokering). These tests do the same for the JAX replacement control plane:
+real OS processes launched by ``dmlc-submit --cluster tpu-pod``, each
+calling ``init_from_env`` -> ``jax.distributed.initialize`` on the CPU
+backend, parsing its own InputSplit shard (shard index = process index),
+assembling a global array across process boundaries, and reducing it with
+an XLA collective. The reduced result must match a single-process parse.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Each worker: rendezvous with the JAX coordinator derived from the DMLC_*
+# contract, rabit-rendezvous with the tracker (liveness plane), parse own
+# shard, all-reduce [row_count, label_sum] over the pod, write the result.
+WORKER_SCRIPT = r"""
+import os, sys
+
+# one CPU device per process: the pod mesh is (process_count,) x 1 device
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.environ["REPO"])
+
+import numpy as np
+
+from dmlc_tpu.parallel.distributed import init_from_env
+from dmlc_tpu.tracker.client import WorkerClient
+
+contract = init_from_env()  # -> jax.distributed.initialize(...)
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.process_count() == contract.num_worker, (
+    jax.process_count(), contract.num_worker)
+assert jax.process_index() == contract.task_id, (
+    jax.process_index(), contract.task_id)
+
+# rabit plane: rank-stable rendezvous + shutdown bookkeeping
+client = WorkerClient(os.environ["DMLC_TRACKER_URI"],
+                      int(os.environ["DMLC_TRACKER_PORT"]))
+client.start()
+
+# data plane: shard index = process index (SURVEY.md §2.3 row 1)
+from dmlc_tpu.data.parsers import create_parser
+
+parser = create_parser(os.environ["DATA"], jax.process_index(),
+                       jax.process_count(), "libsvm", threaded=False)
+rows = 0
+label_sum = 0.0
+for block in parser:
+    rows += len(block.label)
+    label_sum += float(np.sum(block.label))
+
+mesh = Mesh(np.array(jax.devices()), ("data",))
+local = np.array([[float(rows), label_sum]], dtype=np.float32)
+garr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("data")), local)
+
+
+@jax.jit
+def reduce_fn(x):
+    # cross-process reduction over the sharded axis -> XLA all-reduce
+    return jnp.sum(x, axis=0)
+
+
+total = np.asarray(jax.device_get(reduce_fn(garr)))
+out = os.path.join(os.environ["OUT"], f"result_{jax.process_index()}")
+with open(out, "w") as f:
+    f.write(f"{total[0]:.1f} {total[1]:.6f} {rows}")
+client.shutdown()
+"""
+
+
+def _write_corpus(tmp_path, n_rows=64):
+    rng = np.random.RandomState(7)
+    lines = []
+    for i in range(n_rows):
+        feats = " ".join(f"{j}:{rng.rand():.4f}" for j in range(1, 6))
+        lines.append(f"{i % 2} {feats}")
+    path = tmp_path / "train.libsvm"
+    path.write_text("\n".join(lines) + "\n")
+    return str(path), float(sum(i % 2 for i in range(n_rows)))
+
+
+@pytest.mark.parametrize("nworker", [2])
+def test_tpu_pod_jax_distributed_end_to_end(tmp_path, nworker):
+    """2 real OS processes rendezvous via jax.distributed and psum a loss."""
+    data, expect_label_sum = _write_corpus(tmp_path)
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER_SCRIPT)
+
+    from dmlc_tpu.tracker.submit import main
+
+    env_backup = dict(os.environ)
+    os.environ["REPO"] = REPO
+    os.environ["OUT"] = str(tmp_path)
+    os.environ["DATA"] = data
+    try:
+        main(["--cluster", "tpu-pod", "--num-workers", str(nworker),
+              "--host-ip", "127.0.0.1", "--",
+              sys.executable, str(script)])
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
+
+    results = sorted(tmp_path.glob("result_*"))
+    assert len(results) == nworker, [p.name for p in results]
+    local_rows = []
+    for p in results:
+        tot_rows, tot_labels, shard_rows = p.read_text().split()
+        # every process sees the same globally-reduced values
+        assert float(tot_rows) == 64.0
+        assert abs(float(tot_labels) - expect_label_sum) < 1e-3
+        local_rows.append(int(shard_rows))
+    # shards partition the corpus: no dropped or duplicated records
+    assert sum(local_rows) == 64
+    assert all(r > 0 for r in local_rows)
+
+
+def test_init_from_env_single_worker_noop():
+    """num_worker<=1 must skip jax.distributed (single-host JAX works bare)."""
+    from dmlc_tpu.parallel.distributed import init_from_env
+
+    contract = init_from_env(env={"DMLC_NUM_WORKER": "1"})
+    assert contract.num_worker == 1
+
+
+def test_init_from_env_missing_tracker_raises():
+    from dmlc_tpu.parallel.distributed import init_from_env
+    from dmlc_tpu.utils.check import DMLCError
+
+    with pytest.raises(DMLCError, match="DMLC_TRACKER_URI"):
+        init_from_env(env={"DMLC_NUM_WORKER": "2"})
