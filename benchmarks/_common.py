@@ -19,14 +19,18 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def emit(metric: str, value: float, unit: str, baseline: float) -> None:
-    """The ONE stdout JSON line, same schema as bench.py."""
-    print(json.dumps({
+def emit(metric: str, value: float, unit: str, baseline: float, **extra) -> None:
+    """The ONE stdout JSON line, same schema as bench.py (extra keys allowed
+    after the required four, e.g. a secondary ratio)."""
+    line = {
         "metric": metric,
         "value": round(value, 2),
         "unit": unit,
         "vs_baseline": round(value / baseline, 3) if baseline else 0.0,
-    }))
+    }
+    line.update({k: round(v, 3) if isinstance(v, float) else v
+                 for k, v in extra.items()})
+    print(json.dumps(line))
 
 
 def timed_best(fn, reps: int = REPS) -> float:

@@ -26,9 +26,16 @@ import numpy as np
 from dmlc_tpu.data.parsers import Parser
 from dmlc_tpu.data.row_block import DenseBlock, RowBlock, RowBlockContainer
 from dmlc_tpu.io.threaded_iter import ThreadedIter
-from dmlc_tpu.ops.sparse import EllBatch, block_to_bcoo, block_to_dense, block_to_ell
+from dmlc_tpu.ops.sparse import (
+    EllBatch, block_to_bcoo_host, block_to_dense, block_to_ell,
+)
 from dmlc_tpu.utils.check import DMLCError, check
 from dmlc_tpu.utils.timer import get_time
+
+
+# resume marker: yielded by the natural-block producer for skipped blocks
+# (identity-compared — value comparison would touch device arrays)
+_SKIPPED = object()
 
 
 def rebatch_blocks(
@@ -82,8 +89,14 @@ class DeviceIter:
         convert_ahead: int = 4,
         drop_remainder: bool = False,
         device=None,
+        elide_unit_values: bool = False,
     ):
         check(layout in ("dense", "ell", "bcoo"), f"unknown layout {layout!r}")
+        check(batch_size is not None or layout == "bcoo",
+              "batch_size=None (natural blocks) requires layout='bcoo'")
+        check(layout != "bcoo" or (mesh is None and shardings is None),
+              "layout='bcoo' emits single-device batches; mesh/shardings "
+              "sharding is supported for 'dense' and 'ell' only")
         self.source = source
         self.num_col = num_col
         self.batch_size = batch_size
@@ -95,6 +108,13 @@ class DeviceIter:
         self.prefetch = max(1, prefetch)
         self.drop_remainder = drop_remainder
         self.device = device
+        # opt-in: skip transferring all-ones value arrays (binary-feature
+        # corpora) and synthesize them on device — saves 4 B/nnz of
+        # host->HBM traffic. Off by default: each synthesis is one extra
+        # device op per batch, which pays on a TPU-VM but loses on hosts
+        # where per-op dispatch is expensive (e.g. a tunneled device).
+        self.elide_unit_values = bool(elide_unit_values)
+        self._skip_blocks = 0  # producer-put resume: blocks to drop unput
         self.stall_seconds = 0.0        # consumer wait for a ready batch
         self.host_stall_seconds = 0.0   # of which: waiting on host convert
         self.batches_fed = 0
@@ -109,10 +129,21 @@ class DeviceIter:
                 source.set_emit_dense(num_col, batch_rows=batch_size)
             except TypeError:  # sources without the batch_rows extension
                 source.set_emit_dense(num_col)
-        self._host_iter = ThreadedIter.from_factory(
-            self._host_batches, max_capacity=convert_ahead
-        )
+        # the host pipeline starts LAZILY on first pull: load_state must be
+        # able to arm the skip-counter before the producer thread begins
+        # converting/transferring (otherwise resume re-transfers whatever
+        # the eager pipeline already prefetched)
+        self._convert_ahead = convert_ahead
+        self._host_iter_obj: Optional[ThreadedIter] = None
         self._inflight: deque = deque()
+
+    @property
+    def _host_iter(self) -> ThreadedIter:
+        if self._host_iter_obj is None:
+            self._host_iter_obj = ThreadedIter.from_factory(
+                self._host_batches, max_capacity=self._convert_ahead
+            )
+        return self._host_iter_obj
 
     # ---------------- host side ----------------
 
@@ -127,6 +158,22 @@ class DeviceIter:
     def _host_batches(self):
         if self.layout == "dense":
             yield from self._host_batches_dense()
+            return
+        if self.batch_size is None:
+            # natural-block mode (BCOO interop: nnz varies per batch anyway,
+            # so fixed-shape rebatching buys no compile reuse — skip the
+            # merge/slice copies and convert parser blocks as they come).
+            # device_put is issued HERE on the convert thread (it is async:
+            # returns a handle while the DMA proceeds), so the consumer
+            # thread only pops ready handles — one pipeline thread instead
+            # of a GIL ping-pong between convert and put
+            for block in self._blocks():
+                if self._skip_blocks > 0:
+                    # resume fast-path: skip without converting/transferring
+                    self._skip_blocks -= 1
+                    yield _SKIPPED
+                    continue
+                yield self._put(self._convert(block))
             return
         for block in rebatch_blocks(
             self._blocks(), self.batch_size, self.drop_remainder
@@ -176,14 +223,20 @@ class DeviceIter:
             yield ("dense", xp, yp, wp)
 
     def _convert(self, block: RowBlock):
-        pad = self.batch_size if len(block) != self.batch_size else None
+        pad = (self.batch_size
+               if self.batch_size is not None and len(block) != self.batch_size
+               else None)
         if self.layout == "dense":
             x, y, w = block_to_dense(block, self.num_col, pad_rows_to=pad)
             return ("dense", x, y, w)
         if self.layout == "ell":
             ell = block_to_ell(block, self.num_col, max_nnz=self.max_nnz, pad_rows_to=pad)
             return ("ell",) + tuple(ell)
-        return ("bcoo", block)
+        # bcoo: all host-side work (coords/values/label assembly) happens
+        # here on the convert thread; the device transfer is async
+        return ("bcoo",) + block_to_bcoo_host(
+            block, self.num_col, pad_rows_to=pad,
+            unit_values_as_none=self.elide_unit_values)
 
     # ---------------- device side ----------------
 
@@ -200,8 +253,28 @@ class DeviceIter:
     def _put_inner(self, host_batch):
         kind = host_batch[0]
         if kind == "bcoo":
-            block = host_batch[1]
-            return block_to_bcoo(block, self.num_col), jax.numpy.asarray(block.label)
+            from jax.experimental import sparse as jsparse
+
+            coords, vals, label, weight, shape = host_batch[1:]
+            arrs = [coords, label, weight] if vals is None else [
+                vals, coords, label, weight]
+            self.bytes_to_device += sum(a.nbytes for a in arrs)
+            out = (jax.device_put(arrs, self.device)
+                   if self.device is not None else jax.device_put(arrs))
+            if vals is None:
+                # binary-feature batch: ones are synthesized on device
+                # (block_to_bcoo_host elided the value array); create them
+                # on the SAME device the puts target, or BCOO would mix
+                # committed arrays across devices
+                dc, dl, dw = out
+                if self.device is not None:
+                    with jax.default_device(self.device):
+                        dv = jax.numpy.ones(len(coords), jax.numpy.float32)
+                else:
+                    dv = jax.numpy.ones(len(coords), jax.numpy.float32)
+            else:
+                dv, dc, dl, dw = out
+            return jsparse.BCOO((dv, dc), shape=shape), dl, dw
         arrays = host_batch[1:]
         self.bytes_to_device += sum(a.nbytes for a in arrays)
         if self.mesh is not None:
@@ -225,11 +298,17 @@ class DeviceIter:
         return out  # (x, y, w)
 
     def _fill(self) -> None:
+        producer_put = self.batch_size is None  # natural-block mode put already
         while len(self._inflight) < self.prefetch:
             host_batch = self._host_iter.next()
             if host_batch is None:
                 return
-            self._inflight.append(self._put(host_batch))
+            if host_batch is _SKIPPED:
+                # resume marker that load_state's drain missed (stream
+                # shorter than the recorded position) — never hand it out
+                continue
+            self._inflight.append(
+                host_batch if producer_put else self._put(host_batch))
 
     def __iter__(self):
         return self
@@ -256,6 +335,7 @@ class DeviceIter:
     def reset(self) -> None:
         """New epoch: restart the host pipeline (upstream before_first)."""
         self._inflight.clear()
+        self._skip_blocks = 0
         self._host_iter.before_first()
         self.batches_fed = 0
 
@@ -270,14 +350,24 @@ class DeviceIter:
 
     def load_state(self, state: dict) -> None:
         n = int(state["batches"])
-        self.reset()
+        self._inflight.clear()
+        # natural-block mode puts on the producer thread, so skipping must
+        # happen THERE (before conversion/transfer): tear down any running
+        # producer first, THEN arm the skip counter — the replacement
+        # producer (lazily started by the drain below) sees the credits
+        # from its first iteration, with no thread racing the hand-off
+        if self._host_iter_obj is not None:
+            self._host_iter_obj.destroy()
+            self._host_iter_obj = None
+        self._skip_blocks = n if self.batch_size is None else 0
         for _ in range(n):
-            if self._host_iter.next() is None:  # skip: no transfer issued
+            if self._host_iter.next() is None:  # replay: nothing transferred
                 break
         self.batches_fed = n
 
     def close(self) -> None:
-        self._host_iter.destroy()
+        if self._host_iter_obj is not None:
+            self._host_iter_obj.destroy()
         if hasattr(self.source, "close"):
             self.source.close()
 
