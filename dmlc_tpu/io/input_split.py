@@ -975,9 +975,9 @@ def create_input_split(
     fs = get_filesystem(uri)
     # hot path: native recordio pipeline (read + framing scan + multi-part
     # reassembly in C++, off the GIL) for plain local .rec corpora
-    if (type_ == "recordio"
-            and os.environ.get("DMLC_TPU_NO_NATIVE_READER", "0") in ("", "0")
-            and spec.args.get("engine") != "python"):
+    from dmlc_tpu.io.native_recordio import native_engine_enabled
+
+    if type_ == "recordio" and native_engine_enabled(spec.args):
         from dmlc_tpu.io.native_recordio import (
             NativeRecordIOSplit,
             native_recordio_eligible,
@@ -992,6 +992,45 @@ def create_input_split(
                     uri, part_index, num_parts,
                     recurse_directories=recurse_directories,
                     chunk_bytes=chunk_bytes)
+            except DMLCError:
+                pass  # fall through to the Python engine
+        else:
+            # remote .rec corpora: Python range-reads feed the C++ chunk
+            # feeder (framing scan + multi-part reassembly off the GIL)
+            from dmlc_tpu.io.native_recordio import (
+                NativeFeedRecordIOSplit,
+                native_feed_recordio_eligible,
+            )
+
+            if native_feed_recordio_eligible(
+                    uri, threaded, index_uri=index_uri, shuffle=shuffle,
+                    num_shuffle_parts=num_shuffle_parts,
+                    cache_file=cache_file):
+                try:
+                    return NativeFeedRecordIOSplit(
+                        uri, part_index, num_parts,
+                        recurse_directories=recurse_directories,
+                        chunk_bytes=chunk_bytes)
+                except DMLCError:
+                    pass  # fall through to the Python engine
+    # hot path: native indexed-recordio (record-count partitioning, batched
+    # reads, per-epoch shuffled seeks in C++) — covers the shuffled-epoch
+    # ImageNet .rec case the Python engine served single-threaded before
+    if (type_ == "indexed_recordio" and index_uri is not None
+            and native_engine_enabled(spec.args)):
+        from dmlc_tpu.io.native_recordio import (
+            NativeIndexedRecordIOSplit,
+            native_indexed_eligible,
+        )
+
+        if native_indexed_eligible(
+                uri, index_uri, threaded,
+                num_shuffle_parts=num_shuffle_parts, cache_file=cache_file):
+            try:
+                return NativeIndexedRecordIOSplit(
+                    uri, index_uri, part_index, num_parts,
+                    batch_size=batch_size, shuffle=shuffle, seed=seed,
+                    recurse_directories=recurse_directories)
             except DMLCError:
                 pass  # fall through to the Python engine
 

@@ -30,6 +30,17 @@ from dmlc_tpu.io.input_split import (
 from dmlc_tpu.utils.check import DMLCError, check
 
 
+def native_engine_enabled(args=None) -> bool:
+    """Shared native-route opt-out policy: the DMLC_TPU_NO_NATIVE_READER
+    env switch and the ``?engine=python`` URI arg, in ONE place for every
+    routing site."""
+    import os
+
+    if os.environ.get("DMLC_TPU_NO_NATIVE_READER", "0") not in ("", "0"):
+        return False
+    return (args or {}).get("engine") != "python"
+
+
 def native_recordio_eligible(uri: str, threaded: bool, *, index_uri=None,
                              shuffle: bool = False, num_shuffle_parts: int = 0,
                              cache_file=None,
@@ -48,7 +59,51 @@ def native_recordio_eligible(uri: str, threaded: bool, *, index_uri=None,
     return native.available()
 
 
-class NativeRecordIOSplit(InputSplit):
+class _RecordCursorSplit(InputSplit):
+    """Shared record cursor over native ``(payload, offsets)`` batches:
+    the slicing walk, counters, and bytes accounting used by every native
+    recordio-backed split (one implementation, not N copies)."""
+
+    _reader = None
+
+    def _cursor_clear(self) -> None:
+        self._payload: Optional[np.ndarray] = None
+        self._offsets: Optional[np.ndarray] = None
+        self._i = 0
+        self._records_out = 0
+
+    def _prepare_records(self) -> None:
+        """Hook: put the underlying reader in record mode (lazily)."""
+
+    def _pull_batch(self):
+        """Next ``(payload, offsets)`` batch or None at end of stream."""
+        raise NotImplementedError
+
+    def next_record(self) -> Optional[memoryview]:
+        self._prepare_records()
+        while self._offsets is None or self._i >= len(self._offsets) - 1:
+            nxt = self._pull_batch()
+            if nxt is None:
+                return None
+            self._payload, self._offsets = nxt
+            self._i = 0
+        s = int(self._offsets[self._i])
+        e = int(self._offsets[self._i + 1])
+        self._i += 1
+        self._records_out += 1
+        return memoryview(self._payload)[s:e]
+
+    @property
+    def bytes_read(self) -> int:
+        return self._reader.bytes_read if self._reader is not None else 0
+
+    def close(self) -> None:
+        if self._reader is not None:
+            self._reader.close()
+            self._reader = None
+
+
+class NativeRecordIOSplit(_RecordCursorSplit):
     """InputSplit facade over the native recordio reader.
 
     Serves either records (extracted payloads, multi-part reassembled) or
@@ -78,10 +133,7 @@ class NativeRecordIOSplit(InputSplit):
         self.queue_depth = queue_depth
         self._mode: Optional[int] = None  # FMT_RECORDIO | FMT_RECORDIO_CHUNK
         self._reader = None
-        self._payload: Optional[np.ndarray] = None
-        self._offsets: Optional[np.ndarray] = None
-        self._i = 0
-        self._records_out = 0
+        self._cursor_clear()
 
     # ---------------- native reader lifecycle ----------------
 
@@ -100,45 +152,33 @@ class NativeRecordIOSplit(InputSplit):
                 "be mixed within one epoch")
         return self._reader
 
-    def _next_batch(self) -> bool:
-        nxt = self._reader.next()
-        if nxt is None:
-            return False
-        _, (payload, offsets) = nxt
-        self._payload, self._offsets, self._i = payload, offsets, 0
-        return True
-
     # ---------------- InputSplit interface ----------------
 
-    def next_record(self) -> Optional[memoryview]:
+    def _prepare_records(self) -> None:
         from dmlc_tpu import native
 
         self._ensure_reader(native.FMT_RECORDIO)
-        while (self._offsets is None
-               or self._i >= len(self._offsets) - 1):
-            if not self._next_batch():
-                return None
-        s = int(self._offsets[self._i])
-        e = int(self._offsets[self._i + 1])
-        self._i += 1
-        self._records_out += 1
-        return memoryview(self._payload)[s:e]
+
+    def _pull_batch(self):
+        nxt = self._reader.next()
+        return None if nxt is None else nxt[1]
 
     def next_chunk(self) -> Optional[memoryview]:
         from dmlc_tpu import native
 
         self._ensure_reader(native.FMT_RECORDIO_CHUNK)
-        if not self._next_batch():
+        nxt = self._pull_batch()
+        if nxt is None:
             return None
+        self._payload, self._offsets = nxt
+        self._i = 0
         self._records_out += 1
         return memoryview(self._payload)
 
     def before_first(self) -> None:
         if self._reader is not None:
             self._reader.before_first()
-        self._payload = self._offsets = None
-        self._i = 0
-        self._records_out = 0
+        self._cursor_clear()
         self._mode = None if self._reader is None else self._mode
 
     def reset_partition(self, part_index: int, num_parts: int) -> None:
@@ -149,17 +189,11 @@ class NativeRecordIOSplit(InputSplit):
         self.part_index = part_index
         self.num_parts = num_parts
         self._mode = None
-        self._payload = self._offsets = None
-        self._i = 0
-        self._records_out = 0
+        self._cursor_clear()
 
     def hint_chunk_size(self, chunk_size: int) -> None:
         if chunk_size > self.chunk_bytes:
             self.chunk_bytes = chunk_size
-
-    @property
-    def bytes_read(self) -> int:
-        return self._reader.bytes_read if self._reader is not None else 0
 
     # -------- checkpoint / resume (count-based, like NativeStreamParser) ----
 
@@ -179,13 +213,252 @@ class NativeRecordIOSplit(InputSplit):
                 break
         self._records_out = n
 
-    def close(self) -> None:
-        if self._reader is not None:
-            self._reader.close()
-            self._reader = None
-
 
 def _chunk_mode() -> int:
     from dmlc_tpu import native
 
     return native.FMT_RECORDIO_CHUNK
+
+
+def native_indexed_eligible(uri: str, index_uri: str, threaded: bool, *,
+                            num_shuffle_parts: int = 0, cache_file=None) -> bool:
+    """True when create_input_split can route indexed recordio natively
+    (shuffle IS supported here, unlike the plain recordio fast path)."""
+    from dmlc_tpu import native
+
+    if not threaded or num_shuffle_parts or cache_file:
+        return False
+    try:
+        if not isinstance(get_filesystem(uri), LocalFileSystem):
+            return False
+        if not isinstance(get_filesystem(index_uri), LocalFileSystem):
+            return False
+    except DMLCError:
+        return False
+    return native.available()
+
+
+class NativeIndexedRecordIOSplit(_RecordCursorSplit):
+    """InputSplit facade over the native indexed-recordio reader: record-
+    count partitioning, batched contiguous reads, per-epoch shuffled seeks
+    all in C++ (reader.cc IndexedReader; indexed_recordio_split.cc:12-233).
+
+    Sequential order matches the Python engine row-for-row; shuffled order
+    is deterministic per (seed, epoch) via mt19937 but intentionally not
+    identical to the Python engine's random.Random permutation.
+    """
+
+    def __init__(self, uri: str, index_uri: str, part_index: int,
+                 num_parts: int, batch_size: int = 256,
+                 shuffle: bool = False, seed: int = 0,
+                 recurse_directories: bool = False, queue_depth: int = 4):
+        from dmlc_tpu.io.recordio import read_index_file
+        from dmlc_tpu.io.uri import URI
+
+        check(num_parts >= 1, f"num_parts must be >= 1, got {num_parts}")
+        check(0 <= part_index < num_parts,
+              f"part_index {part_index} out of range for {num_parts} parts")
+        fs = get_filesystem(uri)
+        check(isinstance(fs, LocalFileSystem),
+              "native indexed recordio split requires local files")
+        lister = RecordIOSplitter(fs, uri, recurse_directories)
+        self.paths: List[str] = [info.path.name for info in lister.files]
+        self.sizes: List[int] = [info.size for info in lister.files]
+        total = sum(self.sizes)
+        with get_filesystem(index_uri).open_for_read(URI(index_uri)) as f:
+            self.index = read_index_file(f, total)
+        self.part_index = part_index
+        self.num_parts = num_parts
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.queue_depth = queue_depth
+        self._reader = None
+        self._cursor_clear()
+        self._epochs = 0
+
+    def _ensure_reader(self):
+        from dmlc_tpu import native
+
+        if self._reader is None:
+            self._reader = native.IndexedReader(
+                self.paths, self.sizes, [off for off, _ in self.index],
+                self.part_index, self.num_parts,
+                batch_records=self.batch_size, shuffle=self.shuffle,
+                seed=self.seed, queue_depth=self.queue_depth)
+        return self._reader
+
+    def _prepare_records(self) -> None:
+        self._ensure_reader()
+
+    def _pull_batch(self):
+        return self._reader.next()
+
+    def next_chunk(self) -> Optional[memoryview]:
+        raise DMLCError(
+            "indexed recordio serves records, not raw chunks "
+            "(reference NextChunk is record-batched here too)")
+
+    def before_first(self) -> None:
+        if self._reader is not None:
+            self._reader.before_first()
+            self._epochs += 1
+        self._cursor_clear()
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        check(num_parts >= 1, f"num_parts must be >= 1, got {num_parts}")
+        check(0 <= part_index < num_parts,
+              f"part_index {part_index} out of range for {num_parts} parts")
+        self.close()
+        self.part_index = part_index
+        self.num_parts = num_parts
+        self._cursor_clear()
+        self._epochs = 0
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        pass  # batching is record-count based
+
+    # -------- checkpoint / resume --------
+    #
+    # Shuffled epochs are a deterministic function of (seed, epoch), so the
+    # native reader can land on (epoch, record) by pure rng replay + a seek
+    # — no prefix bytes are read (dmlc_indexed_reader_skip).
+
+    def state_dict(self) -> dict:
+        return {"kind": "indexed_native", "records": self._records_out,
+                "epochs": self._epochs}
+
+    def load_state(self, state: dict) -> None:
+        check(state.get("kind") == "indexed_native",
+              "incompatible indexed-native split state")
+        self.close()
+        reader = self._ensure_reader()
+        epochs = int(state.get("epochs", 0))
+        n = int(state["records"])
+        reader.skip(epochs, n)
+        self._cursor_clear()
+        self._epochs = epochs
+        self._records_out = n
+
+
+def native_feed_recordio_eligible(uri: str, threaded: bool, *, index_uri=None,
+                                  shuffle: bool = False,
+                                  num_shuffle_parts: int = 0,
+                                  cache_file=None) -> bool:
+    """True when create_input_split can route a REMOTE .rec corpus through
+    the push-mode feeder (reader.cc push mode + recordio framing)."""
+    from dmlc_tpu import native
+
+    if not threaded or index_uri or shuffle or num_shuffle_parts or cache_file:
+        return False
+    try:
+        fs = get_filesystem(uri)
+    except DMLCError:
+        return False
+    if isinstance(fs, LocalFileSystem):
+        return False  # local corpora take the pull-mode reader
+    return native.available()
+
+
+class NativeFeedRecordIOSplit(NativeRecordIOSplit):
+    """Remote .rec corpora through the native pipeline: a Python feed
+    thread range-reads this partition's bytes through the FileSystem layer
+    (S3 / GCS / HTTP / HDFS) and pushes them into the C++ chunk feeder,
+    which owns record-aligned chunking, framing scan, and multi-part
+    reassembly off the GIL — the reference wraps EVERY source and record
+    type in its threaded decorator the same way (src/io.cc:119-124).
+
+    Partitioning (byte ranges, record-boundary adjustment at the 4-byte
+    magic alignment) stays with the Python input-split engine, which
+    already speaks every filesystem.
+    """
+
+    FEED_CHUNK = 1 << 20
+
+    def __init__(self, uri: str, part_index: int, num_parts: int,
+                 recurse_directories: bool = False,
+                 chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                 queue_depth: int = 4):
+        check(num_parts >= 1, f"num_parts must be >= 1, got {num_parts}")
+        check(0 <= part_index < num_parts,
+              f"part_index {part_index} out of range for {num_parts} parts")
+        self.uri = uri
+        self.recurse_directories = recurse_directories
+        self.part_index = part_index
+        self.num_parts = num_parts
+        self.chunk_bytes = chunk_bytes
+        self.queue_depth = queue_depth
+        self._mode: Optional[int] = None
+        self._reader = None
+        self._payload: Optional[np.ndarray] = None
+        self._offsets: Optional[np.ndarray] = None
+        self._i = 0
+        self._records_out = 0
+        self._feed_thread = None
+
+    def _make_split(self) -> RecordIOSplitter:
+        split = RecordIOSplitter(get_filesystem(self.uri), self.uri,
+                                 self.recurse_directories)
+        split.reset_partition(self.part_index, self.num_parts)
+        return split
+
+    def _start_feed(self) -> None:
+        import threading
+
+        feeder = self._reader
+        split = self._make_split()
+
+        def run() -> None:
+            try:
+                while True:
+                    data = split._read(self.FEED_CHUNK)
+                    if not data or not feeder.push(data):
+                        break
+                feeder.finish()
+            except Exception as exc:  # noqa: BLE001
+                # a mid-stream remote failure must NOT look like EOF
+                feeder.fail(f"feed failed: {exc}")
+            finally:
+                try:
+                    split.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        self._feed_thread = threading.Thread(
+            target=run, name="dmlc-rec-feed", daemon=True)
+        self._feed_thread.start()
+
+    def _stop_feed(self) -> None:
+        if self._feed_thread is not None:
+            if self._reader is not None:
+                self._reader.abort()
+            self._feed_thread.join()
+            self._feed_thread = None
+
+    def _ensure_reader(self, fmt: int):
+        from dmlc_tpu import native
+
+        if self._reader is None:
+            self._mode = fmt
+            self._reader = native.Feeder(
+                fmt, chunk_bytes=self.chunk_bytes,
+                queue_depth=self.queue_depth)
+            self._start_feed()
+        elif self._mode != fmt:
+            raise DMLCError(
+                "native recordio split: next_record and next_chunk cannot "
+                "be mixed within one epoch")
+        return self._reader
+
+    def before_first(self) -> None:
+        if self._reader is not None:
+            self._stop_feed()
+            self._reader.before_first()
+            self._start_feed()
+        self._payload = self._offsets = None
+        self._i = 0
+        self._records_out = 0
+
+    def close(self) -> None:
+        self._stop_feed()
+        super().close()

@@ -35,7 +35,7 @@ _HDRS = [os.path.join(_SRC_DIR, f)
          for f in ("api.h", "strtonum.h", "parse_internal.h")]
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 _SO_PATH = os.path.join(_BUILD_DIR, "libdmlc_tpu_native.so")
-_ABI_VERSION = 8
+_ABI_VERSION = 10
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -243,6 +243,22 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.dmlc_feeder_error.restype = ctypes.c_char_p
     lib.dmlc_feeder_error.argtypes = [ctypes.c_void_p]
     lib.dmlc_feeder_destroy.argtypes = [ctypes.c_void_p]
+    lib.dmlc_indexed_reader_create.restype = ctypes.c_void_p
+    lib.dmlc_indexed_reader_create.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_uint64, ctypes.c_int32]
+    lib.dmlc_indexed_reader_next.restype = ctypes.c_void_p
+    lib.dmlc_indexed_reader_next.argtypes = [ctypes.c_void_p]
+    lib.dmlc_indexed_reader_before_first.argtypes = [ctypes.c_void_p]
+    lib.dmlc_indexed_reader_skip.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
+    lib.dmlc_indexed_reader_bytes_read.restype = ctypes.c_int64
+    lib.dmlc_indexed_reader_bytes_read.argtypes = [ctypes.c_void_p]
+    lib.dmlc_indexed_reader_error.restype = ctypes.c_char_p
+    lib.dmlc_indexed_reader_error.argtypes = [ctypes.c_void_p]
+    lib.dmlc_indexed_reader_destroy.argtypes = [ctypes.c_void_p]
 
 
 def available() -> bool:
@@ -626,6 +642,81 @@ class Feeder:
     def close(self) -> None:
         if self._h is not None:
             self._lib.dmlc_feeder_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class IndexedReader:
+    """Native indexed-recordio pipeline: record-count partitioning over an
+    external index, batched contiguous reads, per-epoch shuffled seeks —
+    reader.cc IndexedReader (indexed_recordio_split.cc:12-41,159-233).
+
+    :meth:`next` blocks (GIL released) until a batch of extracted record
+    payloads is ready and wraps it zero-copy as ``(payload, offsets)``.
+    """
+
+    def __init__(self, paths, sizes, index_offsets, part_index: int,
+                 num_parts: int, batch_records: int = 256,
+                 shuffle: bool = False, seed: int = 0, queue_depth: int = 4):
+        lib = _load()
+        if lib is None:
+            raise DMLCError("native core unavailable")
+        self._lib = lib
+        arr_p = (ctypes.c_char_p * len(paths))(
+            *[os.fsencode(p) for p in paths])
+        arr_s = (ctypes.c_int64 * len(sizes))(*sizes)
+        arr_i = (ctypes.c_int64 * len(index_offsets))(*index_offsets)
+        self._h = lib.dmlc_indexed_reader_create(
+            arr_p, arr_s, len(paths), arr_i, len(index_offsets),
+            part_index, num_parts, batch_records, 1 if shuffle else 0,
+            seed, queue_depth)
+        if not self._h:
+            raise DMLCError(
+                "native indexed reader creation failed (out of memory)")
+        self._check_error()
+
+    def _check_error(self) -> None:
+        err = self._lib.dmlc_indexed_reader_error(self._h)
+        if err:
+            raise DMLCError(err.decode())
+
+    def next(self):
+        """Next batch as ``(payload, offsets)`` numpy views; None at end."""
+        if self._h is None:
+            return None
+        ptr = self._lib.dmlc_indexed_reader_next(self._h)
+        if not ptr:
+            self._check_error()
+            return None
+        return _wrap_records(
+            self._lib, ctypes.cast(ptr, ctypes.POINTER(_RecordBatchResult)))
+
+    def before_first(self) -> None:
+        """Epoch reset; under shuffle the NEXT epoch's permutation is drawn."""
+        if self._h is not None:
+            self._lib.dmlc_indexed_reader_before_first(self._h)
+
+    def skip(self, epochs: int, records: int) -> None:
+        """Native resume: land in epoch `epochs` at record `records` with no
+        prefix I/O (missing permutations are drawn by pure rng replay).
+        Forward-only — use a fresh reader to revisit an earlier epoch."""
+        if self._h is not None:
+            self._lib.dmlc_indexed_reader_skip(self._h, epochs, records)
+            self._check_error()
+
+    @property
+    def bytes_read(self) -> int:
+        return (self._lib.dmlc_indexed_reader_bytes_read(self._h)
+                if self._h is not None else 0)
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.dmlc_indexed_reader_destroy(self._h)
             self._h = None
 
     def __del__(self):

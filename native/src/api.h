@@ -108,6 +108,31 @@ void* dmlc_reader_create(const char** paths, const int64_t* sizes,
 void* dmlc_reader_next(void* handle, int32_t* fmt_out);
 void dmlc_reader_before_first(void* handle);
 int64_t dmlc_reader_bytes_read(void* handle);
+
+// ---------------- indexed recordio reader (reader.cc) ----------------
+//
+// Record-count partitioned reader over an external index (sorted record
+// start offsets, global over the concatenated files): batched contiguous
+// reads when shuffle=0, per-epoch shuffled per-record seeks when
+// shuffle=1 (mt19937_64 seeded with `seed`; each before_first draws the
+// next epoch's permutation). Results are RecordBatchResult (payloads
+// extracted, multi-part reassembled). Mirrors indexed_recordio_split.cc
+// (ResetPartition :12-41, NextBatchEx :159-212, BeforeFirst :221-233).
+void* dmlc_indexed_reader_create(const char** paths, const int64_t* sizes,
+                                 int32_t nfiles, const int64_t* index_offsets,
+                                 int64_t n_index, int64_t part_index,
+                                 int64_t num_parts, int64_t batch_records,
+                                 int32_t shuffle, uint64_t seed,
+                                 int32_t queue_depth);
+void* dmlc_indexed_reader_next(void* handle);  // RecordBatchResult*
+void dmlc_indexed_reader_before_first(void* handle);
+// Native resume: land in epoch `epochs` (counting before_first calls) at
+// record `records` of the partition — missing epoch permutations are drawn
+// (pure rng replay, no I/O) and the producer starts at the record cursor.
+void dmlc_indexed_reader_skip(void* handle, int64_t epochs, int64_t records);
+int64_t dmlc_indexed_reader_bytes_read(void* handle);
+const char* dmlc_indexed_reader_error(void* handle);
+void dmlc_indexed_reader_destroy(void* handle);
 // Non-NULL when the reader itself failed (open/seek/IO); owned by the handle.
 const char* dmlc_reader_error(void* handle);
 void dmlc_reader_destroy(void* handle);

@@ -28,6 +28,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <random>
 #include <condition_variable>
 #include <cstdio>
 #include <cstdlib>
@@ -1158,6 +1159,350 @@ class LineReader {
   std::string error_;
 };
 
+// ---------------- indexed recordio reader ----------------
+//
+// Record-count partitioning over an external index, batched contiguous
+// reads, and per-epoch shuffled per-record seeks — the native rebuild of
+// the reference's IndexedRecordIOSplitter (indexed_recordio_split.cc:
+// 12-41 ResetPartition by record count, 159-212 NextBatchEx batched /
+// shuffled reads, 221-233 per-epoch reshuffle in BeforeFirst). Results are
+// RecordBatchResult batches (payloads extracted + multi-part reassembled
+// by dmlc_recordio_extract), matching the Python engine row-for-row for
+// sequential access; shuffled order is produced by mt19937 and therefore
+// deterministic per (seed, epoch) but intentionally NOT identical to the
+// Python engine's random.Random order.
+
+class IndexedReader {
+ public:
+  IndexedReader(std::vector<std::string> paths, std::vector<int64_t> sizes,
+                std::vector<int64_t> index_offsets, int64_t part_index,
+                int64_t num_parts, int64_t batch_records, bool shuffle,
+                uint64_t seed, int queue_depth)
+      : paths_(std::move(paths)),
+        index_(std::move(index_offsets)),
+        batch_records_(batch_records < 1 ? 256 : batch_records),
+        shuffle_(shuffle),
+        rng_(seed),
+        queue_depth_(queue_depth < 1 ? 1 : queue_depth) {
+    file_offset_.push_back(0);
+    for (size_t i = 0; i < sizes.size(); ++i) {
+      if (sizes[i] % 4 != 0) {
+        error_ = "recordio: file " + paths_[i] + " does not align by 4 bytes";
+      }
+      file_offset_.push_back(file_offset_.back() + sizes[i]);
+    }
+    if (index_.empty()) error_ = "indexed recordio: empty index";
+    std::sort(index_.begin(), index_.end());
+    if (error_.empty()) reset_partition(part_index, num_parts);
+    if (error_.empty()) {
+      draw_epoch();
+      start();
+    } else {
+      produce_done_ = true;
+    }
+  }
+
+  ~IndexedReader() {
+    stop_and_join();
+    close_fp();
+  }
+
+  RecordBatchResult* next() {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_pop_.wait(lk, [&] { return !queue_.empty() || produce_done_; });
+    if (queue_.empty()) return nullptr;
+    RecordBatchResult* item = queue_.front();
+    queue_.pop_front();
+    cv_push_.notify_one();
+    return item;
+  }
+
+  // Epoch reset: a NEW permutation is drawn each epoch (BeforeFirst,
+  // indexed_recordio_split.cc:221-233) — rng_ keeps advancing, so the
+  // epoch sequence is deterministic for a given seed.
+  void before_first() {
+    stop_and_join();
+    close_fp();
+    if (error_.empty()) {
+      draw_epoch();
+      start();
+    } else {
+      std::lock_guard<std::mutex> lk(mu_);
+      produce_done_ = true;
+      cv_pop_.notify_all();
+    }
+  }
+
+  // Native resume: land in epoch `epochs` (counting before_first calls)
+  // positioned at record `records` of the partition. The permutation is a
+  // pure function of (seed, epoch), so replay = drawing the missing epoch
+  // permutations (O(n) shuffles, no I/O) and starting the producer at the
+  // record cursor — no bytes of the prefix are read.
+  void skip(int64_t epochs, int64_t records) {
+    stop_and_join();
+    close_fp();
+    if (!error_.empty()) {
+      std::lock_guard<std::mutex> lk(mu_);
+      produce_done_ = true;
+      cv_pop_.notify_all();
+      return;
+    }
+    if (epochs_drawn_ > epochs + 1) {
+      // rng cannot rewind: resuming an earlier epoch needs a fresh reader
+      set_error("indexed reader: cannot skip backwards");
+      std::lock_guard<std::mutex> lk(mu_);
+      produce_done_ = true;
+      cv_pop_.notify_all();
+      return;
+    }
+    while (epochs_drawn_ < epochs + 1) draw_epoch();
+    start_record_ = std::max<int64_t>(0, records);
+    start();
+  }
+
+  int64_t bytes_read() const {
+    return bytes_read_.load(std::memory_order_relaxed);
+  }
+
+  const char* error() const {
+    std::lock_guard<std::mutex> lk(err_mu_);
+    return error_.empty() ? nullptr : error_.c_str();
+  }
+
+ private:
+  int64_t ntotal() const { return static_cast<int64_t>(index_.size()); }
+
+  int64_t record_size(int64_t i) const {
+    int64_t end = (i + 1 < ntotal()) ? index_[i + 1] : file_offset_.back();
+    return end - index_[i];
+  }
+
+  // Partition by record count (indexed_recordio_split.cc:12-41; identical
+  // to the Python engine's IndexedRecordIOSplitter.reset_partition).
+  void reset_partition(int64_t part_index, int64_t num_parts) {
+    int64_t n = ntotal();
+    int64_t nstep = (n + num_parts - 1) / num_parts;
+    if (part_index * nstep >= n) {
+      index_begin_ = index_end_ = 0;
+      offset_end_ = 0;
+      return;
+    }
+    index_begin_ = part_index * nstep;
+    if ((part_index + 1) * nstep < n) {
+      index_end_ = (part_index + 1) * nstep;
+      offset_end_ = index_[index_end_];
+    } else {
+      index_end_ = n;
+      offset_end_ = file_offset_.back();
+    }
+  }
+
+  size_t file_of(int64_t off) const {
+    size_t lo = 0, hi = file_offset_.size();
+    while (lo < hi) {
+      size_t mid = (lo + hi) / 2;
+      if (file_offset_[mid] <= off) lo = mid + 1; else hi = mid;
+    }
+    return lo - 1;
+  }
+
+  void close_fp() {
+    if (fp_) {
+      fclose(fp_);
+      fp_ = nullptr;
+    }
+  }
+
+  // Append the absolute span [offset, offset+size) to `out`, crossing file
+  // joins (binary: no synthetic bytes). Reuses the open FILE* when the
+  // span continues where the last read ended — contiguous batches pay one
+  // seek, shuffled access seeks per record as the reference does.
+  bool read_span(int64_t offset, int64_t size, std::string* out) {
+    while (size > 0) {
+      size_t f = file_of(offset);
+      int64_t local = offset - file_offset_[f];
+      int64_t avail = file_offset_[f + 1] - offset;
+      int64_t take = std::min(size, avail);
+      if (!fp_ || fp_file_ != f) {
+        close_fp();
+        fp_ = fopen(paths_[f].c_str(), "rb");
+        if (!fp_) {
+          set_error("cannot open " + paths_[f]);
+          return false;
+        }
+        fp_file_ = f;
+        fp_pos_ = 0;
+      }
+      if (fp_pos_ != local) {
+        if (fseeko(fp_, static_cast<off_t>(local), SEEK_SET) != 0) {
+          set_error("seek failed in " + paths_[f]);
+          return false;
+        }
+        fp_pos_ = local;
+      }
+      size_t base = out->size();
+      out->resize(base + static_cast<size_t>(take));
+      if (fread(&(*out)[base], 1, static_cast<size_t>(take), fp_) !=
+          static_cast<size_t>(take)) {
+        set_error("read failed in " + paths_[f]);
+        return false;
+      }
+      fp_pos_ += take;
+      offset += take;
+      size -= take;
+      bytes_read_.fetch_add(take, std::memory_order_relaxed);
+    }
+    return true;
+  }
+
+  // Draw the next epoch's permutation (shuffle only); rng_ advances once
+  // per epoch so the sequence is deterministic per seed.
+  void draw_epoch() {
+    ++epochs_drawn_;
+    if (!shuffle_) return;
+    perm_.resize(static_cast<size_t>(index_end_ - index_begin_));
+    for (size_t i = 0; i < perm_.size(); ++i) {
+      perm_[i] = index_begin_ + static_cast<int64_t>(i);
+    }
+    std::shuffle(perm_.begin(), perm_.end(), rng_);
+  }
+
+  void produce_loop() {
+    int64_t cur = index_begin_ + start_record_;
+    size_t pcur = static_cast<size_t>(start_record_);
+    start_record_ = 0;  // one-shot: consumed by this producer run
+    std::string buf;
+    while (!stop_requested()) {
+      buf.clear();
+      if (shuffle_) {
+        if (pcur >= perm_.size()) break;
+        size_t take = std::min<size_t>(
+            static_cast<size_t>(batch_records_), perm_.size() - pcur);
+        for (size_t i = 0; i < take; ++i) {
+          int64_t rec = perm_[pcur + i];
+          if (!read_span(index_[rec], record_size(rec), &buf)) {
+            mark_done();
+            return;
+          }
+        }
+        pcur += take;
+      } else {
+        if (cur >= index_end_) break;
+        int64_t last = std::min(cur + batch_records_, index_end_);
+        int64_t begin_off = index_[cur];
+        int64_t end_off =
+            (last < ntotal()) ? index_[last] : file_offset_.back();
+        if (last == index_end_) end_off = offset_end_;
+        if (!read_span(begin_off, end_off - begin_off, &buf)) {
+          mark_done();
+          return;
+        }
+        cur = last;
+      }
+      if (buf.empty()) break;
+      RecordBatchResult* res = dmlc_recordio_extract(
+          buf.data(), static_cast<int64_t>(buf.size()));
+      if (!res) {
+        set_error("indexed recordio: out of memory");
+        break;
+      }
+      bool had_error = res->error != nullptr;
+      if (!push_result(res)) return;
+      if (had_error) break;
+    }
+    mark_done();
+  }
+
+  void mark_done() {
+    std::lock_guard<std::mutex> lk(mu_);
+    produce_done_ = true;
+    cv_pop_.notify_all();
+  }
+
+  bool push_result(RecordBatchResult* res) {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_push_.wait(lk, [&] {
+        return static_cast<int>(queue_.size()) < queue_depth_ || stop_;
+      });
+      if (stop_) {
+        dmlc_free_records(res);
+        produce_done_ = true;
+        cv_pop_.notify_all();
+        return false;
+      }
+      queue_.push_back(res);
+    }
+    cv_pop_.notify_one();
+    return true;
+  }
+
+  void start() {
+    stop_ = false;
+    produce_done_ = false;
+    producer_ = std::thread([this] {
+      try {
+        produce_loop();
+      } catch (const std::exception& ex) {
+        set_error(std::string("indexed reader failed: ") + ex.what());
+        mark_done();
+      } catch (...) {
+        set_error("indexed reader failed: unknown error");
+        mark_done();
+      }
+    });
+  }
+
+  void stop_and_join() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+      cv_push_.notify_all();
+    }
+    if (producer_.joinable()) producer_.join();
+    for (auto* item : queue_) dmlc_free_records(item);
+    queue_.clear();
+    stop_ = false;
+    produce_done_ = false;
+  }
+
+  bool stop_requested() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return stop_;
+  }
+
+  void set_error(std::string msg) {
+    std::lock_guard<std::mutex> lk(err_mu_);
+    if (error_.empty()) error_ = std::move(msg);
+  }
+
+  std::vector<std::string> paths_;
+  std::vector<int64_t> file_offset_;
+  std::vector<int64_t> index_;  // sorted record start offsets (global)
+  int64_t batch_records_;
+  bool shuffle_;
+  std::mt19937_64 rng_;
+  int queue_depth_;
+
+  std::vector<int64_t> perm_;   // current epoch's permutation (shuffle)
+  int64_t epochs_drawn_ = 0;    // permutations drawn so far (epoch + 1)
+  int64_t start_record_ = 0;    // resume cursor for the next producer run
+  int64_t index_begin_ = 0, index_end_ = 0, offset_end_ = 0;
+  FILE* fp_ = nullptr;
+  size_t fp_file_ = 0;
+  int64_t fp_pos_ = 0;
+
+  std::thread producer_;
+  std::mutex mu_;
+  std::condition_variable cv_push_, cv_pop_;
+  std::deque<RecordBatchResult*> queue_;
+  bool stop_ = false;
+  bool produce_done_ = false;
+  std::atomic<int64_t> bytes_read_{0};
+  mutable std::mutex err_mu_;
+  std::string error_;
+};
+
 }  // namespace
 
 extern "C" {
@@ -1250,6 +1595,48 @@ const char* dmlc_feeder_error(void* handle) {
 
 void dmlc_feeder_destroy(void* handle) {
   delete static_cast<LineReader*>(handle);
+}
+
+void* dmlc_indexed_reader_create(const char** paths, const int64_t* sizes,
+                                 int32_t nfiles, const int64_t* index_offsets,
+                                 int64_t n_index, int64_t part_index,
+                                 int64_t num_parts, int64_t batch_records,
+                                 int32_t shuffle, uint64_t seed,
+                                 int32_t queue_depth) {
+  try {
+    std::vector<std::string> p(paths, paths + nfiles);
+    std::vector<int64_t> s(sizes, sizes + nfiles);
+    std::vector<int64_t> idx(index_offsets, index_offsets + n_index);
+    return new IndexedReader(std::move(p), std::move(s), std::move(idx),
+                             part_index, num_parts, batch_records,
+                             shuffle != 0, seed, queue_depth);
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+void* dmlc_indexed_reader_next(void* handle) {
+  return static_cast<IndexedReader*>(handle)->next();
+}
+
+void dmlc_indexed_reader_before_first(void* handle) {
+  static_cast<IndexedReader*>(handle)->before_first();
+}
+
+void dmlc_indexed_reader_skip(void* handle, int64_t epochs, int64_t records) {
+  static_cast<IndexedReader*>(handle)->skip(epochs, records);
+}
+
+int64_t dmlc_indexed_reader_bytes_read(void* handle) {
+  return static_cast<IndexedReader*>(handle)->bytes_read();
+}
+
+const char* dmlc_indexed_reader_error(void* handle) {
+  return static_cast<IndexedReader*>(handle)->error();
+}
+
+void dmlc_indexed_reader_destroy(void* handle) {
+  delete static_cast<IndexedReader*>(handle);
 }
 
 }  // extern "C"
