@@ -1,0 +1,43 @@
+# One-command CI gate — the analog of the reference's travis_script.sh
+# (scripts/travis/travis_script.sh:39-66: gtest suite + TSAN task).
+#
+#   make check        pytest + sanitizers + native parse bench, logged to
+#                     CHECK.log (dated) — the full pre-commit gate
+#   make test         pytest only (fast inner loop)
+#   make sanitize     ASan/UBSan + TSan native runs -> native/SANITIZE.log
+#   make parse-bench  native scanner throughput tool (no device needed)
+
+PYTHON ?= python
+# bash + pipefail so a failing stage is never masked by the tee into CHECK.log
+SHELL := /bin/bash
+.SHELLFLAGS := -o pipefail -c
+
+.PHONY: check test sanitize parse-bench
+
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+sanitize:
+	sh native/run_sanitizers.sh
+
+parse-bench:
+	mkdir -p native/build
+	g++ -O3 -std=c++17 -pthread -o native/build/parse_bench \
+	    native/test/parse_bench.cc native/src/parse.cc native/src/reader.cc \
+	    native/src/recordio.cc
+	@test -f native/build/bench_corpus.libsvm || $(PYTHON) -c "import random; \
+	    r = random.Random(7); \
+	    f = open('native/build/bench_corpus.libsvm', 'w'); \
+	    [f.write(str(i % 2) + ' ' + ' '.join(f'{j}:{r.random():.6f}' \
+	        for j in range(28)) + '\n') for i in range(40000)]"
+	./native/build/parse_bench native/build/bench_corpus.libsvm 28 3
+
+check:
+	@echo "== make check $$(date -u +%Y-%m-%dT%H:%M:%SZ) ==" | tee CHECK.log
+	@echo "-- pytest --" | tee -a CHECK.log
+	$(PYTHON) -m pytest tests/ -q 2>&1 | tee -a CHECK.log
+	@echo "-- sanitizers --" | tee -a CHECK.log
+	sh native/run_sanitizers.sh 2>&1 | tee -a CHECK.log
+	@echo "-- parse bench --" | tee -a CHECK.log
+	$(MAKE) --no-print-directory parse-bench 2>&1 | tee -a CHECK.log
+	@echo "== make check: ALL GREEN ==" | tee -a CHECK.log

@@ -92,7 +92,69 @@ int main() {
   dmlc_reader_destroy(r);
   remove(path);
 
-  CHECK_TRUE(dmlc_native_abi_version() == 8);
+  // indexed recordio reader: sequential, shuffled epochs, native skip —
+  // all under the sanitizer (producer thread + per-record seeks)
+  {
+    char rpath[] = "/tmp/dmlc_tpu_smoke_rec_XXXXXX";
+    int rfd = mkstemp(rpath);
+    CHECK_TRUE(rfd >= 0);
+    FILE* rf = fdopen(rfd, "wb");
+    const uint32_t magic = 0xced7230a;
+    std::string idx_offsets_bytes;
+    int64_t offsets[64];
+    for (int i = 0; i < 64; ++i) {
+      offsets[i] = static_cast<int64_t>(ftell(rf));
+      uint32_t len = 8 + static_cast<uint32_t>(i % 4);
+      uint32_t lrec = len;  // cflag 0
+      fwrite(&magic, 4, 1, rf);
+      fwrite(&lrec, 4, 1, rf);
+      char payload[12] = {0};
+      payload[0] = static_cast<char>(i);
+      fwrite(payload, 1, len, rf);
+      size_t pad = (4 - len % 4) % 4;
+      char zeros[4] = {0, 0, 0, 0};
+      fwrite(zeros, 1, pad, rf);
+    }
+    int64_t fsize = static_cast<int64_t>(ftell(rf));
+    fclose(rf);
+    const char* rpaths[1] = {rpath};
+    for (int shuffle = 0; shuffle < 2; ++shuffle) {
+      void* ir = dmlc_indexed_reader_create(
+          rpaths, &fsize, 1, offsets, 64, /*part=*/0, /*nparts=*/1,
+          /*batch_records=*/7, shuffle, /*seed=*/3, /*queue_depth=*/2);
+      CHECK_TRUE(ir != nullptr);
+      for (int pass = 0; pass < 2; ++pass) {
+        int64_t recs = 0;
+        while (true) {
+          void* res = dmlc_indexed_reader_next(ir);
+          if (!res) break;
+          RecordBatchResult* rb = static_cast<RecordBatchResult*>(res);
+          CHECK_TRUE(rb->error == nullptr);
+          recs += rb->n_records;
+          dmlc_free_records(rb);
+        }
+        CHECK_TRUE(dmlc_indexed_reader_error(ir) == nullptr);
+        CHECK_TRUE(recs == 64);
+        dmlc_indexed_reader_before_first(ir);
+      }
+      // native skip: land mid-epoch, count only the suffix
+      dmlc_indexed_reader_skip(ir, /*epochs=*/2, /*records=*/50);
+      CHECK_TRUE(dmlc_indexed_reader_error(ir) == nullptr);
+      int64_t rest = 0;
+      while (true) {
+        void* res = dmlc_indexed_reader_next(ir);
+        if (!res) break;
+        RecordBatchResult* rb = static_cast<RecordBatchResult*>(res);
+        rest += rb->n_records;
+        dmlc_free_records(rb);
+      }
+      CHECK_TRUE(rest == 14);
+      dmlc_indexed_reader_destroy(ir);
+    }
+    remove(rpath);
+  }
+
+  CHECK_TRUE(dmlc_native_abi_version() == 10);
   if (failures == 0) std::printf("native_smoke: all checks passed\n");
   return failures == 0 ? 0 : 1;
 }
