@@ -136,6 +136,16 @@ class DeviceIter:
         self._convert_ahead = convert_ahead
         self._host_iter_obj: Optional[ThreadedIter] = None
         self._inflight: deque = deque()
+        # byte-exact resume (SURVEY.md §5.4): blocks annotated by the parser
+        # chain carry the source state just after them; the convert thread
+        # maps each produced batch to (latest block boundary, rows past it)
+        # and the consumer keeps the annotation of the last delivered batch
+        self._annot_fifo: deque = deque()
+        self._boundaries: deque = deque()
+        self._cur_boundary = None          # (rows_at_end, source_state)
+        self._last_resume: Optional[dict] = None
+        self._drop_rows = 0                # rows to drop after a seek-restore
+        self._suppress_before_first = False
 
     @property
     def _host_iter(self) -> ThreadedIter:
@@ -148,12 +158,51 @@ class DeviceIter:
     # ---------------- host side ----------------
 
     def _blocks(self) -> Iterator[RowBlock]:
-        self.source.before_first()
+        if self._suppress_before_first:
+            # seek-restored: the source already sits at the resume position
+            self._suppress_before_first = False
+        else:
+            self.source.before_first()
         while True:
             blk = self.source.next_block()
             if blk is None:
                 return
             yield blk
+
+    def _tracked_blocks(self) -> Iterator[RowBlock]:
+        """Source blocks with (a) a resume-prefix drop after a seek-restore
+        and (b) block-boundary bookkeeping for byte-exact checkpoints."""
+        self._boundaries.clear()
+        self._cur_boundary = None
+        rows = 0
+        drop = self._drop_rows
+        self._drop_rows = 0
+        for block in self._blocks():
+            # read the annotation BEFORE any drop-slice: it marks the
+            # position AFTER the block, which the tail slice still ends at
+            annot = getattr(block, "resume_state", None)
+            if drop > 0:
+                if drop >= len(block):
+                    drop -= len(block)
+                    continue
+                block = block.slice(drop, len(block))
+                drop = 0
+            rows += len(block)
+            if annot is not None:
+                self._boundaries.append((rows, annot))
+            yield block
+
+    def _push_annot(self, rows_emitted: int) -> None:
+        """Record the resume annotation for the batch ending at
+        ``rows_emitted`` (rows of real data since stream/resume start)."""
+        while self._boundaries and self._boundaries[0][0] <= rows_emitted:
+            self._cur_boundary = self._boundaries.popleft()
+        if self._cur_boundary is None:
+            self._annot_fifo.append(None)
+            return
+        r, state = self._cur_boundary
+        self._annot_fifo.append(
+            {"source": state, "skip_rows": rows_emitted - r})
 
     def _host_batches(self):
         if self.layout == "dense":
@@ -175,9 +224,12 @@ class DeviceIter:
                     continue
                 yield self._put(self._convert(block))
             return
+        emitted = 0
         for block in rebatch_blocks(
-            self._blocks(), self.batch_size, self.drop_remainder
+            self._tracked_blocks(), self.batch_size, self.drop_remainder
         ):
+            emitted += len(block)
+            self._push_annot(emitted)
             yield self._convert(block)
 
     def _host_batches_dense(self):
@@ -189,7 +241,8 @@ class DeviceIter:
         B = self.batch_size
         parts: list = []  # [(x, y, w)] pending, total rows < B after drain
         pending = 0
-        for block in self._blocks():
+        emitted = 0
+        for block in self._tracked_blocks():
             if isinstance(block, DenseBlock):
                 w = (block.weight if block.weight is not None
                      else np.ones(len(block), np.float32))
@@ -204,6 +257,8 @@ class DeviceIter:
                 w = np.concatenate(ws) if len(ws) > 1 else ws[0]
                 pos = 0
                 while pos + B <= len(y):
+                    emitted += B
+                    self._push_annot(emitted)
                     yield ("dense", x[pos:pos + B], y[pos:pos + B], w[pos:pos + B])
                     pos += B
                 parts = [(x[pos:], y[pos:], w[pos:])] if pos < len(y) else []
@@ -220,6 +275,8 @@ class DeviceIter:
             yp[:n] = y
             wp = np.zeros(B, np.float32)
             wp[:n] = w
+            emitted += n
+            self._push_annot(emitted)
             yield ("dense", xp, yp, wp)
 
     def _convert(self, block: RowBlock):
@@ -327,42 +384,80 @@ class DeviceIter:
         self.host_stall_seconds += self._host_iter.stall_seconds
         self._host_iter.stall_seconds = 0.0
         self.batches_fed += 1
+        if self._annot_fifo:
+            # production order == delivery order, so the head annotation
+            # belongs to the batch just handed out
+            self._last_resume = self._annot_fifo.popleft()
         # issue the replacement transfer before handing the batch out —
         # pipeline work, not consumer stall, so outside the timed region
         self._fill()
         return out
 
     def reset(self) -> None:
-        """New epoch: restart the host pipeline (upstream before_first)."""
-        self._inflight.clear()
+        """New epoch: restart the host pipeline. The producer thread is
+        JOINED (not just signalled) before annotation state is cleared —
+        an in-flight produce step could otherwise append a stale old-epoch
+        annotation after the clear and desync the fifo for the whole next
+        epoch."""
+        self._teardown_producer()
         self._skip_blocks = 0
-        self._host_iter.before_first()
+        self._drop_rows = 0
+        self._suppress_before_first = False
+        self._last_resume = None
         self.batches_fed = 0
 
     # -------- checkpoint / resume (SURVEY.md §5.4 addition) --------
 
     def state_dict(self) -> dict:
-        """Mid-epoch resume point: batches delivered so far. Rebatching is
-        deterministic, so replaying that count on restore lands on the same
-        boundary. Transfers in flight (not yet handed out) are dropped and
-        re-issued on restore."""
+        """Mid-epoch resume point. When the source chain annotates blocks
+        (the Python parser stack), the state composes the split layer's
+        byte-exact position — restore SEEKS there, O(1) in epoch position.
+        Otherwise: batch count, replayed deterministically on restore.
+        Transfers in flight (not yet handed out) are dropped either way."""
+        if self._last_resume is not None:
+            return {"kind": "source", "batches": self.batches_fed,
+                    **self._last_resume}
         return {"kind": "batches", "batches": self.batches_fed}
 
-    def load_state(self, state: dict) -> None:
-        n = int(state["batches"])
+    def _teardown_producer(self) -> None:
         self._inflight.clear()
+        if self._host_iter_obj is not None:
+            self._host_iter_obj.destroy()
+            self._host_iter_obj = None
+        self._annot_fifo.clear()
+
+    def load_state(self, state: dict) -> None:
+        if state.get("kind") == "source":
+            # byte-exact restore: seek the source (parser -> split) to the
+            # block boundary, drop the few rows into it, rebatch from there
+            # — no prefix bytes are re-read or re-parsed
+            self._teardown_producer()
+            self._skip_blocks = 0
+            self.source.load_state(state["source"])
+            self._drop_rows = int(state["skip_rows"])
+            self._suppress_before_first = True
+            self._last_resume = {k: state[k] for k in ("source", "skip_rows")}
+            self.batches_fed = int(state["batches"])
+            return
+        n = int(state["batches"])
         # natural-block mode puts on the producer thread, so skipping must
         # happen THERE (before conversion/transfer): tear down any running
         # producer first, THEN arm the skip counter — the replacement
         # producer (lazily started by the drain below) sees the credits
         # from its first iteration, with no thread racing the hand-off
-        if self._host_iter_obj is not None:
-            self._host_iter_obj.destroy()
-            self._host_iter_obj = None
+        self._teardown_producer()
         self._skip_blocks = n if self.batch_size is None else 0
+        self._drop_rows = 0
+        self._suppress_before_first = False
+        self._last_resume = None
         for _ in range(n):
             if self._host_iter.next() is None:  # replay: nothing transferred
                 break
+            if self._annot_fifo:
+                # keep the 1-push/1-pop pairing: each replayed batch pushed
+                # an annotation; consume it like a delivery would (it also
+                # upgrades later checkpoints to byte-exact)
+                self._last_resume = self._annot_fifo.popleft()
         self.batches_fed = n
 
     def close(self) -> None:

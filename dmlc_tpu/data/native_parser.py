@@ -208,6 +208,8 @@ class NativeStreamParser(Parser):
         return {"kind": "blocks", "blocks": self._blocks_out}
 
     def load_state(self, state: dict) -> None:
+        check(state.get("kind") == "blocks",
+              f"native parser: incompatible resume state {state.get('kind')!r}")
         n = int(state["blocks"])
         self.before_first()
         reader = self._ensure_reader()

@@ -160,6 +160,15 @@ class TextParserBase(Parser):
             self._chunks_in += 1
             block = self.parse_chunk(_chunk_bytes(chunk))
             if len(block) > 0:
+                # annotate with the parser state positioned just AFTER this
+                # block, so downstream prefetch pipelines (ThreadedParser,
+                # DeviceIter) can checkpoint byte-exactly even though their
+                # own view runs behind this producer (SURVEY.md §5.4)
+                split_state = getattr(self.source, "chunk_resume_state", None)
+                if split_state is not None:
+                    block.resume_state = {"kind": "split",
+                                          "split": split_state,
+                                          "chunks": self._chunks_in}
                 return block
 
     def before_first(self) -> None:
@@ -169,12 +178,18 @@ class TextParserBase(Parser):
     # -------- checkpoint / resume (SURVEY.md §5.4 addition) --------
 
     def state_dict(self) -> dict:
-        """Resume point at a block boundary. Byte-exact when the source is an
-        undecorated split (it carries the file offset); otherwise a chunk
-        count replayed on restore."""
-        if hasattr(self.source, "state_dict"):
-            return {"kind": "split", "split": self.source.state_dict(),
+        """Resume point at a block boundary. Byte-exact whenever the source
+        exposes a chunk-synchronized state (undecorated splits AND the
+        prefetching ThreadedInputSplit, whose chunks carry the position they
+        were produced at); otherwise a chunk count replayed on restore."""
+        split_state = getattr(self.source, "chunk_resume_state", None)
+        if split_state is not None:
+            return {"kind": "split", "split": split_state,
                     "chunks": self._chunks_in}
+        if self._chunks_in == 0 and hasattr(self.source, "state_dict"):
+            # epoch start: no chunk pulled yet, the live state is exact
+            return {"kind": "split", "split": self.source.state_dict(),
+                    "chunks": 0}
         return {"kind": "chunks", "chunks": self._chunks_in}
 
     def load_state(self, state: dict) -> None:
@@ -541,6 +556,7 @@ class ThreadedParser(Parser):
         self.base = base
         self._capacity = capacity
         self._delivered = 0
+        self._last_annot = None  # resume_state of the last delivered block
         # the producer thread starts on first pull, not construction, so
         # callers can still configure the base (e.g. set_emit_dense) without
         # racing blocks already in flight
@@ -570,27 +586,42 @@ class ThreadedParser(Parser):
         block = self._ensure_iter().next()
         if block is not None:
             self._delivered += 1
+            # byte-exact checkpoints ride the blocks (TextParserBase
+            # annotates each with the state just after it) — the base
+            # parser's live position runs ahead of delivery
+            self._last_annot = getattr(block, "resume_state", None)
         return block
 
     def before_first(self) -> None:
         self._ensure_iter().before_first()
         self._delivered = 0
+        self._last_annot = None
 
     def state_dict(self) -> dict:
-        # the base parser runs ahead of delivery, so its own position is not
-        # the consumer's; count delivered blocks and replay on restore
+        if self._last_annot is not None:
+            return dict(self._last_annot, blocks=self._delivered)
+        # no annotation (epoch start, or a base without them): count
+        # delivered blocks and replay on restore
         return {"kind": "blocks", "blocks": self._delivered}
 
     def load_state(self, state: dict) -> None:
-        n = int(state["blocks"])
         if self._iter is not None:
             self._iter.destroy()
             self._iter = None
+        if state.get("kind") == "split":
+            # seek, don't replay: the base parser restores the split's
+            # byte-exact position and production continues from there
+            self.base.load_state(state)
+            self._delivered = int(state.get("blocks", 0))
+            self._last_annot = {k: v for k, v in state.items() if k != "blocks"}
+            return
+        n = int(state["blocks"])
         self.base.before_first()
         for _ in range(n):
             if self.base.next_block() is None:
                 break
         self._delivered = n
+        self._last_annot = None
 
     @property
     def bytes_read(self) -> int:

@@ -181,7 +181,7 @@ class DenseBlock:
     analog (its parsers always build CSR RowBlocks, src/data/row_block.h).
     """
 
-    __slots__ = ("x", "label", "weight", "hold")
+    __slots__ = ("x", "label", "weight", "hold", "resume_state")
 
     def __init__(self, x: np.ndarray, label: np.ndarray,
                  weight: Optional[np.ndarray] = None, hold=None):
@@ -189,9 +189,17 @@ class DenseBlock:
         self.label = label
         self.weight = weight
         self.hold = hold
+        self.resume_state = None  # parser position just after this block
 
     def __len__(self) -> int:
         return len(self.label)
+
+    def slice(self, begin: int, end: int) -> "DenseBlock":
+        """Row range view [begin, end), mirroring RowBlock.slice."""
+        return DenseBlock(
+            self.x[begin:end], self.label[begin:end],
+            self.weight[begin:end] if self.weight is not None else None,
+            hold=self.hold)
 
 
 class RowBlockContainer:

@@ -91,7 +91,7 @@ class _Chunk:
     materialized once.
     """
 
-    __slots__ = ("raw", "data", "pos")
+    __slots__ = ("raw", "data", "pos", "resume_state")
 
     def __init__(self, data):
         if isinstance(data, memoryview):
@@ -357,6 +357,14 @@ class InputSplitBase(InputSplit):
     # A capability the reference lacks (SURVEY.md §5.4): capture the exact
     # mid-partition read position so a preempted job resumes without
     # re-reading the prefix. State is JSON-friendly.
+
+    @property
+    def chunk_resume_state(self) -> Optional[dict]:
+        """Resume state positioned just after the chunk most recently
+        returned by ``next_chunk``. On an undecorated split the live
+        ``state_dict`` IS that position; prefetching decorators override
+        this to return the state captured when the chunk was produced."""
+        return self.state_dict()
 
     def state_dict(self) -> dict:
         """Byte-exact resume point: global offset + undelivered buffer tails."""
@@ -641,6 +649,9 @@ class IndexedRecordIOSplitter(InputSplitBase):
 
     is_text = False
     align_bytes = 4
+    # state_dict carries the epoch permutation + rng state — far too heavy
+    # to snapshot per prefetched chunk (ThreadedInputSplit._produce)
+    cheap_chunk_state = False
 
     def __init__(
         self,
@@ -812,19 +823,41 @@ class ThreadedInputSplit(InputSplit):
         self._capacity = capacity
         self._iter = ThreadedIter(self._produce, self._reset_base, max_capacity=capacity)
         self._chunk: Optional[_Chunk] = None
+        self._last_chunk_state = None
 
     def _produce(self, cell):
         chunk = self.base.next_chunk()
         if chunk is None:
             return False, None
-        return True, _Chunk(chunk)
+        out = _Chunk(chunk)
+        # capture the base's position WITH the chunk (the live state runs
+        # ahead of consumption once prefetched) — consumers read it back
+        # via chunk_resume_state for byte-exact checkpoints. Splitters whose
+        # state is heavy (e.g. a shuffled index permutation) opt out via
+        # cheap_chunk_state and fall back to count-based resume.
+        out.resume_state = None
+        if getattr(self.base, "cheap_chunk_state", True):
+            try:
+                out.resume_state = self.base.state_dict()
+            except (AttributeError, DMLCError):
+                pass
+        return True, out
 
     def _reset_base(self):
         self.base.before_first()
 
     def next_chunk(self) -> Optional[memoryview]:
         chunk = self._iter.next()
-        return chunk.data if chunk is not None else None
+        if chunk is None:
+            return None
+        self._last_chunk_state = getattr(chunk, "resume_state", None)
+        return chunk.data
+
+    @property
+    def chunk_resume_state(self):
+        """Base state as of the chunk last handed out (not the prefetched
+        live position)."""
+        return self._last_chunk_state
 
     def next_record(self) -> Optional[memoryview]:
         while True:
@@ -839,6 +872,7 @@ class ThreadedInputSplit(InputSplit):
     def before_first(self) -> None:
         self._iter.before_first()
         self._chunk = None
+        self._last_chunk_state = None  # stale end-of-epoch position otherwise
 
     def reset_partition(self, part_index: int, num_parts: int) -> None:
         # quiesce the producer, repartition the base, restart
@@ -848,6 +882,19 @@ class ThreadedInputSplit(InputSplit):
             self._produce, self._reset_base, max_capacity=self._capacity
         )
         self._chunk = None
+        self._last_chunk_state = None
+
+    def load_state(self, state: dict) -> None:
+        """Seek the base to a saved position (a ``chunk_resume_state`` /
+        base ``state_dict``) and restart the prefetch from there — the
+        producer never re-reads the consumed prefix."""
+        self._iter.destroy()
+        self.base.load_state(state)
+        self._iter = ThreadedIter(
+            self._produce, self._reset_base, max_capacity=self._capacity
+        )
+        self._chunk = None
+        self._last_chunk_state = state
 
     def hint_chunk_size(self, chunk_size: int) -> None:
         self.base.hint_chunk_size(chunk_size)

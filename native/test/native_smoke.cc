@@ -100,7 +100,6 @@ int main() {
     CHECK_TRUE(rfd >= 0);
     FILE* rf = fdopen(rfd, "wb");
     const uint32_t magic = 0xced7230a;
-    std::string idx_offsets_bytes;
     int64_t offsets[64];
     for (int i = 0; i < 64; ++i) {
       offsets[i] = static_cast<int64_t>(ftell(rf));
