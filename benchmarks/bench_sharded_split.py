@@ -29,12 +29,16 @@ def run() -> None:
 
     def consume(nshard: int) -> int:
         # shards run back-to-back in one process (a real pod runs one per
-        # host); synchronous parsers avoid per-shard thread churn
+        # host); ONE parser re-pointed per shard via reset_partition, so
+        # the file listing / offset table / parser setup amortize across
+        # shards (unittest_inputsplit.cc's loop-all-parts pattern)
         rows = 0
+        p = create_parser(path, 0, nshard, "libsvm", threaded=False)
         for part in range(nshard):
-            p = create_parser(path, part, nshard, "libsvm", threaded=False)
+            if part:
+                p.reset_partition(part, nshard)
             rows += sum(len(b) for b in p)
-            p.close()
+        p.close()
         return rows
 
     n1 = consume(1)

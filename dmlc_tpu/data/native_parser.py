@@ -200,6 +200,20 @@ class NativeStreamParser(Parser):
             self._reader.before_first()
         self._blocks_out = 0
 
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        """Re-point at another partition; the file listing (paths/sizes) is
+        reused — only the native reader is rebuilt, lazily."""
+        check(num_parts >= 1, f"num_parts must be >= 1, got {num_parts}")
+        check(0 <= part_index < num_parts,
+              f"part_index {part_index} out of range for {num_parts} parts")
+        # keep bytes_read cumulative across partitions, matching the Python
+        # engine's accumulating counter
+        self._bytes_base = self.bytes_read
+        self.close()
+        self.part_index = part_index
+        self.num_parts = num_parts
+        self._blocks_out = 0
+
     # -------- checkpoint / resume (SURVEY.md §5.4 addition) --------
 
     def state_dict(self) -> dict:
@@ -220,7 +234,8 @@ class NativeStreamParser(Parser):
 
     @property
     def bytes_read(self) -> int:
-        return self._reader.bytes_read if self._reader is not None else 0
+        live = self._reader.bytes_read if self._reader is not None else 0
+        return getattr(self, "_bytes_base", 0) + live
 
     @property
     def stall_seconds(self) -> float:

@@ -175,6 +175,14 @@ class TextParserBase(Parser):
         self.source.before_first()
         self._chunks_in = 0
 
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        """Re-point this parser at another partition of the same corpus
+        (InputSplit::ResetPartition, io.h:190-242) — the file listing and
+        offset table are reused, so looping all parts in one process pays
+        the setup cost once."""
+        self.source.reset_partition(part_index, num_parts)
+        self._chunks_in = 0
+
     # -------- checkpoint / resume (SURVEY.md §5.4 addition) --------
 
     def state_dict(self) -> dict:
@@ -594,6 +602,15 @@ class ThreadedParser(Parser):
 
     def before_first(self) -> None:
         self._ensure_iter().before_first()
+        self._delivered = 0
+        self._last_annot = None
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        # quiesce the producer before re-pointing the base
+        if self._iter is not None:
+            self._iter.destroy()
+            self._iter = None
+        self.base.reset_partition(part_index, num_parts)
         self._delivered = 0
         self._last_annot = None
 

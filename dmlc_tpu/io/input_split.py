@@ -207,6 +207,11 @@ class InputSplitBase(InputSplit):
     def reset_partition(self, part_index: int, num_parts: int) -> None:
         """Byte-range partition + record-boundary adjustment
         (ResetPartition, input_split_base.cc:30-64)."""
+        check(num_parts >= 1, f"num_parts must be >= 1, got {num_parts}")
+        check(0 <= part_index < num_parts,
+              f"part_index {part_index} out of range for {num_parts} parts")
+        self.part_index = part_index
+        self.num_parts = num_parts
         ntotal = self.file_offset[-1]
         nstep = (ntotal + num_parts - 1) // num_parts
         align = self.align_bytes
@@ -380,11 +385,21 @@ class InputSplitBase(InputSplit):
             "file_ptr": self.file_ptr,
             "overflow": self._overflow.hex(),
             "chunk": pending_chunk.hex(),
+            # partition identity: restore can re-point a split that was
+            # constructed for (or last reset to) a different shard
+            "part_index": getattr(self, "part_index", None),
+            "num_parts": getattr(self, "num_parts", None),
         }
 
     def load_state(self, state: dict) -> None:
-        """Seek to a :meth:`state_dict` position (same URI + partition)."""
+        """Seek to a :meth:`state_dict` position (same URI; the recorded
+        partition is re-applied when it differs from the current one)."""
         check(state.get("kind") == "byte", "incompatible split state")
+        part, nparts = state.get("part_index"), state.get("num_parts")
+        if (nparts is not None and part is not None
+                and (part, nparts) != (getattr(self, "part_index", None),
+                                       getattr(self, "num_parts", None))):
+            self.reset_partition(int(part), int(nparts))
         off = int(state["offset_curr"])
         check(
             self.offset_begin <= off <= self.offset_end,
@@ -679,6 +694,11 @@ class IndexedRecordIOSplitter(InputSplitBase):
 
     def reset_partition(self, part_index: int, num_parts: int) -> None:
         """Partition by record count (indexed_recordio_split.cc:12-41)."""
+        check(num_parts >= 1, f"num_parts must be >= 1, got {num_parts}")
+        check(0 <= part_index < num_parts,
+              f"part_index {part_index} out of range for {num_parts} parts")
+        self.part_index = part_index
+        self.num_parts = num_parts
         ntotal = len(self.index)
         nstep = (ntotal + num_parts - 1) // num_parts
         if part_index * nstep >= ntotal:
@@ -724,6 +744,8 @@ class IndexedRecordIOSplitter(InputSplitBase):
             "kind": "indexed",
             "current_index": self.current_index,
             "chunk": pending_chunk.hex(),
+            "part_index": getattr(self, "part_index", None),
+            "num_parts": getattr(self, "num_parts", None),
         }
         if self.shuffle:
             st["permutation"] = list(self.permutation)
@@ -734,6 +756,11 @@ class IndexedRecordIOSplitter(InputSplitBase):
     def load_state(self, state: dict) -> None:
         check(state.get("kind") == "indexed",
               "incompatible indexed-recordio split state")
+        part, nparts = state.get("part_index"), state.get("num_parts")
+        if (nparts is not None and part is not None
+                and (part, nparts) != (getattr(self, "part_index", None),
+                                       getattr(self, "num_parts", None))):
+            self.reset_partition(int(part), int(nparts))
         self._close_fp()
         self._overflow = b""
         if self.shuffle:

@@ -587,3 +587,84 @@ def test_view_owner_survives_gc():
     del x
     gc.collect()
     np.testing.assert_allclose(sl, [5, 6])
+
+
+@pytest.mark.parametrize("threaded,force_python", [
+    (False, False),
+    (True, False),   # native stream parser on native-enabled hosts
+    (True, True),    # ThreadedParser + ThreadedInputSplit quiesce path
+])
+def test_parser_reset_partition_loops_all_parts(tmp_path, monkeypatch,
+                                                threaded, force_python):
+    """One parser re-pointed via reset_partition covers every shard with
+    no dropped/duplicated rows (unittest_inputsplit.cc loop pattern)."""
+    if force_python:
+        monkeypatch.setenv("DMLC_TPU_NO_NATIVE_READER", "1")
+    path = tmp_path / "shards.libsvm"
+    path.write_text("".join(f"{i % 2} 0:{i}.5 1:2.0\n" for i in range(777)))
+
+    # fresh-parser-per-part reference
+    want = []
+    for part in range(4):
+        p = create_parser(str(path), part, 4, "libsvm", threaded=threaded)
+        for b in p:
+            want.append(np.asarray(b.label))
+        p.close()
+    want = np.concatenate(want)
+
+    got = []
+    p = create_parser(str(path), 0, 4, "libsvm", threaded=threaded)
+    for part in range(4):
+        if part:
+            p.reset_partition(part, 4)
+        for b in p:
+            got.append(np.asarray(b.label))
+    p.close()
+    got = np.concatenate(got)
+    assert len(got) == 777
+    np.testing.assert_array_equal(got, want)
+
+
+def test_parser_reset_partition_validates():
+    from dmlc_tpu.utils.check import DMLCError
+
+    import tempfile, os as _os
+    tmp = tempfile.mkdtemp()
+    p_file = _os.path.join(tmp, "v.libsvm")
+    with open(p_file, "w") as f:
+        f.write("1 0:1\n0 0:2\n")
+    p = create_parser(p_file, 0, 2, "libsvm", threaded=False)
+    with pytest.raises(DMLCError):
+        p.reset_partition(7, 4)   # out of range: silent empty shard before
+    with pytest.raises(DMLCError):
+        p.reset_partition(0, 0)   # ZeroDivisionError before
+    p.close()
+
+
+def test_checkpoint_carries_partition_identity(tmp_path):
+    """A checkpoint taken on shard k restores onto a parser created for a
+    DIFFERENT shard: the state re-applies the recorded partition."""
+    path = tmp_path / "pid.libsvm"
+    path.write_text("".join(f"{i % 2} 0:{i}.5\n" for i in range(4000)))
+
+    p = create_parser(str(path), 0, 4, "libsvm", threaded=False,
+                      chunk_bytes=512)
+    p.reset_partition(2, 4)
+    first = p.next_block()
+    st = p.state_dict()
+    want = []
+    while (b := p.next_block()) is not None:
+        want.append(np.asarray(b.label))
+    p.close()
+    assert first is not None and want
+
+    p2 = create_parser(str(path), 0, 4, "libsvm", threaded=False,
+                       chunk_bytes=512)  # shard 0!
+    p2.load_state(st)
+    got = []
+    while (b := p2.next_block()) is not None:
+        got.append(np.asarray(b.label))
+    p2.close()
+    assert len(got) == len(want)
+    for a, b_ in zip(got, want):
+        np.testing.assert_array_equal(a, b_)
