@@ -218,12 +218,19 @@ class NativeStreamParser(Parser):
 
     def state_dict(self) -> dict:
         """Resume point at a block boundary. Chunking in the native reader is
-        deterministic, so a block count replays exactly."""
-        return {"kind": "blocks", "blocks": self._blocks_out}
+        deterministic, so a block count replays exactly. Partition identity
+        rides along so restore onto a differently-pointed parser re-applies
+        the recorded shard first."""
+        return {"kind": "blocks", "blocks": self._blocks_out,
+                "part_index": self.part_index, "num_parts": self.num_parts}
 
     def load_state(self, state: dict) -> None:
         check(state.get("kind") == "blocks",
               f"native parser: incompatible resume state {state.get('kind')!r}")
+        part, nparts = state.get("part_index"), state.get("num_parts")
+        if (nparts is not None and part is not None
+                and (part, nparts) != (self.part_index, self.num_parts)):
+            self.reset_partition(int(part), int(nparts))
         n = int(state["blocks"])
         self.before_first()
         reader = self._ensure_reader()

@@ -625,15 +625,10 @@ def test_parser_reset_partition_loops_all_parts(tmp_path, monkeypatch,
     np.testing.assert_array_equal(got, want)
 
 
-def test_parser_reset_partition_validates():
-    from dmlc_tpu.utils.check import DMLCError
-
-    import tempfile, os as _os
-    tmp = tempfile.mkdtemp()
-    p_file = _os.path.join(tmp, "v.libsvm")
-    with open(p_file, "w") as f:
-        f.write("1 0:1\n0 0:2\n")
-    p = create_parser(p_file, 0, 2, "libsvm", threaded=False)
+def test_parser_reset_partition_validates(tmp_path):
+    p_file = tmp_path / "v.libsvm"
+    p_file.write_text("1 0:1\n0 0:2\n")
+    p = create_parser(str(p_file), 0, 2, "libsvm", threaded=False)
     with pytest.raises(DMLCError):
         p.reset_partition(7, 4)   # out of range: silent empty shard before
     with pytest.raises(DMLCError):
@@ -641,13 +636,15 @@ def test_parser_reset_partition_validates():
     p.close()
 
 
-def test_checkpoint_carries_partition_identity(tmp_path):
+@pytest.mark.parametrize("threaded", [False, True])
+def test_checkpoint_carries_partition_identity(tmp_path, threaded):
     """A checkpoint taken on shard k restores onto a parser created for a
-    DIFFERENT shard: the state re-applies the recorded partition."""
+    DIFFERENT shard: the state re-applies the recorded partition (both
+    engines — threaded=True is the native stream parser where eligible)."""
     path = tmp_path / "pid.libsvm"
     path.write_text("".join(f"{i % 2} 0:{i}.5\n" for i in range(4000)))
 
-    p = create_parser(str(path), 0, 4, "libsvm", threaded=False,
+    p = create_parser(str(path), 0, 4, "libsvm", threaded=threaded,
                       chunk_bytes=512)
     p.reset_partition(2, 4)
     first = p.next_block()
@@ -658,7 +655,7 @@ def test_checkpoint_carries_partition_identity(tmp_path):
     p.close()
     assert first is not None and want
 
-    p2 = create_parser(str(path), 0, 4, "libsvm", threaded=False,
+    p2 = create_parser(str(path), 0, 4, "libsvm", threaded=threaded,
                        chunk_bytes=512)  # shard 0!
     p2.load_state(st)
     got = []
