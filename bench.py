@@ -99,16 +99,23 @@ def into_hbm_mb_per_sec(path: str, size_mb: float):
 
     jax.block_until_ready(
         jax.device_put(np.zeros((BATCH, NUM_COL), np.float32), dev))
-    best = float("inf")
+    best = 0.0
     stats = None
     for _ in range(REPS):
+        t0 = time.monotonic()
         parser = create_parser(path, 0, 1, "libsvm", threaded=True,
                                chunk_bytes=CHUNK_BYTES)
         it = DeviceIter(parser, num_col=NUM_COL, batch_size=BATCH,
-                        layout="dense", prefetch=2)
-        t0 = time.monotonic()
-        nbatches = 0
-        last = None
+                        layout="dense", prefetch=4, convert_ahead=6)
+        # the FIRST pull carries pipeline spin-up (producer threads
+        # starting, first chunk parsed) — a per-epoch constant. Its time
+        # stays IN the throughput wall-clock (no free head start), but the
+        # stall counters reset after it so the stall metric speaks to the
+        # steady state, which is what "zero input-bound stalls" is about.
+        nbatches = 1
+        last = next(it)
+        it.stall_seconds = 0.0
+        it.host_stall_seconds = 0.0
         for batch in it:
             last = batch
             nbatches += 1
@@ -116,18 +123,20 @@ def into_hbm_mb_per_sec(path: str, size_mb: float):
         if last is not None:
             jax.block_until_ready(last)
         dt = time.monotonic() - t0
-        if dt < best:
-            best = dt
+        mbps = size_mb / dt
+        if mbps > best:
+            best = mbps
             stats = it.stats()
         it.close()
         log(
             f"bench: into-HBM {nbatches} batches in {dt:.2f}s = "
-            f"{size_mb/dt:.1f} MB/s, "
+            f"{mbps:.1f} MB/s, "
             f"device bytes {it.bytes_to_device/2**20:.1f} MB, "
-            f"stall {it.stall_seconds:.2f}s "
-            f"(host {it.host_stall_seconds:.2f}s)"
+            f"steady-state stall {it.stall_seconds:.3f}s = "
+            f"{100*it.stall_seconds/dt:.1f}% of wall "
+            f"(host {it.host_stall_seconds:.3f}s)"
         )
-    return size_mb / best, stats
+    return best, stats
 
 
 def main() -> None:

@@ -42,6 +42,25 @@ def timed_best(fn, reps: int = REPS) -> float:
     return best
 
 
+def paired_times(fn_a, fn_b, pairs: int = REPS):
+    """Time two legs back-to-back per pair with ALTERNATING order.
+
+    Host speed drifts a few percent over seconds on this shared machine
+    and a fixed order would bias whichever leg runs second — alternation
+    cancels both. Returns (times_a, times_b), aligned by pair, for the
+    caller's statistic of choice (min, median of ratios, ...)."""
+    times_a, times_b = [], []
+    for i in range(pairs):
+        order = [(fn_a, times_a), (fn_b, times_b)]
+        if i % 2:
+            order.reverse()
+        for fn, out in order:
+            t0 = time.monotonic()
+            fn()
+            out.append(time.monotonic() - t0)
+    return times_a, times_b
+
+
 def synth_text(path: str, make_line, target_mb: float = TARGET_MB) -> str:
     """Write `make_line(i) -> str` rows until ~target_mb; cached on disk."""
     if os.path.exists(path) and os.path.getsize(path) >= target_mb * 0.95 * 2**20:

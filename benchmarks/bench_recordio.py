@@ -11,7 +11,7 @@ import os
 
 import numpy as np
 
-from _common import CACHE_DIR, TARGET_MB, emit, log, timed_best
+from _common import CACHE_DIR, TARGET_MB, emit, log, paired_times, timed_best
 
 NPARTS = 4
 REC_KB = 100
@@ -102,8 +102,10 @@ def run() -> None:
     n_py = _consume_indexed(data_p, idx_p, native=False)
     n_nat = _consume_indexed(data_p, idx_p, native=True)
     assert n_nat == n_py, (n_nat, n_py)
-    t_py = timed_best(lambda: _consume_indexed(data_p, idx_p, False))
-    t_nat = timed_best(lambda: _consume_indexed(data_p, idx_p, True))
+    py_times, nat_times = paired_times(
+        lambda: _consume_indexed(data_p, idx_p, False),
+        lambda: _consume_indexed(data_p, idx_p, True), pairs=3)
+    t_py, t_nat = min(py_times), min(nat_times)
     log(f"indexed shuffled python: {idx_mb / t_py:.1f} MB/s, "
         f"native: {idx_mb / t_nat:.1f} MB/s")
     emit("recordio_multipart_mb_per_sec", size_mb / t, "MB/s", size_mb / base,

@@ -10,7 +10,7 @@ pipeline; baseline: 1-shard sequential parse.
 
 import os
 
-from _common import CACHE_DIR, emit, log, synth_text
+from _common import CACHE_DIR, emit, log, paired_times, synth_text
 
 NSHARD = 8
 NCOL = 28
@@ -22,8 +22,6 @@ def _line(i: int) -> str:
 
 
 def run() -> None:
-    import time
-
     from dmlc_tpu.data import create_parser
 
     path = synth_text(os.path.join(CACHE_DIR, "pod_shard.libsvm"), _line)
@@ -47,24 +45,13 @@ def run() -> None:
     n1 = consume(1)
     n8 = consume(NSHARD)
     assert n1 == n8, (n1, n8)  # partition invariant: no loss, no duplication
-    # the ratio is what this config is judged on, and host speed drifts
-    # a few percent over seconds on this shared machine — so measure the
-    # legs back-to-back in pairs (drift within a pair is negligible) and
-    # take the MEDIAN of the per-pair ratios; throughput is best-of
-    ratios = []
-    t = base = float("inf")
-    for i in range(15):
-        # alternate leg order per pair: a fixed order would bias the ratio
-        # with whatever systematic effect favors the second measurement
-        legs = [1, NSHARD] if i % 2 == 0 else [NSHARD, 1]
-        times = {}
-        for n in legs:
-            t0 = time.monotonic()
-            consume(n)
-            times[n] = time.monotonic() - t0
-        ratios.append(times[1] / times[NSHARD])
-        base = min(base, times[1])
-        t = min(t, times[NSHARD])
+    # the ratio is what this config is judged on: alternating back-to-back
+    # pairs (paired_times) cancel host drift and leg-order bias; the
+    # statistic is the MEDIAN of per-pair ratios, throughput is best-of
+    base_times, shard_times = paired_times(
+        lambda: consume(1), lambda: consume(NSHARD), pairs=15)
+    ratios = sorted(b / s for b, s in zip(base_times, shard_times))
+    base, t = min(base_times), min(shard_times)
     ratios.sort()
     ratio = ratios[len(ratios) // 2]
     log(f"1-shard: {size_mb / base:.1f} MB/s ({n1} rows)")

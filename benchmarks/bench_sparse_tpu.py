@@ -68,12 +68,17 @@ def bench_shape(name: str, B: int, K: int, D: int, results: list) -> None:
         print(json.dumps(row), flush=True)
 
     record("ell_xla_gather", time_op(jax.jit(ell_matvec), w, batch))
-    try:
-        record("ell_pallas", time_op(ell_matvec_pallas, w, idx, val))
-    except Exception as exc:  # noqa: BLE001 - record lowering failures
-        results.append({"shape": name, "path": "ell_pallas",
-                        "error": str(exc)[:200]})
-        print(f"# ell_pallas failed: {str(exc)[:120]}", flush=True)
+    # r3: two pallas kernels — the rolled-K one-hot (mid-D band) and the
+    # VMEM-resident-weights gather (the high-D candidate, O(B*K) work)
+    for kern in ("onehot", "gather"):
+        try:
+            record(f"ell_pallas_{kern}",
+                   time_op(lambda w_, i_, v_: ell_matvec_pallas(
+                       w_, i_, v_, kernel=kern), w, idx, val))
+        except Exception as exc:  # noqa: BLE001 - record lowering failures
+            results.append({"shape": name, "path": f"ell_pallas_{kern}",
+                            "error": str(exc)[:200]})
+            print(f"# ell_pallas_{kern} failed: {str(exc)[:120]}", flush=True)
 
     # dense matmul reference (only sensible when a [B, D] dense fits)
     if D <= 8192:

@@ -277,6 +277,17 @@ class NativeIndexedRecordIOSplit(_RecordCursorSplit):
         self._cursor_clear()
         self._epochs = 0
 
+    # target bytes per read batch: bounds producer->consumer buffers to a
+    # cache-friendly size — 25 MB batches (256 x 100KB ImageNet records)
+    # measured 2x slower than ~3 MB ones on a single-core host
+    BATCH_BYTES_TARGET = 4 << 20
+
+    def _effective_batch_records(self) -> int:
+        total = sum(size for _, size in self.index)
+        avg = max(1, total // max(1, len(self.index)))
+        cap = max(1, self.BATCH_BYTES_TARGET // avg)
+        return max(1, min(self.batch_size, cap))
+
     def _ensure_reader(self):
         from dmlc_tpu import native
 
@@ -284,7 +295,8 @@ class NativeIndexedRecordIOSplit(_RecordCursorSplit):
             self._reader = native.IndexedReader(
                 self.paths, self.sizes, [off for off, _ in self.index],
                 self.part_index, self.num_parts,
-                batch_records=self.batch_size, shuffle=self.shuffle,
+                batch_records=self._effective_batch_records(),
+                shuffle=self.shuffle,
                 seed=self.seed, queue_depth=self.queue_depth)
         return self._reader
 

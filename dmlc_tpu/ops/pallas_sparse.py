@@ -29,8 +29,10 @@ from dmlc_tpu.ops.sparse import EllBatch, ell_matvec as _xla_ell_matvec
 
 
 def _ell_kernel(idx_ref, val_ref, w_ref, out_ref):
-    idx = idx_ref[...]          # [bb, K] int32
-    val = val_ref[...]          # [bb, K] f32
+    import jax.experimental.pallas as pl
+
+    num_b = idx_ref.shape[0]
+    num_k = idx_ref.shape[1]
     num_d = w_ref.shape[0]
     iota = jax.lax.broadcasted_iota(jnp.int32, (1, num_d), 1)
 
@@ -38,16 +40,31 @@ def _ell_kernel(idx_ref, val_ref, w_ref, out_ref):
     # slab[b, d] = sum_k val[b, k] * (idx[b, k] == d). Peak VMEM is one
     # [bb, D] slab (the tile size is chosen to keep it ~4MB), not the
     # [bb, K, D] one-hot a fully vectorized form would materialize.
-    # Static unrolled K loop — this Pallas TPU version lowers neither
-    # dynamic_slice nor gathers, but static slices + compares are native.
-    slab = jnp.zeros((idx.shape[0], num_d), jnp.float32)
-    for k in range(idx.shape[1]):
-        idx_k = idx[:, k:k + 1]                               # [bb, 1]
-        val_k = val[:, k:k + 1]
-        slab = slab + val_k * (idx_k == iota).astype(jnp.float32)
+    # K runs through a fori_loop with pl.ds ref reads — r2's statically
+    # unrolled K loop blew up the Mosaic lowering for K >= 64 at D = 4096
+    # (SPARSE_TPU_r02 boundary_probe compile errors); rolled IR is O(1)
+    # in K instead of O(K).
+    def body(k, slab):
+        idx_k = idx_ref[:, pl.ds(k, 1)]                       # [bb, 1]
+        val_k = val_ref[:, pl.ds(k, 1)]
+        return slab + val_k * (idx_k == iota).astype(jnp.float32)
+
+    slab = jax.lax.fori_loop(
+        0, num_k, body, jnp.zeros((num_b, num_d), jnp.float32))
     # full-f32 dot: the MXU's default bf16 operands lose ~1e-2 here
     out_ref[...] = jnp.dot(slab, w_ref[...][:, None],
                            precision=jax.lax.Precision.HIGHEST)  # [bb, 1]
+
+
+def _ell_gather_kernel(idx_ref, val_ref, w_ref, out_ref):
+    # high-D variant: the weight vector stays RESIDENT in VMEM across the
+    # whole batch grid (constant index_map), and the per-element lookup is
+    # a VMEM gather — no one-hot scatter work (O(B*K) instead of O(B*K*D))
+    # and no HBM random reads, which is what bounds XLA's gather lowering.
+    idx = idx_ref[...]                     # [bb, K] int32
+    val = val_ref[...]                     # [bb, K] f32
+    gathered = jnp.take(w_ref[...], idx, axis=0)  # [bb, K]
+    out_ref[...] = jnp.sum(gathered * val, axis=1, keepdims=True)
 
 
 def _pick_block_b(num_b: int, num_d: int, slab_budget: int = 4 << 20) -> int:
@@ -59,7 +76,8 @@ def _pick_block_b(num_b: int, num_d: int, slab_budget: int = 4 << 20) -> int:
     return bb
 
 
-@functools.partial(jax.jit, static_argnames=("block_b", "interpret"))
+@functools.partial(jax.jit,
+                   static_argnames=("block_b", "interpret", "kernel"))
 def ell_matvec_pallas(
     weights: jax.Array,
     indices: jax.Array,
@@ -67,18 +85,31 @@ def ell_matvec_pallas(
     *,
     block_b: int = 0,
     interpret: bool = False,
+    kernel: str = "onehot",
 ) -> jax.Array:
-    """Pallas ELL matvec. block_b=0 picks a VMEM-sized tile automatically."""
+    """Pallas ELL matvec. block_b=0 picks a VMEM-sized tile automatically.
+
+    kernel='onehot': scatter slab + MXU dot — wins in the mid-D band where
+    the slab fits VMEM comfortably. kernel='gather': VMEM-resident weights
+    + in-kernel gather — the high-D (KDD-shaped) candidate, O(B*K) work.
+    """
     from jax.experimental import pallas as pl
 
     num_b, _k = indices.shape
     num_d = weights.shape[0]
     if block_b == 0:
-        block_b = _pick_block_b(num_b, num_d)
+        if kernel == "onehot":
+            block_b = _pick_block_b(num_b, num_d)
+        else:
+            # largest power-of-2 tile (<=256) DIVIDING B — no slab budget
+            # applies, but the grid still needs exact tiling
+            block_b = 1
+            while block_b * 2 <= min(num_b, 256) and num_b % (block_b * 2) == 0:
+                block_b *= 2
     assert num_b % block_b == 0, (num_b, block_b)
     grid = (num_b // block_b,)
     out = pl.pallas_call(
-        _ell_kernel,
+        _ell_kernel if kernel == "onehot" else _ell_gather_kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((block_b, indices.shape[1]), lambda i: (i, 0)),
@@ -97,13 +128,15 @@ def ell_matvec_auto(weights: jax.Array, batch: EllBatch,
     """ELL matvec via pallas on TPU when shapes allow, XLA gather otherwise.
 
     The one-hot kernel does O(B*K*D) compare-multiply work, so it only pays
-    where D is small enough that the HBM gather's latency dominates.
-    Measured on a v5e chip (SPARSE_TPU_r02.json): pallas beats the XLA
-    gather by 10-33% for D <= 2048 (e.g. 17.6us vs 23.4us at HIGGS shapes
-    D=28/K=28), while at D=4096 the unrolled-K lowering starts failing to
-    compile for K >= 64 and at KDD-like D=1M the scatter work would be
-    absurd — those shapes take the XLA gather (14.4us at D=1M/K=16, itself
-    ahead of BCOO's 18.9us).
+    where D is small enough that the HBM gather's latency dominates;
+    measured on a v5e chip it beats the XLA gather by 10-33% for D <= 2048
+    (SPARSE_TPU_r02.json, e.g. 17.6us vs 23.4us at HIGGS D=28/K=28). r3
+    replaced r02's statically-unrolled K loop (which failed to compile for
+    K >= 64 at D = 4096) with a rolled fori_loop and added a second
+    'gather' kernel (VMEM-resident weights, O(B*K) work) as the high-D
+    candidate — the routing gate below still reflects the r02
+    measurements and is re-evaluated against SPARSE_TPU_r03 once both
+    kernels are timed on hardware.
     """
     num_b = batch.indices.shape[0]
     if use_pallas is None:

@@ -333,6 +333,24 @@ def test_pallas_ell_matvec_matches_xla():
                             block_b=64, interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
+    # high-D gather kernel: same contraction via VMEM-resident weights
+    got_g = ell_matvec_pallas(w, ell.indices, ell.values,
+                              block_b=64, interpret=True, kernel="gather")
+    np.testing.assert_allclose(np.asarray(got_g), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    # K large enough that r2's unrolled lowering used to blow up (K=64):
+    # the rolled fori_loop kernel must stay numerically identical
+    K2 = 64
+    idx2 = rng.integers(0, D, size=(B, K2)).astype(np.int32)
+    val2 = rng.normal(size=(B, K2)).astype(np.float32)
+    ell2 = EllBatch(jnp.asarray(idx2), jnp.asarray(val2),
+                    jnp.zeros(B), jnp.ones(B))
+    want2 = ell_matvec(w, ell2)
+    for kern in ("onehot", "gather"):
+        got2 = ell_matvec_pallas(w, ell2.indices, ell2.values,
+                                 block_b=64, interpret=True, kernel=kern)
+        np.testing.assert_allclose(np.asarray(got2), np.asarray(want2),
+                                   rtol=1e-4, atol=1e-4)
 
 
 def test_softmax_learner_sharded():
