@@ -90,6 +90,7 @@ class DeviceIter:
         drop_remainder: bool = False,
         device=None,
         elide_unit_values: bool = False,
+        x_dtype: str = "float32",
     ):
         check(layout in ("dense", "ell", "bcoo"), f"unknown layout {layout!r}")
         check(batch_size is not None or layout == "bcoo",
@@ -114,6 +115,14 @@ class DeviceIter:
         # device op per batch, which pays on a TPU-VM but loses on hosts
         # where per-op dispatch is expensive (e.g. a tunneled device).
         self.elide_unit_values = bool(elide_unit_values)
+        # 'bfloat16' ships dense x at half the bytes in the MXU's preferred
+        # operand width; the native repack converts in its single copy pass,
+        # the python fallback converts per block (round-to-nearest-even)
+        check(x_dtype in ("float32", "bfloat16"),
+              f"unknown x_dtype {x_dtype!r}")
+        check(x_dtype == "float32" or layout == "dense",
+              "x_dtype='bfloat16' applies to the dense layout only")
+        self.x_dtype = x_dtype
         self._skip_blocks = 0  # producer-put resume: blocks to drop unput
         self.stall_seconds = 0.0        # consumer wait for a ready batch
         self.host_stall_seconds = 0.0   # of which: waiting on host convert
@@ -123,11 +132,13 @@ class DeviceIter:
         self._trace = os.environ.get("DMLC_TPU_TRACE", "0") == "1"
         if layout == "dense" and hasattr(source, "set_emit_dense"):
             # ask the parser for HBM-ready dense batches (skips CSR), repacked
-            # to this batch size off-GIL when the native reader is in play;
-            # safe to ignore the answer — _host_batches_dense handles all kinds
+            # to this batch size (and target dtype) off-GIL when the native
+            # reader is in play; safe to ignore the answer —
+            # _host_batches_dense handles all kinds
             try:
-                source.set_emit_dense(num_col, batch_rows=batch_size)
-            except TypeError:  # sources without the batch_rows extension
+                source.set_emit_dense(num_col, batch_rows=batch_size,
+                                      dtype=x_dtype)
+            except TypeError:  # sources without the extended signature
                 source.set_emit_dense(num_col)
         # the host pipeline starts LAZILY on first pull: load_state must be
         # able to arm the skip-counter before the producer thread begins
@@ -239,6 +250,7 @@ class DeviceIter:
         instead of merging CSR containers and re-slicing, which costs several
         copies of all seven RowBlock arrays per batch on the host core."""
         B = self.batch_size
+        xdt = self._x_np_dtype()
         parts: list = []  # [(x, y, w)] pending, total rows < B after drain
         pending = 0
         emitted = 0
@@ -246,9 +258,15 @@ class DeviceIter:
             if isinstance(block, DenseBlock):
                 w = (block.weight if block.weight is not None
                      else np.ones(len(block), np.float32))
-                parts.append((block.x, block.label, w))
+                x = block.x
+                if x.dtype != xdt:  # python fallback block in target dtype
+                    x = x.astype(xdt)
+                parts.append((x, block.label, w))
             else:
-                parts.append(block_to_dense(block, self.num_col, copy=False))
+                x, y, w = block_to_dense(block, self.num_col, copy=False)
+                if x.dtype != xdt:
+                    x = x.astype(xdt)
+                parts.append((x, y, w))
             pending += len(parts[-1][1])
             while pending >= B:
                 xs, ys, ws = zip(*parts)
@@ -269,7 +287,7 @@ class DeviceIter:
             y = np.concatenate(ys) if len(ys) > 1 else ys[0]
             w = np.concatenate(ws) if len(ws) > 1 else ws[0]
             n = len(y)
-            xp = np.zeros((B, self.num_col), np.float32)
+            xp = np.zeros((B, self.num_col), xdt)
             xp[:n] = x
             yp = np.zeros(B, np.float32)
             yp[:n] = y
@@ -278,6 +296,13 @@ class DeviceIter:
             emitted += n
             self._push_annot(emitted)
             yield ("dense", xp, yp, wp)
+
+    def _x_np_dtype(self):
+        if self.x_dtype == "bfloat16":
+            from dmlc_tpu.native import bf16_dtype
+
+            return bf16_dtype()
+        return np.dtype(np.float32)
 
     def _convert(self, block: RowBlock):
         pad = (self.batch_size

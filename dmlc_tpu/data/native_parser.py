@@ -103,6 +103,7 @@ class NativeStreamParser(Parser):
         self._init_source(uri)
         self._reader = None
         self._emit_dense: Optional[int] = None
+        self._emit_bf16 = False
         self._stall = 0.0
         self._blocks_out = 0  # delivered blocks, for count-based resume
         self._batch_rows = 0
@@ -114,17 +115,21 @@ class NativeStreamParser(Parser):
 
     # ---------------- configuration ----------------
 
-    def set_emit_dense(self, num_col: int, batch_rows: int = 0) -> bool:
+    def set_emit_dense(self, num_col: int, batch_rows: int = 0,
+                       dtype: str = "float32") -> bool:
         """Emit DenseBlock batches straight from the native dense scanner.
         With ``batch_rows``, the native reader additionally repacks rows
         into exact [batch_rows, num_col] blocks off-GIL (the consumer can
-        then slice views instead of concatenating). Must be called before
-        the first pull (the reader pipeline starts lazily). libfm has no
-        dense analog."""
+        then slice views instead of concatenating); ``dtype='bfloat16'``
+        makes that repack pass emit bf16 x — half the host->HBM bytes in
+        the MXU's preferred operand width. Must be called before the first
+        pull (the reader pipeline starts lazily). libfm has no dense
+        analog."""
         if self._reader is not None or self.fmt_name == "libfm":
             return False
         self._emit_dense = int(num_col)
         self._batch_rows = int(batch_rows)
+        self._emit_bf16 = dtype == "bfloat16"
         return True
 
     # ---------------- pipeline ----------------
@@ -151,6 +156,7 @@ class NativeStreamParser(Parser):
             batch_rows=self._batch_rows if repack else 0,
             label_col=getattr(self.param, "label_column", -1),
             weight_col=getattr(self.param, "weight_column", -1),
+            out_bf16=bool(repack and self._batch_rows and self._emit_bf16),
         )
         return fmt, kwargs
 

@@ -117,10 +117,12 @@ class TextParserBase(Parser):
         self._native = None  # tri-state: None=unprobed, False=off, True=on
         self._emit_dense: Optional[int] = None  # num_col when dense mode is on
 
-    def set_emit_dense(self, num_col: int) -> bool:
+    def set_emit_dense(self, num_col: int, batch_rows: int = 0,
+                       dtype: str = "float32") -> bool:
         """Opt in to emitting DenseBlock batches straight from the scanner
         (the TPU-first layout fast path). Returns False when this parser has
-        no dense scanner; callers then get RowBlocks as usual."""
+        no dense scanner; callers then get RowBlocks as usual. batch_rows
+        and dtype are honored only by the fully-native stream parser."""
         return False
 
     def use_native(self) -> bool:
@@ -279,7 +281,8 @@ class LibSVMParser(TextParserBase):
         self.param.init(dict(args or {}), allow_unknown=True)
         check(self.param.format == "libsvm", "LibSVMParser: format must be libsvm")
 
-    def set_emit_dense(self, num_col: int) -> bool:
+    def set_emit_dense(self, num_col: int, batch_rows: int = 0,
+                       dtype: str = "float32") -> bool:
         if self.use_native():
             self._emit_dense = int(num_col)
             return True
@@ -402,7 +405,8 @@ class CSVParser(TextParserBase):
         # the native csv scanner emits float32 cells only
         return self.param.dtype == "float32"
 
-    def set_emit_dense(self, num_col: int) -> bool:
+    def set_emit_dense(self, num_col: int, batch_rows: int = 0,
+                       dtype: str = "float32") -> bool:
         if self._native_supported() and self.use_native():
             self._emit_dense = int(num_col)
             return True
@@ -583,12 +587,16 @@ class ThreadedParser(Parser):
             return False, None
         return True, block
 
-    def set_emit_dense(self, num_col: int) -> bool:
+    def set_emit_dense(self, num_col: int, batch_rows: int = 0,
+                       dtype: str = "float32") -> bool:
         if self._iter is not None:
             # producer already running: flipping modes mid-stream would mix
             # block kinds racily, so decline — callers handle RowBlocks too
             return False
-        return self.base.set_emit_dense(num_col)
+        try:
+            return self.base.set_emit_dense(num_col, batch_rows, dtype)
+        except TypeError:  # legacy one-arg bases keep working when wrapped
+            return self.base.set_emit_dense(num_col)
 
     def next_block(self) -> Optional[RowBlock]:
         block = self._ensure_iter().next()

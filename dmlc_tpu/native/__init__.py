@@ -35,7 +35,7 @@ _HDRS = [os.path.join(_SRC_DIR, f)
          for f in ("api.h", "strtonum.h", "parse_internal.h")]
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 _SO_PATH = os.path.join(_BUILD_DIR, "libdmlc_tpu_native.so")
-_ABI_VERSION = 10
+_ABI_VERSION = 11
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -66,6 +66,7 @@ class _DenseResult(ctypes.Structure):
         ("weight", ctypes.POINTER(ctypes.c_float)),
         ("error", ctypes.c_char_p),
         ("needs_csr", ctypes.c_int32),
+        ("x_bf16", ctypes.c_int32),
     ]
 
 
@@ -213,7 +214,7 @@ def _declare(lib: ctypes.CDLL) -> None:
         ctypes.c_int32, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
         ctypes.c_int64, ctypes.c_int32, ctypes.c_char, ctypes.c_int32,
         ctypes.c_int64, ctypes.c_int32, ctypes.c_int64, ctypes.c_int32,
-        ctypes.c_int32]
+        ctypes.c_int32, ctypes.c_int32]
     lib.dmlc_reader_next.restype = ctypes.c_void_p
     lib.dmlc_reader_next.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32)]
@@ -227,7 +228,7 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.dmlc_feeder_create.argtypes = [
         ctypes.c_int32, ctypes.c_int64, ctypes.c_int32, ctypes.c_char,
         ctypes.c_int32, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
-        ctypes.c_int32, ctypes.c_int32]
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32]
     lib.dmlc_feeder_push.restype = ctypes.c_int32
     lib.dmlc_feeder_push.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
@@ -396,13 +397,22 @@ def _wrap_dense(lib, res, num_col: int):
         raise NeedsCsrError(msg) if needs_csr else DMLCError(msg)
     owner = _Owner(lib, res, _free_dense)
     n = r.n_rows
+    x_dtype = bf16_dtype() if r.x_bf16 else np.float32
     if n == 0:
-        return (np.zeros((0, num_col), np.float32),
+        return (np.zeros((0, num_col), x_dtype),
                 np.empty(0, np.float32), None, owner)
-    x = _view(r.x, n * num_col, np.float32, owner).reshape(n, num_col)
+    x = _view(r.x, n * num_col, x_dtype, owner).reshape(n, num_col)
     label = _view(r.label, n, np.float32, owner)
     weight = _view(r.weight, n, np.float32, owner)
     return x, label, weight, owner
+
+
+def bf16_dtype():
+    """bfloat16 as a numpy dtype (ml_dtypes ships with jax) — the ONE
+    lookup shared by the native view wrapper and the Python fallbacks."""
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
 
 
 def parse_csv(chunk: bytes, delimiter: str = ",", nthread: int = 0):
@@ -507,7 +517,7 @@ class Reader:
                  delimiter: str = ",", nthread: int = 0,
                  chunk_bytes: int = 1 << 20, queue_depth: int = 4,
                  batch_rows: int = 0, label_col: int = -1,
-                 weight_col: int = -1):
+                 weight_col: int = -1, out_bf16: bool = False):
         lib = _load()
         if lib is None:
             raise DMLCError("native core unavailable")
@@ -521,7 +531,7 @@ class Reader:
             arr_p, arr_s, len(paths), part_index, num_parts, fmt, num_col,
             indexing_mode, delimiter.encode()[0] if delimiter else b","[0],
             nthread or default_nthread(), chunk_bytes, queue_depth,
-            batch_rows, label_col, weight_col)
+            batch_rows, label_col, weight_col, 1 if out_bf16 else 0)
         if not self._h:
             raise DMLCError(
                 "native reader creation failed (out of memory or threads)")
@@ -583,7 +593,7 @@ class Feeder:
                  delimiter: str = ",", nthread: int = 0,
                  chunk_bytes: int = 1 << 20, queue_depth: int = 4,
                  batch_rows: int = 0, label_col: int = -1,
-                 weight_col: int = -1):
+                 weight_col: int = -1, out_bf16: bool = False):
         lib = _load()
         if lib is None:
             raise DMLCError("native core unavailable")
@@ -594,7 +604,7 @@ class Feeder:
             fmt, num_col, indexing_mode,
             delimiter.encode()[0] if delimiter else b","[0],
             nthread or default_nthread(), chunk_bytes, queue_depth,
-            batch_rows, label_col, weight_col)
+            batch_rows, label_col, weight_col, 1 if out_bf16 else 0)
         if not self._h:
             raise DMLCError("native feeder creation failed")
 

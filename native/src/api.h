@@ -28,13 +28,15 @@ struct CsrBlockResult {
 struct DenseResult {
   int64_t n_rows;
   int64_t n_cols;
-  float* x;       // [n_rows, n_cols]
+  float* x;       // [n_rows, n_cols]; bf16 (uint16) payload when x_bf16 = 1
   float* label;   // [n_rows]
   float* weight;  // [n_rows] or null
   char* error;    // null on success
   int32_t needs_csr;  // 1 = data needs the CSR path (e.g. qid rows); error is
                       // also set. Explicit flag so callers never route on
                       // error-message wording.
+  int32_t x_bf16;     // 1 = x holds bfloat16 (the TPU-native ingest format:
+                      // half the host->HBM bytes, MXU-preferred operand)
 };
 
 // Dense CSV result: cells laid out row-major [n_rows, n_cols].
@@ -91,13 +93,16 @@ int dmlc_native_abi_version();
 // rows into exact [batch_rows, num_col] dense blocks off the consumer
 // thread (final block may be short). For csv, label_col/weight_col (-1 =
 // absent) are split out and the remaining cells padded/truncated to
-// num_col; results then carry format 1 (dense).
+// num_col; results then carry format 1 (dense). out_bf16 = 1 converts x
+// to bfloat16 (round-to-nearest-even) DURING the repack copy — the same
+// single pass, half the output bytes.
 void* dmlc_reader_create(const char** paths, const int64_t* sizes,
                          int32_t nfiles, int64_t part_index, int64_t num_parts,
                          int32_t format, int64_t num_col, int32_t indexing_mode,
                          char delim, int32_t nthread, int64_t chunk_bytes,
                          int32_t queue_depth, int64_t batch_rows,
-                         int32_t label_col, int32_t weight_col);
+                         int32_t label_col, int32_t weight_col,
+                         int32_t out_bf16);
 // Next parsed block; NULL at end-of-partition or on reader error (check
 // dmlc_reader_error). Parse errors ride the result's own error field.
 // Blocks with zero rows are never returned. `fmt_out` (may be NULL)
@@ -152,7 +157,7 @@ void* dmlc_feeder_create(int32_t format, int64_t num_col,
                          int32_t indexing_mode, char delim, int32_t nthread,
                          int64_t chunk_bytes, int32_t queue_depth,
                          int64_t batch_rows, int32_t label_col,
-                         int32_t weight_col);
+                         int32_t weight_col, int32_t out_bf16);
 // 0 = accepted; -1 = reader stopped/failed (check dmlc_feeder_error).
 int32_t dmlc_feeder_push(void* handle, const char* data, int64_t len);
 // Signal end of input: the pipeline flushes its tail and then next()

@@ -795,6 +795,6 @@ void dmlc_free_csv(CsvResult* r) {
   free(r);
 }
 
-int dmlc_native_abi_version() { return 10; }
+int dmlc_native_abi_version() { return 11; }
 
 }  // extern "C"

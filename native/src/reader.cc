@@ -105,6 +105,19 @@ const char* result_error(int format, void* res) {
 
 inline bool is_eol(char c) { return c == '\n' || c == '\r'; }
 
+// f32 -> bf16 with round-to-nearest-even (the TPU-native ingest format:
+// half the host->HBM bytes; the MXU's preferred operand width)
+inline uint16_t f32_to_bf16(float f) {
+  uint32_t bits;
+  memcpy(&bits, &f, 4);
+  bits += 0x7fffu + ((bits >> 16) & 1u);
+  return static_cast<uint16_t>(bits >> 16);
+}
+
+inline void convert_row_bf16(uint16_t* dst, const float* src, size_t n) {
+  for (size_t i = 0; i < n; ++i) dst[i] = f32_to_bf16(src[i]);
+}
+
 // ---------------- recordio framing helpers ----------------
 
 constexpr uint32_t kRecMagic = 0xced7230a;
@@ -134,7 +147,7 @@ class LineReader {
              int64_t part_index, int64_t num_parts, int format,
              int64_t num_col, int indexing_mode, char delim, int nthread,
              int64_t chunk_bytes, int queue_depth, int64_t batch_rows,
-             int32_t label_col, int32_t weight_col)
+             int32_t label_col, int32_t weight_col, bool out_bf16 = false)
       : paths_(std::move(paths)),
         format_(format),
         num_col_(num_col),
@@ -145,7 +158,8 @@ class LineReader {
         queue_depth_(queue_depth < 1 ? 1 : queue_depth),
         batch_rows_(batch_rows > 0 ? batch_rows : 0),
         label_col_(label_col),
-        weight_col_(weight_col) {
+        weight_col_(weight_col),
+        out_bf16_(out_bf16 && batch_rows > 0) {
     file_offset_.push_back(0);
     for (size_t i = 0; i < sizes.size(); ++i) {
       if (format_ >= kFmtRecordIO && sizes[i] % 4 != 0) {
@@ -167,7 +181,8 @@ class LineReader {
   // Push-mode constructor: bytes arrive via push() instead of local files.
   LineReader(int format, int64_t num_col, int indexing_mode, char delim,
              int nthread, int64_t chunk_bytes, int queue_depth,
-             int64_t batch_rows, int32_t label_col, int32_t weight_col)
+             int64_t batch_rows, int32_t label_col, int32_t weight_col,
+             bool out_bf16 = false)
       : format_(format),
         num_col_(num_col),
         indexing_mode_(indexing_mode),
@@ -178,6 +193,7 @@ class LineReader {
         batch_rows_(batch_rows > 0 ? batch_rows : 0),
         label_col_(label_col),
         weight_col_(weight_col),
+        out_bf16_(out_bf16 && batch_rows > 0),
         push_mode_(true) {
     file_offset_.push_back(0);
     start();
@@ -899,8 +915,10 @@ class LineReader {
     auto* out = static_cast<DenseResult*>(calloc(1, sizeof(DenseResult)));
     if (!out) return nullptr;
     out->n_cols = num_col_;
+    out->x_bf16 = out_bf16_ ? 1 : 0;
     out->x = static_cast<float*>(
-        malloc(static_cast<size_t>(batch_rows_) * num_col_ * sizeof(float)));
+        malloc(static_cast<size_t>(batch_rows_) * num_col_ *
+               (out_bf16_ ? sizeof(uint16_t) : sizeof(float))));
     out->label =
         static_cast<float*>(malloc(static_cast<size_t>(batch_rows_) * sizeof(float)));
     bool ok = out->x && out->label;
@@ -968,10 +986,19 @@ class LineReader {
       }
       size_t space = static_cast<size_t>(batch_rows_ - cur_rows_);
       size_t take = n - done < space ? n - done : space;
-      float* dst = cur_->x + static_cast<size_t>(cur_rows_) * ncol;
       const float* src = x + done * stride + off;
-      for (size_t i = 0; i < take; ++i) {
-        memcpy(dst + i * ncol, src + i * stride, ncol * sizeof(float));
+      if (out_bf16_) {
+        // the single repack pass doubles as the f32->bf16 conversion
+        uint16_t* dst16 = reinterpret_cast<uint16_t*>(cur_->x) +
+                          static_cast<size_t>(cur_rows_) * ncol;
+        for (size_t i = 0; i < take; ++i) {
+          convert_row_bf16(dst16 + i * ncol, src + i * stride, ncol);
+        }
+      } else {
+        float* dst = cur_->x + static_cast<size_t>(cur_rows_) * ncol;
+        for (size_t i = 0; i < take; ++i) {
+          memcpy(dst + i * ncol, src + i * stride, ncol * sizeof(float));
+        }
       }
       memcpy(cur_->label + cur_rows_, label + done, take * sizeof(float));
       if (cur_has_weight_) {
@@ -1036,13 +1063,23 @@ class LineReader {
         cur_->label[cur_rows_ + r] = label_col_ >= 0 ? row[label_col_] : 0.0f;
         if (cur_has_weight_)
           cur_->weight[cur_rows_ + r] = has_w ? row[weight_col_] : 1.0f;
-        float* dst = cur_->x + static_cast<size_t>(cur_rows_ + r) * num_col_;
         int64_t k = 0;
-        for (int64_t c = 0; c < ncol && k < num_col_; ++c) {
-          if (c == label_col_ || c == weight_col_) continue;
-          dst[k++] = row[c];
+        if (out_bf16_) {
+          uint16_t* dst16 = reinterpret_cast<uint16_t*>(cur_->x) +
+                            static_cast<size_t>(cur_rows_ + r) * num_col_;
+          for (int64_t c = 0; c < ncol && k < num_col_; ++c) {
+            if (c == label_col_ || c == weight_col_) continue;
+            dst16[k++] = f32_to_bf16(row[c]);
+          }
+          while (k < num_col_) dst16[k++] = 0;  // bf16 zero is all-zero bits
+        } else {
+          float* dst = cur_->x + static_cast<size_t>(cur_rows_ + r) * num_col_;
+          for (int64_t c = 0; c < ncol && k < num_col_; ++c) {
+            if (c == label_col_ || c == weight_col_) continue;
+            dst[k++] = row[c];
+          }
+          while (k < num_col_) dst[k++] = 0.0f;  // x is malloc'd, not zeroed
         }
-        while (k < num_col_) dst[k++] = 0.0f;  // batch x is malloc'd, not zeroed
       }
       cur_rows_ += take;
       done += take;
@@ -1129,6 +1166,7 @@ class LineReader {
   int64_t batch_rows_ = 0;
   int32_t label_col_ = -1;   // csv->dense: label/weight column extraction
   int32_t weight_col_ = -1;  // (csv_parser.h label_column/weight_column)
+  bool out_bf16_ = false;    // emit x as bfloat16 (batch repack mode only)
   DenseResult* cur_ = nullptr;  // in-progress output batch (producer-owned)
   int64_t cur_rows_ = 0;
   bool cur_has_weight_ = false;
@@ -1512,14 +1550,15 @@ void* dmlc_reader_create(const char** paths, const int64_t* sizes,
                          int32_t format, int64_t num_col, int32_t indexing_mode,
                          char delim, int32_t nthread, int64_t chunk_bytes,
                          int32_t queue_depth, int64_t batch_rows,
-                         int32_t label_col, int32_t weight_col) {
+                         int32_t label_col, int32_t weight_col,
+                         int32_t out_bf16) {
   try {
     std::vector<std::string> p(paths, paths + nfiles);
     std::vector<int64_t> s(sizes, sizes + nfiles);
     return new LineReader(std::move(p), std::move(s), part_index, num_parts,
                           format, num_col, indexing_mode, delim, nthread,
                           chunk_bytes, queue_depth, batch_rows, label_col,
-                          weight_col);
+                          weight_col, out_bf16 != 0);
   } catch (...) {
     // alloc/thread-spawn failure must not cross the extern "C" boundary
     // (std::terminate); null tells the caller creation failed
@@ -1551,11 +1590,11 @@ void* dmlc_feeder_create(int32_t format, int64_t num_col,
                          int32_t indexing_mode, char delim, int32_t nthread,
                          int64_t chunk_bytes, int32_t queue_depth,
                          int64_t batch_rows, int32_t label_col,
-                         int32_t weight_col) {
+                         int32_t weight_col, int32_t out_bf16) {
   try {
     return new LineReader(format, num_col, indexing_mode, delim, nthread,
                           chunk_bytes, queue_depth, batch_rows, label_col,
-                          weight_col);
+                          weight_col, out_bf16 != 0);
   } catch (...) {
     return nullptr;
   }

@@ -72,7 +72,8 @@ int main() {
   int64_t sizes[] = {size};
   void* r = dmlc_reader_create(paths, sizes, 1, 0, 1, /*fmt=*/0, 0, 0, ',',
                                2, 4096, 2, /*batch_rows=*/0,
-                               /*label_col=*/-1, /*weight_col=*/-1);
+                               /*label_col=*/-1, /*weight_col=*/-1,
+                               /*out_bf16=*/0);
   CHECK_TRUE(r != nullptr);
   for (int pass = 0; pass < 2; ++pass) {
     int64_t rows = 0;
@@ -153,7 +154,7 @@ int main() {
     remove(rpath);
   }
 
-  CHECK_TRUE(dmlc_native_abi_version() == 10);
+  CHECK_TRUE(dmlc_native_abi_version() == 11);
   if (failures == 0) std::printf("native_smoke: all checks passed\n");
   return failures == 0 ? 0 : 1;
 }

@@ -652,3 +652,69 @@ def test_checkpoint_in_second_epoch_after_reset(tmp_path):
     assert len(rest) == len(full) - 3
     for a, b in zip(rest, full[3:]):
         np.testing.assert_allclose(a, b)
+
+
+# ---------------- bf16 ingest ----------------
+
+@pytest.mark.parametrize("threaded", [False, True])
+def test_device_iter_bf16_dense(tmp_path, threaded):
+    """x_dtype='bfloat16': half the transfer bytes, values equal to the
+    f32 pipeline within bf16 rounding — native repack and python fallback."""
+    import ml_dtypes
+
+    uri = _libsvm_corpus(tmp_path, n=64, d=6)
+
+    def run(x_dtype):
+        parser = create_parser(uri, 0, 1, "libsvm", threaded=threaded)
+        it = DeviceIter(parser, num_col=6, batch_size=16, layout="dense",
+                        x_dtype=x_dtype)
+        out = [(np.asarray(x), np.asarray(y)) for x, y, w in it]
+        bytes_ = it.stats()["bytes_to_device"]
+        it.close()
+        return out, bytes_
+
+    f32, bytes_f32 = run("float32")
+    bf16, bytes_bf16 = run("bfloat16")
+    assert len(bf16) == len(f32) == 4
+    for (xb, yb), (xf, yf) in zip(bf16, f32):
+        assert xb.dtype == np.dtype(ml_dtypes.bfloat16)
+        np.testing.assert_allclose(
+            np.asarray(xb, dtype=np.float32), xf, rtol=1 / 128)
+        np.testing.assert_array_equal(yb, yf)  # labels stay f32
+    # x shrinks by exactly half; labels/weights stay f32
+    n_x_f32 = sum(x.size * 4 for x, _ in f32)
+    assert bytes_f32 - bytes_bf16 == n_x_f32 // 2, (bytes_bf16, bytes_f32)
+
+
+def test_native_bf16_repack_matches_f32(tmp_path):
+    """The C++ repack's round-to-nearest-even conversion A/B'd directly."""
+    from dmlc_tpu import native
+
+    if not native.available():
+        pytest.skip("native core unavailable")
+    import ml_dtypes
+
+    path = tmp_path / "bf.libsvm"
+    rng = np.random.default_rng(12)
+    with open(path, "w") as f:
+        for i in range(500):
+            feats = " ".join(f"{j}:{rng.normal():.6f}" for j in range(8))
+            f.write(f"{i % 2} {feats}\n")
+    from dmlc_tpu.data.native_parser import NativeStreamParser
+
+    def collect(dtype):
+        p = NativeStreamParser(str(path), {}, 0, 1, "libsvm")
+        assert p.set_emit_dense(8, batch_rows=64, dtype=dtype)
+        xs = []
+        while (b := p.next_block()) is not None:
+            xs.append(np.asarray(b.x))
+        p.close()
+        return np.concatenate(xs)
+
+    x32 = collect("float32")
+    x16 = collect("bfloat16")
+    assert x16.dtype == np.dtype(ml_dtypes.bfloat16)
+    assert x16.shape == x32.shape
+    # C++ rne conversion must equal numpy/ml_dtypes' own rne cast exactly
+    np.testing.assert_array_equal(
+        x16.view(np.uint16), x32.astype(ml_dtypes.bfloat16).view(np.uint16))
