@@ -122,6 +122,7 @@ class LinearLearner:
         self.opt_state = self.opt.init(self.params)
         self._step = self._build_step()
         self._predict = self._build_predict()
+        self._accuracy = self._build_accuracy()
 
     def batch_shardings(self):
         """Batch placement for a DeviceIter feeding this learner (or None)."""
@@ -203,26 +204,65 @@ class LinearLearner:
 
         return jax.jit(predict)
 
+    def _build_accuracy(self):
+        """Jitted (correct_weighted, total_weight) over one batch.
+
+        The reduction stays ON DEVICE with replicated scalar outputs, so it
+        works for mesh-global batches spanning processes — fetching the
+        per-row margin to the host (the old path) is impossible there
+        (non-addressable shards)."""
+        def acc_fn(params, batch):
+            if self.layout == "ell":
+                margin = _margin_ell(params, batch)
+                label, weight = batch.label, batch.weight
+            else:
+                x, label, weight = batch
+                margin = _margin_dense(params, x)
+            if self.num_class > 1:
+                pred = jnp.argmax(margin, axis=-1).astype(jnp.float32)
+            else:
+                pred = (margin > 0).astype(jnp.float32)
+            correct = ((pred == label) * weight).sum()
+            total = weight.sum()
+            return correct, total
+
+        if self.mesh is None:
+            return jax.jit(acc_fn)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rep = NamedSharding(self.mesh, P())
+        return jax.jit(acc_fn, out_shardings=(rep, rep))
+
     # ---------------- public API ----------------
 
     def step(self, batch) -> float:
         self.params, self.opt_state, loss = self._step(self.params, self.opt_state, batch)
         return loss
 
-    def fit_epoch(self, device_iter) -> Tuple[float, int]:
-        """One pass over a DeviceIter; returns (mean loss, batches)."""
+    def fit_epoch(self, device_iter, max_steps=None) -> Tuple[float, int]:
+        """One pass over a DeviceIter; returns (mean loss, batches).
+
+        ``max_steps`` caps the pass — REQUIRED for multi-process data
+        parallelism when shards can hold unequal batch counts: every
+        process must run the same number of collective steps or the pod
+        deadlocks. Agree on the cap with
+        :func:`dmlc_tpu.parallel.sync_min` first.
+        """
         total, n = 0.0, 0
         for batch in device_iter:
             loss = self.step(batch)
             total += float(loss)
             n += 1
+            if max_steps is not None and n >= max_steps:
+                break
         device_iter.reset()
         return (total / max(n, 1)), n
 
-    def fit(self, device_iter, epochs: int = 1, log_fn=None) -> "LinearLearner":
+    def fit(self, device_iter, epochs: int = 1, log_fn=None,
+            steps_per_epoch=None) -> "LinearLearner":
         for epoch in range(epochs):
             t0 = get_time()
-            loss, nb = self.fit_epoch(device_iter)
+            loss, nb = self.fit_epoch(device_iter, max_steps=steps_per_epoch)
             if log_fn:
                 log_fn(epoch, loss, nb, get_time() - t0)
         return self
@@ -230,20 +270,21 @@ class LinearLearner:
     def predict(self, batch) -> jax.Array:
         return self._predict(self.params, batch)
 
-    def accuracy(self, device_iter) -> float:
-        """Classification accuracy over one pass (logistic objective)."""
+    def accuracy(self, device_iter, max_steps=None) -> float:
+        """Classification accuracy over one pass (logistic objective).
+
+        ``max_steps``: same SPMD step-count contract as :meth:`fit_epoch`
+        (the per-batch metric executes collectives over mesh-global
+        batches; outputs are replicated scalars, addressable everywhere).
+        """
         correct, total = 0.0, 0.0
+        n = 0
         for batch in device_iter:
-            margin = np.asarray(self.predict(batch))
-            if self.layout == "ell":
-                label, weight = np.asarray(batch.label), np.asarray(batch.weight)
-            else:
-                label, weight = np.asarray(batch[1]), np.asarray(batch[2])
-            if self.num_class > 1:
-                pred = margin.argmax(axis=-1).astype(np.float32)
-            else:
-                pred = (margin > 0).astype(np.float32)
-            correct += float(((pred == label) * weight).sum())
-            total += float(weight.sum())
+            c, t = self._accuracy(self.params, batch)
+            correct += float(c)
+            total += float(t)
+            n += 1
+            if max_steps is not None and n >= max_steps:
+                break  # mirror fit_epoch: no extra batch pulled past the cap
         device_iter.reset()
         return correct / max(total, 1.0)

@@ -86,3 +86,28 @@ def init_from_env(
     )
     _INITIALIZED = True
     return contract
+
+
+def sync_min(value: int) -> int:
+    """All-process minimum of a host integer (1 tiny collective).
+
+    The SPMD safety primitive for data-parallel epochs: byte-range shards
+    rarely hold identical batch counts, and a process that runs one more
+    collective step than its peers deadlocks the pod. Agreeing on
+    ``min(local_steps)`` up front keeps every process executing the same
+    program the same number of times. Single-process: returns ``value``.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return int(value)
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("p",))
+    local = np.full((jax.local_device_count(),), int(value), np.int64)
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("p")), local)
+    out = jax.jit(jnp.min, out_shardings=NamedSharding(mesh, P()))(arr)
+    return int(out)

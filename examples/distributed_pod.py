@@ -1,0 +1,119 @@
+"""Multi-process distributed training via dmlc-submit --cluster tpu-pod.
+
+The full distributed recipe in one file, runnable WITHOUT a pod (local
+multi-process simulation on the CPU backend — the same code path a real
+TPU pod slice takes, where each host runs one process and collectives ride
+ICI instead of a loopback mesh):
+
+    python examples/distributed_pod.py            # launches itself 2-way
+
+What happens (SURVEY.md §2.4's control/data-plane split):
+ 1. the launcher starts the rabit tracker and spawns one worker process
+    per "host" with the DMLC_* env contract
+    (tracker/dmlc_tracker/tracker.py:178-184 is the reference analog);
+ 2. each worker calls :func:`dmlc_tpu.parallel.init_from_env`, which maps
+    that contract onto ``jax.distributed.initialize`` (coordinator =
+    tracker host, port + 1) — the whole rank-brokering protocol the
+    reference runs over sockets collapses into this one call;
+ 3. each worker parses ITS OWN InputSplit shard (shard index = process
+    index, SURVEY.md §2.3 row 1), feeds batches through DeviceIter, and
+    the jitted SGD step psums gradients across all processes' devices.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+NUM_COL = 8
+ROWS = 2048
+
+
+def make_corpus(path: str) -> None:
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=NUM_COL)
+    with open(path, "w") as f:
+        for _ in range(ROWS):
+            x = rng.normal(size=NUM_COL)
+            y = int(x @ w_true > 0)
+            feats = " ".join(f"{j}:{x[j]:.5f}" for j in range(NUM_COL))
+            f.write(f"{y} {feats}\n")
+
+
+def worker() -> None:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # honor the launcher's platform pin via jax.config too: on hosts
+        # whose sitecustomize registers extra PJRT plugins at interpreter
+        # start, the env var alone can be consulted too late
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from dmlc_tpu.parallel.distributed import init_from_env
+    from dmlc_tpu.tracker.client import WorkerClient
+
+    init_from_env()  # DMLC_* -> jax.distributed.initialize
+    rank, world = jax.process_index(), jax.process_count()
+    print(f"[worker {rank}/{world}] backend up", flush=True)
+    # rabit plane: rank-stable rendezvous + job-completion bookkeeping
+    # (the tracker waits for every rank's shutdown)
+    client = WorkerClient(os.environ["DMLC_TRACKER_URI"],
+                          int(os.environ["DMLC_TRACKER_PORT"]))
+    client.start()
+
+    from dmlc_tpu.data import create_parser
+    from dmlc_tpu.data.device import DeviceIter
+    from dmlc_tpu.models import LinearLearner
+    from dmlc_tpu.parallel import make_mesh, sync_min
+    mesh = make_mesh({"data": jax.device_count()})
+    model = LinearLearner(num_col=NUM_COL, objective="logistic",
+                          learning_rate=0.5, mesh=mesh)
+    # shard index = process index: each worker reads only its byte range
+    batch = 64
+    probe = create_parser(os.environ["DATA"], rank, world, "libsvm",
+                          threaded=False)
+    local_rows = sum(len(b) for b in probe)
+    probe.close()
+    # SPMD safety: byte-range shards rarely hold EQUAL batch counts, and a
+    # process running one extra collective step deadlocks the pod — agree
+    # on min(local_steps) before training (dmlc_tpu.parallel.sync_min)
+    steps = sync_min(local_rows // batch)
+    parser = create_parser(os.environ["DATA"], rank, world, "libsvm")
+    it = DeviceIter(parser, num_col=model.device_num_col(), batch_size=batch,
+                    layout="dense", mesh=mesh, drop_remainder=True)
+    model.fit(it, epochs=5, steps_per_epoch=steps)
+    acc = model.accuracy(it, max_steps=steps)
+    it.close()
+    print(f"[worker {rank}/{world}] accuracy {float(acc):.3f} "
+          f"({steps} steps/epoch)", flush=True)
+    client.shutdown()
+
+
+def main() -> None:
+    if os.environ.get("DMLC_ROLE") == "worker":
+        worker()
+        return
+    import tempfile
+
+    from dmlc_tpu.tracker.submit import main as submit
+
+    data = os.path.join(tempfile.mkdtemp(), "pod.libsvm")
+    make_corpus(data)
+    os.environ["DATA"] = data
+    # LOCAL SIMULATION: pin workers to one CPU device each (the env must be
+    # in place before the worker interpreters start, so it goes in the
+    # launcher). On a real TPU pod slice DELETE these two lines — each host
+    # grabs its local TPU chips and the same code runs over ICI.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    nworker = int(os.environ.get("NWORKER", "2"))
+    submit(["--cluster", "tpu-pod", "--num-workers", str(nworker),
+            "--host-ip", "127.0.0.1", "--",
+            sys.executable, os.path.abspath(__file__)])
+    print("pod job finished")
+
+
+if __name__ == "__main__":
+    main()

@@ -110,6 +110,12 @@ inline bool is_eol(char c) { return c == '\n' || c == '\r'; }
 inline uint16_t f32_to_bf16(float f) {
   uint32_t bits;
   memcpy(&bits, &f, 4);
+  if ((bits & 0x7fffffffu) > 0x7f800000u) {
+    // NaN: the rounding add below could carry a low-mantissa-only payload
+    // into the exponent and emit Inf — quiet it instead (sign + high
+    // mantissa kept, quiet bit set), matching ml_dtypes' cast
+    return static_cast<uint16_t>((bits >> 16) | 0x0040u);
+  }
   bits += 0x7fffu + ((bits >> 16) & 1u);
   return static_cast<uint16_t>(bits >> 16);
 }

@@ -696,10 +696,16 @@ def test_native_bf16_repack_matches_f32(tmp_path):
 
     path = tmp_path / "bf.libsvm"
     rng = np.random.default_rng(12)
+    special = ["nan", "-nan", "inf", "-inf", "-0.0", "3.4e38", "1e-40"]
     with open(path, "w") as f:
         for i in range(500):
             feats = " ".join(f"{j}:{rng.normal():.6f}" for j in range(8))
             f.write(f"{i % 2} {feats}\n")
+        # special values: NaN payloads must not round into Inf etc.
+        for i in range(len(special)):
+            feats = " ".join(
+                f"{j}:{special[(i + j) % len(special)]}" for j in range(8))
+            f.write(f"1 {feats}\n")
     from dmlc_tpu.data.native_parser import NativeStreamParser
 
     def collect(dtype):

@@ -77,6 +77,14 @@ def reduce_fn(x):
 
 
 total = np.asarray(jax.device_get(reduce_fn(garr)))
+
+# SPMD step agreement: every process must learn min(local_steps) —
+# rank-dependent inputs, one replicated answer (parallel.sync_min)
+from dmlc_tpu.parallel import sync_min
+
+agreed = sync_min(10 + jax.process_index())
+assert agreed == 10, agreed
+
 out = os.path.join(os.environ["OUT"], f"result_{jax.process_index()}")
 with open(out, "w") as f:
     f.write(f"{total[0]:.1f} {total[1]:.6f} {rows}")
