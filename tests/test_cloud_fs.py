@@ -747,3 +747,39 @@ class TestNativeFeedRecordIO:
         e2 = [bytes(r) for r in s.iter_records()]
         s.close()
         assert e1 == e2 == recs
+
+
+def test_s3_feeder_bf16_dense_repack(fake_s3):
+    """Remote corpora get the bf16 repack too (feeder out_bf16 path)."""
+    import numpy as np
+
+    from dmlc_tpu import native
+
+    if not native.available():
+        pytest.skip("native core unavailable")
+    import ml_dtypes
+
+    from dmlc_tpu.data import create_parser
+    from dmlc_tpu.data.device import DeviceIter
+    from dmlc_tpu.data.native_parser import NativeFeedParser
+
+    rng = np.random.default_rng(9)
+    body = "".join(
+        f"{i % 2} " + " ".join(f"{j}:{rng.normal():.5f}" for j in range(6)) + "\n"
+        for i in range(400)).encode()
+    fake_s3.store[("bkt", "bf/x.libsvm")] = body
+
+    def run(dtype):
+        p = create_parser("s3://bkt/bf/x.libsvm", 0, 1, "libsvm")
+        assert isinstance(p, NativeFeedParser)
+        it = DeviceIter(p, num_col=6, batch_size=100, layout="dense",
+                        x_dtype=dtype)
+        out = [np.asarray(x) for x, y, w in it]
+        it.close()
+        return np.concatenate(out)
+
+    x32 = run("float32")
+    x16 = run("bfloat16")
+    assert x16.dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(
+        x16.view(np.uint16), x32.astype(ml_dtypes.bfloat16).view(np.uint16))
