@@ -1,0 +1,75 @@
+"""Device-benchmark battery: everything that needs the real TPU, one shot.
+
+Probes the device first (bounded) and exits 3 if unreachable, so a retry
+loop can run it until the tunnel is healthy:
+
+    python benchmarks/tpu_battery.py [--probe-only]
+
+On success it runs, in order, writing stdout JSON lines to
+``TPU_BATTERY.log`` at the repo root:
+  1. the sparse layout A/B (-> SPARSE_TPU_$DMLC_BENCH_TAG.json),
+  2. bench.py at 64 MB (north-star config 1),
+  3. bench_libfm_bcoo.py at 64 MB (config 4),
+  4. bench.py at DMLC_BENCH_MB=1024 (GB-scale config 1),
+  5. bench_libfm_bcoo.py at 1024 MB (GB-scale config 4).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "TPU_BATTERY.log")
+
+
+def probe(timeout: float = 45.0) -> bool:
+    code = (
+        "import jax, numpy as np;"
+        "x = jax.device_put(np.ones((64, 64), np.float32));"
+        "jax.block_until_ready(x); print('probe-ok', jax.devices()[0])"
+    )
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return False
+    return proc.returncode == 0 and "probe-ok" in proc.stdout
+
+
+def run(cmd, env=None, timeout=3600):
+    with open(LOG, "a") as log:
+        log.write(f"\n== {time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())} "
+                  f"{' '.join(cmd)} (env {env or {}}) ==\n")
+        log.flush()
+        e = dict(os.environ)
+        e.update(env or {})
+        proc = subprocess.run(cmd, env=e, cwd=REPO, stdout=log,
+                              stderr=subprocess.STDOUT, timeout=timeout)
+        log.write(f"== rc={proc.returncode} ==\n")
+        return proc.returncode
+
+
+def main() -> int:
+    if not probe():
+        print("device unreachable", flush=True)
+        return 3
+    print("device reachable; running battery", flush=True)
+    if "--probe-only" in sys.argv:
+        return 0
+    py = sys.executable
+    rcs = [
+        run([py, "benchmarks/bench_sparse_tpu.py"],
+            env={"DMLC_BENCH_TAG": os.environ.get("DMLC_BENCH_TAG", "r03")}),
+        run([py, "bench.py"]),
+        run([py, "benchmarks/bench_libfm_bcoo.py"]),
+        run([py, "bench.py"], env={"DMLC_BENCH_MB": "1024"}, timeout=5400),
+        run([py, "benchmarks/bench_libfm_bcoo.py"],
+            env={"DMLC_BENCH_MB": "1024"}, timeout=5400),
+    ]
+    print("battery done:", rcs, flush=True)
+    return 0 if all(rc == 0 for rc in rcs) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
