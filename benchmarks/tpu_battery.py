@@ -44,8 +44,12 @@ def run(cmd, env=None, timeout=3600):
         log.flush()
         e = dict(os.environ)
         e.update(env or {})
-        proc = subprocess.run(cmd, env=e, cwd=REPO, stdout=log,
-                              stderr=subprocess.STDOUT, timeout=timeout)
+        try:
+            proc = subprocess.run(cmd, env=e, cwd=REPO, stdout=log,
+                                  stderr=subprocess.STDOUT, timeout=timeout)
+        except subprocess.TimeoutExpired:
+            log.write(f"== TIMEOUT after {timeout}s ==\n")
+            return -1
         log.write(f"== rc={proc.returncode} ==\n")
         return proc.returncode
 

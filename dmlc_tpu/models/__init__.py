@@ -7,6 +7,7 @@ a jit/pjit logistic-regression / linear-regression SGD learner over the
 device pipeline — the SURVEY.md §7 "minimum slice" model.
 """
 
+from dmlc_tpu.models.fm import FMLearner, FMParams
 from dmlc_tpu.models.linear import LinearLearner, LinearParams
 
-__all__ = ["LinearLearner", "LinearParams"]
+__all__ = ["FMLearner", "FMParams", "LinearLearner", "LinearParams"]

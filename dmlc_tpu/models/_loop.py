@@ -1,0 +1,62 @@
+"""Shared training-loop surface for the learners.
+
+One implementation of step/fit_epoch/fit/accuracy — including the SPMD
+step-count contract (``steps_per_epoch`` / ``max_steps``): every process in
+a pod must execute the same number of collective steps per epoch or the
+pod deadlocks; agree on the cap with :func:`dmlc_tpu.parallel.sync_min`.
+
+Learners provide ``self._step(params, opt_state, batch)`` and
+``self._accuracy(params, batch) -> (correct_weighted, total_weight)``
+(jitted, replicated scalar outputs so results are addressable on every
+process) plus ``self.params`` / ``self.opt_state`` attributes.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from dmlc_tpu.utils.timer import get_time
+
+
+class TrainLoopMixin:
+    def step(self, batch) -> float:
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, batch)
+        return loss
+
+    def fit_epoch(self, device_iter, max_steps=None) -> Tuple[float, int]:
+        """One pass over a DeviceIter; returns (mean loss, batches).
+        ``max_steps`` is the SPMD step-count cap (module docstring)."""
+        total, n = 0.0, 0
+        for batch in device_iter:
+            loss = self.step(batch)
+            total += float(loss)
+            n += 1
+            if max_steps is not None and n >= max_steps:
+                break
+        device_iter.reset()
+        return (total / max(n, 1)), n
+
+    def fit(self, device_iter, epochs: int = 1, log_fn=None,
+            steps_per_epoch=None):
+        for epoch in range(epochs):
+            t0 = get_time()
+            loss, nb = self.fit_epoch(device_iter, max_steps=steps_per_epoch)
+            if log_fn:
+                log_fn(epoch, loss, nb, get_time() - t0)
+        return self
+
+    def accuracy(self, device_iter, max_steps=None) -> float:
+        """Weighted accuracy over one pass, reduced ON DEVICE (replicated
+        scalars — pod-safe); ``max_steps`` as in :meth:`fit_epoch`."""
+        correct, total = 0.0, 0.0
+        n = 0
+        for batch in device_iter:
+            c, t = self._accuracy(self.params, batch)
+            correct += float(c)
+            total += float(t)
+            n += 1
+            if max_steps is not None and n >= max_steps:
+                break
+        device_iter.reset()
+        return correct / max(total, 1.0)

@@ -13,17 +13,16 @@ TPU-first design:
 
 from __future__ import annotations
 
-import functools
-from typing import Iterable, NamedTuple, Optional, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
 
+from dmlc_tpu.models._loop import TrainLoopMixin
 from dmlc_tpu.ops.sparse import EllBatch, ell_matvec
 from dmlc_tpu.utils.check import check
-from dmlc_tpu.utils.timer import get_time
 
 
 class LinearParams(NamedTuple):
@@ -75,7 +74,7 @@ def _loss_from_margin(margin, label, weight, objective: str, l2: float, params):
     return loss
 
 
-class LinearLearner:
+class LinearLearner(TrainLoopMixin):
     """Logistic / least-squares / multinomial-softmax learner with optax
     updates (the learner family the reference's Row::SDot was built for,
     data.h:146-161, widened to multi-class).
@@ -235,56 +234,6 @@ class LinearLearner:
 
     # ---------------- public API ----------------
 
-    def step(self, batch) -> float:
-        self.params, self.opt_state, loss = self._step(self.params, self.opt_state, batch)
-        return loss
-
-    def fit_epoch(self, device_iter, max_steps=None) -> Tuple[float, int]:
-        """One pass over a DeviceIter; returns (mean loss, batches).
-
-        ``max_steps`` caps the pass — REQUIRED for multi-process data
-        parallelism when shards can hold unequal batch counts: every
-        process must run the same number of collective steps or the pod
-        deadlocks. Agree on the cap with
-        :func:`dmlc_tpu.parallel.sync_min` first.
-        """
-        total, n = 0.0, 0
-        for batch in device_iter:
-            loss = self.step(batch)
-            total += float(loss)
-            n += 1
-            if max_steps is not None and n >= max_steps:
-                break
-        device_iter.reset()
-        return (total / max(n, 1)), n
-
-    def fit(self, device_iter, epochs: int = 1, log_fn=None,
-            steps_per_epoch=None) -> "LinearLearner":
-        for epoch in range(epochs):
-            t0 = get_time()
-            loss, nb = self.fit_epoch(device_iter, max_steps=steps_per_epoch)
-            if log_fn:
-                log_fn(epoch, loss, nb, get_time() - t0)
-        return self
-
     def predict(self, batch) -> jax.Array:
         return self._predict(self.params, batch)
 
-    def accuracy(self, device_iter, max_steps=None) -> float:
-        """Classification accuracy over one pass (logistic objective).
-
-        ``max_steps``: same SPMD step-count contract as :meth:`fit_epoch`
-        (the per-batch metric executes collectives over mesh-global
-        batches; outputs are replicated scalars, addressable everywhere).
-        """
-        correct, total = 0.0, 0.0
-        n = 0
-        for batch in device_iter:
-            c, t = self._accuracy(self.params, batch)
-            correct += float(c)
-            total += float(t)
-            n += 1
-            if max_steps is not None and n >= max_steps:
-                break  # mirror fit_epoch: no extra batch pulled past the cap
-        device_iter.reset()
-        return correct / max(total, 1.0)

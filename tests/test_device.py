@@ -724,3 +724,94 @@ def test_native_bf16_repack_matches_f32(tmp_path):
     # C++ rne conversion must equal numpy/ml_dtypes' own rne cast exactly
     np.testing.assert_array_equal(
         x16.view(np.uint16), x32.astype(ml_dtypes.bfloat16).view(np.uint16))
+
+
+# ---------------- factorization machine ----------------
+
+def _xor_corpus(tmp_path, n=512):
+    """Labels depend on a feature INTERACTION (x0 XOR x1) — linearly
+    inseparable, learnable only through the second-order term."""
+    rng = np.random.default_rng(3)
+    lines = []
+    for _ in range(n):
+        a, b = int(rng.integers(0, 2)), int(rng.integers(0, 2))
+        y = a ^ b
+        noise = " ".join(f"{j}:{rng.normal() * 0.01:.5f}" for j in range(2, 6))
+        lines.append(f"{y} 0:{2 * a - 1} 1:{2 * b - 1} {noise}")
+    p = tmp_path / "xor.libsvm"
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+@pytest.mark.parametrize("layout", ["dense", "ell"])
+def test_fm_learns_interactions(tmp_path, layout):
+    from dmlc_tpu.models.fm import FMLearner
+
+    uri = _xor_corpus(tmp_path)
+    model = FMLearner(num_col=6, num_factors=4, layout=layout,
+                      learning_rate=0.1, seed=1)
+    parser = create_parser(uri, 0, 1, "libsvm", threaded=False)
+    it = DeviceIter(parser, num_col=model.device_num_col(), batch_size=64,
+                    layout=layout, max_nnz=6, drop_remainder=True)
+    model.fit(it, epochs=40)
+    acc = model.accuracy(it)
+    it.close()
+    assert acc > 0.9, f"layout={layout} acc={acc}"
+
+    # a LINEAR model cannot express XOR: it stays near chance
+    lin = LinearLearner(num_col=6, layout="dense", learning_rate=0.1)
+    parser2 = create_parser(uri, 0, 1, "libsvm", threaded=False)
+    it2 = DeviceIter(parser2, num_col=lin.device_num_col(), batch_size=64,
+                     layout="dense", drop_remainder=True)
+    lin.fit(it2, epochs=40)
+    lin_acc = lin.accuracy(it2)
+    it2.close()
+    assert lin_acc < 0.75, lin_acc
+
+
+def test_fm_sharded_dp_matches_single(tmp_path):
+    from dmlc_tpu.models.fm import FMLearner
+
+    uri = _xor_corpus(tmp_path, n=256)
+    mesh = make_mesh({"data": 8})
+
+    def run(mesh_arg):
+        model = FMLearner(num_col=6, num_factors=4, layout="dense",
+                          learning_rate=0.1, seed=2, mesh=mesh_arg)
+        parser = create_parser(uri, 0, 1, "libsvm", threaded=False)
+        it = DeviceIter(parser, num_col=model.device_num_col(), batch_size=64,
+                        layout="dense", mesh=mesh_arg, drop_remainder=True)
+        model.fit(it, epochs=3)
+        it.close()
+        return np.asarray(model.params.v)
+
+    v_single = run(None)
+    v_sharded = run(mesh)
+    np.testing.assert_allclose(v_sharded, v_single, rtol=1e-4, atol=1e-5)
+
+
+def test_fm_libfm_format_end_to_end(tmp_path):
+    """The libfm FORMAT feeding the FM MODEL — the pairing the reference's
+    libfm parser exists for (libfm_parser.h)."""
+    from dmlc_tpu.models.fm import FMLearner
+
+    rng = np.random.default_rng(5)
+    lines = []
+    for _ in range(400):
+        a, b = int(rng.integers(0, 2)), int(rng.integers(0, 2))
+        y = a ^ b
+        # field:index:value tokens (fields 0/1)
+        lines.append(f"{y} 0:{a}:1 1:{2 + b}:1")
+    p = tmp_path / "fm.libfm"
+    p.write_text("\n".join(lines) + "\n")
+
+    model = FMLearner(num_col=4, num_factors=4, layout="ell",
+                      learning_rate=0.15, seed=3)
+    parser = create_parser(str(p) + "?format=libfm", 0, 1, "auto",
+                           threaded=False)
+    it = DeviceIter(parser, num_col=model.device_num_col(), batch_size=50,
+                    layout="ell", max_nnz=2, drop_remainder=True)
+    model.fit(it, epochs=60)
+    acc = model.accuracy(it)
+    it.close()
+    assert acc > 0.9, acc
