@@ -4,7 +4,9 @@ dmlc-core itself contains no models, but its Row::SDot (data.h:146-161) and
 RowBlock design exist to serve linear learners (XGBoost's linear booster,
 wormhole's linear solvers). The flagship end-to-end slice here is therefore
 a jit/pjit logistic-regression / linear-regression SGD learner over the
-device pipeline — the SURVEY.md §7 "minimum slice" model.
+device pipeline — the SURVEY.md §7 "minimum slice" model — plus the
+second-order factorization machine the libfm format exists to feed
+(models/fm.py).
 """
 
 from dmlc_tpu.models.fm import FMLearner, FMParams
