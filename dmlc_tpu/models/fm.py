@@ -105,6 +105,7 @@ class FMLearner(TrainLoopMixin):
         self.opt_state = self.opt.init(self.params)
         self._step = self._build_step()
         self._accuracy = self._build_accuracy()
+        self._predict = jax.jit(lambda params, batch: self._margin(params, batch)[0])
 
     def device_num_col(self) -> int:
         """The ``num_col`` a DeviceIter must use to feed this learner."""
@@ -188,3 +189,7 @@ class FMLearner(TrainLoopMixin):
 
         rep = NamedSharding(self.mesh, P())
         return jax.jit(acc_fn, out_shardings=(rep, rep))
+
+    def predict(self, batch) -> jax.Array:
+        """Raw margin for a batch (apply sigmoid for probabilities)."""
+        return self._predict(self.params, batch)

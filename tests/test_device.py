@@ -815,3 +815,9 @@ def test_fm_libfm_format_end_to_end(tmp_path):
     acc = model.accuracy(it)
     it.close()
     assert acc > 0.9, acc
+
+
+def test_sync_min_single_process():
+    from dmlc_tpu.parallel import sync_min
+
+    assert sync_min(7) == 7  # 1-process: identity, no collective needed
