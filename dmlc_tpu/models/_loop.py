@@ -5,10 +5,12 @@ step-count contract (``steps_per_epoch`` / ``max_steps``): every process in
 a pod must execute the same number of collective steps per epoch or the
 pod deadlocks; agree on the cap with :func:`dmlc_tpu.parallel.sync_min`.
 
-Learners provide ``self._step(params, opt_state, batch)`` and
-``self._accuracy(params, batch) -> (correct_weighted, total_weight)``
-(jitted, replicated scalar outputs so results are addressable on every
-process) plus ``self.params`` / ``self.opt_state`` attributes.
+Learners provide ``self._step(params, opt_state, batch)``,
+``self._margin(params, batch) -> (margin, label, weight)`` and
+``self._pred_from_margin(margin)``; :meth:`TrainLoopMixin._build_accuracy`
+derives the jitted on-device metric from those (replicated scalar outputs,
+so results are addressable on every process). ``self.params`` /
+``self.opt_state`` / ``self.mesh`` attributes are assumed.
 """
 
 from __future__ import annotations
@@ -19,6 +21,24 @@ from dmlc_tpu.utils.timer import get_time
 
 
 class TrainLoopMixin:
+    def _build_accuracy(self):
+        """Jitted (correct_weighted, total_weight) over one batch; the
+        reduction stays ON DEVICE so mesh-global batches spanning processes
+        work (their per-row values are not host-addressable)."""
+        import jax
+
+        def acc_fn(params, batch):
+            margin, label, weight = self._margin(params, batch)
+            pred = self._pred_from_margin(margin)
+            return ((pred == label) * weight).sum(), weight.sum()
+
+        if self.mesh is None:
+            return jax.jit(acc_fn)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rep = NamedSharding(self.mesh, P())
+        return jax.jit(acc_fn, out_shardings=(rep, rep))
+
     def step(self, batch) -> float:
         self.params, self.opt_state, loss = self._step(
             self.params, self.opt_state, batch)

@@ -116,6 +116,9 @@ class FMLearner(TrainLoopMixin):
 
     # ---------------- jitted functions ----------------
 
+    def _pred_from_margin(self, margin: jax.Array) -> jax.Array:
+        return (margin > 0).astype(jnp.float32)
+
     def _margin(self, params: FMParams, batch):
         if self.layout == "ell":
             return _margin_ell(params, batch), batch.label, batch.weight
@@ -176,19 +179,6 @@ class FMLearner(TrainLoopMixin):
             in_shardings=(params_sh, opt_sh, batch_sh),
             out_shardings=(params_sh, opt_sh, rep),
         )
-
-    def _build_accuracy(self):
-        def acc_fn(params, batch):
-            margin, label, weight = self._margin(params, batch)
-            pred = (margin > 0).astype(jnp.float32)
-            return ((pred == label) * weight).sum(), weight.sum()
-
-        if self.mesh is None:
-            return jax.jit(acc_fn)
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        rep = NamedSharding(self.mesh, P())
-        return jax.jit(acc_fn, out_shardings=(rep, rep))
 
     def predict(self, batch) -> jax.Array:
         """Raw margin for a batch (apply sigmoid for probabilities)."""

@@ -137,13 +137,19 @@ class LinearLearner(TrainLoopMixin):
 
     # ---------------- jitted functions ----------------
 
-    def loss_fn(self, params: LinearParams, batch) -> jax.Array:
+    def _margin(self, params: LinearParams, batch):
         if self.layout == "ell":
-            margin = _margin_ell(params, batch)
-            label, weight = batch.label, batch.weight
-        else:
-            x, label, weight = batch
-            margin = _margin_dense(params, x)
+            return _margin_ell(params, batch), batch.label, batch.weight
+        x, label, weight = batch
+        return _margin_dense(params, x), label, weight
+
+    def _pred_from_margin(self, margin: jax.Array) -> jax.Array:
+        if self.num_class > 1:
+            return jnp.argmax(margin, axis=-1).astype(jnp.float32)
+        return (margin > 0).astype(jnp.float32)
+
+    def loss_fn(self, params: LinearParams, batch) -> jax.Array:
+        margin, label, weight = self._margin(params, batch)
         return _loss_from_margin(margin, label, weight, self.objective, self.l2, params)
 
     def _shardings(self):
@@ -202,35 +208,6 @@ class LinearLearner(TrainLoopMixin):
             return _margin_dense(params, batch[0])
 
         return jax.jit(predict)
-
-    def _build_accuracy(self):
-        """Jitted (correct_weighted, total_weight) over one batch.
-
-        The reduction stays ON DEVICE with replicated scalar outputs, so it
-        works for mesh-global batches spanning processes — fetching the
-        per-row margin to the host (the old path) is impossible there
-        (non-addressable shards)."""
-        def acc_fn(params, batch):
-            if self.layout == "ell":
-                margin = _margin_ell(params, batch)
-                label, weight = batch.label, batch.weight
-            else:
-                x, label, weight = batch
-                margin = _margin_dense(params, x)
-            if self.num_class > 1:
-                pred = jnp.argmax(margin, axis=-1).astype(jnp.float32)
-            else:
-                pred = (margin > 0).astype(jnp.float32)
-            correct = ((pred == label) * weight).sum()
-            total = weight.sum()
-            return correct, total
-
-        if self.mesh is None:
-            return jax.jit(acc_fn)
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        rep = NamedSharding(self.mesh, P())
-        return jax.jit(acc_fn, out_shardings=(rep, rep))
 
     # ---------------- public API ----------------
 
