@@ -103,7 +103,7 @@ def _write_corpus(tmp_path, n_rows=64):
     return str(path), float(sum(i % 2 for i in range(n_rows)))
 
 
-@pytest.mark.parametrize("nworker", [2])
+@pytest.mark.parametrize("nworker", [2, 4])
 def test_tpu_pod_jax_distributed_end_to_end(tmp_path, nworker):
     """2 real OS processes rendezvous via jax.distributed and psum a loss."""
     data, expect_label_sum = _write_corpus(tmp_path)
