@@ -18,6 +18,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    # honor an explicit platform pin even on hosts whose sitecustomize
+    # registers extra PJRT plugins before the env var is consulted
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 from dmlc_tpu.data import create_parser
 from dmlc_tpu.data.device import DeviceIter
 

@@ -35,6 +35,11 @@ def synthesize(path: str, n: int = 4096, d: int = 28) -> None:
 def main() -> None:
     import jax
 
+    if os.environ.get("JAX_PLATFORMS"):
+        # honor an explicit platform pin even on hosts whose sitecustomize
+        # registers extra PJRT plugins before the env var is consulted
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     from dmlc_tpu.data import create_parser
     from dmlc_tpu.data.device import DeviceIter
     from dmlc_tpu.models import LinearLearner
@@ -56,7 +61,8 @@ def main() -> None:
     else:
         path = "/tmp/dmlc_tpu_example.libsvm"
         num_col = 28
-        synthesize(path, d=num_col)
+        # enough rows for several full global batches on any device count
+        synthesize(path, n=4096 * max(1, len(jax.devices())), d=num_col)
 
     mesh = make_mesh()  # 1-D data mesh over all devices
     part, nparts = host_shard_info()
