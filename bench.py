@@ -120,9 +120,15 @@ def into_hbm_mb_per_sec(path: str, size_mb: float, x_dtype: str = "float32"):
         for batch in it:
             last = batch
             nbatches += 1
-        # ensure all transfers have actually landed in HBM
+        # ensure all transfers have actually landed in HBM. device_put is
+        # async, so stall_seconds (wait for a batch HANDLE) cannot see
+        # transfers still in flight — this drain is that blind spot made
+        # visible: the backlog of issued-but-unlanded transfers when the
+        # consumer finishes pulling. Pipeline keeping up => ~one batch.
+        t_drain = time.monotonic()
         if last is not None:
             jax.block_until_ready(last)
+        drain = time.monotonic() - t_drain
         dt = time.monotonic() - t0
         mbps = size_mb / dt
         if mbps > best:
@@ -135,7 +141,8 @@ def into_hbm_mb_per_sec(path: str, size_mb: float, x_dtype: str = "float32"):
             f"device bytes {it.bytes_to_device/2**20:.1f} MB, "
             f"steady-state stall {it.stall_seconds:.3f}s = "
             f"{100*it.stall_seconds/dt:.1f}% of wall "
-            f"(host {it.host_stall_seconds:.3f}s)"
+            f"(host {it.host_stall_seconds:.3f}s, "
+            f"final transfer drain {drain:.3f}s)"
         )
     return best, stats
 

@@ -68,17 +68,24 @@ def bench_shape(name: str, B: int, K: int, D: int, results: list) -> None:
         print(json.dumps(row), flush=True)
 
     record("ell_xla_gather", time_op(jax.jit(ell_matvec), w, batch))
-    # r3: two pallas kernels — the rolled-K one-hot (mid-D band) and the
-    # VMEM-resident-weights gather (the high-D candidate, O(B*K) work)
-    for kern in ("onehot", "gather"):
+    # r3 final form: grid-K one-hot kernel (the K loop is a grid dimension,
+    # so the IR is O(1) in K and every block index is static). It is only
+    # run where the [bb, D] slab fits VMEM; for high D no pallas kernel can
+    # win by construction — see ops/pallas_sparse.py module docstring.
+    # viability bound: the [D, bb] slab must fit the 4MB VMEM budget with
+    # bb >= 128 (the Mosaic lane-tile minimum) -> D <= 8192
+    if D <= 8192:
         try:
-            record(f"ell_pallas_{kern}",
-                   time_op(lambda w_, i_, v_: ell_matvec_pallas(
-                       w_, i_, v_, kernel=kern), w, idx, val))
+            record("ell_pallas_onehot", time_op(ell_matvec_pallas, w, idx, val))
         except Exception as exc:  # noqa: BLE001 - record lowering failures
-            results.append({"shape": name, "path": f"ell_pallas_{kern}",
+            results.append({"shape": name, "path": "ell_pallas_onehot",
                             "error": str(exc)[:200]})
-            print(f"# ell_pallas_{kern} failed: {str(exc)[:120]}", flush=True)
+            print(f"# ell_pallas_onehot failed: {str(exc)[:120]}", flush=True)
+    else:
+        results.append({"shape": name, "path": "ell_pallas_onehot",
+                        "skipped": "D beyond VMEM slab budget; XLA gather "
+                                   "is the right lowering (see "
+                                   "ops/pallas_sparse.py)"})
 
     # dense matmul reference (only sensible when a [B, D] dense fits)
     if D <= 8192:

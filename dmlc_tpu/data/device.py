@@ -91,6 +91,8 @@ class DeviceIter:
         device=None,
         elide_unit_values: bool = False,
         x_dtype: str = "float32",
+        nnz_bucket: int = 16384,
+        row_bucket: int = 1024,
     ):
         check(layout in ("dense", "ell", "bcoo"), f"unknown layout {layout!r}")
         check(batch_size is not None or layout == "bcoo",
@@ -123,6 +125,17 @@ class DeviceIter:
         check(x_dtype == "float32" or layout == "dense",
               "x_dtype='bfloat16' applies to the dense layout only")
         self.x_dtype = x_dtype
+        # bcoo shape quantization: round nnz (and, in natural-block mode,
+        # rows) UP to bucket multiples so batch shapes repeat instead of
+        # being unique per batch. A novel-shape transfer costs a fresh
+        # transfer plan (measured ~100x a repeated-shape device_put on a
+        # tunneled device) and a recompile in any downstream jit. The nnz
+        # padding uses OUT-OF-BOUNDS coords, which every BCOO op masks —
+        # load-bearing for elide_unit_values, where the device synthesizes
+        # ones for pad slots too (see block_to_bcoo_host). Set 0 to
+        # disable (exact shapes, e.g. for interop tests).
+        self.nnz_bucket = int(nnz_bucket)
+        self.row_bucket = int(row_bucket)
         self._skip_blocks = 0  # producer-put resume: blocks to drop unput
         self.stall_seconds = 0.0        # consumer wait for a ready batch
         self.host_stall_seconds = 0.0   # of which: waiting on host convert
@@ -316,9 +329,16 @@ class DeviceIter:
             return ("ell",) + tuple(ell)
         # bcoo: all host-side work (coords/values/label assembly) happens
         # here on the convert thread; the device transfer is async
+        if pad is None and self.batch_size is None and self.row_bucket:
+            # natural-block mode: quantize the row dimension too
+            pad = -(-len(block) // self.row_bucket) * self.row_bucket
+        nnz = len(block.index)
+        pad_nnz = (-(-max(nnz, 1) // self.nnz_bucket) * self.nnz_bucket
+                   if self.nnz_bucket else None)
         return ("bcoo",) + block_to_bcoo_host(
             block, self.num_col, pad_rows_to=pad,
-            unit_values_as_none=self.elide_unit_values)
+            unit_values_as_none=self.elide_unit_values,
+            pad_nnz_to=pad_nnz)
 
     # ---------------- device side ----------------
 
@@ -399,7 +419,11 @@ class DeviceIter:
         # stall = wall time the consumer spends in here before a batch is
         # available (covers host-parse waits AND device-side transfer setup
         # — everything between "consumer wants a batch" and "batch handed
-        # out"); with the prefetch pipeline keeping up this is ~0
+        # out"); with the prefetch pipeline keeping up this is ~0.
+        # NOTE: device_put is async, so this times the wait for a batch
+        # HANDLE — a transfer still in flight at first on-device use is
+        # invisible here (it surfaces at the consumer's block_until_ready;
+        # bench.py reports that residue as the final transfer drain)
         t0 = get_time()
         self._fill()
         if not self._inflight:

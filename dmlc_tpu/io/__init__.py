@@ -23,6 +23,7 @@ from dmlc_tpu.io import http_filesys as _http_filesys  # registers http/cloud sl
 from dmlc_tpu.io import s3_filesys as _s3_filesys  # replaces the s3:// slot
 from dmlc_tpu.io import gcs_filesys as _gcs_filesys  # replaces the gs:// slot
 from dmlc_tpu.io import hdfs_filesys as _hdfs_filesys  # replaces the hdfs:// slot
+from dmlc_tpu.io import azure_filesys as _azure_filesys  # replaces the azure:// slot
 
 __all__ = [
     "URI", "URISpec", "FileInfo", "FileSystem", "LocalFileSystem",

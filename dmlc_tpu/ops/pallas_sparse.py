@@ -3,19 +3,43 @@
 XLA lowers ``jnp.take`` (ops/sparse.ell_matvec) to an HBM-bound dynamic
 gather per batch element. This kernel instead keeps the weight vector
 resident in VMEM across the whole batch grid and turns the gather into
-one-hot contractions over D-tiles — compare + multiply + reduce, all
-VPU/MXU-friendly primitives with static shapes, no HBM gather traffic.
+one-hot contractions — compare + multiply + reduce, all VPU/MXU-friendly
+primitives with static shapes, no HBM gather traffic.
 
 out[b] = sum_k w[idx[b, k]] * val[b, k]
 
-Grid: batch tiles of ``block_b`` rows. Per step, for each D-tile of
-``block_d`` weights: scatter the tile's values into a dense [block_b,
-block_d] slab via a one-hot compare against the tile's index range, then
-dot with the weight tile. The padding sink (idx == len(w) - 1 slots with
-value 0) falls out naturally because the values are 0.
+Lowering history (each form rejected by Mosaic with the error quoted):
+- r2: statically unrolled K loop over ``[bb, K]`` blocks — IR O(K*D),
+  blew up compile for K >= 64 at D = 4096 (SPARSE_TPU_r02).
+- r3 draft 1: rolled ``fori_loop`` with ``idx_ref[:, pl.ds(k, 1)]`` —
+  dynamic lane-dimension slices fail the alignment proof ("cannot
+  statically prove that index in dimension 1 is a multiple of 128").
+- r3 draft 2: K as a grid dimension with ``(bb, 1)`` blocks — lane-dim
+  block size must be a multiple of 128 or the full axis.
 
-Use :func:`ell_matvec_auto` to pick pallas when supported (TPU, shapes
-tile-able) and fall back to the XLA gather otherwise.
+Final form: inputs are fed K-MAJOR (``[K8, B]``, K padded to a multiple
+of 8 with zero-valued slots) so the K loop lives in the GRID with
+``(8, bb)`` blocks — both block dims satisfy the (8, 128) tiling rule,
+every index is static, and the kernel body unrolls exactly 8 compare+
+accumulate steps regardless of K. A VMEM scratch holds the one-hot slab
+``[D, bb]`` across the sequential k steps (TPU grids iterate the last
+dimension innermost); the final k step contracts ``w[1, D] @ slab`` on
+the MXU.
+
+Why there is NO pallas kernel for high D (the KDD/1M regime), by
+construction rather than by un-tuned accident:
+- the one-hot algorithm is O(B*K*D) compare-multiply work — at D = 2^20
+  it is arithmetically disqualified regardless of lowering;
+- an in-kernel VMEM table gather (O(B*K) work) is not expressible:
+  Mosaic's dynamic-gather primitive requires input/indices/output of THE
+  SAME 2D shape (per-lane shuffles), i.e. it cannot index a [D] table
+  with [B, K] indices ("Only 2D gather is supported" / "Shape mismatch
+  in input, indices and output");
+- a scalar-core loop over B*K VMEM loads costs ~B*K cycles (~140 us at
+  8192x16), ~6x worse than XLA's measured 24 us gather at kdd_like.
+So beyond the VMEM slab budget the right lowering IS XLA's native
+gather, and :func:`ell_matvec_auto` routes there; the measured A/B lives
+in SPARSE_TPU_r03.json.
 """
 
 from __future__ import annotations
@@ -27,44 +51,35 @@ import jax.numpy as jnp
 
 from dmlc_tpu.ops.sparse import EllBatch, ell_matvec as _xla_ell_matvec
 
+_KTILE = 8  # sublane tile: K is padded to a multiple of this
 
-def _ell_kernel(idx_ref, val_ref, w_ref, out_ref):
+
+def _ell_kernel(idx_ref, val_ref, w_ref, out_ref, slab_ref):
     import jax.experimental.pallas as pl
 
-    num_b = idx_ref.shape[0]
-    num_k = idx_ref.shape[1]
-    num_d = w_ref.shape[0]
-    iota = jax.lax.broadcasted_iota(jnp.int32, (1, num_d), 1)
+    k = pl.program_id(1)
+    num_k = pl.num_programs(1)
+    num_d = w_ref.shape[1]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (num_d, 1), 0)
 
-    # accumulate the dense scatter slab one nonzero-slot at a time:
-    # slab[b, d] = sum_k val[b, k] * (idx[b, k] == d). Peak VMEM is one
-    # [bb, D] slab (the tile size is chosen to keep it ~4MB), not the
-    # [bb, K, D] one-hot a fully vectorized form would materialize.
-    # K runs through a fori_loop with pl.ds ref reads — r2's statically
-    # unrolled K loop blew up the Mosaic lowering for K >= 64 at D = 4096
-    # (SPARSE_TPU_r02 boundary_probe compile errors); rolled IR is O(1)
-    # in K instead of O(K).
-    def body(k, slab):
-        idx_k = idx_ref[:, pl.ds(k, 1)]                       # [bb, 1]
-        val_k = val_ref[:, pl.ds(k, 1)]
-        return slab + val_k * (idx_k == iota).astype(jnp.float32)
+    @pl.when(k == 0)
+    def _init():
+        slab_ref[...] = jnp.zeros_like(slab_ref)
 
-    slab = jax.lax.fori_loop(
-        0, num_k, body, jnp.zeros((num_b, num_d), jnp.float32))
-    # full-f32 dot: the MXU's default bf16 operands lose ~1e-2 here
-    out_ref[...] = jnp.dot(slab, w_ref[...][:, None],
-                           precision=jax.lax.Precision.HIGHEST)  # [bb, 1]
+    # 8 static compare+accumulate steps per grid step: padded slots carry
+    # value 0, so they add nothing regardless of their index
+    slab = slab_ref[...]
+    for j in range(_KTILE):
+        idx_j = idx_ref[j:j + 1, :]                       # [1, bb], static
+        val_j = val_ref[j:j + 1, :]
+        slab += val_j * (idx_j == iota).astype(jnp.float32)  # [D, bb]
+    slab_ref[...] = slab
 
-
-def _ell_gather_kernel(idx_ref, val_ref, w_ref, out_ref):
-    # high-D variant: the weight vector stays RESIDENT in VMEM across the
-    # whole batch grid (constant index_map), and the per-element lookup is
-    # a VMEM gather — no one-hot scatter work (O(B*K) instead of O(B*K*D))
-    # and no HBM random reads, which is what bounds XLA's gather lowering.
-    idx = idx_ref[...]                     # [bb, K] int32
-    val = val_ref[...]                     # [bb, K] f32
-    gathered = jnp.take(w_ref[...], idx, axis=0)  # [bb, K]
-    out_ref[...] = jnp.sum(gathered * val, axis=1, keepdims=True)
+    @pl.when(k == num_k - 1)
+    def _contract():
+        # full-f32 dot: the MXU's default bf16 operands lose ~1e-2 here
+        out_ref[...] = jnp.dot(w_ref[...], slab_ref[...],
+                               precision=jax.lax.Precision.HIGHEST)  # [1, bb]
 
 
 def _pick_block_b(num_b: int, num_d: int, slab_budget: int = 4 << 20) -> int:
@@ -76,8 +91,7 @@ def _pick_block_b(num_b: int, num_d: int, slab_budget: int = 4 << 20) -> int:
     return bb
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("block_b", "interpret", "kernel"))
+@functools.partial(jax.jit, static_argnames=("block_b", "interpret"))
 def ell_matvec_pallas(
     weights: jax.Array,
     indices: jax.Array,
@@ -85,42 +99,36 @@ def ell_matvec_pallas(
     *,
     block_b: int = 0,
     interpret: bool = False,
-    kernel: str = "onehot",
 ) -> jax.Array:
-    """Pallas ELL matvec. block_b=0 picks a VMEM-sized tile automatically.
-
-    kernel='onehot': scatter slab + MXU dot — wins in the mid-D band where
-    the slab fits VMEM comfortably. kernel='gather': VMEM-resident weights
-    + in-kernel gather — the high-D (KDD-shaped) candidate, O(B*K) work.
-    """
+    """Pallas ELL matvec (one-hot slab). block_b=0 picks a VMEM-sized tile."""
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
-    num_b, _k = indices.shape
+    num_b, num_k = indices.shape
     num_d = weights.shape[0]
     if block_b == 0:
-        if kernel == "onehot":
-            block_b = _pick_block_b(num_b, num_d)
-        else:
-            # largest power-of-2 tile (<=256) DIVIDING B — no slab budget
-            # applies, but the grid still needs exact tiling
-            block_b = 1
-            while block_b * 2 <= min(num_b, 256) and num_b % (block_b * 2) == 0:
-                block_b *= 2
+        block_b = _pick_block_b(num_b, num_d)
     assert num_b % block_b == 0, (num_b, block_b)
-    grid = (num_b // block_b,)
+    k8 = -(-num_k // _KTILE) * _KTILE
+    # K-major layout, K padded to the sublane tile with zero-valued slots
+    idx_t = jnp.zeros((k8, num_b), jnp.int32).at[:num_k].set(
+        indices.astype(jnp.int32).T)
+    val_t = jnp.zeros((k8, num_b), jnp.float32).at[:num_k].set(values.T)
+    grid = (num_b // block_b, k8 // _KTILE)
     out = pl.pallas_call(
-        _ell_kernel if kernel == "onehot" else _ell_gather_kernel,
+        _ell_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((block_b, indices.shape[1]), lambda i: (i, 0)),
-            pl.BlockSpec((block_b, values.shape[1]), lambda i: (i, 0)),
-            pl.BlockSpec((num_d,), lambda i: (0,)),  # whole w every step
+            pl.BlockSpec((_KTILE, block_b), lambda i, k: (k, i)),
+            pl.BlockSpec((_KTILE, block_b), lambda i, k: (k, i)),
+            pl.BlockSpec((1, num_d), lambda i, k: (0, 0)),  # resident w
         ],
-        out_specs=pl.BlockSpec((block_b, 1), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((num_b, 1), jnp.float32),
+        out_specs=pl.BlockSpec((1, block_b), lambda i, k: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, num_b), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((num_d, block_b), jnp.float32)],
         interpret=interpret,
-    )(indices.astype(jnp.int32), values, weights)
-    return out[:, 0]
+    )(idx_t, val_t, weights[None, :])
+    return out[0]
 
 
 def ell_matvec_auto(weights: jax.Array, batch: EllBatch,
@@ -128,15 +136,12 @@ def ell_matvec_auto(weights: jax.Array, batch: EllBatch,
     """ELL matvec via pallas on TPU when shapes allow, XLA gather otherwise.
 
     The one-hot kernel does O(B*K*D) compare-multiply work, so it only pays
-    where D is small enough that the HBM gather's latency dominates;
-    measured on a v5e chip it beats the XLA gather by 10-33% for D <= 2048
-    (SPARSE_TPU_r02.json, e.g. 17.6us vs 23.4us at HIGGS D=28/K=28). r3
-    replaced r02's statically-unrolled K loop (which failed to compile for
-    K >= 64 at D = 4096) with a rolled fori_loop and added a second
-    'gather' kernel (VMEM-resident weights, O(B*K) work) as the high-D
-    candidate — the routing gate below still reflects the r02
-    measurements and is re-evaluated against SPARSE_TPU_r03 once both
-    kernels are timed on hardware.
+    where D is small enough that the HBM gather's latency dominates; the
+    routing gate keeps pallas to the D <= 2048 band where SPARSE_TPU
+    measurements showed it beating the XLA gather, and where the [D, bb]
+    slab fits the VMEM budget. For larger D the XLA gather is the right
+    lowering by construction — see the module docstring for why no pallas
+    kernel can win there.
     """
     num_b = batch.indices.shape[0]
     if use_pallas is None:

@@ -137,7 +137,7 @@ def block_to_dense(
 
 def block_to_bcoo_host(
     block: RowBlock, num_col: int, pad_rows_to: Optional[int] = None,
-    unit_values_as_none: bool = False,
+    unit_values_as_none: bool = False, pad_nnz_to: Optional[int] = None,
 ) -> Tuple[np.ndarray, Optional[np.ndarray], np.ndarray, np.ndarray, Tuple[int, int]]:
     """CSR -> host-side COO arrays ``(coords, vals, label, weight, shape)``.
 
@@ -149,18 +149,31 @@ def block_to_bcoo_host(
     roughly halves host->HBM traffic for the whole batch. ``pad_rows_to``
     pads the batch dimension (zero-weight empty rows) so every batch shares
     one static shape.
+
+    ``pad_nnz_to`` pads the nnz dimension with OUT-OF-BOUNDS coordinates
+    ``(rows_out, num_col)`` — BCOO's canonical padding, masked by every
+    sparse op (todense/matvec/matmul drop OOB entries), so the pad values
+    are free to be anything and ``unit_values_as_none`` elision composes
+    with padding. Quantizing nnz to a bucket multiple keeps the set of
+    distinct array shapes small and REPEATING — a fresh shape per batch
+    forces a new transfer plan in the runtime and a recompile in any
+    downstream jit; on a tunneled device a novel-shape ``device_put``
+    measured ~100x the cost of a repeated-shape one.
     """
     n = len(block)
     nnz = len(block.index)
     rows_out = int(pad_rows_to if pad_rows_to is not None else n)
-    idx_dtype = np.int32 if max(rows_out, num_col) < (1 << 31) else np.int64
+    nnz_out = int(pad_nnz_to) if pad_nnz_to is not None and pad_nnz_to > nnz else nnz
+    idx_dtype = np.int32 if max(rows_out + 1, num_col + 1) < (1 << 31) else np.int64
     lens = _row_lengths(block)
-    coords = np.empty((nnz, 2), idx_dtype)
-    coords[:, 0] = np.repeat(np.arange(n, dtype=idx_dtype), lens)
-    coords[:, 1] = block.index
+    coords = np.empty((nnz_out, 2), idx_dtype)
+    coords[:nnz, 0] = np.repeat(np.arange(n, dtype=idx_dtype), lens)
+    coords[:nnz, 1] = block.index
+    coords[nnz:, 0] = rows_out   # OOB pad: masked by all BCOO ops
+    coords[nnz:, 1] = num_col
     vals: Optional[np.ndarray]
     if block.value is None:
-        vals = None if unit_values_as_none else np.ones(nnz, np.float32)
+        vals = None if unit_values_as_none else np.ones(nnz_out, np.float32)
     else:
         vals = block.value
         if vals.dtype != np.float32:
@@ -170,6 +183,10 @@ def block_to_bcoo_host(
             # the consumer synthesizes ones on device, saving 4 B/nnz of
             # host->HBM traffic — the value array is 1/3 of a COO batch
             vals = None
+    if vals is not None and nnz_out > len(vals):
+        out = np.zeros(nnz_out, np.float32)
+        out[:len(vals)] = vals
+        vals = out
     label = np.zeros(rows_out, np.float32)
     label[:n] = block.label
     weight = np.zeros(rows_out, np.float32)

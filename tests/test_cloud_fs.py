@@ -783,3 +783,294 @@ def test_s3_feeder_bf16_dense_repack(fake_s3):
     assert x16.dtype == np.dtype(ml_dtypes.bfloat16)
     np.testing.assert_array_equal(
         x16.view(np.uint16), x32.astype(ml_dtypes.bfloat16).view(np.uint16))
+
+
+# ---------------- Azure Blob (SharedKey REST client) ----------------
+
+_AZ_ACCOUNT = "testacct"
+_AZ_KEY = "c2VjcmV0LWtleS1mb3ItdGVzdHM="  # base64("secret-key-for-tests")
+
+
+def _azure_expected_sig(method, path, query, headers):
+    """Independent SharedKey derivation written from the Blob-service auth
+    spec (NOT the client's helper), so canonicalization bugs can't cancel
+    out between client and verifier."""
+    import base64
+    import hashlib
+    import hmac
+
+    low = {k.lower(): v for k, v in headers.items()}
+    cl = low.get("content-length", "")
+    if cl == "0":
+        cl = ""
+    canon_headers = "".join(
+        f"{k}:{low[k]}\n" for k in sorted(low) if k.startswith("x-ms-"))
+    canon_resource = f"/{_AZ_ACCOUNT}{path}"
+    for k in sorted(query, key=str.lower):
+        canon_resource += f"\n{k.lower()}:{query[k]}"
+    sts = "\n".join([
+        method, low.get("content-encoding", ""), low.get("content-language", ""),
+        cl, low.get("content-md5", ""), low.get("content-type", ""),
+        "",  # Date is carried by x-ms-date
+        low.get("if-modified-since", ""), low.get("if-match", ""),
+        low.get("if-none-match", ""), low.get("if-unmodified-since", ""),
+        low.get("range", ""),
+    ]) + "\n" + canon_headers + canon_resource
+    mac = hmac.new(base64.b64decode(_AZ_KEY), sts.encode(), hashlib.sha256)
+    return f"SharedKey {_AZ_ACCOUNT}:" + base64.b64encode(mac.digest()).decode()
+
+
+class _FakeAzureHandler(http.server.BaseHTTPRequestHandler):
+    """Minimal Blob service: HEAD props, List Blobs (delimiter+marker),
+    ranged GET, Put Blob, Put Block / Put Block List. Every request's
+    SharedKey signature is verified against the independent derivation."""
+
+    store: dict = {}        # (container, name) -> bytes
+    staged: dict = {}       # (container, name) -> {block_id: bytes}
+    auth_failures: list = []
+
+    def log_message(self, *a):
+        pass
+
+    def _parse(self):
+        parsed = urllib.parse.urlparse(self.path)
+        qs = dict(urllib.parse.parse_qsl(parsed.query, keep_blank_values=True))
+        expected = _azure_expected_sig(
+            self.command, parsed.path, qs, dict(self.headers))
+        if self.headers.get("Authorization") != expected:
+            type(self).auth_failures.append(
+                (self.command, self.path,
+                 self.headers.get("Authorization"), expected))
+        parts = parsed.path.lstrip("/").split("/", 1)
+        return parts[0], (parts[1] if len(parts) > 1 else ""), qs
+
+    def _reply(self, code, body=b"", headers=None):
+        self.send_response(code)
+        headers = dict(headers or {})
+        headers.setdefault("Content-Length", str(len(body)))
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(body)
+
+    def do_HEAD(self):
+        container, name, _ = self._parse()
+        blob = self.store.get((container, name))
+        if blob is None:
+            self._reply(404)
+        else:
+            self._reply(200, headers={"Content-Length": str(len(blob))})
+
+    def do_GET(self):
+        container, name, qs = self._parse()
+        if qs.get("comp") == "list":
+            prefix = qs.get("prefix", "")
+            delim = qs.get("delimiter")
+            blobs, prefixes = [], set()
+            for (c, n), data in sorted(self.store.items()):
+                if c != container or not n.startswith(prefix):
+                    continue
+                rest = n[len(prefix):]
+                if delim and delim in rest:
+                    prefixes.add(prefix + rest.split(delim, 1)[0] + delim)
+                else:
+                    blobs.append(
+                        f"<Blob><Name>{n}</Name><Properties>"
+                        f"<Content-Length>{len(data)}</Content-Length>"
+                        f"</Properties></Blob>")
+            pfx = "".join(f"<BlobPrefix><Name>{p}</Name></BlobPrefix>"
+                          for p in sorted(prefixes))
+            xml = ("<?xml version='1.0'?><EnumerationResults><Blobs>"
+                   + "".join(blobs) + pfx
+                   + "</Blobs><NextMarker/></EnumerationResults>")
+            self._reply(200, xml.encode())
+            return
+        blob = self.store.get((container, name))
+        if blob is None:
+            self._reply(404)
+            return
+        rng = self.headers.get("Range")
+        if rng:
+            lo, hi = rng.split("=")[1].split("-")
+            body = blob[int(lo):int(hi) + 1]
+            self._reply(206, body)
+        else:
+            self._reply(200, blob)
+
+    def do_PUT(self):
+        container, name, qs = self._parse()
+        n = int(self.headers.get("Content-Length", 0))
+        data = self.rfile.read(n)
+        if qs.get("comp") == "block":
+            self.staged.setdefault((container, name), {})[qs["blockid"]] = data
+            self._reply(201)
+            return
+        if qs.get("comp") == "blocklist":
+            import re
+
+            ids = re.findall(r"<Latest>([^<]+)</Latest>", data.decode())
+            blocks = self.staged.pop((container, name), {})
+            self.store[(container, name)] = b"".join(
+                blocks[b] for b in ids)
+            self._reply(201)
+            return
+        assert self.headers.get("x-ms-blob-type") == "BlockBlob", \
+            "single-shot upload must set x-ms-blob-type"
+        self.store[(container, name)] = data
+        self._reply(201)
+
+
+@pytest.fixture()
+def fake_azure(monkeypatch):
+    _FakeAzureHandler.store = {}
+    _FakeAzureHandler.staged = {}
+    _FakeAzureHandler.auth_failures = []
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                             _FakeAzureHandler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    port = server.server_address[1]
+    monkeypatch.setenv("AZURE_STORAGE_ACCOUNT", _AZ_ACCOUNT)
+    monkeypatch.setenv("AZURE_STORAGE_ACCESS_KEY", _AZ_KEY)
+    monkeypatch.delenv("AZURE_STORAGE_SAS_TOKEN", raising=False)
+    monkeypatch.setenv("AZURE_ENDPOINT", f"http://127.0.0.1:{port}")
+    yield _FakeAzureHandler
+    server.shutdown()
+    server.server_close()
+
+
+class TestAzureFileSystem:
+    """Blob REST client vs a hermetic fake that verifies every SharedKey
+    signature independently. The reference's Azure member is a stub
+    (azure_filesys.h:22-31: only ListDirectory works) — this suite covers
+    the full surface the rebuild adds."""
+
+    def _fs(self):
+        from dmlc_tpu.io.azure_filesys import AzureConfig, AzureFileSystem
+
+        return AzureFileSystem(AzureConfig())
+
+    def test_string_to_sign_golden_format(self):
+        """Exact StringToSign layout, asserted against a literal — anchors
+        the canonicalization independently of any server round-trip."""
+        from dmlc_tpu.io.azure_filesys import string_to_sign
+
+        sts = string_to_sign(
+            "GET", "myaccount", "/mycontainer/blob.txt",
+            {"comp": "list", "restype": "container"},
+            {"x-ms-date": "Wed, 01 Jan 2026 00:00:00 GMT",
+             "x-ms-version": "2021-08-06",
+             "Range": "bytes=0-1023",
+             "Content-Length": "0"})
+        assert sts == (
+            "GET\n\n\n\n\n\n\n\n\n\n\nbytes=0-1023\n"
+            "x-ms-date:Wed, 01 Jan 2026 00:00:00 GMT\n"
+            "x-ms-version:2021-08-06\n"
+            "/myaccount/mycontainer/blob.txt"
+            "\ncomp:list\nrestype:container")
+
+    def test_read_ranges_and_seek(self, fake_azure):
+        payload = bytes(range(256)) * 400
+        fake_azure.store[("cont", "dir/data.bin")] = payload
+        fs = self._fs()
+        with fs.open_for_read(URI("azure://cont/dir/data.bin")) as f:
+            assert f.read(16) == payload[:16]
+            f.seek(90000)
+            assert f.read(64) == payload[90000:90064]
+            f.seek(0)
+            assert f.read() == payload
+        assert fake_azure.auth_failures == []
+
+    def test_status_list_and_missing(self, fake_azure):
+        fake_azure.store[("cont", "d/a.txt")] = b"xy"
+        fake_azure.store[("cont", "d/sub/b.txt")] = b"zzz"
+        fs = self._fs()
+        info = fs.get_path_info(URI("azure://cont/d/a.txt"))
+        assert info.size == 2 and info.type == "file"
+        assert fs.get_path_info(URI("azure://cont/d")).type == "directory"
+        names = sorted(str(i.path) for i in fs.list_directory(URI("azure://cont/d")))
+        assert names == ["azure://cont/d/a.txt", "azure://cont/d/sub"]
+        rec = fs.list_directory_recursive(URI("azure://cont/d"))
+        assert sorted(str(i.path) for i in rec) == [
+            "azure://cont/d/a.txt", "azure://cont/d/sub/b.txt"]
+        with pytest.raises(DMLCError, match="not found"):
+            fs.get_path_info(URI("azure://cont/missing"))
+        assert fake_azure.auth_failures == []
+
+    def test_small_write_single_put(self, fake_azure):
+        fs = self._fs()
+        with fs.open(URI("azure://cont/out/small.bin"), "w") as f:
+            f.write(b"hello ")
+            f.write(b"azure")
+        assert fake_azure.store[("cont", "out/small.bin")] == b"hello azure"
+        assert fake_azure.auth_failures == []
+
+    def test_large_write_block_list(self, fake_azure, monkeypatch):
+        # the env knob is read per-config-instance, so setting it here
+        # (after package import) must take effect
+        monkeypatch.setenv("AZURE_BLOCK_MB", "1")
+        payload = bytes(range(256)) * 10240  # 2.5 MB -> 3 staged blocks
+        fs = self._fs()
+        with fs.open(URI("azure://cont/out/big.bin"), "w") as f:
+            f.write(payload)
+        assert fake_azure.store[("cont", "out/big.bin")] == payload
+        assert fake_azure.staged == {}
+        assert fake_azure.auth_failures == []
+
+    def test_libsvm_corpus_streamed_from_azure(self, fake_azure):
+        """End-to-end: remote azure corpus through create_parser, sharded
+        two ways — the same integration shape as the S3/HDFS suites."""
+        from dmlc_tpu.data import create_parser
+
+        lines = "".join(f"{i % 2} 0:{i}.5 1:2.0\n" for i in range(400))
+        fake_azure.store[("cont", "corp/p0.libsvm")] = lines.encode()
+        fake_azure.store[("cont", "corp/p1.libsvm")] = lines.encode()
+        total = 0
+        for part in range(2):
+            p = create_parser("azure://cont/corp", part, 2, "libsvm")
+            total += sum(len(b) for b in p)
+            p.close()
+        assert total == 800
+        assert fake_azure.auth_failures == []
+
+    def test_sas_auth_skips_authorization_header(self, fake_azure, monkeypatch):
+        monkeypatch.delenv("AZURE_STORAGE_ACCESS_KEY")
+        monkeypatch.setenv("AZURE_STORAGE_SAS_TOKEN",
+                           "sv=2021-08-06&sig=fakesig")
+        fake_azure.store[("cont", "x.bin")] = b"123456"
+        # the fake's signature check can't apply without SharedKey; just
+        # assert the data path works and the SAS params reach the server
+        seen = {}
+        orig = _FakeAzureHandler._parse
+
+        def spy(handler):
+            out = orig(handler)
+            seen.update(out[2])
+            return out
+
+        monkeypatch.setattr(_FakeAzureHandler, "_parse", spy)
+        fs = self._fs()
+        with fs.open_for_read(URI("azure://cont/x.bin")) as f:
+            assert f.read() == b"123456"
+        assert seen.get("sv") == "2021-08-06" and "sig" in seen
+
+    def test_read_when_server_ignores_range(self, fake_azure, monkeypatch):
+        """A proxy that replies 200-whole-blob to a ranged GET must still
+        yield correct slices (the parent HttpReadStream contract)."""
+        payload = bytes(range(256)) * 200
+        fake_azure.store[("cont", "whole.bin")] = payload
+        orig = _FakeAzureHandler.do_GET
+
+        def no_range(handler):
+            # drop the Range header so the fake serves 200 + the full blob
+            del handler.headers["Range"]
+            return orig(handler)
+
+        monkeypatch.setattr(_FakeAzureHandler, "do_GET", no_range)
+        fs = self._fs()
+        with fs.open_for_read(URI("azure://cont/whole.bin")) as f:
+            f.seek(40000)
+            assert f.read(64) == payload[40000:40064]
+            f.seek(10)
+            assert f.read(5) == payload[10:15]
