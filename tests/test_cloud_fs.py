@@ -828,6 +828,7 @@ class _FakeAzureHandler(http.server.BaseHTTPRequestHandler):
     store: dict = {}        # (container, name) -> bytes
     staged: dict = {}       # (container, name) -> {block_id: bytes}
     auth_failures: list = []
+    page_size = 0           # >0: page List Blobs and emit NextMarker
 
     def log_message(self, *a):
         pass
@@ -867,23 +868,32 @@ class _FakeAzureHandler(http.server.BaseHTTPRequestHandler):
         if qs.get("comp") == "list":
             prefix = qs.get("prefix", "")
             delim = qs.get("delimiter")
-            blobs, prefixes = [], set()
+            marker = qs.get("marker", "")
+            entries, prefixes = [], set()
             for (c, n), data in sorted(self.store.items()):
                 if c != container or not n.startswith(prefix):
                     continue
+                if marker and n <= marker:
+                    continue  # resume strictly after the marker
                 rest = n[len(prefix):]
                 if delim and delim in rest:
                     prefixes.add(prefix + rest.split(delim, 1)[0] + delim)
                 else:
-                    blobs.append(
-                        f"<Blob><Name>{n}</Name><Properties>"
-                        f"<Content-Length>{len(data)}</Content-Length>"
-                        f"</Properties></Blob>")
+                    entries.append((n, data))
+            next_marker = ""
+            if self.page_size and len(entries) > self.page_size:
+                next_marker = entries[self.page_size - 1][0]
+                entries = entries[:self.page_size]
+                prefixes = set()  # prefixes only on the final page
+            blobs = "".join(
+                f"<Blob><Name>{n}</Name><Properties>"
+                f"<Content-Length>{len(data)}</Content-Length>"
+                f"</Properties></Blob>" for n, data in entries)
             pfx = "".join(f"<BlobPrefix><Name>{p}</Name></BlobPrefix>"
                           for p in sorted(prefixes))
             xml = ("<?xml version='1.0'?><EnumerationResults><Blobs>"
-                   + "".join(blobs) + pfx
-                   + "</Blobs><NextMarker/></EnumerationResults>")
+                   + blobs + pfx + "</Blobs><NextMarker>" + next_marker
+                   + "</NextMarker></EnumerationResults>")
             self._reply(200, xml.encode())
             return
         blob = self.store.get((container, name))
@@ -926,6 +936,7 @@ def fake_azure(monkeypatch):
     _FakeAzureHandler.store = {}
     _FakeAzureHandler.staged = {}
     _FakeAzureHandler.auth_failures = []
+    _FakeAzureHandler.page_size = 0
     server = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
                                              _FakeAzureHandler)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
@@ -1074,3 +1085,16 @@ class TestAzureFileSystem:
             assert f.read(64) == payload[40000:40064]
             f.seek(10)
             assert f.read(5) == payload[10:15]
+
+    def test_list_pagination_follows_next_marker(self, fake_azure):
+        """Multi-page List Blobs: the client's marker loop must stitch
+        pages into one complete listing."""
+        for i in range(7):
+            fake_azure.store[("cont", f"pg/f{i:02d}.bin")] = b"x" * (i + 1)
+        fake_azure.page_size = 3  # 7 entries -> 3 pages
+        fs = self._fs()
+        infos = fs.list_directory(URI("azure://cont/pg"))
+        assert [str(i.path) for i in infos] == [
+            f"azure://cont/pg/f{i:02d}.bin" for i in range(7)]
+        assert [i.size for i in infos] == list(range(1, 8))
+        assert fake_azure.auth_failures == []
