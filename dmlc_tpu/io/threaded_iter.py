@@ -20,6 +20,7 @@ None, and ok=False signals end of stream. A simpler ``iterator`` front-end
 
 from __future__ import annotations
 
+import os
 import threading
 from collections import deque
 from typing import Any, Callable, Deque, Generic, Optional, Tuple, TypeVar
@@ -33,6 +34,19 @@ T = TypeVar("T")
 _SIG_PRODUCE = 0
 _SIG_BEFORE_FIRST = 1
 _SIG_DESTROY = 2
+
+
+def _stall_timeout() -> float:
+    """Opt-in pipeline stall watchdog (seconds; 0 = off, the default).
+
+    A wedged producer — most commonly a device backend whose transfer hangs
+    (e.g. a dead TPU tunnel) — otherwise blocks the consumer silently and
+    forever. With ``DMLC_PIPELINE_STALL_TIMEOUT=N`` the consumer raises a
+    diagnosable error after waiting N seconds with a live but unproductive
+    producer. Off by default: a legitimately slow first chunk (GB-scale
+    remote reads) must never be killed by an arbitrary limit.
+    """
+    return float(os.environ.get("DMLC_PIPELINE_STALL_TIMEOUT", "0") or 0)
 
 
 class ThreadedIter(Generic[T]):
@@ -116,8 +130,22 @@ class ThreadedIter(Generic[T]):
         if self._destroyed:
             raise DMLCError("ThreadedIter: already destroyed")
         t0 = get_time()
+        timeout = _stall_timeout()
         with self._lock:
-            self._lock.wait_for(lambda: self._queue or self._produce_end)
+            if timeout > 0:
+                if not self._lock.wait_for(
+                    lambda: self._queue or self._produce_end, timeout=timeout
+                ):
+                    alive = self._thread.is_alive()
+                    raise DMLCError(
+                        f"pipeline stalled: no item produced in {timeout:.0f}s "
+                        f"(producer thread {'alive but blocked' if alive else 'dead'}, "
+                        f"queue empty, free cells {len(self._free)}). A hung "
+                        f"device transfer or remote read is the usual cause; "
+                        f"unset DMLC_PIPELINE_STALL_TIMEOUT to wait forever"
+                    )
+            else:
+                self._lock.wait_for(lambda: self._queue or self._produce_end)
             self.stall_seconds += get_time() - t0
             if self._queue:
                 item = self._queue.popleft()

@@ -167,6 +167,31 @@ def test_threaded_iter_before_first():
     it.destroy()
 
 
+def test_threaded_iter_stall_watchdog(monkeypatch):
+    """DMLC_PIPELINE_STALL_TIMEOUT: a live-but-wedged producer (hung device
+    transfer, dead tunnel) raises a diagnosable error instead of blocking
+    the consumer forever. Off by default."""
+    import threading as _threading
+
+    release = _threading.Event()
+
+    def gen():
+        yield 1
+        release.wait(30)  # wedge until the test releases us
+        yield 2
+
+    it = ThreadedIter.from_factory(lambda: gen(), max_capacity=1)
+    assert it.next() == 1
+    monkeypatch.setenv("DMLC_PIPELINE_STALL_TIMEOUT", "0.3")
+    with pytest.raises(DMLCError, match="pipeline stalled.*alive but blocked"):
+        it.next()
+    # un-wedge: with the watchdog off again the stream continues normally
+    monkeypatch.delenv("DMLC_PIPELINE_STALL_TIMEOUT")
+    release.set()
+    assert it.next() == 2
+    it.destroy()
+
+
 def test_threaded_iter_exception_propagation():
     # mirror unittest_threaditer_exc_handling.cc:25-60
     def gen():
