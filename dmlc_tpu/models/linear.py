@@ -50,7 +50,16 @@ def _margin_dense(params: LinearParams, x: jax.Array) -> jax.Array:
     return x @ params.weight + params.bias
 
 
-def _margin_ell(params: LinearParams, batch: EllBatch) -> jax.Array:
+def _margin_ell(params: LinearParams, batch: EllBatch,
+                use_auto: bool = False) -> jax.Array:
+    if use_auto:
+        # single-device / replicated-weight case: let the router pick the
+        # pallas one-hot kernel in its winning band (TPU, D <= 2048,
+        # B % 256 == 0) and the XLA gather elsewhere. Sharded weights stay
+        # on ell_matvec — pallas_call is not shard_map-aware here.
+        from dmlc_tpu.ops.pallas_sparse import ell_matvec_auto
+
+        return ell_matvec_auto(params.weight, batch) + params.bias
     return ell_matvec(params.weight, batch) + params.bias
 
 
@@ -80,8 +89,9 @@ class LinearLearner(TrainLoopMixin):
     data.h:146-161, widened to multi-class).
 
     ``layout`` must match the DeviceIter layout ('dense' or 'ell');
-    ``objective='softmax'`` needs ``num_class >= 2`` and the dense layout
-    (labels are integer class ids carried in the float label column).
+    ``objective='softmax'`` needs ``num_class >= 2`` and works on either
+    layout — the ELL path gathers rows of the [W, C] table (labels are
+    integer class ids carried in the float label column).
     """
 
     def __init__(
@@ -100,8 +110,6 @@ class LinearLearner(TrainLoopMixin):
         check(layout in ("dense", "ell"), "LinearLearner: layout must be dense|ell")
         check((objective == "softmax") == (num_class > 1),
               "softmax objective iff num_class > 1")
-        check(num_class <= 1 or layout == "dense",
-              "softmax needs the dense layout")
         self.num_class = num_class
         self.num_col = num_col
         self.objective = objective
@@ -139,7 +147,8 @@ class LinearLearner(TrainLoopMixin):
 
     def _margin(self, params: LinearParams, batch):
         if self.layout == "ell":
-            return _margin_ell(params, batch), batch.label, batch.weight
+            return (_margin_ell(params, batch, use_auto=self.mesh is None),
+                    batch.label, batch.weight)
         x, label, weight = batch
         return _margin_dense(params, x), label, weight
 
@@ -204,7 +213,7 @@ class LinearLearner(TrainLoopMixin):
     def _build_predict(self):
         def predict(params, batch):
             if self.layout == "ell":
-                return _margin_ell(params, batch)
+                return _margin_ell(params, batch, use_auto=self.mesh is None)
             return _margin_dense(params, batch[0])
 
         return jax.jit(predict)

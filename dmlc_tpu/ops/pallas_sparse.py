@@ -131,6 +131,34 @@ def ell_matvec_pallas(
     return out[0]
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _ell_matvec_pallas_ad(weights, indices, values, interpret=False):
+    """Differentiable wrapper: pallas forward, XLA backward.
+
+    ``pallas_call`` has a JVP rule but NO transpose rule in current JAX, so
+    reverse-mode AD through the raw kernel fails at trace time. The VJP of
+    ``out[b] = sum_k w[idx[b,k]] * val[b,k]`` is closed-form: a scatter-add
+    for dw and a gather for dval — both standard XLA lowerings, so training
+    steps (value_and_grad) can route through the kernel's fast forward.
+    """
+    return ell_matvec_pallas(weights, indices, values, interpret=interpret)
+
+
+def _ell_ad_fwd(weights, indices, values, interpret=False):
+    return (_ell_matvec_pallas_ad(weights, indices, values, interpret),
+            (weights, indices, values))
+
+
+def _ell_ad_bwd(interpret, res, g):
+    weights, indices, values = res
+    dw = jnp.zeros_like(weights).at[indices].add(values * g[:, None])
+    dval = jnp.take(weights, indices, axis=0) * g[:, None]
+    return dw, None, dval
+
+
+_ell_matvec_pallas_ad.defvjp(_ell_ad_fwd, _ell_ad_bwd)
+
+
 def ell_matvec_auto(weights: jax.Array, batch: EllBatch,
                     use_pallas: bool | None = None) -> jax.Array:
     """ELL matvec via pallas on TPU when shapes allow, XLA gather otherwise.
@@ -146,8 +174,9 @@ def ell_matvec_auto(weights: jax.Array, batch: EllBatch,
     num_b = batch.indices.shape[0]
     if use_pallas is None:
         on_tpu = jax.devices()[0].platform == "tpu"
-        use_pallas = on_tpu and num_b % 256 == 0 and weights.shape[0] <= 2048
+        use_pallas = (on_tpu and weights.ndim == 1  # kernel is [D]-table only
+                      and num_b % 256 == 0 and weights.shape[0] <= 2048)
     if not use_pallas:
         return _xla_ell_matvec(weights, batch)
-    return ell_matvec_pallas(
+    return _ell_matvec_pallas_ad(
         weights, jnp.asarray(batch.indices), jnp.asarray(batch.values))

@@ -210,9 +210,12 @@ def ell_matvec(weights: jax.Array, batch: EllBatch) -> jax.Array:
     The TPU analog of Row::SDot (data.h:146-161). ``weights`` is [D+1]; the
     final slot is the padding sink (index=num_col) and must be 0 — callers
     keep a D+1 parameter vector and simply never touch the last slot.
+    A 2D table [D+1, C] (multinomial per-class weights) broadcasts the
+    values over the class dim and returns [B, C].
     """
-    gathered = jnp.take(weights, batch.indices, axis=0)  # [B, K]
-    return jnp.sum(gathered * batch.values, axis=-1)
+    gathered = jnp.take(weights, batch.indices, axis=0)  # [B, K] or [B, K, C]
+    vals = batch.values if weights.ndim == 1 else batch.values[..., None]
+    return jnp.sum(gathered * vals, axis=1)
 
 
 def ell_matmul(weights: jax.Array, batch: EllBatch) -> jax.Array:

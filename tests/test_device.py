@@ -390,9 +390,10 @@ def test_softmax_config_validation():
         LinearLearner(num_col=4, objective="softmax")  # num_class missing
     with pytest.raises(DMLCError):
         LinearLearner(num_col=4, num_class=3)  # non-softmax multi-class
-    with pytest.raises(DMLCError):
-        LinearLearner(num_col=4, objective="softmax", num_class=3,
+    # softmax over the ELL layout is supported (2D table ELL gather)
+    m = LinearLearner(num_col=4, objective="softmax", num_class=3,
                       layout="ell")
+    assert m.params.weight.shape == (5, 3)  # +1 padding-sink row
 
 
 # ---------------- bcoo natural-block mode ----------------
@@ -871,3 +872,76 @@ def test_bcoo_shape_bucketing_quantizes_and_preserves_math(tmp_path):
                                                    np.zeros(mb.shape[0] - rows,
                                                             np.float32)]),
                                    rtol=1e-6)
+
+
+def test_ell_matvec_auto_routing_guards():
+    """The auto router must keep 2D (multinomial) weight tables on the XLA
+    gather — the pallas kernel is a [D]-table matvec only."""
+    from dmlc_tpu.ops.pallas_sparse import ell_matvec_auto
+    from dmlc_tpu.ops.sparse import EllBatch, ell_matvec
+
+    rng = np.random.default_rng(0)
+    B, K, D, C = 256, 4, 64, 3
+    idx = jnp.asarray(rng.integers(0, D, size=(B, K)).astype(np.int32))
+    val = jnp.asarray(rng.normal(size=(B, K)).astype(np.float32))
+    batch = EllBatch(idx, val, None, None)
+    w2 = jnp.asarray(rng.normal(size=(D, C)).astype(np.float32))
+    got = ell_matvec_auto(w2, batch)          # must not attempt pallas
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(ell_matvec(w2, batch)), rtol=1e-6)
+    assert got.shape == (B, C)
+
+
+def test_softmax_learner_ell_layout(tmp_path):
+    """Multinomial softmax over the ELL sparse layout (2D weight table
+    through the ELL gather)."""
+    rng = np.random.default_rng(5)
+    d, n, C = 6, 300, 3
+    centers = rng.normal(size=(C, d)) * 2
+    lines = []
+    for _ in range(n):
+        c = int(rng.integers(0, C))
+        x = centers[c] + rng.normal(size=d) * 0.3
+        feats = " ".join(f"{j}:{x[j]:.5f}" for j in range(d))
+        lines.append(f"{c} {feats}")
+    p = tmp_path / "multi.libsvm"
+    p.write_text("\n".join(lines) + "\n")
+
+    model = LinearLearner(num_col=d, objective="softmax", num_class=C,
+                          layout="ell", learning_rate=0.5)
+    parser = create_parser(str(p), 0, 1, "libsvm", threaded=False)
+    it = DeviceIter(parser, num_col=model.device_num_col(), batch_size=50,
+                    layout="ell", max_nnz=d)
+    model.fit(it, epochs=10)
+    acc = model.accuracy(it)
+    assert acc > 0.85, acc
+    it.close()
+
+
+def test_pallas_ell_matvec_grad_matches_xla():
+    """value_and_grad through the pallas forward (custom_vjp: XLA backward)
+    must match grads of the pure-XLA gather — this is the training-path
+    configuration (single-device TPU, 1D table) that routes to the kernel."""
+    from dmlc_tpu.ops.pallas_sparse import _ell_matvec_pallas_ad
+    from dmlc_tpu.ops.sparse import EllBatch, ell_matvec
+
+    rng = np.random.default_rng(11)
+    B, K, D = 256, 7, 96
+    idx = jnp.asarray(rng.integers(0, D, size=(B, K)).astype(np.int32))
+    val = jnp.asarray(rng.normal(size=(B, K)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=D).astype(np.float32))
+    g = jnp.asarray(rng.normal(size=B).astype(np.float32))  # loss weights
+
+    def loss_pallas(w_, v_):
+        return jnp.sum(_ell_matvec_pallas_ad(w_, idx, v_, True) * g)
+
+    def loss_xla(w_, v_):
+        return jnp.sum(ell_matvec(w_, EllBatch(idx, v_, None, None)) * g)
+
+    (lp, (dwp, dvp)) = jax.value_and_grad(loss_pallas, argnums=(0, 1))(w, val)
+    (lx, (dwx, dvx)) = jax.value_and_grad(loss_xla, argnums=(0, 1))(w, val)
+    np.testing.assert_allclose(float(lp), float(lx), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(dwp), np.asarray(dwx),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dvp), np.asarray(dvx),
+                               rtol=1e-4, atol=1e-5)
