@@ -11,8 +11,10 @@ from dmlc_tpu.ops.sparse import (
     EllBatch, block_to_bcoo, block_to_dense, block_to_ell,
     ell_matvec, ell_matmul, segment_csr_matvec,
 )
+from dmlc_tpu.ops.pallas_sparse import ell_matvec_auto, ell_matvec_pallas
 
 __all__ = [
     "EllBatch", "block_to_bcoo", "block_to_dense", "block_to_ell",
     "ell_matvec", "ell_matmul", "segment_csr_matvec",
+    "ell_matvec_auto", "ell_matvec_pallas",
 ]
