@@ -945,3 +945,39 @@ def test_pallas_ell_matvec_grad_matches_xla():
                                rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(np.asarray(dvp), np.asarray(dvx),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_linear_learner_fit_through_pallas_routed_margin(tmp_path, monkeypatch):
+    """End-to-end fit() with the margin forced onto the pallas kernel
+    (interpret mode): exercises jit(value_and_grad(custom_vjp(pallas)))
+    — the exact single-device-TPU training path the auto-router selects."""
+    import dmlc_tpu.ops.pallas_sparse as ps
+    import dmlc_tpu.models.linear as lin
+
+    real_kernel = ps.ell_matvec_pallas
+
+    def forced_interpret(w, i, v, **kw):
+        kw["interpret"] = True  # CPU backend: interpret is the only mode
+        return real_kernel(w, i, v, **kw)
+
+    monkeypatch.setattr(ps, "ell_matvec_pallas", forced_interpret)
+    calls = {"n": 0}
+    real_auto = ps.ell_matvec_auto
+
+    def forced_auto(w, batch, use_pallas=None):
+        calls["n"] += 1
+        return real_auto(w, batch, use_pallas=True)
+
+    monkeypatch.setattr(ps, "ell_matvec_auto", forced_auto)
+
+    uri = _separable_corpus(tmp_path, n=512)
+    model = lin.LinearLearner(num_col=8, objective="logistic", layout="ell",
+                              learning_rate=0.5)
+    parser = create_parser(uri, 0, 1, "libsvm", threaded=False)
+    it = DeviceIter(parser, num_col=model.device_num_col(), batch_size=256,
+                    layout="ell", max_nnz=8, drop_remainder=True)
+    model.fit(it, epochs=8)
+    acc = model.accuracy(it)
+    it.close()
+    assert calls["n"] > 0, "margin never reached the routed kernel"
+    assert acc > 0.9, acc
