@@ -26,7 +26,8 @@ from dmlc_tpu.utils.check import check
 
 
 class LinearParams(NamedTuple):
-    weight: jax.Array  # [W]; last slot is the ELL padding sink, kept at 0
+    weight: jax.Array  # [W]; dense/ell: last slot is the padding sink,
+    #                    pinned to 0 — for bcoo it is a real feature weight
     bias: jax.Array    # scalar
 
 
@@ -88,8 +89,9 @@ class LinearLearner(TrainLoopMixin):
     updates (the learner family the reference's Row::SDot was built for,
     data.h:146-161, widened to multi-class).
 
-    ``layout`` must match the DeviceIter layout ('dense' or 'ell');
-    ``objective='softmax'`` needs ``num_class >= 2`` and works on either
+    ``layout`` must match the DeviceIter layout ('dense', 'ell', or
+    'bcoo' — the last single-device, margin via bcoo_dot_general);
+    ``objective='softmax'`` needs ``num_class >= 2`` and works on any
     layout — the ELL path gathers rows of the [W, C] table (labels are
     integer class ids carried in the float label column).
     """
@@ -107,7 +109,10 @@ class LinearLearner(TrainLoopMixin):
         model_axis: Optional[str] = None,
         num_class: int = 1,
     ):
-        check(layout in ("dense", "ell"), "LinearLearner: layout must be dense|ell")
+        check(layout in ("dense", "ell", "bcoo"),
+              "LinearLearner: layout must be dense|ell|bcoo")
+        check(layout != "bcoo" or mesh is None,
+              "layout='bcoo' is single-device (matches DeviceIter bcoo)")
         check((objective == "softmax") == (num_class > 1),
               "softmax objective iff num_class > 1")
         self.num_class = num_class
@@ -119,11 +124,16 @@ class LinearLearner(TrainLoopMixin):
         self.data_axis = data_axis
         self.model_axis = model_axis
         # weight length: num_col features + 1 padding sink, rounded up so a
-        # model-axis sharding divides it evenly
-        model_size = 1
-        if mesh is not None and model_axis is not None:
-            model_size = mesh.shape[model_axis]
-        self.weight_dim = -(-(num_col + 1) // model_size) * model_size
+        # model-axis sharding divides it evenly. BCOO batches carry real
+        # coordinates only (pad entries are out-of-bounds and masked), so
+        # no sink slot is needed there.
+        if layout == "bcoo":
+            self.weight_dim = num_col
+        else:
+            model_size = 1
+            if mesh is not None and model_axis is not None:
+                model_size = mesh.shape[model_axis]
+            self.weight_dim = -(-(num_col + 1) // model_size) * model_size
         self.opt = optimizer or optax.sgd(learning_rate)
         self.params = init_params(self.weight_dim, num_class)
         self.opt_state = self.opt.init(self.params)
@@ -139,9 +149,12 @@ class LinearLearner(TrainLoopMixin):
         """The ``num_col`` a DeviceIter must use to feed this learner.
 
         dense: batches are [B, weight_dim] (zero columns beyond the data's
-        features); ell: pad index = weight_dim - 1, the pinned-zero sink.
+        features); ell: pad index = weight_dim - 1, the pinned-zero sink;
+        bcoo: the true column count (OOB pad coords are masked).
         """
-        return self.weight_dim if self.layout == "dense" else self.weight_dim - 1
+        if self.layout == "ell":
+            return self.weight_dim - 1
+        return self.weight_dim
 
     # ---------------- jitted functions ----------------
 
@@ -149,6 +162,8 @@ class LinearLearner(TrainLoopMixin):
         if self.layout == "ell":
             return (_margin_ell(params, batch, use_auto=self.mesh is None),
                     batch.label, batch.weight)
+        # dense and bcoo share one margin: _margin_dense's `x @ weight` is
+        # bcoo_dot_general when x is a BCOO batch (AD-complete wrt weights)
         x, label, weight = batch
         return _margin_dense(params, x), label, weight
 
@@ -196,8 +211,11 @@ class LinearLearner(TrainLoopMixin):
             loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
             updates, opt_state = self.opt.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            # keep the padding sink at zero so ELL gathers of pad slots are inert
-            params = params._replace(weight=params.weight.at[-1].set(0.0))
+            if self.layout != "bcoo":
+                # keep the padding sink at zero so ELL gathers of pad slots
+                # are inert (bcoo has no sink: its last weight is real)
+                params = params._replace(
+                    weight=params.weight.at[-1].set(0.0))
             return params, opt_state, loss
 
         params_sh, batch_sh = self._shardings()
@@ -214,7 +232,7 @@ class LinearLearner(TrainLoopMixin):
         def predict(params, batch):
             if self.layout == "ell":
                 return _margin_ell(params, batch, use_auto=self.mesh is None)
-            return _margin_dense(params, batch[0])
+            return _margin_dense(params, batch[0])  # dense or bcoo operand
 
         return jax.jit(predict)
 

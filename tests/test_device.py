@@ -981,3 +981,21 @@ def test_linear_learner_fit_through_pallas_routed_margin(tmp_path, monkeypatch):
     it.close()
     assert calls["n"] > 0, "margin never reached the routed kernel"
     assert acc > 0.9, acc
+
+
+@pytest.mark.parametrize("batch_size", [64, None])
+def test_linear_learner_bcoo_layout(tmp_path, batch_size):
+    """Training straight off BCOO batches (fixed-size and natural-block):
+    the libfm->BCOO ingestion path ends in a learner, not just a transfer."""
+    uri = _separable_corpus(tmp_path, n=256)
+    model = LinearLearner(num_col=8, objective="logistic", layout="bcoo",
+                          learning_rate=0.5)
+    parser = create_parser(uri, 0, 1, "libsvm", threaded=False,
+                           chunk_bytes=4096)
+    it = DeviceIter(parser, num_col=model.device_num_col(),
+                    batch_size=batch_size, layout="bcoo",
+                    nnz_bucket=256, row_bucket=32)
+    model.fit(it, epochs=12)
+    acc = model.accuracy(it)
+    it.close()
+    assert acc > 0.9, f"batch_size={batch_size} acc={acc}"
