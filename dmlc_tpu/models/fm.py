@@ -45,6 +45,19 @@ def _margin_dense(params: FMParams, x: jax.Array) -> jax.Array:
     return linear + 0.5 * jnp.sum(xv * xv - x2v2, axis=-1)
 
 
+def _margin_bcoo(params: FMParams, mat) -> jax.Array:
+    # sparse @ dense (bcoo_dot_general) for both contractions; the squared
+    # operand is a second BCOO sharing the coords with squared values —
+    # OOB pad coords stay masked in it too
+    from jax.experimental import sparse as jsparse
+
+    linear = mat @ params.w + params.w0
+    xv = mat @ params.v                                     # [B, F]
+    mat2 = jsparse.BCOO((mat.data * mat.data, mat.indices), shape=mat.shape)
+    x2v2 = mat2 @ (params.v * params.v)                     # [B, F]
+    return linear + 0.5 * jnp.sum(xv * xv - x2v2, axis=-1)
+
+
 def _margin_ell(params: FMParams, batch: EllBatch) -> jax.Array:
     # gathers over the factor table; padding slots carry value 0 so they
     # contribute nothing to any sum
@@ -60,7 +73,8 @@ def _margin_ell(params: FMParams, batch: EllBatch) -> jax.Array:
 class FMLearner(TrainLoopMixin):
     """Second-order factorization machine (logistic or squared objective).
 
-    ``layout`` matches the DeviceIter layout ('dense' or 'ell'); factors
+    ``layout`` matches the DeviceIter layout ('dense', 'ell', or 'bcoo' —
+    the last single-device, both contractions via bcoo_dot_general); factors
     initialize to small gaussian noise (all-zero factors have zero gradient
     through the interaction term). With ``mesh``, batches shard over
     ``data_axis`` and the update psums over the pod.
@@ -80,7 +94,10 @@ class FMLearner(TrainLoopMixin):
         mesh=None,
         data_axis: str = "data",
     ):
-        check(layout in ("dense", "ell"), "FMLearner: layout must be dense|ell")
+        check(layout in ("dense", "ell", "bcoo"),
+              "FMLearner: layout must be dense|ell|bcoo")
+        check(layout != "bcoo" or mesh is None,
+              "layout='bcoo' is single-device (matches DeviceIter bcoo)")
         check(objective in ("logistic", "squared"),
               f"FMLearner: unknown objective {objective!r}")
         check(num_factors >= 1, "FMLearner: num_factors must be >= 1")
@@ -91,11 +108,14 @@ class FMLearner(TrainLoopMixin):
         self.l2 = l2
         self.mesh = mesh
         self.data_axis = data_axis
-        self.weight_dim = num_col + 1  # +1 = ELL padding sink
+        # +1 = ELL/dense padding sink; BCOO pads with OOB coords instead,
+        # so its last weight/factor row is real
+        self.weight_dim = num_col if layout == "bcoo" else num_col + 1
         key = jax.random.PRNGKey(seed)
         v = init_scale * jax.random.normal(
             key, (self.weight_dim, num_factors), jnp.float32)
-        v = v.at[-1].set(0.0)  # sink row inert
+        if layout != "bcoo":
+            v = v.at[-1].set(0.0)  # sink row inert
         self.params = FMParams(
             w0=jnp.zeros((), jnp.float32),
             w=jnp.zeros(self.weight_dim, jnp.float32),
@@ -109,7 +129,9 @@ class FMLearner(TrainLoopMixin):
 
     def device_num_col(self) -> int:
         """The ``num_col`` a DeviceIter must use to feed this learner."""
-        return self.weight_dim if self.layout == "dense" else self.weight_dim - 1
+        if self.layout == "ell":
+            return self.weight_dim - 1
+        return self.weight_dim
 
     def batch_shardings(self):
         return self._shardings()[1]
@@ -123,6 +145,8 @@ class FMLearner(TrainLoopMixin):
         if self.layout == "ell":
             return _margin_ell(params, batch), batch.label, batch.weight
         x, label, weight = batch
+        if self.layout == "bcoo":
+            return _margin_bcoo(params, x), label, weight
         return _margin_dense(params, x), label, weight
 
     def loss_fn(self, params: FMParams, batch) -> jax.Array:
@@ -159,11 +183,12 @@ class FMLearner(TrainLoopMixin):
             loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
             updates, opt_state = self.opt.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            # keep the ELL padding sink inert
-            params = params._replace(
-                w=params.w.at[-1].set(0.0),
-                v=params.v.at[-1].set(0.0),
-            )
+            if self.layout != "bcoo":
+                # keep the padding sink inert (bcoo's last row is real)
+                params = params._replace(
+                    w=params.w.at[-1].set(0.0),
+                    v=params.v.at[-1].set(0.0),
+                )
             return params, opt_state, loss
 
         params_sh, batch_sh = self._shardings()

@@ -751,7 +751,7 @@ def _xor_corpus(tmp_path, n=512):
     return str(p)
 
 
-@pytest.mark.parametrize("layout", ["dense", "ell"])
+@pytest.mark.parametrize("layout", ["dense", "ell", "bcoo"])
 def test_fm_learns_interactions(tmp_path, layout):
     from dmlc_tpu.models.fm import FMLearner
 
@@ -760,7 +760,8 @@ def test_fm_learns_interactions(tmp_path, layout):
                       learning_rate=0.1, seed=1)
     parser = create_parser(uri, 0, 1, "libsvm", threaded=False)
     it = DeviceIter(parser, num_col=model.device_num_col(), batch_size=64,
-                    layout=layout, max_nnz=6, drop_remainder=True)
+                    layout=layout, max_nnz=6, drop_remainder=True,
+                    nnz_bucket=256, row_bucket=32)
     model.fit(it, epochs=40)
     acc = model.accuracy(it)
     it.close()
