@@ -8,6 +8,9 @@ Run (single host, any JAX backend):
     python examples/train_linear.py [path.libsvm] [num_col]
 
 Without a path it generates a small separable synthetic dataset.
+``DMLC_EXAMPLE_LAYOUT`` picks the device layout: ``dense`` (default,
+sharded over the mesh), or single-device ``ell`` / ``bcoo`` — the same
+model trains on all three.
 Multi-host: launch through `bin/dmlc-submit --cluster tpu-pod ...`; each
 process reads its own partition (process_index/process_count) and the psum
 runs over ICI.
@@ -64,15 +67,18 @@ def main() -> None:
         # enough rows for several full global batches on any device count
         synthesize(path, n=4096 * max(1, len(jax.devices())), d=num_col)
 
-    mesh = make_mesh()  # 1-D data mesh over all devices
+    layout = os.environ.get("DMLC_EXAMPLE_LAYOUT", "dense")
+    # sparse layouts run single-device; dense shards over the mesh
+    mesh = make_mesh() if layout == "dense" else None
     part, nparts = host_shard_info()
     model = LinearLearner(num_col=num_col, objective="logistic",
-                          layout="dense", learning_rate=0.3, mesh=mesh)
+                          layout=layout, learning_rate=0.3, mesh=mesh)
     parser = create_parser(path, part, nparts, "libsvm")
-    batch = 1024 * len(jax.devices())
+    batch = 1024 * (len(jax.devices()) if mesh is not None else 1)
     it = DeviceIter(parser, num_col=model.device_num_col(), batch_size=batch,
-                    layout="dense", mesh=mesh, drop_remainder=True,
-                    shardings=model.batch_shardings())
+                    layout=layout, mesh=mesh, drop_remainder=True,
+                    max_nnz=num_col,
+                    shardings=model.batch_shardings() if mesh else None)
 
     def log(epoch, loss, nb, secs):
         print(f"epoch {epoch}: loss={loss:.4f} batches={nb} {secs:.2f}s "
