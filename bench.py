@@ -10,12 +10,24 @@ single-threaded host-only parse (no device), i.e. BASELINE.json config #1's
 host-only parsing.
 
 Prints ONE JSON line on stdout; everything else goes to stderr.
+
+Infra resilience: the TPU tunnel on this host flakes transiently (r3's
+driver run died on one unguarded backend init). The measurement therefore
+runs in a CHILD process under a supervisor that (a) retries the whole run
+in a fresh process when it fails on a backend/transport error, probing the
+device between attempts until it recovers, and (b) on persistent
+unavailability still prints a machine-readable JSON line
+({"infra": "tpu_unavailable", ...}, exit code 3) instead of a traceback —
+the reference's harness always yields a parseable record
+(/root/reference/src/data/basic_row_iter.h:68-81 logs unconditionally;
+/root/reference/tracker/dmlc_tracker/local.py:26-49 retries failed workers).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -64,11 +76,18 @@ CHUNK_BYTES = 1 << 20
 REPS = 3  # best-of, to tame shared-host + tunnel noise
 
 
-def host_only_mb_per_sec(path: str, size_mb: float) -> float:
-    """Single-threaded parse to RowBlocks on the host (the CPU reference)."""
+from statistics import median as _median  # noqa: E402
+
+
+def host_only_mb_per_sec(path: str, size_mb: float):
+    """Single-threaded parse to RowBlocks on the host (the CPU reference).
+
+    Returns (best, median) MB/s over REPS runs — ambient host speed swings
+    2-4x on this shared machine, so both statistics are recorded.
+    """
     from dmlc_tpu.data import create_parser
 
-    best = float("inf")
+    rates = []
     for _ in range(REPS):
         parser = create_parser(path, 0, 1, "libsvm", threaded=False,
                                chunk_bytes=CHUNK_BYTES)
@@ -78,14 +97,22 @@ def host_only_mb_per_sec(path: str, size_mb: float) -> float:
             rows += len(block)
         dt = time.monotonic() - t0
         parser.close()
-        best = min(best, dt)
+        rates.append(size_mb / dt)
         log(f"bench: host-only parse {rows} rows in {dt:.2f}s = {size_mb/dt:.1f} MB/s")
-    return size_mb / best
+    return max(rates), _median(rates)
 
 
 def into_hbm_mb_per_sec(path: str, size_mb: float, x_dtype: str = "float32"):
     """Full async pipeline into device HBM."""
     import jax
+
+    # JAX_PLATFORMS in the env does NOT stick on this host (the site hook
+    # registers the axon TPU platform at interpreter start); the in-process
+    # config update is the working pin. Used to smoke-test the pipeline on
+    # CPU when the tunnel is down.
+    platform = os.environ.get("DMLC_BENCH_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
 
     from dmlc_tpu.data import create_parser
     from dmlc_tpu.data.device import DeviceIter
@@ -99,6 +126,7 @@ def into_hbm_mb_per_sec(path: str, size_mb: float, x_dtype: str = "float32"):
 
     jax.block_until_ready(
         jax.device_put(np.zeros((BATCH, NUM_COL), np.float32), dev))
+    rates = []
     best = 0.0
     stats = None
     for _ in range(REPS):
@@ -131,6 +159,7 @@ def into_hbm_mb_per_sec(path: str, size_mb: float, x_dtype: str = "float32"):
         drain = time.monotonic() - t_drain
         dt = time.monotonic() - t0
         mbps = size_mb / dt
+        rates.append(mbps)
         if mbps > best:
             best = mbps
             stats = it.stats()
@@ -144,31 +173,147 @@ def into_hbm_mb_per_sec(path: str, size_mb: float, x_dtype: str = "float32"):
             f"(host {it.host_stall_seconds:.3f}s, "
             f"final transfer drain {drain:.3f}s)"
         )
-    return best, stats
+    return best, _median(rates), (min(rates), max(rates)), stats
 
 
-def main() -> None:
+# child exit code for backend/transport failures — the supervisor retries
+# these (after waiting out the flake) and treats any other nonzero rc as a
+# deterministic bench bug, reported immediately without re-running
+EX_INFRA = 75  # sysexits EX_TEMPFAIL
+
+_INFRA_MARKERS = (
+    "UNAVAILABLE", "Unable to initialize backend", "DEADLINE_EXCEEDED",
+    "Socket closed", "failed to connect", "Connection reset",
+    "backend setup/compile error",
+)
+
+
+def run_child() -> None:
+    """The actual measurement (one process, one backend init)."""
     path = make_corpus()
     size_mb = os.path.getsize(path) / 2**20
     log(f"bench: corpus {size_mb:.1f} MB")
-    baseline = host_only_mb_per_sec(path, size_mb)
-    value, _stats = into_hbm_mb_per_sec(path, size_mb)
+    base_best, base_med = host_only_mb_per_sec(path, size_mb)
+    try:
+        value, med, spread, _stats = into_hbm_mb_per_sec(path, size_mb)
+    except Exception as exc:  # noqa: BLE001 - classify for the supervisor
+        msg = f"{type(exc).__name__}: {exc}"
+        if any(m in msg for m in _INFRA_MARKERS):
+            log(f"bench: backend/transport failure: {msg}")
+            sys.exit(EX_INFRA)
+        raise
     line = {
         "metric": "rowblockiter_mb_per_sec_into_hbm",
         "value": round(value, 2),
         "unit": "MB/s",
-        "vs_baseline": round(value / baseline, 3),
+        "vs_baseline": round(value / base_best, 3),
+        # median + spread alongside best-of: with 2-4x ambient swings on this
+        # shared host a single lucky rep can overstate steady state
+        "median": round(med, 2),
+        "median_vs_baseline": round(med / base_med, 3),
+        "spread": [round(spread[0], 2), round(spread[1], 2)],
+        "reps": REPS,
     }
     # bf16 ingest: the C++ repack emits bfloat16 (the MXU's operand width),
     # halving host->HBM bytes — reported alongside, headline stays f32
     try:
-        bf16_value, _ = into_hbm_mb_per_sec(path, size_mb, x_dtype="bfloat16")
+        bf16_value, bf16_med, _sp, _ = into_hbm_mb_per_sec(
+            path, size_mb, x_dtype="bfloat16")
         line["bf16_mb_per_sec"] = round(bf16_value, 2)
-        line["bf16_vs_baseline"] = round(bf16_value / baseline, 3)
+        line["bf16_vs_baseline"] = round(bf16_value / base_best, 3)
+        line["bf16_median_vs_baseline"] = round(bf16_med / base_med, 3)
     except Exception as exc:  # noqa: BLE001 - the headline must still print
         log(f"bench: bf16 leg failed: {exc}")
     print(json.dumps(line))
 
 
+# ---------------------------------------------------------------------------
+# Supervisor: retry the child through TPU-tunnel flakes.
+
+def _probe_device(timeout: float = 45.0) -> bool:
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "benchmarks"))
+    from _common import probe_device
+
+    return probe_device(timeout)
+
+
+def wait_for_device(window_s: float) -> bool:
+    """Probe every 60s for up to window_s; the tunnel demonstrably recovers
+    within minutes (TPU_BATTERY.log r3)."""
+    deadline = time.monotonic() + window_s
+    while True:
+        if _probe_device():
+            return True
+        if time.monotonic() >= deadline:
+            return False
+        log("bench: device unreachable, re-probing in 60s")
+        time.sleep(60)
+
+
+def main() -> int:
+    if os.environ.get("DMLC_BENCH_CHILD") == "1":
+        run_child()
+        return 0
+
+    attempts = int(os.environ.get("DMLC_BENCH_ATTEMPTS", "3"))
+    # GB-scale runs need hours-scale headroom; default scales with corpus
+    timeout = float(os.environ.get("DMLC_BENCH_TIMEOUT",
+                                   str(max(1800.0, TARGET_MB * 6.0))))
+    probe_window = float(os.environ.get("DMLC_BENCH_PROBE_WINDOW", "600"))
+    env = dict(os.environ, DMLC_BENCH_CHILD="1")
+    last_err = ""
+    infra = True
+    for attempt in range(1, attempts + 1):
+        log(f"bench: attempt {attempt}/{attempts}")
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                stdout=subprocess.PIPE, stderr=sys.stderr, text=True,
+                timeout=timeout)
+        except subprocess.TimeoutExpired:
+            # the tunnel can hang a backend init indefinitely: a timeout is
+            # an infra failure, not a bench bug
+            last_err = f"timeout after {timeout:.0f}s"
+            log(f"bench: child {last_err}")
+        else:
+            out_lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+            if proc.returncode == 0 and out_lines:
+                try:
+                    parsed = json.loads(out_lines[-1])
+                except ValueError:
+                    parsed = None
+                if isinstance(parsed, dict) and "metric" in parsed:
+                    if attempt > 1:
+                        parsed["infra_retries"] = attempt - 1
+                    print(json.dumps(parsed))
+                    return 0
+            last_err = f"rc={proc.returncode}"
+            log(f"bench: child failed ({last_err})")
+            if proc.returncode != EX_INFRA:
+                # deterministic bench bug: re-running cannot succeed
+                infra = False
+                break
+        if attempt < attempts:
+            # wait out the flake before burning another full run; if the
+            # device never comes back inside the window, stop burning
+            # child timeouts and report unavailability now
+            if wait_for_device(probe_window):
+                log("bench: device reachable again, retrying")
+            else:
+                log("bench: device still unreachable after probe window")
+                break
+    print(json.dumps({
+        "metric": "rowblockiter_mb_per_sec_into_hbm",
+        "value": None,
+        "unit": "MB/s",
+        "vs_baseline": None,
+        "infra": "tpu_unavailable" if infra else "bench_error",
+        "attempts": attempts,
+        "last_error": last_err,
+    }))
+    return 3 if infra else 1
+
+
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

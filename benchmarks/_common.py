@@ -15,6 +15,43 @@ TARGET_MB = float(os.environ.get("DMLC_BENCH_MB", "64"))  # = bench.py
 REPS = 3
 
 
+def pin_platform() -> None:
+    """Apply DMLC_BENCH_PLATFORM as an in-process jax platform pin — env
+    vars alone do NOT redirect jax on this host (a site hook registers the
+    TPU tunnel platform at interpreter start). Call before first jax use;
+    lets any device benchmark be smoke-tested on CPU."""
+    platform = os.environ.get("DMLC_BENCH_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+
+def probe_device(timeout: float = 45.0) -> bool:
+    """Can a fresh process reach the accelerator? Bounded — the tunnel can
+    HANG a backend init indefinitely, so the probe lives in a killable
+    subprocess. Honors DMLC_BENCH_PLATFORM (in-process jax platform pin,
+    the only pin that works on this host); without it, a CPU fallback does
+    NOT count as reachable — the probe exists to detect the TPU."""
+    import subprocess
+
+    platform = os.environ.get("DMLC_BENCH_PLATFORM")
+    pin = f"jax.config.update('jax_platforms', {platform!r});" if platform else ""
+    guard = "" if platform else (
+        "assert jax.devices()[0].platform != 'cpu', 'cpu fallback';")
+    code = (
+        "import jax, numpy as np;" + pin + guard +
+        "x = jax.device_put(np.ones((64, 64), np.float32));"
+        "jax.block_until_ready(x); print('probe-ok', jax.devices()[0])"
+    )
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return False
+    return proc.returncode == 0 and "probe-ok" in proc.stdout
+
+
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
@@ -34,12 +71,24 @@ def emit(metric: str, value: float, unit: str, baseline: float, **extra) -> None
 
 
 def timed_best(fn, reps: int = REPS) -> float:
-    best = float("inf")
+    return timed_stats(fn, reps)[0]
+
+
+def timed_stats(fn, reps: int = REPS):
+    """Time ``fn`` reps times -> (best, median, times).
+
+    Ambient throughput on this shared host swings 2-4x run-to-run: best-of
+    guards against infra slowness, but a single lucky rep can overstate
+    steady state by the same factor — benchmarks report BOTH (VERDICT r3
+    weak #4)."""
+    from statistics import median
+
+    times = []
     for _ in range(reps):
         t0 = time.monotonic()
         fn()
-        best = min(best, time.monotonic() - t0)
-    return best
+        times.append(time.monotonic() - t0)
+    return min(times), median(times), times
 
 
 def paired_times(fn_a, fn_b, pairs: int = REPS):

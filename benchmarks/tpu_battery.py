@@ -19,22 +19,10 @@ import subprocess
 import sys
 import time
 
+from _common import probe_device as probe
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, "TPU_BATTERY.log")
-
-
-def probe(timeout: float = 45.0) -> bool:
-    code = (
-        "import jax, numpy as np;"
-        "x = jax.device_put(np.ones((64, 64), np.float32));"
-        "jax.block_until_ready(x); print('probe-ok', jax.devices()[0])"
-    )
-    try:
-        proc = subprocess.run([sys.executable, "-c", code],
-                              capture_output=True, text=True, timeout=timeout)
-    except subprocess.TimeoutExpired:
-        return False
-    return proc.returncode == 0 and "probe-ok" in proc.stdout
 
 
 def run(cmd, env=None, timeout=3600):
