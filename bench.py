@@ -106,13 +106,7 @@ def into_hbm_mb_per_sec(path: str, size_mb: float, x_dtype: str = "float32"):
     """Full async pipeline into device HBM."""
     import jax
 
-    # JAX_PLATFORMS in the env does NOT stick on this host (the site hook
-    # registers the axon TPU platform at interpreter start); the in-process
-    # config update is the working pin. Used to smoke-test the pipeline on
-    # CPU when the tunnel is down.
-    platform = os.environ.get("DMLC_BENCH_PLATFORM")
-    if platform:
-        jax.config.update("jax_platforms", platform)
+    _bench_common().pin_platform()
 
     from dmlc_tpu.data import create_parser
     from dmlc_tpu.data.device import DeviceIter
@@ -230,12 +224,18 @@ def run_child() -> None:
 # ---------------------------------------------------------------------------
 # Supervisor: retry the child through TPU-tunnel flakes.
 
-def _probe_device(timeout: float = 45.0) -> bool:
+def _bench_common():
+    """The shared benchmark helpers (probe, platform pin) — one module so
+    the logic cannot diverge between bench.py and benchmarks/*."""
     sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                     "benchmarks"))
-    from _common import probe_device
+    import _common
 
-    return probe_device(timeout)
+    return _common
+
+
+def _probe_device(timeout: float = 45.0) -> bool:
+    return _bench_common().probe_device(timeout)
 
 
 def wait_for_device(window_s: float) -> bool:
@@ -264,6 +264,7 @@ def main() -> int:
     env = dict(os.environ, DMLC_BENCH_CHILD="1")
     last_err = ""
     infra = True
+    attempt = 0
     for attempt in range(1, attempts + 1):
         log(f"bench: attempt {attempt}/{attempts}")
         try:
@@ -309,7 +310,7 @@ def main() -> int:
         "unit": "MB/s",
         "vs_baseline": None,
         "infra": "tpu_unavailable" if infra else "bench_error",
-        "attempts": attempts,
+        "attempts": attempt,  # attempts actually made, not the configured max
         "last_error": last_err,
     }))
     return 3 if infra else 1

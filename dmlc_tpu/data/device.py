@@ -24,7 +24,9 @@ import jax
 import numpy as np
 
 from dmlc_tpu.data.parsers import Parser
-from dmlc_tpu.data.row_block import DenseBlock, RowBlock, RowBlockContainer
+from dmlc_tpu.data.row_block import (
+    CooBlock, DenseBlock, RowBlock, RowBlockContainer,
+)
 from dmlc_tpu.io.threaded_iter import ThreadedIter
 from dmlc_tpu.ops.sparse import (
     EllBatch, block_to_bcoo_host, block_to_dense, block_to_ell,
@@ -91,7 +93,7 @@ class DeviceIter:
         device=None,
         elide_unit_values: bool = False,
         x_dtype: str = "float32",
-        nnz_bucket: int = 16384,
+        nnz_bucket: Optional[int] = None,
         row_bucket: int = 1024,
     ):
         check(layout in ("dense", "ell", "bcoo"), f"unknown layout {layout!r}")
@@ -132,8 +134,20 @@ class DeviceIter:
         # tunneled device) and a recompile in any downstream jit. The nnz
         # padding uses OUT-OF-BOUNDS coords, which every BCOO op masks —
         # load-bearing for elide_unit_values, where the device synthesizes
-        # ones for pad slots too (see block_to_bcoo_host). Set 0 to
-        # disable (exact shapes, e.g. for interop tests).
+        # ones for pad slots too (see block_to_bcoo_host). NOTE: batches
+        # then carry mat.nse > true nnz — padding is part of the shape;
+        # consumers needing the true count must track it themselves.
+        # Default (None) derives the bucket: batch_size * max_nnz when both
+        # are known (one exact repeating shape), a small 4096 quantum for
+        # fixed small batches, 16384 for chunk-sized natural blocks. Set 0
+        # to disable (exact shapes, e.g. for interop tests).
+        if nnz_bucket is None:
+            if batch_size is not None and max_nnz:
+                nnz_bucket = int(batch_size) * int(max_nnz)
+            elif batch_size is not None:
+                nnz_bucket = 4096
+            else:
+                nnz_bucket = 16384
         self.nnz_bucket = int(nnz_bucket)
         self.row_bucket = int(row_bucket)
         self._skip_blocks = 0  # producer-put resume: blocks to drop unput
@@ -143,6 +157,16 @@ class DeviceIter:
         self.bytes_to_device = 0
         # DMLC_TPU_TRACE=1 wraps each transfer in a profiler annotation
         self._trace = os.environ.get("DMLC_TPU_TRACE", "0") == "1"
+        if (layout == "bcoo" and batch_size is None
+                and hasattr(source, "set_emit_coo")):
+            # ask the parser for device-ready COO batches: coordinate
+            # assembly, bucket padding, and unit-value elision move off-GIL
+            # into the C++ parse threads; the convert thread then only
+            # issues the (async) device_put. Safe to ignore the answer —
+            # _convert handles CooBlock and RowBlock alike.
+            source.set_emit_coo(num_col, row_bucket=self.row_bucket,
+                                nnz_bucket=self.nnz_bucket,
+                                elide_unit=self.elide_unit_values)
         if layout == "dense" and hasattr(source, "set_emit_dense"):
             # ask the parser for HBM-ready dense batches (skips CSR), repacked
             # to this batch size (and target dtype) off-GIL when the native
@@ -318,6 +342,11 @@ class DeviceIter:
         return np.dtype(np.float32)
 
     def _convert(self, block: RowBlock):
+        if isinstance(block, CooBlock):
+            # native COO emit: already device-layout (coords/values/label/
+            # weight assembled + bucket-padded off-GIL) — nothing to do here
+            return ("bcoo", block.coords, block.values, block.label,
+                    block.weight, block.shape)
         pad = (self.batch_size
                if self.batch_size is not None and len(block) != self.batch_size
                else None)

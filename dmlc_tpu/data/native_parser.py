@@ -29,7 +29,7 @@ from dmlc_tpu.data.parsers import (
     csv_cells_to_block,
     csv_cells_to_dense,
 )
-from dmlc_tpu.data.row_block import DenseBlock, RowBlock
+from dmlc_tpu.data.row_block import CooBlock, DenseBlock, RowBlock
 from dmlc_tpu.io.filesystem import LocalFileSystem, get_filesystem
 from dmlc_tpu.io.input_split import DEFAULT_CHUNK_BYTES, LineSplitter
 from dmlc_tpu.utils.check import DMLCError, check
@@ -104,6 +104,10 @@ class NativeStreamParser(Parser):
         self._reader = None
         self._emit_dense: Optional[int] = None
         self._emit_bf16 = False
+        self._emit_coo: Optional[int] = None
+        self._coo_row_bucket = 0
+        self._coo_nnz_bucket = 0
+        self._coo_elide = False
         self._stall = 0.0
         self._blocks_out = 0  # delivered blocks, for count-based resume
         self._batch_rows = 0
@@ -132,6 +136,23 @@ class NativeStreamParser(Parser):
         self._emit_bf16 = dtype == "bfloat16"
         return True
 
+    def set_emit_coo(self, num_col: int, row_bucket: int = 0,
+                     nnz_bucket: int = 0, elide_unit: bool = False) -> bool:
+        """Emit CooBlock batches straight from the native parse: int32
+        (row, col) coordinate pairs with OOB bucket padding, optional
+        all-ones value elision — the whole convert stage of the BCOO
+        pipeline moves off-GIL into the C++ parse threads. One CooBlock per
+        chunk (natural-block mode). Must be called before the first pull.
+        csv has no sparse analog; int32 coords require num_col + 1 < 2^31."""
+        if (self._reader is not None or self.fmt_name == "csv"
+                or int(num_col) + 1 >= (1 << 31)):
+            return False
+        self._emit_coo = int(num_col)
+        self._coo_row_bucket = int(row_bucket)
+        self._coo_nnz_bucket = int(nnz_bucket)
+        self._coo_elide = bool(elide_unit)
+        return True
+
     # ---------------- pipeline ----------------
 
     def _stream_config(self):
@@ -139,7 +160,10 @@ class NativeStreamParser(Parser):
         Feeder — one place for format selection and repack policy."""
         from dmlc_tpu import native
 
-        if self.fmt_name == "libsvm":
+        if self._emit_coo is not None and self.fmt_name in ("libsvm", "libfm"):
+            fmt = (native.FMT_LIBFM_COO if self.fmt_name == "libfm"
+                   else native.FMT_LIBSVM_COO)
+        elif self.fmt_name == "libsvm":
             fmt = (native.FMT_LIBSVM_DENSE if self._emit_dense is not None
                    else native.FMT_LIBSVM)
         elif self.fmt_name == "csv":
@@ -148,8 +172,9 @@ class NativeStreamParser(Parser):
             fmt = native.FMT_LIBFM
         repack = (fmt == native.FMT_LIBSVM_DENSE
                   or (fmt == native.FMT_CSV and self._emit_dense is not None))
+        coo = fmt in (native.FMT_LIBSVM_COO, native.FMT_LIBFM_COO)
         kwargs = dict(
-            num_col=self._emit_dense or 0,
+            num_col=(self._emit_coo if coo else self._emit_dense) or 0,
             indexing_mode=getattr(self.param, "indexing_mode", 0),
             delimiter=getattr(self.param, "delimiter", ","),
             chunk_bytes=self.chunk_bytes,
@@ -157,6 +182,9 @@ class NativeStreamParser(Parser):
             label_col=getattr(self.param, "label_column", -1),
             weight_col=getattr(self.param, "weight_column", -1),
             out_bf16=bool(repack and self._batch_rows and self._emit_bf16),
+            row_bucket=self._coo_row_bucket if coo else 0,
+            nnz_bucket=self._coo_nnz_bucket if coo else 0,
+            elide_unit=self._coo_elide if coo else False,
         )
         return fmt, kwargs
 
@@ -184,6 +212,11 @@ class NativeStreamParser(Parser):
         if fmt == native.FMT_LIBSVM_DENSE:
             x, label, weight, owner = data
             return DenseBlock(x, label, weight, hold=owner)
+        if fmt in (native.FMT_LIBSVM_COO, native.FMT_LIBFM_COO):
+            return CooBlock(
+                data["coords"], data["values"], data["label"],
+                data["weight"], data["n_rows"], data["nnz"],
+                int(self._emit_coo), hold=data["_owner"])
         if fmt in (native.FMT_LIBSVM, native.FMT_LIBFM):
             return RowBlock(
                 offset=data["offset"], label=data["label"],

@@ -202,6 +202,44 @@ class DenseBlock:
             hold=self.hold)
 
 
+class CooBlock:
+    """A parsed batch already in device-ready COO layout.
+
+    Emitted by parsers in COO mode (``set_emit_coo``) — coordinates are
+    int32 [nnz_padded, 2] (row, col) with OOB padding, ``values`` is None
+    when the block is all-ones and elision is on (the device synthesizes
+    them), and label/weight carry the bucket-padded row dim. The native
+    pass assembles these off-GIL, replacing the numpy coordinate assembly
+    of ops.sparse.block_to_bcoo_host on the convert thread. ``n_rows`` and
+    ``nnz`` are the REAL counts. No reference analog (its parsers always
+    build CSR, src/data/row_block.h); this is the TPU-first sparse path.
+    """
+
+    __slots__ = ("coords", "values", "label", "weight", "n_rows", "nnz",
+                 "num_col", "hold", "resume_state")
+
+    def __init__(self, coords: np.ndarray, values: Optional[np.ndarray],
+                 label: np.ndarray, weight: np.ndarray, n_rows: int,
+                 nnz: int, num_col: int, hold=None):
+        self.coords = coords
+        self.values = values
+        self.label = label
+        self.weight = weight
+        self.n_rows = n_rows
+        self.nnz = nnz
+        self.num_col = num_col
+        self.hold = hold
+        self.resume_state = None
+
+    @property
+    def shape(self):
+        """BCOO dense shape: (padded rows, declared width)."""
+        return (len(self.label), self.num_col)
+
+    def __len__(self) -> int:
+        return self.n_rows
+
+
 class RowBlockContainer:
     """Growable RowBlock accumulator — analog of src/data/row_block.h.
 

@@ -35,7 +35,7 @@ _HDRS = [os.path.join(_SRC_DIR, f)
          for f in ("api.h", "strtonum.h", "parse_internal.h")]
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 _SO_PATH = os.path.join(_BUILD_DIR, "libdmlc_tpu_native.so")
-_ABI_VERSION = 11
+_ABI_VERSION = 12
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -76,6 +76,21 @@ class _CsvResult(ctypes.Structure):
         ("n_cols", ctypes.c_int64),
         ("cells", ctypes.POINTER(ctypes.c_float)),
         ("error", ctypes.c_char_p),
+    ]
+
+
+class _CooResult(ctypes.Structure):
+    _fields_ = [
+        ("n_rows", ctypes.c_int64),
+        ("nnz", ctypes.c_int64),
+        ("rows_padded", ctypes.c_int64),
+        ("nnz_padded", ctypes.c_int64),
+        ("coords", ctypes.POINTER(ctypes.c_int32)),
+        ("values", ctypes.POINTER(ctypes.c_float)),
+        ("label", ctypes.POINTER(ctypes.c_float)),
+        ("weight", ctypes.POINTER(ctypes.c_float)),
+        ("error", ctypes.c_char_p),
+        ("values_elided", ctypes.c_int32),
     ]
 
 
@@ -208,13 +223,20 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.dmlc_recordio_extract.restype = ctypes.POINTER(_RecordBatchResult)
     lib.dmlc_recordio_extract.argtypes = [ctypes.c_char_p, ctypes.c_int64]
     lib.dmlc_free_records.argtypes = [ctypes.c_void_p]
+    lib.dmlc_parse_coo.restype = ctypes.POINTER(_CooResult)
+    lib.dmlc_parse_coo.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int32]
+    lib.dmlc_free_coo.argtypes = [ctypes.c_void_p]
     lib.dmlc_reader_create.restype = ctypes.c_void_p
     lib.dmlc_reader_create.argtypes = [
         ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
         ctypes.c_int32, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
         ctypes.c_int64, ctypes.c_int32, ctypes.c_char, ctypes.c_int32,
         ctypes.c_int64, ctypes.c_int32, ctypes.c_int64, ctypes.c_int32,
-        ctypes.c_int32, ctypes.c_int32]
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int32]
     lib.dmlc_reader_next.restype = ctypes.c_void_p
     lib.dmlc_reader_next.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32)]
@@ -228,7 +250,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.dmlc_feeder_create.argtypes = [
         ctypes.c_int32, ctypes.c_int64, ctypes.c_int32, ctypes.c_char,
         ctypes.c_int32, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
-        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32]
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int32]
     lib.dmlc_feeder_push.restype = ctypes.c_int32
     lib.dmlc_feeder_push.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
@@ -487,6 +510,40 @@ FMT_CSV = 2
 FMT_LIBFM = 3
 FMT_RECORDIO = 4
 FMT_RECORDIO_CHUNK = 5
+FMT_LIBSVM_COO = 6
+FMT_LIBFM_COO = 7
+
+
+def _free_coo(lib, addr):
+    lib.dmlc_free_coo(addr)
+
+
+def _wrap_coo(lib, res):
+    """Wrap a CooResult as a dict of zero-copy views.
+
+    ``coords`` is int32 [nnz_padded, 2]; ``values`` is None when the block
+    is all-ones and elision was requested (consumer synthesizes on device);
+    ``n_rows``/``nnz`` are the REAL counts (shape dims carry bucket pad)."""
+    r = res.contents
+    if r.error:
+        msg = r.error.decode()
+        lib.dmlc_free_coo(res)
+        raise DMLCError(msg)
+    owner = _Owner(lib, res, _free_coo)
+    coords = _view(r.coords, 2 * r.nnz_padded, np.int32, owner)
+    coords = coords.reshape(r.nnz_padded, 2) if coords is not None \
+        else np.zeros((0, 2), np.int32)
+    return {
+        "n_rows": int(r.n_rows),
+        "nnz": int(r.nnz),
+        "rows_padded": int(r.rows_padded),
+        "coords": coords,
+        "values": (None if r.values_elided
+                   else _view(r.values, r.nnz_padded, np.float32, owner)),
+        "label": _view(r.label, r.rows_padded, np.float32, owner),
+        "weight": _view(r.weight, r.rows_padded, np.float32, owner),
+        "_owner": owner,
+    }
 
 
 def _wrap_stream_result(lib, ptr, fmt_value, num_col):
@@ -500,6 +557,9 @@ def _wrap_stream_result(lib, ptr, fmt_value, num_col):
     if fmt_value in (FMT_RECORDIO, FMT_RECORDIO_CHUNK):
         return fmt_value, _wrap_records(
             lib, ctypes.cast(ptr, ctypes.POINTER(_RecordBatchResult)))
+    if fmt_value in (FMT_LIBSVM_COO, FMT_LIBFM_COO):
+        return fmt_value, _wrap_coo(
+            lib, ctypes.cast(ptr, ctypes.POINTER(_CooResult)))
     return fmt_value, _wrap_csv(
         lib, ctypes.cast(ptr, ctypes.POINTER(_CsvResult)))
 
@@ -517,7 +577,9 @@ class Reader:
                  delimiter: str = ",", nthread: int = 0,
                  chunk_bytes: int = 1 << 20, queue_depth: int = 4,
                  batch_rows: int = 0, label_col: int = -1,
-                 weight_col: int = -1, out_bf16: bool = False):
+                 weight_col: int = -1, out_bf16: bool = False,
+                 row_bucket: int = 0, nnz_bucket: int = 0,
+                 elide_unit: bool = False):
         lib = _load()
         if lib is None:
             raise DMLCError("native core unavailable")
@@ -531,7 +593,8 @@ class Reader:
             arr_p, arr_s, len(paths), part_index, num_parts, fmt, num_col,
             indexing_mode, delimiter.encode()[0] if delimiter else b","[0],
             nthread or default_nthread(), chunk_bytes, queue_depth,
-            batch_rows, label_col, weight_col, 1 if out_bf16 else 0)
+            batch_rows, label_col, weight_col, 1 if out_bf16 else 0,
+            row_bucket, nnz_bucket, 1 if elide_unit else 0)
         if not self._h:
             raise DMLCError(
                 "native reader creation failed (out of memory or threads)")
@@ -593,7 +656,9 @@ class Feeder:
                  delimiter: str = ",", nthread: int = 0,
                  chunk_bytes: int = 1 << 20, queue_depth: int = 4,
                  batch_rows: int = 0, label_col: int = -1,
-                 weight_col: int = -1, out_bf16: bool = False):
+                 weight_col: int = -1, out_bf16: bool = False,
+                 row_bucket: int = 0, nnz_bucket: int = 0,
+                 elide_unit: bool = False):
         lib = _load()
         if lib is None:
             raise DMLCError("native core unavailable")
@@ -604,7 +669,8 @@ class Feeder:
             fmt, num_col, indexing_mode,
             delimiter.encode()[0] if delimiter else b","[0],
             nthread or default_nthread(), chunk_bytes, queue_depth,
-            batch_rows, label_col, weight_col, 1 if out_bf16 else 0)
+            batch_rows, label_col, weight_col, 1 if out_bf16 else 0,
+            row_bucket, nnz_bucket, 1 if elide_unit else 0)
         if not self._h:
             raise DMLCError("native feeder creation failed")
 

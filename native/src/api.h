@@ -47,6 +47,40 @@ struct CsvResult {
   char* error;
 };
 
+// Sparse batch in device-ready COO layout (the BCOO host half): coords are
+// int32 (row, col) pairs — on KDD-shaped data the coordinate array
+// dominates transfer bytes, so int32 halves host->HBM traffic vs int64 —
+// padded out to rows_padded/nnz_padded with OUT-OF-BOUNDS entries
+// (rows_padded, num_col), which every jax BCOO op masks. values may be
+// NULL with values_elided=1 when every real value is 1.0f (binary-feature
+// corpora): the consumer synthesizes ones on device, saving 4 B/nnz of
+// transfer. qid/field are not carried (BCOO interop drops them, matching
+// the Python convert path). Free with dmlc_free_coo.
+struct CooResult {
+  int64_t n_rows;       // real rows
+  int64_t nnz;          // real entries
+  int64_t rows_padded;  // label/weight length (>= n_rows)
+  int64_t nnz_padded;   // coords rows / values length (>= nnz)
+  int32_t* coords;      // [nnz_padded, 2] row-major (row, col)
+  float* values;        // [nnz_padded] or NULL when values_elided
+  float* label;         // [rows_padded], zeros past n_rows
+  float* weight;        // [rows_padded], zeros past n_rows
+  char* error;          // null on success
+  int32_t values_elided;
+};
+
+// Parse a text chunk (fmt: 0 = libsvm, 3 = libfm) straight to COO.
+// row_bucket/nnz_bucket quantize the padded dims UP to bucket multiples so
+// batch shapes REPEAT across chunks (a novel-shape device_put costs a fresh
+// transfer plan, measured ~100x a repeated-shape one on a tunneled TPU);
+// 0 disables. elide_unit enables the all-ones value elision. Requires
+// max(num_col, chunk rows) + 1 < 2^31 (int32 coords); callers guard.
+CooResult* dmlc_parse_coo(const char* data, int64_t len, int nthread,
+                          int indexing_mode, int fmt, int64_t num_col,
+                          int64_t row_bucket, int64_t nnz_bucket,
+                          int32_t elide_unit);
+void dmlc_free_coo(CooResult* r);
+
 // A batch of RecordIO record payloads: record i is
 // data[offsets[i] : offsets[i+1]]. Free with dmlc_free_records.
 struct RecordBatchResult {
@@ -96,13 +130,17 @@ int dmlc_native_abi_version();
 // num_col; results then carry format 1 (dense). out_bf16 = 1 converts x
 // to bfloat16 (round-to-nearest-even) DURING the repack copy — the same
 // single pass, half the output bytes.
+// Formats 6 (libsvm -> COO) and 7 (libfm -> COO) emit CooResult blocks:
+// one device-ready COO batch per chunk, with row_bucket/nnz_bucket shape
+// quantization and optional unit-value elision (see dmlc_parse_coo).
 void* dmlc_reader_create(const char** paths, const int64_t* sizes,
                          int32_t nfiles, int64_t part_index, int64_t num_parts,
                          int32_t format, int64_t num_col, int32_t indexing_mode,
                          char delim, int32_t nthread, int64_t chunk_bytes,
                          int32_t queue_depth, int64_t batch_rows,
                          int32_t label_col, int32_t weight_col,
-                         int32_t out_bf16);
+                         int32_t out_bf16, int64_t row_bucket,
+                         int64_t nnz_bucket, int32_t elide_unit);
 // Next parsed block; NULL at end-of-partition or on reader error (check
 // dmlc_reader_error). Parse errors ride the result's own error field.
 // Blocks with zero rows are never returned. `fmt_out` (may be NULL)
@@ -157,7 +195,9 @@ void* dmlc_feeder_create(int32_t format, int64_t num_col,
                          int32_t indexing_mode, char delim, int32_t nthread,
                          int64_t chunk_bytes, int32_t queue_depth,
                          int64_t batch_rows, int32_t label_col,
-                         int32_t weight_col, int32_t out_bf16);
+                         int32_t weight_col, int32_t out_bf16,
+                         int64_t row_bucket, int64_t nnz_bucket,
+                         int32_t elide_unit);
 // 0 = accepted; -1 = reader stopped/failed (check dmlc_feeder_error).
 int32_t dmlc_feeder_push(void* handle, const char* data, int64_t len);
 // Signal end of input: the pipeline flushes its tail and then next()

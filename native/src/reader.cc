@@ -50,6 +50,12 @@ constexpr int kFmtCsv = 2;
 constexpr int kFmtLibfm = 3;
 constexpr int kFmtRecordIO = 4;
 constexpr int kFmtRecordIOChunk = 5;  // raw framed chunks, one per result
+constexpr int kFmtLibsvmCoo = 6;      // device-ready COO (CooResult)
+constexpr int kFmtLibfmCoo = 7;
+
+inline bool is_recordio_fmt(int format) {
+  return format == kFmtRecordIO || format == kFmtRecordIOChunk;
+}
 
 void free_result(int format, void* res) {
   if (!res) return;
@@ -68,6 +74,10 @@ void free_result(int format, void* res) {
     case kFmtRecordIOChunk:
       dmlc_free_records(static_cast<RecordBatchResult*>(res));
       break;
+    case kFmtLibsvmCoo:
+    case kFmtLibfmCoo:
+      dmlc_free_coo(static_cast<CooResult*>(res));
+      break;
   }
 }
 
@@ -83,6 +93,9 @@ int64_t result_rows(int format, void* res) {
     case kFmtRecordIO:
     case kFmtRecordIOChunk:
       return static_cast<RecordBatchResult*>(res)->n_records;
+    case kFmtLibsvmCoo:
+    case kFmtLibfmCoo:
+      return static_cast<CooResult*>(res)->n_rows;
   }
   return 0;
 }
@@ -99,6 +112,9 @@ const char* result_error(int format, void* res) {
     case kFmtRecordIO:
     case kFmtRecordIOChunk:
       return static_cast<RecordBatchResult*>(res)->error;
+    case kFmtLibsvmCoo:
+    case kFmtLibfmCoo:
+      return static_cast<CooResult*>(res)->error;
   }
   return nullptr;
 }
@@ -153,7 +169,9 @@ class LineReader {
              int64_t part_index, int64_t num_parts, int format,
              int64_t num_col, int indexing_mode, char delim, int nthread,
              int64_t chunk_bytes, int queue_depth, int64_t batch_rows,
-             int32_t label_col, int32_t weight_col, bool out_bf16 = false)
+             int32_t label_col, int32_t weight_col, bool out_bf16 = false,
+             int64_t row_bucket = 0, int64_t nnz_bucket = 0,
+             bool elide_unit = false)
       : paths_(std::move(paths)),
         format_(format),
         num_col_(num_col),
@@ -165,10 +183,13 @@ class LineReader {
         batch_rows_(batch_rows > 0 ? batch_rows : 0),
         label_col_(label_col),
         weight_col_(weight_col),
-        out_bf16_(out_bf16 && batch_rows > 0) {
+        out_bf16_(out_bf16 && batch_rows > 0),
+        row_bucket_(row_bucket > 0 ? row_bucket : 0),
+        nnz_bucket_(nnz_bucket > 0 ? nnz_bucket : 0),
+        elide_unit_(elide_unit) {
     file_offset_.push_back(0);
     for (size_t i = 0; i < sizes.size(); ++i) {
-      if (format_ >= kFmtRecordIO && sizes[i] % 4 != 0) {
+      if (is_recordio_fmt(format_) && sizes[i] % 4 != 0) {
         error_ = "recordio: file " + paths_[i] + " does not align by 4 bytes";
       }
       file_offset_.push_back(file_offset_.back() + sizes[i]);
@@ -188,7 +209,8 @@ class LineReader {
   LineReader(int format, int64_t num_col, int indexing_mode, char delim,
              int nthread, int64_t chunk_bytes, int queue_depth,
              int64_t batch_rows, int32_t label_col, int32_t weight_col,
-             bool out_bf16 = false)
+             bool out_bf16 = false, int64_t row_bucket = 0,
+             int64_t nnz_bucket = 0, bool elide_unit = false)
       : format_(format),
         num_col_(num_col),
         indexing_mode_(indexing_mode),
@@ -200,6 +222,9 @@ class LineReader {
         label_col_(label_col),
         weight_col_(weight_col),
         out_bf16_(out_bf16 && batch_rows > 0),
+        row_bucket_(row_bucket > 0 ? row_bucket : 0),
+        nnz_bucket_(nnz_bucket > 0 ? nnz_bucket : 0),
+        elide_unit_(elide_unit),
         push_mode_(true) {
     file_offset_.push_back(0);
     start();
@@ -304,7 +329,7 @@ class LineReader {
   }
 
  private:
-  bool is_text() const { return format_ < kFmtRecordIO; }
+  bool is_text() const { return !is_recordio_fmt(format_); }
 
   // ---------------- partitioning (create-time, mirrors ResetPartition) ----
   void reset_partition(int64_t part_index, int64_t num_parts) {
@@ -563,6 +588,15 @@ class LineReader {
         return dmlc_parse_csv(data, len, nthread_, delim_);
       case kFmtLibfm:
         return dmlc_parse_libfm(data, len, nthread_, indexing_mode_);
+      case kFmtLibsvmCoo:
+      case kFmtLibfmCoo: {
+        void* r = dmlc_parse_coo(data, len, nthread_, indexing_mode_,
+                                 format_ == kFmtLibfmCoo ? 3 : 0, num_col_,
+                                 row_bucket_, nnz_bucket_,
+                                 elide_unit_ ? 1 : 0);
+        if (!r) set_error("coo: out of memory");
+        return r;
+      }
       case kFmtRecordIO: {
         void* r = dmlc_recordio_extract(data, len);
         if (!r) set_error("recordio: out of memory");
@@ -1173,6 +1207,10 @@ class LineReader {
   int32_t label_col_ = -1;   // csv->dense: label/weight column extraction
   int32_t weight_col_ = -1;  // (csv_parser.h label_column/weight_column)
   bool out_bf16_ = false;    // emit x as bfloat16 (batch repack mode only)
+  // COO formats: shape quantization buckets + unit-value elision
+  int64_t row_bucket_ = 0;
+  int64_t nnz_bucket_ = 0;
+  bool elide_unit_ = false;
   DenseResult* cur_ = nullptr;  // in-progress output batch (producer-owned)
   int64_t cur_rows_ = 0;
   bool cur_has_weight_ = false;
@@ -1557,14 +1595,16 @@ void* dmlc_reader_create(const char** paths, const int64_t* sizes,
                          char delim, int32_t nthread, int64_t chunk_bytes,
                          int32_t queue_depth, int64_t batch_rows,
                          int32_t label_col, int32_t weight_col,
-                         int32_t out_bf16) {
+                         int32_t out_bf16, int64_t row_bucket,
+                         int64_t nnz_bucket, int32_t elide_unit) {
   try {
     std::vector<std::string> p(paths, paths + nfiles);
     std::vector<int64_t> s(sizes, sizes + nfiles);
     return new LineReader(std::move(p), std::move(s), part_index, num_parts,
                           format, num_col, indexing_mode, delim, nthread,
                           chunk_bytes, queue_depth, batch_rows, label_col,
-                          weight_col, out_bf16 != 0);
+                          weight_col, out_bf16 != 0, row_bucket, nnz_bucket,
+                          elide_unit != 0);
   } catch (...) {
     // alloc/thread-spawn failure must not cross the extern "C" boundary
     // (std::terminate); null tells the caller creation failed
@@ -1596,11 +1636,14 @@ void* dmlc_feeder_create(int32_t format, int64_t num_col,
                          int32_t indexing_mode, char delim, int32_t nthread,
                          int64_t chunk_bytes, int32_t queue_depth,
                          int64_t batch_rows, int32_t label_col,
-                         int32_t weight_col, int32_t out_bf16) {
+                         int32_t weight_col, int32_t out_bf16,
+                         int64_t row_bucket, int64_t nnz_bucket,
+                         int32_t elide_unit) {
   try {
     return new LineReader(format, num_col, indexing_mode, delim, nthread,
                           chunk_bytes, queue_depth, batch_rows, label_col,
-                          weight_col, out_bf16 != 0);
+                          weight_col, out_bf16 != 0, row_bucket, nnz_bucket,
+                          elide_unit != 0);
   } catch (...) {
     return nullptr;
   }

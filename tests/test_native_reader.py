@@ -578,3 +578,171 @@ class TestNativeIndexedRecordIO:
         assert s2.bytes_read < total // 2, (s2.bytes_read, total)
         s2.close()
         assert got == want
+
+
+class TestNativeCooEmit:
+    """set_emit_coo: the native parse emits device-ready COO blocks (int32
+    coords, bucket padding with OOB sentinels, all-ones value elision) —
+    must agree entry-for-entry with the Python CSR -> block_to_bcoo_host
+    convert path it replaces (ops/sparse.py)."""
+
+    NUM_COL = 1_000_000
+
+    def _libfm_corpus(self, tmp_path, n=400, unit=True):
+        p = tmp_path / "c.libfm"
+        lines = []
+        for i in range(n):
+            val = "1" if unit else f"{(i % 7) + 0.5:.1f}"
+            feats = " ".join(
+                f"{j}:{(i * 2654435761 + j * 40503) % self.NUM_COL}:{val}"
+                for j in range(6))
+            lines.append(f"{i % 2} {feats}")
+        p.write_text("\n".join(lines) + "\n")
+        return str(p)
+
+    def _native_coo_blocks(self, uri, fmt, num_col, **coo_kw):
+        parser = create_parser(uri, 0, 1, fmt, threaded=True)
+        assert isinstance(parser, NativeStreamParser)
+        assert parser.set_emit_coo(num_col, **coo_kw)
+        blocks = []
+        while True:
+            b = parser.next_block()
+            if b is None:
+                break
+            blocks.append(b)
+        parser.close()
+        return blocks
+
+    def _python_ref(self, path, fmt, num_col):
+        from dmlc_tpu.ops.sparse import block_to_bcoo_host
+
+        parser = _py_parser(path, 0, 1, fmt)
+        coords, values, labels, weights = [], [], [], []
+        for blk in parser:
+            c, v, l, w, _ = block_to_bcoo_host(blk, num_col)
+            coords.append(c)
+            values.append(v if v is not None
+                          else np.ones(len(c), np.float32))
+            labels.append(l)
+            weights.append(w)
+        parser.close()
+        return (np.concatenate(coords), np.concatenate(values),
+                np.concatenate(labels), np.concatenate(weights))
+
+    @staticmethod
+    def _concat_real(blocks):
+        """Strip bucket padding and re-base row ids across blocks."""
+        from dmlc_tpu.data.row_block import CooBlock
+
+        coords, values, labels, weights = [], [], [], []
+        base = 0
+        for b in blocks:
+            assert isinstance(b, CooBlock)
+            c = b.coords[:b.nnz].astype(np.int64)
+            c[:, 0] += base
+            base += b.n_rows
+            coords.append(c)
+            values.append(np.ones(b.nnz, np.float32) if b.values is None
+                          else np.asarray(b.values[:b.nnz]))
+            labels.append(b.label[:b.n_rows])
+            weights.append(b.weight[:b.n_rows])
+        return (np.concatenate(coords), np.concatenate(values),
+                np.concatenate(labels), np.concatenate(weights))
+
+    def test_libfm_matches_python_convert(self, tmp_path):
+        path = self._libfm_corpus(tmp_path)
+        blocks = self._native_coo_blocks(
+            path + "?format=libfm", "libfm", self.NUM_COL,
+            row_bucket=128, nnz_bucket=512, elide_unit=True)
+        rc, rv, rl, rw = self._python_ref(path, "libfm", self.NUM_COL)
+        nc, nv, nl, nw = self._concat_real(blocks)
+        assert (nc == rc).all()
+        assert (nv == rv).all()
+        assert (nl == rl).all()
+        assert (nw == rw).all()
+
+    def test_unit_values_elided_and_padded_shapes(self, tmp_path):
+        path = self._libfm_corpus(tmp_path, unit=True)
+        blocks = self._native_coo_blocks(
+            path + "?format=libfm", "libfm", self.NUM_COL,
+            row_bucket=128, nnz_bucket=512, elide_unit=True)
+        for b in blocks:
+            assert b.values is None  # ":1" corpus -> elided
+            assert b.coords.dtype == np.int32
+            assert b.coords.shape[0] % 512 == 0
+            assert len(b.label) % 128 == 0
+            assert b.shape == (len(b.label), self.NUM_COL)
+            # padding is OOB (rows_padded, num_col) — masked by BCOO ops
+            pad = b.coords[b.nnz:]
+            if len(pad):
+                assert (pad[:, 0] == len(b.label)).all()
+                assert (pad[:, 1] == self.NUM_COL).all()
+            # pad rows are zero-weight
+            assert (np.asarray(b.weight[b.n_rows:]) == 0).all()
+
+    def test_non_unit_values_not_elided(self, tmp_path):
+        path = self._libfm_corpus(tmp_path, unit=False)
+        blocks = self._native_coo_blocks(
+            path + "?format=libfm", "libfm", self.NUM_COL,
+            row_bucket=128, nnz_bucket=512, elide_unit=True)
+        rc, rv, rl, rw = self._python_ref(path, "libfm", self.NUM_COL)
+        nc, nv, nl, nw = self._concat_real(blocks)
+        assert any(b.values is not None for b in blocks)
+        for b in blocks:
+            if b.values is not None:  # padding slots carry zero values
+                assert (np.asarray(b.values[b.nnz:]) == 0).all()
+        assert (nv == rv).all()
+        assert (nc == rc).all()
+
+    def test_libsvm_weights_and_indexing_heuristic(self, tmp_path):
+        # 1-based indices everywhere -> heuristic shifts to 0-based
+        # (libsvm_parser.h:159-168); weights ride the label:weight syntax
+        p = tmp_path / "w.libsvm"
+        p.write_text("".join(
+            f"{i % 2}:{0.5 + i} {1 + (i * 37) % 50}:2.5 {1 + (i * 53) % 50 + 50}:1\n"
+            for i in range(200)))
+        blocks = self._native_coo_blocks(
+            str(p), "libsvm", 101, row_bucket=64, nnz_bucket=64,
+            elide_unit=True)
+        rc, rv, rl, rw = self._python_ref(str(p), "libsvm", 101)
+        nc, nv, nl, nw = self._concat_real(blocks)
+        assert (nc == rc).all()
+        assert (nv == rv).all()
+        assert (nw == rw).all()
+        assert nc[:, 1].min() >= 0 and nc[:, 1].max() <= 100
+
+    def test_deviceiter_routes_native_coo(self, tmp_path):
+        from dmlc_tpu.data.device import DeviceIter
+
+        path = self._libfm_corpus(tmp_path)
+        parser = create_parser(path + "?format=libfm", 0, 1, threaded=True)
+        it = DeviceIter(parser, num_col=self.NUM_COL, batch_size=None,
+                        layout="bcoo", elide_unit_values=True)
+        total_rows = 0
+        for mat, y, w in it:
+            assert mat.shape[1] == self.NUM_COL
+            total_rows += int(w.sum())  # pad rows are zero-weight
+        it.close()
+        assert total_rows == 400
+
+    def test_feeder_coo_path(self, tmp_path):
+        """Push-mode (remote) pipeline speaks COO too."""
+        path = self._libfm_corpus(tmp_path)
+        with open(path, "rb") as fh:
+            data = fh.read()
+        f = native.Feeder(native.FMT_LIBFM_COO, num_col=self.NUM_COL,
+                          row_bucket=128, nnz_bucket=512, elide_unit=True)
+        f.push(data)
+        f.finish()
+        blocks = []
+        while True:
+            out = f.next()
+            if out is None:
+                break
+            fmt, d = out
+            assert fmt == native.FMT_LIBFM_COO
+            blocks.append(d)
+        f.close()
+        assert blocks
+        assert sum(b["n_rows"] for b in blocks) == 400
+        assert all(b["values"] is None for b in blocks)
