@@ -15,13 +15,13 @@ from typing import Dict, List
 from dmlc_tpu.utils.check import get_logger
 
 
-def exec_cmd(cmd: List[str], role: str, taskid: int, pass_env: Dict[str, str],
-             num_attempt: int = 1) -> None:
-    env = os.environ.copy()
-    env.update(pass_env)
-    env["DMLC_TASK_ID"] = str(taskid)
-    env["DMLC_ROLE"] = role
-    env["DMLC_JOB_CLUSTER"] = "local"
+def run_with_retry(cmd: List[str], env: Dict[str, str], label: str,
+                   num_attempt: int = 1) -> None:
+    """THE DMLC_NUM_ATTEMPT retry contract (reference local.py:26-49),
+    shared by every process-spawning backend: relaunch a failed worker with
+    the same identity env up to ``num_attempt`` times, exporting the
+    attempt counter in DMLC_NUM_ATTEMPT so a restarted worker can take its
+    recovery path (e.g. rabit ``recover`` with its old rank)."""
     ntrial = 0
     while True:
         returncode = subprocess.call(cmd, env=env)
@@ -30,12 +30,22 @@ def exec_cmd(cmd: List[str], role: str, taskid: int, pass_env: Dict[str, str],
         ntrial += 1
         if ntrial >= num_attempt:
             raise RuntimeError(
-                f"local worker {role}:{taskid} failed with code {returncode} "
+                f"{label} failed with code {returncode} "
                 f"after {ntrial} attempt(s)")
         env["DMLC_NUM_ATTEMPT"] = str(ntrial)
         get_logger().warning(
-            "local worker %s:%d failed (code %d), retry %d/%d",
-            role, taskid, returncode, ntrial, num_attempt)
+            "%s failed (code %d), relaunching %d/%d",
+            label, returncode, ntrial, num_attempt)
+
+
+def exec_cmd(cmd: List[str], role: str, taskid: int, pass_env: Dict[str, str],
+             num_attempt: int = 1) -> None:
+    env = os.environ.copy()
+    env.update(pass_env)
+    env["DMLC_TASK_ID"] = str(taskid)
+    env["DMLC_ROLE"] = role
+    env["DMLC_JOB_CLUSTER"] = "local"
+    run_with_retry(cmd, env, f"local worker {role}:{taskid}", num_attempt)
 
 
 def submit(args):

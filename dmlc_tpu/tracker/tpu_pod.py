@@ -26,6 +26,7 @@ import subprocess
 import threading
 from typing import Dict, List
 
+from dmlc_tpu.tracker.local import run_with_retry
 from dmlc_tpu.tracker.opts import read_host_file
 from dmlc_tpu.tracker.ssh import build_remote_command, build_ssh_argv, parse_host
 from dmlc_tpu.utils.check import get_logger
@@ -49,8 +50,16 @@ def submit(args):
     def run(nworker: int, nserver: int, envs: Dict[str, str]):
         assert nserver == 0, "tpu-pod jobs are allreduce-style (no PS role)"
         threads = []
+        errors: List[BaseException] = []
         base = dict(envs)
         base.update(args.pass_envs)
+
+        def guarded(fn, *fn_args) -> None:
+            try:
+                fn(*fn_args)
+            except BaseException as exc:  # noqa: BLE001 - reported to launcher
+                errors.append(exc)
+
         if hosts:
             assert len(hosts) >= nworker, (
                 f"tpu-pod: host file lists {len(hosts)} hosts < {nworker} workers")
@@ -60,23 +69,28 @@ def submit(args):
                 remote = build_remote_command(
                     args.command, env, host, args.sync_dst_dir or os.getcwd())
                 argv = build_ssh_argv(host, port, remote)
-                t = threading.Thread(target=subprocess.check_call, args=(argv,))
+                t = threading.Thread(
+                    target=guarded, args=(subprocess.check_call, argv))
                 t.daemon = True
                 t.start()
                 threads.append(t)
         else:
             get_logger().info(
                 "tpu-pod: no --host-file, launching %d local processes", nworker)
+            num_attempt = max(1, getattr(args, "local_num_attempt", 1))
             for i in range(nworker):
                 env = os.environ.copy()
                 env.update(worker_env(base, i))
                 t = threading.Thread(
-                    target=subprocess.check_call,
-                    kwargs={"args": args.command, "env": env})
+                    target=guarded,
+                    args=(run_with_retry, args.command, env,
+                          f"tpu-pod worker {i}", num_attempt))
                 t.daemon = True
                 t.start()
                 threads.append(t)
         for t in threads:
             t.join()
+        if errors:
+            raise RuntimeError(f"tpu-pod job failed: {errors[0]}")
 
     return run

@@ -168,8 +168,20 @@ class WorkerEntry:
             return job_map[self.jobid]
         return -1
 
-    def assign_rank(self, rank, wait_conn, tree_map, parent_map, ring_map):
-        """Send topology + broker peer connections (tracker.py:81-136)."""
+    def assign_rank(self, rank, wait_conn, tree_map, parent_map, ring_map,
+                    known_addr=None):
+        """Send topology + broker peer connections (tracker.py:81-136).
+
+        ``known_addr`` (rank -> (host, port) of every previously assigned
+        worker) is passed on RECOVERY: the recovered worker then dials ALL
+        its live peers itself instead of waiting for them to redial. Real
+        rabit peers redial when their socket to the dead worker breaks
+        (their next allreduce fails); on the TPU plane the data path is XLA
+        collectives and peer sockets are topology bookkeeping only, so no
+        redial ever comes — without this, a recovered rank would sit in
+        ``wait_conn`` forever and its eventual shutdown would kill the
+        accept loop (SURVEY.md §2.4 data-plane mapping).
+        """
         self.rank = rank
         conn = self.conn
         nnset = set(tree_map[rank])
@@ -196,11 +208,19 @@ class WorkerEntry:
             assert goodset.issubset(nnset), (goodset, nnset)
             badset = nnset - goodset
             conset = [r for r in badset if r in wait_conn]
-            conn.send_int(len(conset))
-            conn.send_int(len(badset) - len(conset))
+            extra = ([r for r in badset
+                      if r not in wait_conn and r in known_addr]
+                     if known_addr else [])
+            conn.send_int(len(conset) + len(extra))
+            conn.send_int(len(badset) - len(conset) - len(extra))
             for r in conset:
                 conn.send_str(wait_conn[r].host)
                 conn.send_int(wait_conn[r].port)
+                conn.send_int(r)
+            for r in extra:
+                host, port = known_addr[r]
+                conn.send_str(host)
+                conn.send_int(port)
                 conn.send_int(r)
             nerr = conn.recv_int()
             if nerr != 0:
@@ -213,7 +233,7 @@ class WorkerEntry:
                     done.append(r)
             for r in done:
                 wait_conn.pop(r, None)
-            self.wait_accept = len(badset) - len(conset)
+            self.wait_accept = len(badset) - len(conset) - len(extra)
             return done
 
 
@@ -320,6 +340,9 @@ class RabitTracker:
         tree_map = None
         parent_map = ring_map = None
         todo_nodes: List[int] = []
+        # latest (host, listen-port) per assigned rank — the recovery
+        # brokering source (see WorkerEntry.assign_rank known_addr)
+        rank_addr: Dict[int, tuple] = {}
 
         while len(shutdown) != num_workers:
             self._processing_since = None
@@ -377,15 +400,41 @@ class RabitTracker:
                         w.assign_rank(r, wait_conn, tree_map, parent_map, ring_map)
                         if w.wait_accept > 0:
                             wait_conn[r] = w
+                        rank_addr[r] = (w.host, w.port)
                         logger.debug("%s from %s -> rank %d", w.cmd, w.host, w.rank)
                     pending = []
                 if not todo_nodes:
                     logger.info("@tracker all %d nodes started", num_workers)
                     self.start_time = time.time()
             else:
-                worker.assign_rank(rank, wait_conn, tree_map, parent_map, ring_map)
+                known_addr = None
+                if worker.cmd == "recover":
+                    # never hand out a dead peer's listener: a rank flagged
+                    # lost by the liveness monitor may be dead or relaunching
+                    # — its old (host, port) would fail the recovered
+                    # worker's dial. It re-links when that rank recovers.
+                    with self._liveness_lock:
+                        lost = set(self.lost_workers)
+                    known_addr = {r: a for r, a in rank_addr.items()
+                                  if r not in lost}
+                try:
+                    worker.assign_rank(rank, wait_conn, tree_map, parent_map,
+                                       ring_map, known_addr=known_addr)
+                except (ConnectionError, OSError, EOFError) as exc:
+                    # a worker dying mid-recovery-brokering must not kill
+                    # the accept loop (it relaunches under DMLC_NUM_ATTEMPT
+                    # and re-enters recover); the start-path batch protocol
+                    # keeps its strict semantics above
+                    if worker.cmd != "recover":
+                        raise
+                    logger.warning(
+                        "tracker: recover brokering for rank %d failed (%s); "
+                        "awaiting its relaunch", rank, exc)
+                    worker.conn.close()
+                    continue
                 if worker.wait_accept > 0:
                     wait_conn[rank] = worker
+                rank_addr[rank] = (worker.host, worker.port)
                 logger.debug("%s from rank %d", worker.cmd, worker.rank)
         self.end_time = time.time()
         if self.start_time is not None:
