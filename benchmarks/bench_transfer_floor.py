@@ -1,0 +1,66 @@
+"""Raw host->HBM transfer floor for BASELINE config #1's bytes.
+
+Times repeated-shape ``jax.device_put`` of the exact batches bench.py
+ships ([8192, 28] f32 and bf16) with NO parsing attached. Purpose
+(VERDICT r3 weak #2 / next #7): if raw transfer alone is at or below the
+host-only parse rate, config #1's f32 ratio is a link-bandwidth floor on
+this host, not a pipeline defect — the pipeline's job is to hide parse
+behind transfer, and it cannot ship bytes faster than the link. Conversely
+a floor well above the pipeline's rate would indict the pipeline.
+
+One JSON line; vs_baseline is 0.0 (the comparison target is bench.py's
+host-only MB/s, recorded alongside in the battery log).
+"""
+
+import numpy as np
+
+from _common import TARGET_MB, emit, log, pin_platform, timed_stats
+
+pin_platform()
+
+import jax  # noqa: E402
+
+BATCH, NUM_COL = 8192, 28  # = bench.py's batch geometry
+
+
+def run() -> None:
+    rng = np.random.default_rng(0)
+    x32 = rng.standard_normal((BATCH, NUM_COL)).astype(np.float32)
+    batch_mb = x32.nbytes / 2**20
+    n = max(8, int(min(TARGET_MB, 256) / batch_mb))
+
+    def leg(arr):
+        def f():
+            handles = [jax.device_put(arr) for _ in range(n)]
+            jax.block_until_ready(handles)
+        return f
+
+    dev = jax.devices()[0]
+    log(f"transfer floor: device {dev}, {n} x {batch_mb:.2f} MB batches")
+    jax.block_until_ready(jax.device_put(x32))  # transfer-plan warmup
+    mb = n * batch_mb
+    best, med, times = timed_stats(leg(x32), reps=5)
+    log(f"f32 device_put: {mb / best:.1f} MB/s best, {mb / med:.1f} median")
+
+    from dmlc_tpu.native import bf16_dtype
+
+    x16 = x32.astype(bf16_dtype())
+    jax.block_until_ready(jax.device_put(x16))
+    mb16 = n * x16.nbytes / 2**20
+    b16, m16, _ = timed_stats(leg(x16), reps=5)
+    log(f"bf16 device_put: {mb16 / b16:.1f} MB/s best, {mb16 / m16:.1f} median")
+
+    emit("device_put_floor_mb_per_sec", mb / best, "MB/s", 0.0,
+         median=mb / med,
+         spread=[round(mb / max(times), 2), round(mb / min(times), 2)],
+         reps=5,
+         bf16_mb_per_sec=round(mb16 / b16, 2),
+         bf16_median=round(mb16 / m16, 2),
+         # corpus-equivalent rates: config #1's text rows are ~110 B and
+         # ship as 112 B (f32) / 56 B (bf16) of x — the bf16 wire rate
+         # DOUBLES the corpus MB/s the same link can sustain
+         bf16_corpus_equiv=round(2 * mb16 / b16, 2))
+
+
+if __name__ == "__main__":
+    run()

@@ -53,6 +53,7 @@ def main() -> int:
     rcs = [
         run([py, "benchmarks/bench_sparse_tpu.py"],
             env={"DMLC_BENCH_TAG": os.environ.get("DMLC_BENCH_TAG", "r03")}),
+        run([py, "benchmarks/bench_transfer_floor.py"]),
         run([py, "bench.py"]),
         run([py, "benchmarks/bench_libfm_bcoo.py"]),
         run([py, "bench.py"], env={"DMLC_BENCH_MB": "1024"}, timeout=5400),

@@ -146,7 +146,11 @@ def _request(cfg: AzureConfig, method: str, path: str,
             req.add_header(k, v)
         try:
             with urllib.request.urlopen(req, timeout=60) as resp:
-                return resp.status, resp.read(), dict(resp.headers)
+                # lower-case header keys: HTTP headers are case-insensitive
+                # and proxies/emulators emit e.g. content-length — a
+                # case-sensitive lookup would read size 0 and truncate reads
+                return resp.status, resp.read(), {
+                    k.lower(): v for k, v in resp.headers.items()}
         except urllib.error.HTTPError as exc:
             if exc.code == 404:
                 return 404, b"", {}
@@ -295,7 +299,7 @@ class AzureFileSystem(FileSystem):
         status, _, headers = _request(self.cfg, "HEAD",
                                       f"/{container}/{key}")
         if status == 200:
-            return FileInfo(path, int(headers.get("Content-Length", 0)),
+            return FileInfo(path, int(headers.get("content-length", 0)),
                             FILE_TYPE)
         prefix = key.rstrip("/") + "/" if key else ""
         if self._list(container, prefix):

@@ -83,7 +83,24 @@ def _ell_kernel(idx_ref, val_ref, w_ref, out_ref, slab_ref):
 
 
 def _pick_block_b(num_b: int, num_d: int, slab_budget: int = 4 << 20) -> int:
-    """Largest power-of-2 tile (<=256) dividing B whose slab fits the budget."""
+    """Largest lane-aligned tile (128 or 256) dividing B whose [D, bb] slab
+    fits the VMEM budget; 0 when none exists.
+
+    bb sits in the LANE dimension of the kernel's (8, bb)/(1, bb) blocks,
+    and Mosaic requires lane tiles to be multiples of 128 — a smaller bb
+    lowers in interpret mode but fails on hardware, so rather than rely on
+    caller guards this returns 0 and the entry point refuses loudly."""
+    limit = max(8, slab_budget // max(num_d * 4, 1))
+    for bb in (256, 128):
+        if bb <= limit and num_b % bb == 0:
+            return bb
+    return 0
+
+
+def _pick_block_b_interpret(num_b: int, num_d: int,
+                            slab_budget: int = 4 << 20) -> int:
+    """Interpret-mode tile pick: any power-of-2 (Mosaic constraints do not
+    apply off-hardware), so small-shape correctness tests stay cheap."""
     limit = max(8, slab_budget // max(num_d * 4, 1))
     bb = 1
     while bb * 2 <= min(num_b, 256, limit) and num_b % (bb * 2) == 0:
@@ -107,7 +124,13 @@ def ell_matvec_pallas(
     num_b, num_k = indices.shape
     num_d = weights.shape[0]
     if block_b == 0:
-        block_b = _pick_block_b(num_b, num_d)
+        block_b = (_pick_block_b_interpret(num_b, num_d) if interpret
+                   else _pick_block_b(num_b, num_d))
+        if block_b == 0:
+            raise ValueError(
+                f"ell_matvec_pallas: no Mosaic-lane-aligned tile for "
+                f"B={num_b}, D={num_d} (need B % 128 == 0 and a [D, 128] "
+                f"slab within VMEM) — use ell_matvec_auto / the XLA gather")
     assert num_b % block_b == 0, (num_b, block_b)
     k8 = -(-num_k // _KTILE) * _KTILE
     # K-major layout, K padded to the sublane tile with zero-valued slots

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
 from dmlc_tpu.tracker.tracker import MAGIC, Conn
@@ -88,20 +89,47 @@ class WorkerClient:
         neighbors = [conn.recv_int() for _ in range(num_nn)]
         rprev = conn.recv_int()
         rnext = conn.recv_int()
-        # brokering loop: we have nothing connected yet
-        conn.send_int(0)
-        nconn = conn.recv_int()
-        nwait = conn.recv_int()
+        # brokering loop: report linked ranks, dial what the tracker hands
+        # out, and report dial FAILURES via the protocol's nerr field (the
+        # tracker then re-brokers) instead of dying on the first refused
+        # connection — recovery can be handed a peer that died in the same
+        # window (tracker.py assign_rank known_addr). Bounded: persistent
+        # failures raise, and the DMLC_NUM_ATTEMPT relaunch re-enters
+        # recover with a fresh, liveness-filtered peer map.
+        good: List[int] = []
         peers: List[Tuple[str, int, int]] = []
-        for _ in range(nconn):
-            host = conn.recv_str()
-            pport = conn.recv_int()
-            prank = conn.recv_int()
-            peers.append((host, pport, prank))
-        for host, pport, _prank in peers:
-            self._peer_socks.append(
-                socket.create_connection((host, pport), timeout=30))
-        conn.send_int(0)  # no errors
+        nwait = 0
+        for attempt in range(3):
+            conn.send_int(len(good))
+            for r in good:
+                conn.send_int(r)
+            nconn = conn.recv_int()
+            nwait = conn.recv_int()
+            todo = []
+            for _ in range(nconn):
+                host = conn.recv_str()
+                pport = conn.recv_int()
+                prank = conn.recv_int()
+                todo.append((host, pport, prank))
+            nerr = 0
+            for host, pport, prank in todo:
+                try:
+                    sock_ = socket.create_connection((host, pport), timeout=30)
+                except OSError:
+                    nerr += 1
+                    continue
+                self._peer_socks.append(sock_)
+                good.append(prank)
+                peers.append((host, pport, prank))
+            conn.send_int(nerr)
+            if nerr == 0:
+                break
+            if attempt == 2:
+                conn.close()
+                raise ConnectionError(
+                    f"rank {self.rank}: could not link {nerr} peer(s) "
+                    f"after {attempt + 1} brokering rounds")
+            time.sleep(0.2)
         conn.send_int(port)
         conn.close()
         if nwait > 0:

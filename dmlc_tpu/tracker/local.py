@@ -76,6 +76,8 @@ def submit(args):
         for t in threads:
             t.join()
         if errors:
-            raise RuntimeError(f"local job failed: {errors[0]}")
+            raise RuntimeError(
+                f"local job failed ({len(errors)} worker thread(s)): "
+                f"{'; '.join(str(e) for e in errors)}") from errors[0]
 
     return run

@@ -410,13 +410,15 @@ class RabitTracker:
                 known_addr = None
                 if worker.cmd == "recover":
                     # never hand out a dead peer's listener: a rank flagged
-                    # lost by the liveness monitor may be dead or relaunching
-                    # — its old (host, port) would fail the recovered
-                    # worker's dial. It re-links when that rank recovers.
+                    # lost by the liveness monitor may be dead or
+                    # relaunching, and a rank that already sent shutdown has
+                    # exited (listener closed) — either address would fail
+                    # the recovered worker's dial. A lost rank re-links when
+                    # it recovers; a shut-down one never needs to.
                     with self._liveness_lock:
-                        lost = set(self.lost_workers)
+                        dead = set(self.lost_workers) | self._shutdown_ranks
                     known_addr = {r: a for r, a in rank_addr.items()
-                                  if r not in lost}
+                                  if r not in dead}
                 try:
                     worker.assign_rank(rank, wait_conn, tree_map, parent_map,
                                        ring_map, known_addr=known_addr)

@@ -8,6 +8,16 @@
 
 #include <cstdint>
 
+// The wire formats this core reads (recordio frames, indexed .idx offsets)
+// are little-endian, and the frame loads are memcpy-native by design (the
+// hot path must not pay per-load byte swaps on the LE hosts we target).
+// Refuse to BUILD on a big-endian target rather than corrupt data at
+// runtime — the compile-time analog of the reference's s390x CI guard
+// (scripts/travis/travis_script.sh:62-66, endian.h DMLC_IO_NO_ENDIAN_SWAP).
+#if defined(__BYTE_ORDER__) && (__BYTE_ORDER__ != __ORDER_LITTLE_ENDIAN__)
+#error "dmlc_tpu native core requires a little-endian host (LE wire format)"
+#endif
+
 extern "C" {
 
 // One parsed CSR block (libsvm / libfm). Free with dmlc_free_block.

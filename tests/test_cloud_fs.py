@@ -981,6 +981,29 @@ class TestAzureFileSystem:
             "/myaccount/mycontainer/blob.txt"
             "\ncomp:list\nrestype:container")
 
+    def test_lowercase_response_headers(self, fake_azure, monkeypatch):
+        """HTTP headers are case-insensitive: a proxy/emulator emitting
+        ``content-length`` must not make get_path_info read size 0 (and
+        AzureReadStream then truncate reads) — advisor r3."""
+        fake_azure.store[("cont", "lc.bin")] = b"0123456789"
+
+        def lower_reply(self, code, body=b"", headers=None):
+            self.send_response(code)
+            out = {k.lower(): v for k, v in dict(headers or {}).items()}
+            out.setdefault("content-length", str(len(body)))
+            for k, v in out.items():
+                self.send_header(k, v)
+            self.end_headers()
+            if self.command != "HEAD":
+                self.wfile.write(body)
+
+        monkeypatch.setattr(_FakeAzureHandler, "_reply", lower_reply)
+        fs = self._fs()
+        info = fs.get_path_info(URI("azure://cont/lc.bin"))
+        assert info.size == 10
+        with fs.open_for_read(URI("azure://cont/lc.bin")) as f:
+            assert f.read() == b"0123456789"
+
     def test_read_ranges_and_seek(self, fake_azure):
         payload = bytes(range(256)) * 400
         fake_azure.store[("cont", "dir/data.bin")] = payload

@@ -356,6 +356,27 @@ def test_pallas_ell_matvec_matches_xla():
                                rtol=1e-4, atol=1e-4)
 
 
+def test_pallas_tile_pick_lane_aligned():
+    """Compiled-mode tiles must be multiples of 128 (Mosaic lane minimum,
+    advisor r3): _pick_block_b returns only {256, 128, 0}, and the raw
+    kernel entry refuses loudly when no valid tile exists instead of
+    failing to lower on hardware."""
+    from dmlc_tpu.ops.pallas_sparse import (
+        _pick_block_b, ell_matvec_pallas,
+    )
+
+    assert _pick_block_b(8192, 640) == 256
+    assert _pick_block_b(8192, 1 << 20) == 0       # slab beyond VMEM budget
+    assert _pick_block_b(384, 640) == 128          # 384 % 256 != 0
+    assert _pick_block_b(200, 640) == 0            # no lane-aligned divisor
+    rng = np.random.default_rng(0)
+    idx = jnp.asarray(rng.integers(0, 64, size=(200, 4)).astype(np.int32))
+    val = jnp.asarray(rng.normal(size=(200, 4)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=64).astype(np.float32))
+    with pytest.raises(ValueError, match="lane-aligned"):
+        ell_matvec_pallas(w, idx, val)  # compiled-mode pick: B=200 invalid
+
+
 def test_softmax_learner_sharded():
     """Multinomial softmax on a 2D mesh (dp x tp), end-to-end data pipeline."""
     import jax.numpy as jnp

@@ -92,8 +92,8 @@ client.shutdown()
 """
 
 
-def _write_corpus(tmp_path, n_rows=64):
-    rng = np.random.RandomState(7)
+def _write_corpus(tmp_path, n_rows=64, seed=7):
+    rng = np.random.RandomState(seed)
     lines = []
     for i in range(n_rows):
         feats = " ".join(f"{j}:{rng.rand():.4f}" for j in range(1, 6))
@@ -214,17 +214,6 @@ client.shutdown()
 """
 
 
-def _train_corpus(tmp_path, n_rows=96):
-    rng = np.random.RandomState(11)
-    lines = []
-    for i in range(n_rows):
-        feats = " ".join(f"{j}:{rng.rand():.4f}" for j in range(1, 6))
-        lines.append(f"{i % 2} {feats}")
-    path = tmp_path / "train.libsvm"
-    path.write_text("\n".join(lines) + "\n")
-    return str(path)
-
-
 def _single_process_reference(data, nworker, batch):
     """The same optimization run on ONE process: shard exactly as the pod
     does (in-process part loop, SURVEY.md §4 pattern), rebuild each step's
@@ -270,7 +259,7 @@ def _single_process_reference(data, nworker, batch):
 def test_multiprocess_end_to_end_training(tmp_path, nworker):
     """2-4 OS processes train one LinearLearner on mesh-global batches; the
     result must match the single-process run on the same global batches."""
-    data = _train_corpus(tmp_path)
+    data, _ = _write_corpus(tmp_path, n_rows=96, seed=11)
     batch = 8
     script = tmp_path / "worker_train.py"
     script.write_text(TRAIN_SCRIPT)
