@@ -217,10 +217,15 @@ def _request(
                                      headers=hdrs)
         try:
             with urllib.request.urlopen(req, timeout=60) as resp:
-                return resp.status, resp.read(), dict(resp.headers)
+                # lower-cased keys: HTTP headers are case-insensitive and a
+                # proxy/emulator emitting content-length must not read as
+                # size 0 (same normalization as azure_filesys._request)
+                return resp.status, resp.read(), {
+                    k.lower(): v for k, v in resp.headers.items()}
         except urllib.error.HTTPError as exc:
             if exc.code in (404, 403, 416):
-                return exc.code, exc.read(), dict(exc.headers)
+                return exc.code, exc.read(), {
+                    k.lower(): v for k, v in exc.headers.items()}
             last_exc = exc
         except urllib.error.URLError as exc:
             last_exc = exc
@@ -302,7 +307,7 @@ class S3WriteStream(_pyio.RawIOBase):
             body=data,
         )
         check(status == 200, f"s3 part {part_number} upload failed: {status}")
-        self._etags.append(headers.get("ETag", headers.get("Etag", "")))
+        self._etags.append(headers.get("etag", ""))
 
     def close(self) -> None:
         if self._closed:
@@ -356,7 +361,7 @@ class S3FileSystem(FileSystem):
         bucket, key = _parse_s3_uri(path)
         status, _, headers = _request(cfg, "HEAD", bucket, key)
         if status == 200:
-            return FileInfo(path, int(headers.get("Content-Length", 0)),
+            return FileInfo(path, int(headers.get("content-length", 0)),
                             FILE_TYPE)
         # fall back: prefix listing decides directory-ness (bucket root
         # lists with an empty prefix, not "/")

@@ -90,7 +90,7 @@ def _pick_block_b(num_b: int, num_d: int, slab_budget: int = 4 << 20) -> int:
     and Mosaic requires lane tiles to be multiples of 128 — a smaller bb
     lowers in interpret mode but fails on hardware, so rather than rely on
     caller guards this returns 0 and the entry point refuses loudly."""
-    limit = max(8, slab_budget // max(num_d * 4, 1))
+    limit = slab_budget // max(num_d * 4, 1)
     for bb in (256, 128):
         if bb <= limit and num_b % bb == 0:
             return bb

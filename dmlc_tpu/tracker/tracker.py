@@ -202,10 +202,24 @@ class WorkerEntry:
             conn.send_int(rnext)
         else:
             conn.send_int(-1)
+        all_done = []
+        pending_conset: list = []
         while True:
             ngood = conn.recv_int()
             goodset = {conn.recv_int() for _ in range(ngood)}
             assert goodset.issubset(nnset), (goodset, nnset)
+            # settle wait_accept for peers handed out in the PREVIOUS round
+            # that the client did link (their rank is now in goodset). The
+            # original final-round-only accounting was correct when clients
+            # always finished in one round; the client's nerr-retry loop
+            # means a peer can be linked in a non-final round and must be
+            # decremented exactly once, here, not skipped.
+            for r in pending_conset:
+                if r in goodset and r in wait_conn:
+                    wait_conn[r].wait_accept -= 1
+                    if wait_conn[r].wait_accept == 0:
+                        all_done.append(r)
+                        wait_conn.pop(r, None)
             badset = nnset - goodset
             conset = [r for r in badset if r in wait_conn]
             extra = ([r for r in badset
@@ -224,17 +238,16 @@ class WorkerEntry:
                 conn.send_int(r)
             nerr = conn.recv_int()
             if nerr != 0:
+                pending_conset = conset
                 continue
             self.port = conn.recv_int()
-            done = []
             for r in conset:
                 wait_conn[r].wait_accept -= 1
                 if wait_conn[r].wait_accept == 0:
-                    done.append(r)
-            for r in done:
-                wait_conn.pop(r, None)
+                    all_done.append(r)
+                    wait_conn.pop(r, None)
             self.wait_accept = len(badset) - len(conset) - len(extra)
-            return done
+            return all_done
 
 
 class RabitTracker:

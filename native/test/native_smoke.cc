@@ -73,7 +73,8 @@ int main() {
   void* r = dmlc_reader_create(paths, sizes, 1, 0, 1, /*fmt=*/0, 0, 0, ',',
                                2, 4096, 2, /*batch_rows=*/0,
                                /*label_col=*/-1, /*weight_col=*/-1,
-                               /*out_bf16=*/0);
+                               /*out_bf16=*/0, /*row_bucket=*/0,
+                               /*nnz_bucket=*/0, /*elide_unit=*/0);
   CHECK_TRUE(r != nullptr);
   for (int pass = 0; pass < 2; ++pass) {
     int64_t rows = 0;
@@ -154,7 +155,67 @@ int main() {
     remove(rpath);
   }
 
-  CHECK_TRUE(dmlc_native_abi_version() == 11);
+  // text -> COO: one-shot parse with bucket padding + unit elision, and
+  // the streaming reader in COO mode (format 7), all under the sanitizer
+  {
+    const char* fm = "1 0:10:1 1:20:1\n0 2:30:1\n";
+    CooResult* co = dmlc_parse_coo(fm, static_cast<int64_t>(strlen(fm)),
+                                   /*nthread=*/2, /*indexing_mode=*/0,
+                                   /*fmt=*/3, /*num_col=*/100,
+                                   /*row_bucket=*/4, /*nnz_bucket=*/8,
+                                   /*elide_unit=*/1);
+    CHECK_TRUE(co != nullptr && co->error == nullptr);
+    CHECK_TRUE(co->n_rows == 2 && co->nnz == 3);
+    CHECK_TRUE(co->rows_padded == 4 && co->nnz_padded == 8);
+    CHECK_TRUE(co->values_elided == 1 && co->values == nullptr);
+    CHECK_TRUE(co->coords[0] == 0 && co->coords[1] == 10);
+    CHECK_TRUE(co->coords[4] == 1 && co->coords[5] == 30);
+    CHECK_TRUE(co->coords[6] == 4 && co->coords[7] == 100);  // OOB pad
+    CHECK_TRUE(co->weight[1] == 1.0f && co->weight[2] == 0.0f);
+    dmlc_free_coo(co);
+
+    char cpath[] = "/tmp/dmlc_tpu_smoke_coo_XXXXXX";
+    int cfd = mkstemp(cpath);
+    CHECK_TRUE(cfd >= 0);
+    FILE* cf = fdopen(cfd, "w");
+    for (int i = 0; i < 500; ++i)
+      std::fprintf(cf, "%d 0:%d:1 1:%d:2.5\n", i % 2, i % 97, i % 89);
+    long csize;
+    fflush(cf);
+    csize = ftell(cf);
+    fclose(cf);
+    const char* cpaths[] = {cpath};
+    int64_t csizes[] = {csize};
+    void* cr = dmlc_reader_create(cpaths, csizes, 1, 0, 1, /*fmt=*/7,
+                                  /*num_col=*/128, 0, ',', 2, 4096, 2, 0,
+                                  -1, -1, 0, /*row_bucket=*/64,
+                                  /*nnz_bucket=*/256, /*elide_unit=*/1);
+    CHECK_TRUE(cr != nullptr);
+    for (int pass = 0; pass < 2; ++pass) {
+      int64_t rows = 0, nnz = 0;
+      while (true) {
+        int32_t fmt = 7;
+        void* res = dmlc_reader_next(cr, &fmt);
+        if (!res) break;
+        CHECK_TRUE(fmt == 7);
+        CooResult* blk = static_cast<CooResult*>(res);
+        CHECK_TRUE(blk->error == nullptr);
+        CHECK_TRUE(blk->values_elided == 0);  // 2.5 values present
+        CHECK_TRUE(blk->rows_padded % 64 == 0);
+        CHECK_TRUE(blk->nnz_padded % 256 == 0);
+        rows += blk->n_rows;
+        nnz += blk->nnz;
+        dmlc_free_coo(blk);
+      }
+      CHECK_TRUE(dmlc_reader_error(cr) == nullptr);
+      CHECK_TRUE(rows == 500 && nnz == 1000);
+      dmlc_reader_before_first(cr);
+    }
+    dmlc_reader_destroy(cr);
+    remove(cpath);
+  }
+
+  CHECK_TRUE(dmlc_native_abi_version() == 12);
   if (failures == 0) std::printf("native_smoke: all checks passed\n");
   return failures == 0 ? 0 : 1;
 }

@@ -94,6 +94,14 @@ void drain_reader(void* h, int fmt_hint, int64_t* rows_out) {
         dmlc_free_records(r);
         break;
       }
+      case 6:
+      case 7: {
+        auto* r = static_cast<CooResult*>(res);
+        CHECK_TRUE(!r->error, "coo block error");
+        rows += r->n_rows;
+        dmlc_free_coo(r);
+        break;
+      }
       default: {
         auto* r = static_cast<CsvResult*>(res);
         rows += r->n_rows;
@@ -110,7 +118,7 @@ void stress_pull_reader(const std::string& p1, const std::string& p2) {
   // multi-epoch with batch repack, consumer on another thread
   void* h = dmlc_reader_create(paths, sizes, 2, 0, 1, /*fmt dense*/ 1,
                                /*num_col*/ 16, -1, ',', 4, 1 << 16, 4,
-                               /*batch_rows*/ 100, -1, -1, 0);
+                               /*batch_rows*/ 100, -1, -1, 0, 0, 0, 0);
   CHECK_TRUE(h != nullptr, "reader create");
   for (int epoch = 0; epoch < 3; ++epoch) {
     int64_t rows = 0;
@@ -124,7 +132,7 @@ void stress_pull_reader(const std::string& p1, const std::string& p2) {
   // early destruction with the queue full (stop path racing the producer)
   for (int i = 0; i < 8; ++i) {
     void* h2 = dmlc_reader_create(paths, sizes, 2, 0, 1, 0, 0, -1, ',', 4,
-                                  1 << 14, 2, 0, -1, -1, 0);
+                                  1 << 14, 2, 0, -1, -1, 0, 0, 0, 0);
     int32_t fmt = 0;
     void* res = dmlc_reader_next(h2, &fmt);
     if (res) dmlc_free_block(static_cast<CsrBlockResult*>(res));
@@ -137,7 +145,7 @@ void stress_pull_reader(const std::string& p1, const std::string& p2) {
   for (int part = 0; part < 4; ++part) {
     ts.emplace_back([&, part] {
       void* hp = dmlc_reader_create(paths, sizes, 2, part, 4, 0, 0, -1, ',',
-                                    2, 1 << 14, 2, 0, -1, -1, 0);
+                                    2, 1 << 14, 2, 0, -1, -1, 0, 0, 0, 0);
       int64_t rows = 0;
       drain_reader(hp, 0, &rows);
       total += rows;
@@ -160,7 +168,7 @@ void stress_feeder(const std::string& p1) {
 
   for (int epoch = 0; epoch < 2; ++epoch) {
     void* h = dmlc_feeder_create(1, 16, -1, ',', 4, 1 << 14, 2, 128, -1, -1,
-                                 /*out_bf16=*/0);
+                                 /*out_bf16=*/0, 0, 0, 0);
     CHECK_TRUE(h != nullptr, "feeder create");
     std::thread pusher([&] {
       size_t at = 0;
@@ -187,7 +195,8 @@ void stress_feeder(const std::string& p1) {
 
   // abort racing an active pusher
   for (int i = 0; i < 8; ++i) {
-    void* h = dmlc_feeder_create(0, 0, -1, ',', 2, 1 << 12, 1, 0, -1, -1, 0);
+    void* h = dmlc_feeder_create(0, 0, -1, ',', 2, 1 << 12, 1, 0, -1, -1, 0,
+                                 0, 0, 0);
     std::thread pusher([&] {
       size_t at = 0;
       while (at < data.size()) {
@@ -206,6 +215,30 @@ void stress_feeder(const std::string& p1) {
   }
 }
 
+void stress_coo(const std::string& p1, const std::string& p2) {
+  // partitioned concurrent COO readers (libsvm -> fmt 6) with bucket
+  // padding + elision enabled: the merge_parts_coo fill runs under TSan
+  // against the chunk parse threads
+  const char* paths[2] = {p1.c_str(), p2.c_str()};
+  int64_t sizes[2] = {fsize(p1), fsize(p2)};
+  std::vector<std::thread> ts;
+  std::atomic<int64_t> total{0};
+  for (int part = 0; part < 4; ++part) {
+    ts.emplace_back([&, part] {
+      void* hp = dmlc_reader_create(paths, sizes, 2, part, 4, /*fmt=*/6,
+                                    /*num_col=*/64, -1, ',', 2, 1 << 14, 2,
+                                    0, -1, -1, 0, /*row_bucket=*/32,
+                                    /*nnz_bucket=*/128, /*elide_unit=*/1);
+      int64_t rows = 0;
+      drain_reader(hp, 6, &rows);
+      total += rows;
+      dmlc_reader_destroy(hp);
+    });
+  }
+  for (auto& t : ts) t.join();
+  CHECK_TRUE(total.load() == 4000, "coo partitioned row total");
+}
+
 void stress_recordio(const std::string& rec1, const std::string& rec2) {
   const char* paths[2] = {rec1.c_str(), rec2.c_str()};
   int64_t sizes[2] = {fsize(rec1), fsize(rec2)};
@@ -214,7 +247,7 @@ void stress_recordio(const std::string& rec1, const std::string& rec2) {
   for (int part = 0; part < 3; ++part) {
     ts.emplace_back([&, part] {
       void* h = dmlc_reader_create(paths, sizes, 2, part, 3, 4, 0, -1, ',',
-                                   2, 1 << 14, 2, 0, -1, -1, 0);
+                                   2, 1 << 14, 2, 0, -1, -1, 0, 0, 0, 0);
       int64_t recs = 0;
       drain_reader(h, 4, &recs);
       total += recs;
@@ -257,6 +290,7 @@ int main() {
 
   stress_pull_reader(p1, p2);
   stress_feeder(p1);
+  stress_coo(p1, p2);
   stress_recordio(r1, r2);
   stress_parse_threads();
 

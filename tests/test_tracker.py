@@ -133,6 +133,70 @@ def test_tracker_print_and_jobid_rank_stability():
     assert sorted(rank_of.values()) == [0, 1]
 
 
+def test_tracker_multi_round_brokering_accounting():
+    """A client that reports nerr (dial failure) in its first brokering
+    round and links in round 2 must still settle the peer's wait_accept —
+    the final-round-only accounting left the peer in wait_conn forever and
+    its shutdown then killed the accept loop (r4 regression test for the
+    client's nerr-retry protocol)."""
+    tracker = RabitTracker("127.0.0.1", 2, port=19400)
+    tracker.start(2)
+
+    # worker A: a real client (connects first -> rank 0, enters wait_conn)
+    a = WorkerClient("127.0.0.1", tracker.port)
+    a_result = {}
+
+    def run_a():
+        a_result["assign"] = a.start()
+
+    ta = threading.Thread(target=run_a, daemon=True)
+    ta.start()
+
+    # worker B: manual protocol — round 1 reports a dial failure, round 2
+    # claims the link succeeded (goodset includes A's rank)
+    b = WorkerClient("127.0.0.1", tracker.port)
+    port = b._listen()
+    conn = b._hello("start", -1, -1)
+    b.rank = conn.recv_int()
+    conn.recv_int()            # parent
+    conn.recv_int()            # world
+    num_nn = conn.recv_int()
+    neighbors = [conn.recv_int() for _ in range(num_nn)]
+    rprev, rnext = conn.recv_int(), conn.recv_int()
+    linkset = {r for r in neighbors + [rprev, rnext] if r >= 0}
+    # round 1: nothing linked; tracker hands out A's address; fail it
+    conn.send_int(0)
+    nconn = conn.recv_int()
+    conn.recv_int()            # nwait
+    for _ in range(nconn):
+        conn.recv_str(), conn.recv_int(), conn.recv_int()
+    assert nconn >= 1
+    conn.send_int(nconn)       # every dial "failed"
+    # round 2: claim all links made (protocol trusts the client's goodset)
+    conn.send_int(len(linkset))
+    for r in linkset:
+        conn.send_int(r)
+    nconn2 = conn.recv_int()
+    conn.recv_int()
+    assert nconn2 == 0         # nothing left to hand out
+    conn.send_int(0)           # no errors
+    conn.send_int(port)
+    conn.close()
+    ta.join(timeout=30)
+    assert not ta.is_alive()
+
+    a.shutdown()
+    sh = b._hello("shutdown", b.rank, -1)
+    sh.close()
+    tracker.join(timeout=30)
+    # clean completion: with the stale wait_conn entry the accept loop died
+    # on `assert worker.rank not in wait_conn` and never set end_time
+    assert tracker.end_time is not None
+    tracker.close()
+    b.close()
+    a.close()
+
+
 def test_tracker_recover_keeps_rank():
     tracker = RabitTracker("127.0.0.1", 2, port=19300)
     tracker.start(2)
