@@ -204,22 +204,28 @@ class WorkerEntry:
             conn.send_int(-1)
         all_done = []
         pending_conset: list = []
+
+        def settle(rank_):
+            # exactly-once wait_accept accounting for a linked peer — used
+            # by both the pending-round and final-round paths below
+            wait_conn[rank_].wait_accept -= 1
+            if wait_conn[rank_].wait_accept == 0:
+                all_done.append(rank_)
+                wait_conn.pop(rank_, None)
+
         while True:
             ngood = conn.recv_int()
             goodset = {conn.recv_int() for _ in range(ngood)}
             assert goodset.issubset(nnset), (goodset, nnset)
-            # settle wait_accept for peers handed out in the PREVIOUS round
-            # that the client did link (their rank is now in goodset). The
-            # original final-round-only accounting was correct when clients
-            # always finished in one round; the client's nerr-retry loop
-            # means a peer can be linked in a non-final round and must be
-            # decremented exactly once, here, not skipped.
+            # settle peers handed out in the PREVIOUS round that the client
+            # did link (their rank is now in goodset). The original
+            # final-round-only accounting was correct when clients always
+            # finished in one round; the client's nerr-retry loop means a
+            # peer can be linked in a non-final round and must be settled
+            # here, not skipped.
             for r in pending_conset:
                 if r in goodset and r in wait_conn:
-                    wait_conn[r].wait_accept -= 1
-                    if wait_conn[r].wait_accept == 0:
-                        all_done.append(r)
-                        wait_conn.pop(r, None)
+                    settle(r)
             badset = nnset - goodset
             conset = [r for r in badset if r in wait_conn]
             extra = ([r for r in badset
@@ -242,10 +248,7 @@ class WorkerEntry:
                 continue
             self.port = conn.recv_int()
             for r in conset:
-                wait_conn[r].wait_accept -= 1
-                if wait_conn[r].wait_accept == 0:
-                    all_done.append(r)
-                    wait_conn.pop(r, None)
+                settle(r)
             self.wait_accept = len(badset) - len(conset) - len(extra)
             return all_done
 
