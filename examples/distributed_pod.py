@@ -18,6 +18,18 @@ What happens (SURVEY.md §2.4's control/data-plane split):
  3. each worker parses ITS OWN InputSplit shard (shard index = process
     index, SURVEY.md §2.3 row 1), feeds batches through DeviceIter, and
     the jitted SGD step psums gradients across all processes' devices.
+
+Elastic recovery demo (the reference's retry + recover contract,
+tracker/dmlc_tracker/local.py:26-49 + tracker.py:288-301, on the jax
+plane):
+
+    CRASH=1 python examples/distributed_pod.py
+
+Worker 1's first life joins the job, heartbeats, and dies hard mid-job.
+The tracker OBSERVES the death (missed heartbeats), the launcher
+relaunches the worker with the same DMLC_TASK_ID (DMLC_NUM_ATTEMPT
+contract), and the second life rabit-``recover``s its prior rank, joins
+``jax.distributed``, and the job completes normally.
 """
 
 import os
@@ -51,17 +63,48 @@ def worker() -> None:
         # start, the env var alone can be consulted too late
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+    import time
+
     from dmlc_tpu.parallel.distributed import init_from_env
     from dmlc_tpu.tracker.client import WorkerClient
+
+    task_id = int(os.environ["DMLC_TASK_ID"])
+    attempt = int(os.environ.get("DMLC_NUM_ATTEMPT", "0"))
+    # rabit plane: rank-stable rendezvous, liveness heartbeats, and
+    # job-completion bookkeeping (the tracker waits for every rank's
+    # shutdown). The rabit rendezvous runs BEFORE jax.distributed so a
+    # crashing first life never blocks the pod's collective init.
+    client = WorkerClient(os.environ["DMLC_TRACKER_URI"],
+                          int(os.environ["DMLC_TRACKER_PORT"]))
+    rank_file = os.environ["DATA"] + f".rank{task_id}"
+    if os.environ.get("CRASH") == "1" and task_id == 1 and attempt == 0:
+        client.start()
+        with open(rank_file, "w") as f:
+            f.write(str(client.rank))  # "checkpoint" the assigned rank
+        client.start_heartbeat(0.2)
+        time.sleep(0.6)
+        print(f"[worker {task_id}] simulating mid-job crash", flush=True)
+        os._exit(17)  # hard death: heartbeats stop, no shutdown sent
+    if attempt > 0 and os.path.exists(rank_file):
+        # a relaunched worker whose previous life checkpointed a rank
+        # rejoins rank-stable; other relaunches (transient failures with no
+        # checkpoint) just start fresh
+        time.sleep(1.6)  # stay silent past the liveness window: the
+        #                  tracker must OBSERVE the death, not just a retry
+        with open(rank_file) as f:
+            old_rank = int(f.read())
+        client.recover(old_rank)  # rank-stable rejoin
+        print(f"[worker {task_id}] recovered rabit rank {old_rank} "
+              f"(attempt {attempt})", flush=True)
+    else:
+        client.start()
+    # beat well inside the liveness window (1.0s in the demo): an interval
+    # equal to the timeout would flag healthy-but-jittery ranks as lost
+    client.start_heartbeat(0.25)
 
     init_from_env()  # DMLC_* -> jax.distributed.initialize
     rank, world = jax.process_index(), jax.process_count()
     print(f"[worker {rank}/{world}] backend up", flush=True)
-    # rabit plane: rank-stable rendezvous + job-completion bookkeeping
-    # (the tracker waits for every rank's shutdown)
-    client = WorkerClient(os.environ["DMLC_TRACKER_URI"],
-                          int(os.environ["DMLC_TRACKER_PORT"]))
-    client.start()
 
     from dmlc_tpu.data import create_parser
     from dmlc_tpu.data.device import DeviceIter
@@ -88,6 +131,7 @@ def worker() -> None:
     it.close()
     print(f"[worker {rank}/{world}] accuracy {float(acc):.3f} "
           f"({steps} steps/epoch)", flush=True)
+    client.stop_heartbeat()
     client.shutdown()
 
 
@@ -109,9 +153,14 @@ def main() -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     nworker = int(os.environ.get("NWORKER", "2"))
-    submit(["--cluster", "tpu-pod", "--num-workers", str(nworker),
-            "--host-ip", "127.0.0.1", "--",
-            sys.executable, os.path.abspath(__file__)])
+    argv = ["--cluster", "tpu-pod", "--num-workers", str(nworker),
+            "--host-ip", "127.0.0.1"]
+    if os.environ.get("CRASH") == "1":
+        # recovery demo: arm heartbeat failure detection + the relaunch
+        # contract (see module docstring)
+        os.environ["DMLC_LIVENESS_TIMEOUT"] = "1.0"
+        argv += ["--local-num-attempt", "3"]
+    submit(argv + ["--", sys.executable, os.path.abspath(__file__)])
     print("pod job finished")
 
 
