@@ -14,6 +14,11 @@ from _common import CACHE_DIR, emit, log, pin_platform, synth_text, timed_stats
 pin_platform()
 
 NNZ = 10
+# chunk size sets the natural-block batch size, i.e. the device_put count:
+# per-put overhead on a tunneled device is ~1.1 ms, so fewer/larger puts
+# amortize it (shape bucketing keeps the larger shapes repeating) — A/B
+# without editing via DMLC_BENCH_CHUNK_MB
+CHUNK_BYTES = int(float(os.environ.get("DMLC_BENCH_CHUNK_MB", "1")) * 2**20)
 
 
 def _line(i: int) -> str:
@@ -32,7 +37,10 @@ def run() -> None:
     uri = path + "?format=libfm"
 
     def host_only(threaded: bool) -> None:
-        p = create_parser(uri, 0, 1, threaded=threaded)
+        # same chunk size as the device leg: the knob must A/B the
+        # device_put count, not conflate it with parse-rate effects
+        p = create_parser(uri, 0, 1, threaded=threaded,
+                          chunk_bytes=CHUNK_BYTES)
         rows = sum(len(b) for b in p)
         p.close()
         assert rows > 0
@@ -44,7 +52,8 @@ def run() -> None:
         # host->HBM link) and the convert thread only issues the async
         # device_put; the consumer pops ready handles — nothing serializes
         # with parsing (r2 weak #1 was this benchmark bypassing DeviceIter)
-        p = create_parser(uri, 0, 1, threaded=True)
+        p = create_parser(uri, 0, 1, threaded=True,
+                          chunk_bytes=CHUNK_BYTES)
         it = DeviceIter(p, num_col=50_000_000, batch_size=None,
                         layout="bcoo", elide_unit_values=True)
         # block on EVERY array of each batch (not just the last value

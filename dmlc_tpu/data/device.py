@@ -151,6 +151,7 @@ class DeviceIter:
         self.nnz_bucket = int(nnz_bucket)
         self.row_bucket = int(row_bucket)
         self._skip_blocks = 0  # producer-put resume: blocks to drop unput
+        self._ones_cache: dict = {}  # elided-values ones, keyed by length
         self.stall_seconds = 0.0        # consumer wait for a ready batch
         self.host_stall_seconds = 0.0   # of which: waiting on host convert
         self.batches_fed = 0
@@ -394,15 +395,25 @@ class DeviceIter:
                    if self.device is not None else jax.device_put(arrs))
             if vals is None:
                 # binary-feature batch: ones are synthesized on device
-                # (block_to_bcoo_host elided the value array); create them
-                # on the SAME device the puts target, or BCOO would mix
-                # committed arrays across devices
+                # (block_to_bcoo_host elided the value array); created on
+                # the SAME device the puts target (BCOO must not mix
+                # committed arrays across devices) and CACHED per length —
+                # every batch in an nnz bucket shares the identical ones
+                # array, so one device allocation serves the whole epoch
+                # instead of one dispatch per batch
                 dc, dl, dw = out
-                if self.device is not None:
-                    with jax.default_device(self.device):
+                dv = self._ones_cache.get(len(coords))
+                if dv is None:
+                    if self.device is not None:
+                        with jax.default_device(self.device):
+                            dv = jax.numpy.ones(len(coords), jax.numpy.float32)
+                    else:
                         dv = jax.numpy.ones(len(coords), jax.numpy.float32)
-                else:
-                    dv = jax.numpy.ones(len(coords), jax.numpy.float32)
+                    if self.nnz_bucket:
+                        # bucketed shapes repeat, so the key space is tiny;
+                        # with nnz_bucket=0 (exact shapes) every batch could
+                        # pin a new length forever — don't cache there
+                        self._ones_cache[len(coords)] = dv
             else:
                 dv, dc, dl, dw = out
             return jsparse.BCOO((dv, dc), shape=shape), dl, dw
