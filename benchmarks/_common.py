@@ -70,10 +70,6 @@ def emit(metric: str, value: float, unit: str, baseline: float, **extra) -> None
     print(json.dumps(line))
 
 
-def timed_best(fn, reps: int = REPS) -> float:
-    return timed_stats(fn, reps)[0]
-
-
 def timed_stats(fn, reps: int = REPS):
     """Time ``fn`` reps times -> (best, median, times).
 

@@ -7,7 +7,7 @@ pipeline; baseline: the same parse single-threaded without prefetch.
 
 import os
 
-from _common import CACHE_DIR, emit, log, synth_text, timed_best
+from _common import CACHE_DIR, emit, log, synth_text, timed_stats
 
 
 def _line(i: int) -> str:
@@ -29,11 +29,16 @@ def run() -> None:
         p.close()
         assert rows > 0
 
-    base = timed_best(lambda: consume(False))
+    base, base_med, _ = timed_stats(lambda: consume(False))
     log(f"csv single-thread: {size_mb / base:.1f} MB/s")
-    t = timed_best(lambda: consume(True))
-    log(f"csv prefetch: {size_mb / t:.1f} MB/s")
-    emit("csv_prefetch_mb_per_sec", size_mb / t, "MB/s", size_mb / base)
+    t, t_med, times = timed_stats(lambda: consume(True))
+    log(f"csv prefetch: {size_mb / t:.1f} MB/s best, "
+        f"{size_mb / t_med:.1f} median")
+    emit("csv_prefetch_mb_per_sec", size_mb / t, "MB/s", size_mb / base,
+         median=size_mb / t_med,
+         median_vs_baseline=base_med / t_med,
+         spread=[round(size_mb / max(times), 2), round(size_mb / min(times), 2)],
+         reps=len(times))
 
 
 if __name__ == "__main__":
