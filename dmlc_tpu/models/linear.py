@@ -54,10 +54,11 @@ def _margin_dense(params: LinearParams, x: jax.Array) -> jax.Array:
 def _margin_ell(params: LinearParams, batch: EllBatch,
                 use_auto: bool = False) -> jax.Array:
     if use_auto:
-        # single-device / replicated-weight case: let the router pick the
-        # pallas one-hot kernel in its winning band (TPU, D <= 2048,
-        # B % 256 == 0) and the XLA gather elsewhere. Sharded weights stay
-        # on ell_matvec — pallas_call is not shard_map-aware here.
+        # single-device / replicated-weight case: route through the auto
+        # entry (XLA gather by default; pallas is opt-in until a
+        # current-kernel A/B shows a winning band — ell_matvec_auto's
+        # docstring has the routing-honesty rationale). Sharded weights
+        # stay on ell_matvec — pallas_call is not shard_map-aware here.
         from dmlc_tpu.ops.pallas_sparse import ell_matvec_auto
 
         return ell_matvec_auto(params.weight, batch) + params.bias

@@ -897,9 +897,11 @@ def test_bcoo_shape_bucketing_quantizes_and_preserves_math(tmp_path):
 
 
 def test_ell_matvec_auto_routing_guards():
-    """The auto router must keep 2D (multinomial) weight tables on the XLA
-    gather — the pallas kernel is a [D]-table matvec only."""
-    from dmlc_tpu.ops.pallas_sparse import ell_matvec_auto
+    """Default routes the XLA gather for every shape (pallas is opt-in
+    pending a current-kernel winning band); an explicit pallas opt-in with
+    a 2D (multinomial) weight table refuses loudly — the kernel is a
+    [D]-table matvec only."""
+    from dmlc_tpu.ops.pallas_sparse import ell_matvec_auto, ell_matvec_pallas
     from dmlc_tpu.ops.sparse import EllBatch, ell_matvec
 
     rng = np.random.default_rng(0)
@@ -908,10 +910,12 @@ def test_ell_matvec_auto_routing_guards():
     val = jnp.asarray(rng.normal(size=(B, K)).astype(np.float32))
     batch = EllBatch(idx, val, None, None)
     w2 = jnp.asarray(rng.normal(size=(D, C)).astype(np.float32))
-    got = ell_matvec_auto(w2, batch)          # must not attempt pallas
+    got = ell_matvec_auto(w2, batch)          # default: XLA gather
     np.testing.assert_allclose(np.asarray(got),
                                np.asarray(ell_matvec(w2, batch)), rtol=1e-6)
     assert got.shape == (B, C)
+    with pytest.raises(ValueError, match=r"\[D\] table"):
+        ell_matvec_pallas(w2, idx, val, interpret=True)
 
 
 def test_softmax_learner_ell_layout(tmp_path):
