@@ -151,6 +151,14 @@ def test_tracker_multi_round_brokering_accounting():
 
     ta = threading.Thread(target=run_a, daemon=True)
     ta.start()
+    # A must CONNECT first (pending order = arrival order): the tracker
+    # then assigns A rank 0 into wait_conn and hands B its address — the
+    # scenario this test scripts. A's start() blocks until B also joins,
+    # so "A connected" cannot be observed via the client; a short delay
+    # before B's hello makes the arrival order deterministic.
+    import time as _time
+
+    _time.sleep(0.5)
 
     # worker B: manual protocol — round 1 reports a dial failure, round 2
     # claims the link succeeded (goodset includes A's rank)
