@@ -50,12 +50,15 @@ def main() -> int:
     if "--probe-only" in sys.argv:
         return 0
     py = sys.executable
+    # quick, high-value legs first: if the flaky tunnel recovers late in a
+    # round, the floor + 64MB configs + sparse A/B (~15 min) land before
+    # the GB legs (~1-2 h) start
     rcs = [
-        run([py, "benchmarks/bench_sparse_tpu.py"],
-            env={"DMLC_BENCH_TAG": os.environ.get("DMLC_BENCH_TAG", "r03")}),
         run([py, "benchmarks/bench_transfer_floor.py"]),
         run([py, "bench.py"]),
         run([py, "benchmarks/bench_libfm_bcoo.py"]),
+        run([py, "benchmarks/bench_sparse_tpu.py"],
+            env={"DMLC_BENCH_TAG": os.environ.get("DMLC_BENCH_TAG", "r03")}),
         run([py, "bench.py"], env={"DMLC_BENCH_MB": "1024"}, timeout=5400),
         run([py, "benchmarks/bench_libfm_bcoo.py"],
             env={"DMLC_BENCH_MB": "1024"}, timeout=5400),
