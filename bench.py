@@ -268,6 +268,14 @@ def main() -> int:
     last_err = ""
     infra = True
     attempt = 0
+    # probe-gate the first attempt: when the device is down at start, wait
+    # it out (bounded) instead of burning a full child timeout discovering
+    # the same thing — the tunnel can hang a backend init for its entire
+    # budget (observed: multi-hour outages). wait_for_device probes first,
+    # so a healthy device costs one quick probe.
+    if not wait_for_device(probe_window):
+        last_err = "device unreachable before first attempt"
+        attempts = 0
     for attempt in range(1, attempts + 1):
         log(f"bench: attempt {attempt}/{attempts}")
         try:
