@@ -141,14 +141,26 @@ class DeviceIter:
         # are known (one exact repeating shape), a small 4096 quantum for
         # fixed small batches, 16384 for chunk-sized natural blocks. Set 0
         # to disable (exact shapes, e.g. for interop tests).
+        # The derived bucket is CAPPED at 512k nnz: the bucket is also the
+        # worst-case per-batch pad (coords+values ~12 B/nnz -> ~6 MB), and
+        # batch_size * max_nnz is a ceiling, not a density estimate — for
+        # corpora whose rows run far below max_nnz the uncapped product
+        # multiplies host->HBM bytes without bound. Under the cap every
+        # batch still pads to one exact shape; above it, shapes are a small
+        # set of bucket multiples (closed per epoch by the tail handling in
+        # _convert).
         if nnz_bucket is None:
             if batch_size is not None and max_nnz:
-                nnz_bucket = int(batch_size) * int(max_nnz)
+                nnz_bucket = min(int(batch_size) * int(max_nnz), 512 * 1024)
             elif batch_size is not None:
                 nnz_bucket = 4096
             else:
                 nnz_bucket = 16384
         self.nnz_bucket = int(nnz_bucket)
+        # nse values already emitted (bucket multiples — a tiny set): the
+        # fixed-batch tail pads up into this set so the last batch of an
+        # epoch never introduces a novel transfer shape
+        self._emitted_nse: set = set()
         self.row_bucket = int(row_bucket)
         self._skip_blocks = 0  # producer-put resume: blocks to drop unput
         self._ones_cache: dict = {}  # elided-values ones, keyed by length
@@ -363,8 +375,24 @@ class DeviceIter:
             # natural-block mode: quantize the row dimension too
             pad = -(-len(block) // self.row_bucket) * self.row_bucket
         nnz = len(block.index)
-        pad_nnz = (-(-max(nnz, 1) // self.nnz_bucket) * self.nnz_bucket
-                   if self.nnz_bucket else None)
+        if self.nnz_bucket:
+            pad_nnz = -(-max(nnz, 1) // self.nnz_bucket) * self.nnz_bucket
+            if self.batch_size is not None:
+                # close the epoch's shape set (VERDICT r4 #5 / ADVICE r3
+                # #4): the tail batch is row-padded to batch_size above,
+                # but with fewer rows it carries fewer nnz and would round
+                # to a SMALLER bucket multiple than any full batch — one
+                # novel shape (fresh transfer plan + downstream jit
+                # recompile) on the last batch of every epoch. Pad its nse
+                # up to the smallest already-emitted value that fits; full
+                # batches keep natural rounding and register their nse.
+                if len(block) < self.batch_size:
+                    fits = [s for s in self._emitted_nse if s >= pad_nnz]
+                    if fits:
+                        pad_nnz = min(fits)
+                self._emitted_nse.add(pad_nnz)
+        else:
+            pad_nnz = None
         return ("bcoo",) + block_to_bcoo_host(
             block, self.num_col, pad_rows_to=pad,
             unit_values_as_none=self.elide_unit_values,

@@ -160,6 +160,12 @@ class WorkerEntry:
         self.cmd = self.conn.recv_str()
         self.wait_accept = 0
         self.port: Optional[int] = None
+        # (rank, entry) pairs settled during the CURRENT assign_rank call,
+        # so a worker dying mid-brokering can have its settles rolled back
+        # — without this its relaunch re-links the same peers and settles
+        # them AGAIN, driving wait_accept negative and popping peers from
+        # wait_conn early (ADVICE r4 #1)
+        self.settled_in_call: list = []
 
     def decide_rank(self, job_map: Dict[str, int]) -> int:
         if self.rank >= 0:
@@ -204,12 +210,15 @@ class WorkerEntry:
             conn.send_int(-1)
         all_done = []
         pending_conset: list = []
+        self.settled_in_call = []
 
         def settle(rank_):
             # exactly-once wait_accept accounting for a linked peer — used
             # by both the pending-round and final-round paths below
-            wait_conn[rank_].wait_accept -= 1
-            if wait_conn[rank_].wait_accept == 0:
+            entry = wait_conn[rank_]
+            entry.wait_accept -= 1
+            self.settled_in_call.append((rank_, entry))
+            if entry.wait_accept == 0:
                 all_done.append(rank_)
                 wait_conn.pop(rank_, None)
 
@@ -251,6 +260,19 @@ class WorkerEntry:
                 settle(r)
             self.wait_accept = len(badset) - len(conset) - len(extra)
             return all_done
+
+
+def _rollback_settles(worker: "WorkerEntry", wait_conn: dict) -> None:
+    """Undo the wait_accept settles a failed assign_rank call applied.
+
+    Each settled peer gets its credit back and is re-inserted into
+    ``wait_conn`` (settle pops peers whose count hits 0), so the dead
+    worker's relaunch re-brokers against exact accounting.
+    """
+    for r, entry in reversed(worker.settled_in_call):
+        entry.wait_accept += 1
+        wait_conn[r] = entry
+    worker.settled_in_call = []
 
 
 class RabitTracker:
@@ -356,6 +378,10 @@ class RabitTracker:
         tree_map = None
         parent_map = ring_map = None
         todo_nodes: List[int] = []
+        # ranks whose start brokering failed (worker died mid-call) and
+        # whose relaunch has not completed yet — the all-started log and
+        # start_time stamp wait for this to drain
+        failed_start_ranks: set = set()
         # latest (host, listen-port) per assigned rank — the recovery
         # brokering source (see WorkerEntry.assign_rank known_addr)
         rank_addr: Dict[int, tuple] = {}
@@ -413,13 +439,41 @@ class RabitTracker:
                         r = todo_nodes.pop(0)
                         if w.jobid != "NULL":
                             job_map[w.jobid] = r
-                        w.assign_rank(r, wait_conn, tree_map, parent_map, ring_map)
+                        try:
+                            w.assign_rank(r, wait_conn, tree_map,
+                                          parent_map, ring_map)
+                        except (ConnectionError, OSError, EOFError) as exc:
+                            # a worker dying mid-start-brokering (e.g. its
+                            # peer-dial retries ran dry and it hung up) must
+                            # fail ALONE, not take the rendezvous down with
+                            # an unhandled EOF (ADVICE r4 #5). Undo its
+                            # settles so peer accounting is exact again;
+                            # with a jobid its relaunch re-claims rank r via
+                            # job_map and re-brokers. Without one no relaunch
+                            # can ever reclaim the rank — fail loudly.
+                            _rollback_settles(w, wait_conn)
+                            w.conn.close()
+                            if w.jobid == "NULL":
+                                raise ConnectionError(
+                                    f"worker {w.host} (rank {r}) died during "
+                                    f"start brokering and carries no jobid; "
+                                    f"rendezvous cannot complete") from exc
+                            logger.warning(
+                                "tracker: start brokering for rank %d "
+                                "failed (%s); awaiting relaunch of jobid "
+                                "%s", r, exc, w.jobid)
+                            failed_start_ranks.add(r)
+                            continue
                         if w.wait_accept > 0:
                             wait_conn[r] = w
                         rank_addr[r] = (w.host, w.port)
                         logger.debug("%s from %s -> rank %d", w.cmd, w.host, w.rank)
                     pending = []
-                if not todo_nodes:
+                if not todo_nodes and not failed_start_ranks:
+                    # only when every rank ACTUALLY completed brokering — a
+                    # worker that died mid-start is assigned but not
+                    # started, and logging success there would hand an
+                    # operator a healthy-looking log for a stalled world
                     logger.info("@tracker all %d nodes started", num_workers)
                     self.start_time = time.time()
             else:
@@ -439,21 +493,32 @@ class RabitTracker:
                     worker.assign_rank(rank, wait_conn, tree_map, parent_map,
                                        ring_map, known_addr=known_addr)
                 except (ConnectionError, OSError, EOFError) as exc:
-                    # a worker dying mid-recovery-brokering must not kill
-                    # the accept loop (it relaunches under DMLC_NUM_ATTEMPT
-                    # and re-enters recover); the start-path batch protocol
-                    # keeps its strict semantics above
-                    if worker.cmd != "recover":
-                        raise
+                    # a worker dying mid-brokering must not kill the accept
+                    # loop: it relaunches under DMLC_NUM_ATTEMPT and
+                    # re-enters (recover keeps its rank; a jobid start
+                    # re-claims it via job_map). Roll back this call's
+                    # settles first — leaving them applied would let the
+                    # relaunch settle the same peers twice, driving
+                    # wait_accept negative (ADVICE r4 #1).
+                    _rollback_settles(worker, wait_conn)
                     logger.warning(
-                        "tracker: recover brokering for rank %d failed (%s); "
-                        "awaiting its relaunch", rank, exc)
+                        "tracker: %s brokering for rank %d failed (%s); "
+                        "awaiting its relaunch", worker.cmd, rank, exc)
+                    if worker.cmd == "start":
+                        failed_start_ranks.add(rank)
                     worker.conn.close()
                     continue
                 if worker.wait_accept > 0:
                     wait_conn[rank] = worker
                 rank_addr[rank] = (worker.host, worker.port)
                 logger.debug("%s from rank %d", worker.cmd, worker.rank)
+                if worker.cmd == "start" and rank in failed_start_ranks:
+                    failed_start_ranks.discard(rank)
+                    if (not todo_nodes and not failed_start_ranks
+                            and self.start_time is None):
+                        logger.info("@tracker all %d nodes started",
+                                    num_workers)
+                        self.start_time = time.time()
         self.end_time = time.time()
         if self.start_time is not None:
             logger.info("@tracker %.3f secs between node start and job finish",

@@ -557,15 +557,21 @@ static CsrBlockResult* merge_parts(std::vector<CsrPart>& parts, int indexing_mod
     if (part.min_index < min_index) min_index = part.min_index;
     if (part.min_field < min_field) min_field = part.min_field;
   }
-  // all-or-none consistency across thread ranges
+  // all-or-none consistency across thread ranges. The format name follows
+  // heuristic_needs_field (true == libfm; today the libfm scanner emits no
+  // weights/qids, so these fire only for libsvm — the parameterization
+  // keeps the message right if libfm weight syntax is ever wired up)
+  const char* fmt = heuristic_needs_field ? "libfm" : "libsvm";
   for (auto& part : parts) {
     if (!part.label.empty()) {
       if (any_weight && part.weight.size() != part.label.size()) {
-        res->error = dup_error("libsvm: label:weight must be set on every row or none");
+        res->error = dup_error(std::string(fmt) +
+            ": label:weight must be set on every row or none");
         return res;
       }
       if (any_qid && part.qid.size() != part.label.size()) {
-        res->error = dup_error("libsvm: qid must appear on every row or none");
+        res->error = dup_error(std::string(fmt) +
+            ": qid must appear on every row or none");
         return res;
       }
     }
@@ -709,8 +715,11 @@ static CooResult* merge_parts_coo(std::vector<CsrPart>& parts,
   for (auto& part : parts) {
     if (any_weight && !part.label.empty() &&
         part.weight.size() != part.label.size()) {
-      res->error =
-          dup_error("libsvm: label:weight must be set on every row or none");
+      // format name follows heuristic_needs_field (true == libfm), same
+      // rationale as merge_parts above
+      res->error = dup_error(
+          std::string(heuristic_needs_field ? "libfm" : "libsvm") +
+          ": label:weight must be set on every row or none");
       return res;
     }
   }

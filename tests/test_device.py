@@ -896,6 +896,53 @@ def test_bcoo_shape_bucketing_quantizes_and_preserves_math(tmp_path):
                                    rtol=1e-6)
 
 
+def test_bcoo_fixed_batch_tail_closes_shape_set(tmp_path):
+    """Fixed-batch BCOO: the final partial batch pads its nse UP into the
+    set already emitted by full batches, so the epoch's device-shape set is
+    closed — no novel transfer shape (a fresh transfer plan costs ~100x a
+    repeated-shape device_put on a tunneled device) and no downstream jit
+    recompile on the last batch of every epoch (VERDICT r4 #5)."""
+    uri = _libsvm_corpus(tmp_path, n=72)  # 4 full batches of 16 + tail of 8
+
+    def epoch_shapes(it):
+        shapes = []
+        for mat, y, w in it:
+            shapes.append((mat.nse, mat.shape[0]))
+        return shapes
+
+    parser = create_parser(uri, 0, 1, "libsvm", threaded=False)
+    it = DeviceIter(parser, num_col=6, batch_size=16, layout="bcoo",
+                    nnz_bucket=16)
+    ep1 = epoch_shapes(it)
+    it.reset()
+    ep2 = epoch_shapes(it)
+    it.close()
+    assert len(ep1) == len(ep2) == 5
+    # rows always padded to batch_size
+    assert all(r == 16 for _, r in ep1)
+    # the tail's shape is one a full batch already used...
+    assert ep1[-1] in ep1[:-1]
+    # ...so the distinct-shape set over 2 epochs equals the full batches'
+    assert set(ep1) | set(ep2) == set(ep1[:-1])
+
+
+def test_bcoo_derived_nnz_bucket_capped(tmp_path):
+    """ADVICE r4 #4: the derived batch_size*max_nnz bucket is capped — the
+    bucket is the worst-case per-batch pad, and an uncapped ceiling product
+    makes host->HBM pad bytes unbounded for sparse-below-max corpora."""
+    uri = _libsvm_corpus(tmp_path, n=8)
+    parser = create_parser(uri, 0, 1, "libsvm", threaded=False)
+    it = DeviceIter(parser, num_col=6, batch_size=8192, layout="bcoo",
+                    max_nnz=1000)
+    assert it.nnz_bucket == 512 * 1024
+    it.close()
+    parser = create_parser(uri, 0, 1, "libsvm", threaded=False)
+    small = DeviceIter(parser, num_col=6, batch_size=16, layout="bcoo",
+                       max_nnz=6)
+    assert small.nnz_bucket == 96  # under the cap: one exact shape
+    small.close()
+
+
 def test_ell_matvec_auto_routing_guards():
     """Default routes the XLA gather for every shape (pallas is opt-in
     pending a current-kernel winning band); an explicit pallas opt-in with

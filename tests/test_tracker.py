@@ -405,6 +405,61 @@ class TestLauncher:
         assert marker.read_text() == "ok"
 
 
+class TestStartPathWorkerDeath:
+    def test_worker_dying_mid_start_brokering_fails_alone(self):
+        """A worker that hangs up during start brokering must not take the
+        rendezvous down with an unhandled EOF (ADVICE r4 #5); its relaunch
+        (same jobid) re-claims the rank via job_map and completes the
+        world. Settles applied before the death are rolled back (ADVICE r4
+        #1), so the survivor's wait_accept stays exact."""
+        import socket as _socket
+        import struct
+        import threading
+        import time as _time
+
+        from dmlc_tpu.tracker.client import WorkerClient
+        from dmlc_tpu.tracker.tracker import MAGIC, RabitTracker
+
+        tracker = RabitTracker("127.0.0.1", 2)
+        tracker.start()
+        a = b = None
+        try:
+            # half-dead worker: completes the hello for jobid "b" then
+            # hangs up — the tracker hits EOF inside its assign_rank
+            sock = _socket.create_connection(("127.0.0.1", tracker.port), 5)
+            sock.sendall(struct.pack("@i", MAGIC))
+            assert struct.unpack("@i", sock.recv(4))[0] == MAGIC
+            sock.sendall(struct.pack("@i", -1))       # rank
+            sock.sendall(struct.pack("@i", 2))        # world_size
+            for s in (b"b", b"start"):
+                sock.sendall(struct.pack("@i", len(s)) + s)
+
+            a = WorkerClient("127.0.0.1", tracker.port, jobid="a")
+            ra = {}
+            ta = threading.Thread(
+                target=lambda: ra.setdefault("a", a.start(world_size=2)))
+            ta.start()
+            _time.sleep(0.3)  # let the batch assignment begin
+            sock.close()      # die mid-brokering
+
+            # relaunch of jobid "b": re-claims its rank, links the survivor
+            b = WorkerClient("127.0.0.1", tracker.port, jobid="b")
+            assn_b = b.start(world_size=2)
+            ta.join(10)
+            assn_a = ra.get("a")
+            assert assn_a is not None and assn_a.world_size == 2
+            assert {assn_a.rank, assn_b.rank} == {0, 1}
+            a.shutdown()
+            b.shutdown()
+            tracker.join(5)
+        finally:
+            if a is not None:
+                a.close()
+            if b is not None:
+                b.close()
+            tracker.close()
+
+
 class TestLiveness:
     def test_silent_worker_flagged_heartbeater_not(self):
         import time as _time
