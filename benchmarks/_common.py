@@ -87,22 +87,27 @@ def timed_stats(fn, reps: int = REPS):
     return min(times), median(times), times
 
 
-def paired_times(fn_a, fn_b, pairs: int = REPS):
-    """Time two legs back-to-back per pair with ALTERNATING order.
+def rotated_times(fns, rounds: int = REPS):
+    """Time N legs back-to-back per round with ROTATING order.
 
     Host speed drifts a few percent over seconds on this shared machine
-    and a fixed order would bias whichever leg runs second — alternation
-    cancels both. Returns (times_a, times_b), aligned by pair, for the
-    caller's statistic of choice (min, median of ratios, ...)."""
-    times_a, times_b = [], []
-    for i in range(pairs):
-        order = [(fn_a, times_a), (fn_b, times_b)]
-        if i % 2:
-            order.reverse()
-        for fn, out in order:
+    and a fixed order would bias whichever leg runs later — rotation
+    cancels both. Returns one time-list per leg, aligned by round, for
+    the caller's statistic of choice (min, median of ratios, ...)."""
+    sinks = [[] for _ in fns]
+    legs = list(zip(fns, sinks))
+    for i in range(rounds):
+        k = i % len(legs)
+        for fn, out in legs[k:] + legs[:k]:
             t0 = time.monotonic()
             fn()
             out.append(time.monotonic() - t0)
+    return sinks
+
+
+def paired_times(fn_a, fn_b, pairs: int = REPS):
+    """Two-leg form of :func:`rotated_times` (alternating order)."""
+    times_a, times_b = rotated_times([fn_a, fn_b], rounds=pairs)
     return times_a, times_b
 
 

@@ -26,6 +26,7 @@ from dmlc_tpu.data.parsers import (
     LibFMParserParam,
     LibSVMParserParam,
     Parser,
+    _csv_skeleton,
     csv_cells_to_block,
     csv_cells_to_dense,
 )
@@ -167,7 +168,15 @@ class NativeStreamParser(Parser):
             fmt = (native.FMT_LIBSVM_DENSE if self._emit_dense is not None
                    else native.FMT_LIBSVM)
         elif self.fmt_name == "csv":
-            fmt = native.FMT_CSV
+            # label/weight columns configured and no dense repack: the
+            # native merge pass splits them out (FMT_CSV_SPLIT), so the
+            # RowBlock wrap below is zero-copy — the reference re-walks
+            # the cell matrix in its consumer instead (csv_parser.h:120)
+            lc = getattr(self.param, "label_column", -1)
+            wc = getattr(self.param, "weight_column", -1)
+            fmt = (native.FMT_CSV_SPLIT
+                   if self._emit_dense is None and (lc >= 0 or wc >= 0)
+                   else native.FMT_CSV)
         else:
             fmt = native.FMT_LIBFM
         repack = (fmt == native.FMT_LIBSVM_DENSE
@@ -224,6 +233,15 @@ class NativeStreamParser(Parser):
                 weight=data["weight"], qid=data["qid"],
                 field=data["field"], hold=data["_owner"],
             )
+        if fmt == native.FMT_CSV_SPLIT:
+            values, label, weight, n, owner = data
+            k = values.shape[1]
+            index, offset = _csv_skeleton(n, k, self.index_dtype)
+            if label is None:
+                label = np.zeros(n, np.float32)
+            return RowBlock(
+                offset=offset, label=label, index=index,
+                value=values.reshape(-1), weight=weight, hold=owner)
         cells, owner = data
         n, ncol = cells.shape
         if self._emit_dense is not None:

@@ -477,6 +477,30 @@ def csv_cells_to_dense(cells: np.ndarray, n: int, ncol: int, num_col: int,
     return DenseBlock(x, label, weight, hold=owner)
 
 
+# synthetic CSR skeletons for CSV blocks: every row has the same k column
+# indices and k-strided offsets, and block geometry repeats (chunk-sized
+# blocks), so one (n, k) build serves the whole stream — rebuilding them
+# per block was ~2 array builds per MB of corpus on the hot path
+_CSV_SKELETON_CACHE: dict = {}
+
+
+def _csv_skeleton(n: int, k: int, index_dtype):
+    key = (n, k, np.dtype(index_dtype).str)
+    hit = _CSV_SKELETON_CACHE.get(key)
+    if hit is None:
+        if len(_CSV_SKELETON_CACHE) > 64:  # block geometries are few
+            _CSV_SKELETON_CACHE.clear()
+        index = np.tile(np.arange(k, dtype=index_dtype), n)
+        offset = np.arange(0, (n + 1) * k, k, dtype=np.int64)
+        # shared across every block of the stream — freeze so an
+        # accidental in-place edit cannot corrupt sibling blocks
+        index.flags.writeable = False
+        offset.flags.writeable = False
+        hit = (index, offset)
+        _CSV_SKELETON_CACHE[key] = hit
+    return hit
+
+
 def csv_cells_to_block(cells: np.ndarray, n: int, ncol: int,
                        label_column: int, weight_column: int,
                        index_dtype) -> RowBlock:
@@ -486,12 +510,21 @@ def csv_cells_to_block(cells: np.ndarray, n: int, ncol: int,
     check(lc < ncol, f"csv: label_column {lc} >= num columns {ncol}")
     check(wc < ncol, f"csv: weight_column {wc} >= num columns {ncol}")
     feat_cols = [c for c in range(ncol) if c != lc and c != wc]
-    values = cells[:, feat_cols].astype(np.float32)
+    k = len(feat_cols)
+    # the feature columns are CONTIGUOUS whenever label/weight sit at the
+    # edges (or are absent) — the Criteo-like common case. A basic slice +
+    # ascontiguousarray is ONE copy; the general fancy-index + astype path
+    # is two full copies of the feature matrix per block.
+    lo = min(feat_cols) if k else 0
+    contiguous = k and feat_cols == list(range(lo, lo + k))
+    if contiguous and cells.dtype == np.float32:
+        values = np.ascontiguousarray(cells[:, lo:lo + k])
+    else:
+        values = cells[:, feat_cols].astype(np.float32, copy=False)
+        values = np.ascontiguousarray(values)
     label = cells[:, lc].astype(np.float32) if lc >= 0 else np.zeros(n, np.float32)
     weight = cells[:, wc].astype(np.float32) if wc >= 0 else None
-    k = len(feat_cols)
-    index = np.tile(np.arange(k, dtype=index_dtype), n)
-    offset = np.arange(0, (n + 1) * k, k, dtype=np.int64)
+    index, offset = _csv_skeleton(n, k, index_dtype)
     return RowBlock(
         offset=offset, label=label, index=index,
         value=values.reshape(-1), weight=weight,

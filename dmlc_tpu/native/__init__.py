@@ -35,7 +35,7 @@ _HDRS = [os.path.join(_SRC_DIR, f)
          for f in ("api.h", "strtonum.h", "parse_internal.h")]
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 _SO_PATH = os.path.join(_BUILD_DIR, "libdmlc_tpu_native.so")
-_ABI_VERSION = 12
+_ABI_VERSION = 13
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -75,6 +75,17 @@ class _CsvResult(ctypes.Structure):
         ("n_rows", ctypes.c_int64),
         ("n_cols", ctypes.c_int64),
         ("cells", ctypes.POINTER(ctypes.c_float)),
+        ("error", ctypes.c_char_p),
+    ]
+
+
+class _CsvSplitResult(ctypes.Structure):
+    _fields_ = [
+        ("n_rows", ctypes.c_int64),
+        ("n_feat_cols", ctypes.c_int64),
+        ("values", ctypes.POINTER(ctypes.c_float)),
+        ("label", ctypes.POINTER(ctypes.c_float)),
+        ("weight", ctypes.POINTER(ctypes.c_float)),
         ("error", ctypes.c_char_p),
     ]
 
@@ -219,6 +230,7 @@ def _declare(lib: ctypes.CDLL) -> None:
     # changes across importlib.reload) — they may fire at interpreter exit
     lib.dmlc_free_block.argtypes = [ctypes.c_void_p]
     lib.dmlc_free_csv.argtypes = [ctypes.c_void_p]
+    lib.dmlc_free_csv_split.argtypes = [ctypes.c_void_p]
     lib.dmlc_native_abi_version.restype = ctypes.c_int
     lib.dmlc_recordio_extract.restype = ctypes.POINTER(_RecordBatchResult)
     lib.dmlc_recordio_extract.argtypes = [ctypes.c_char_p, ctypes.c_int64]
@@ -346,6 +358,10 @@ def _free_csv(lib, addr):
     lib.dmlc_free_csv(addr)
 
 
+def _free_csv_split(lib, addr):
+    lib.dmlc_free_csv_split(addr)
+
+
 def parse_libsvm(chunk: bytes, nthread: int = 0, indexing_mode: int = 0):
     """Parse a libsvm chunk natively; returns dict of numpy arrays or None."""
     lib = _load()
@@ -466,6 +482,26 @@ def _wrap_csv(lib, res):
     return cells.reshape(n, c), owner
 
 
+def _wrap_csv_split(lib, res):
+    """(values[n,k], label|None, weight|None, n_rows, owner) — all views
+    zero-copy over the C buffers; the RowBlock skeleton (index/offset) is
+    format-implied and supplied by the caller's cache."""
+    r = res.contents
+    if r.error:
+        msg = r.error.decode()
+        lib.dmlc_free_csv_split(res)
+        raise DMLCError(msg)
+    owner = _Owner(lib, res, _free_csv_split)
+    n, k = r.n_rows, r.n_feat_cols
+    if n == 0:
+        return np.zeros((0, 0), np.float32), None, None, 0, owner
+    values = (_view(r.values, n * k, np.float32, owner).reshape(n, k)
+              if k else np.zeros((n, 0), np.float32))
+    label = _view(r.label, n, np.float32, owner)
+    weight = _view(r.weight, n, np.float32, owner)
+    return values, label, weight, int(n), owner
+
+
 def _free_records(lib, addr):
     lib.dmlc_free_records(addr)
 
@@ -512,6 +548,7 @@ FMT_RECORDIO = 4
 FMT_RECORDIO_CHUNK = 5
 FMT_LIBSVM_COO = 6
 FMT_LIBFM_COO = 7
+FMT_CSV_SPLIT = 8
 
 
 def _free_coo(lib, addr):
@@ -560,6 +597,9 @@ def _wrap_stream_result(lib, ptr, fmt_value, num_col):
     if fmt_value in (FMT_LIBSVM_COO, FMT_LIBFM_COO):
         return fmt_value, _wrap_coo(
             lib, ctypes.cast(ptr, ctypes.POINTER(_CooResult)))
+    if fmt_value == FMT_CSV_SPLIT:
+        return fmt_value, _wrap_csv_split(
+            lib, ctypes.cast(ptr, ctypes.POINTER(_CsvSplitResult)))
     return fmt_value, _wrap_csv(
         lib, ctypes.cast(ptr, ctypes.POINTER(_CsvResult)))
 

@@ -57,6 +57,22 @@ struct CsvResult {
   char* error;
 };
 
+// CSV result with the label/weight columns split out during the single
+// merge-copy pass: values holds ONLY the feature cells, row-major
+// [n_rows, n_feat_cols], so the RowBlock wrapper needs zero further copies
+// (the synthetic per-row 0..k-1 index/offset skeleton is format-implied
+// and cached host-side). The reference's CSV path re-walks cells in its
+// consumer (csv_parser.h:120-121); splitting here keeps the whole parse
+// one pass over the bytes.
+struct CsvSplitResult {
+  int64_t n_rows;
+  int64_t n_feat_cols;  // columns minus label/weight columns
+  float* values;        // [n_rows, n_feat_cols]
+  float* label;         // [n_rows], or NULL when label_col < 0
+  float* weight;        // [n_rows], or NULL when weight_col < 0
+  char* error;          // null on success
+};
+
 // Sparse batch in device-ready COO layout (the BCOO host half): coords are
 // int32 (row, col) pairs — on KDD-shaped data the coordinate array
 // dominates transfer bytes, so int32 halves host->HBM traffic vs int64 —
@@ -116,10 +132,14 @@ CsrBlockResult* dmlc_parse_libfm(const char* data, int64_t len, int nthread,
 DenseResult* dmlc_parse_libsvm_dense(const char* data, int64_t len, int nthread,
                                      int64_t num_col, int indexing_mode);
 CsvResult* dmlc_parse_csv(const char* data, int64_t len, int nthread, char delim);
+CsvSplitResult* dmlc_parse_csv_split(const char* data, int64_t len, int nthread,
+                                     char delim, int32_t label_col,
+                                     int32_t weight_col);
 
 void dmlc_free_block(CsrBlockResult* r);
 void dmlc_free_dense(DenseResult* r);
 void dmlc_free_csv(CsvResult* r);
+void dmlc_free_csv_split(CsvSplitResult* r);
 
 int dmlc_native_abi_version();
 

@@ -989,6 +989,103 @@ void dmlc_free_csv(CsvResult* r) {
   free(r);
 }
 
-int dmlc_native_abi_version() { return 12; }
+static CsvSplitResult* csv_split_error(CsvSplitResult* res, const char* msg) {
+  free(res->values); free(res->label); free(res->weight);
+  res->values = res->label = res->weight = nullptr;
+  res->n_rows = res->n_feat_cols = 0;
+  res->error = dup_error(msg);
+  return res;
+}
+
+CsvSplitResult* dmlc_parse_csv_split(const char* data, int64_t len, int nthread,
+                                     char delim, int32_t label_col,
+                                     int32_t weight_col) {
+  // scan phase identical to dmlc_parse_csv (shared per-range scanner); the
+  // split happens in the merge pass, which already touches every cell once
+  const char* end = data + len;
+  data = skip_bom(data, &end);
+  if (nthread < 1) nthread = 1;
+  nthread = clamp_threads(nthread, static_cast<size_t>(end - data));
+  auto ranges = split_lines(data, end, nthread);
+  std::vector<CsvPart> parts(ranges.size());
+  std::vector<std::thread> threads;
+  for (size_t i = 1; i < ranges.size(); ++i) {
+    threads.emplace_back(parse_csv_range_guarded, ranges[i].first,
+                         ranges[i].second, delim, &parts[i]);
+  }
+  if (!ranges.empty())
+    parse_csv_range_guarded(ranges[0].first, ranges[0].second, delim,
+                            &parts[0]);
+  for (auto& t : threads) t.join();
+  auto* res = static_cast<CsvSplitResult*>(calloc(1, sizeof(CsvSplitResult)));
+  if (!res) return nullptr;
+  int64_t ncol = -1, nrow = 0;
+  for (auto& part : parts) {
+    if (!part.error.empty()) return csv_split_error(res, part.error.c_str());
+    if (part.nrow == 0) continue;
+    if (ncol < 0) ncol = part.ncol;
+    if (part.ncol != ncol)
+      return csv_split_error(res, "csv: ragged rows in chunk");
+    nrow += part.nrow;
+  }
+  if (nrow == 0 || ncol <= 0) return res;  // blank chunk
+  if (label_col >= ncol || weight_col >= ncol)
+    return csv_split_error(res, "csv: label/weight column out of range");
+  if (label_col >= 0 && label_col == weight_col)
+    // the Python layer validates this too, but the C ABI must be safe on
+    // its own: equal columns would decrement k twice while the run
+    // builder skips the column once — an out-of-bounds write per row
+    return csv_split_error(res, "csv: label_column must differ from weight_column");
+  const int lc = label_col, wc = weight_col;
+  const int64_t k = ncol - (lc >= 0 ? 1 : 0) - (wc >= 0 ? 1 : 0);
+  res->n_rows = nrow;
+  res->n_feat_cols = k;
+  res->values = static_cast<float*>(malloc(nrow * k * sizeof(float)));
+  res->label = lc >= 0 ? static_cast<float*>(malloc(nrow * sizeof(float)))
+                       : nullptr;
+  res->weight = wc >= 0 ? static_cast<float*>(malloc(nrow * sizeof(float)))
+                        : nullptr;
+  if ((k > 0 && !res->values) || (lc >= 0 && !res->label) ||
+      (wc >= 0 && !res->weight))
+    return csv_split_error(res, "parse: out of memory merging chunk");
+  // feature columns form <=3 contiguous runs around the label/weight
+  // columns; copy run-wise per row (memcpy for all but one-or-two cells)
+  int64_t runs[3][2];
+  int nruns = 0;
+  int64_t at = 0;
+  while (at < ncol) {
+    if (at == lc || at == wc) { ++at; continue; }
+    int64_t hi = at;
+    while (hi < ncol && hi != lc && hi != wc) ++hi;
+    runs[nruns][0] = at;
+    runs[nruns][1] = hi - at;
+    ++nruns;
+    at = hi;
+  }
+  int64_t row = 0;
+  for (auto& part : parts) {
+    const float* cells = part.cells.data();
+    for (int64_t i = 0; i < part.nrow; ++i, ++row) {
+      const float* src = cells + i * ncol;
+      float* dst = res->values + row * k;
+      for (int rix = 0; rix < nruns; ++rix) {
+        memcpy(dst, src + runs[rix][0],
+               static_cast<size_t>(runs[rix][1]) * sizeof(float));
+        dst += runs[rix][1];
+      }
+      if (lc >= 0) res->label[row] = src[lc];
+      if (wc >= 0) res->weight[row] = src[wc];
+    }
+  }
+  return res;
+}
+
+void dmlc_free_csv_split(CsvSplitResult* r) {
+  if (!r) return;
+  free(r->values); free(r->label); free(r->weight); free(r->error);
+  free(r);
+}
+
+int dmlc_native_abi_version() { return 13; }
 
 }  // extern "C"

@@ -52,6 +52,11 @@ constexpr int kFmtRecordIO = 4;
 constexpr int kFmtRecordIOChunk = 5;  // raw framed chunks, one per result
 constexpr int kFmtLibsvmCoo = 6;      // device-ready COO (CooResult)
 constexpr int kFmtLibfmCoo = 7;
+constexpr int kFmtCsvSplit = 8;       // csv with label/weight split out
+                                      // (CsvSplitResult) — auto-promoted
+                                      // from kFmtCsv when label/weight
+                                      // columns are configured and no
+                                      // dense repack is requested
 
 inline bool is_recordio_fmt(int format) {
   return format == kFmtRecordIO || format == kFmtRecordIOChunk;
@@ -69,6 +74,9 @@ void free_result(int format, void* res) {
       break;
     case kFmtCsv:
       dmlc_free_csv(static_cast<CsvResult*>(res));
+      break;
+    case kFmtCsvSplit:
+      dmlc_free_csv_split(static_cast<CsvSplitResult*>(res));
       break;
     case kFmtRecordIO:
     case kFmtRecordIOChunk:
@@ -90,6 +98,8 @@ int64_t result_rows(int format, void* res) {
       return static_cast<DenseResult*>(res)->n_rows;
     case kFmtCsv:
       return static_cast<CsvResult*>(res)->n_rows;
+    case kFmtCsvSplit:
+      return static_cast<CsvSplitResult*>(res)->n_rows;
     case kFmtRecordIO:
     case kFmtRecordIOChunk:
       return static_cast<RecordBatchResult*>(res)->n_records;
@@ -109,6 +119,8 @@ const char* result_error(int format, void* res) {
       return static_cast<DenseResult*>(res)->error;
     case kFmtCsv:
       return static_cast<CsvResult*>(res)->error;
+    case kFmtCsvSplit:
+      return static_cast<CsvSplitResult*>(res)->error;
     case kFmtRecordIO:
     case kFmtRecordIOChunk:
       return static_cast<RecordBatchResult*>(res)->error;
@@ -586,6 +598,9 @@ class LineReader {
                                        indexing_mode_);
       case kFmtCsv:
         return dmlc_parse_csv(data, len, nthread_, delim_);
+      case kFmtCsvSplit:
+        return dmlc_parse_csv_split(data, len, nthread_, delim_, label_col_,
+                                    weight_col_);
       case kFmtLibfm:
         return dmlc_parse_libfm(data, len, nthread_, indexing_mode_);
       case kFmtLibsvmCoo:
