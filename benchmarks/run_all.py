@@ -30,6 +30,9 @@ BENCHES = [
     ("bench_recordio.py", HERE),
     ("bench_libfm_bcoo.py", HERE),
     ("bench_sharded_split.py", HERE),
+    # stretch leg (VERDICT r4 #8): loopback S3 at volume — validates the
+    # signed range-GET read stream + NativeFeedParser under GB reads
+    ("bench_cloud_read.py", HERE),
 ]
 
 

@@ -491,7 +491,10 @@ def _csv_skeleton(n: int, k: int, index_dtype):
         if len(_CSV_SKELETON_CACHE) > 64:  # block geometries are few
             _CSV_SKELETON_CACHE.clear()
         index = np.tile(np.arange(k, dtype=index_dtype), n)
-        offset = np.arange(0, (n + 1) * k, k, dtype=np.int64)
+        # k == 0 (every column is label/weight) is a legal degenerate: all
+        # offsets are 0 — np.arange with step 0 would raise instead
+        offset = (np.arange(0, (n + 1) * k, k, dtype=np.int64)
+                  if k else np.zeros(n + 1, np.int64))
         # shared across every block of the stream — freeze so an
         # accidental in-place edit cannot corrupt sibling blocks
         index.flags.writeable = False

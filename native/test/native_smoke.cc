@@ -54,6 +54,30 @@ int main() {
   CHECK_TRUE(c->n_rows == 2 && c->n_cols == 3 && c->cells[1] == 2.5f);
   dmlc_free_csv(c);
 
+  // csv split: label mid-column, weight last — features are the two runs
+  // around them; the sanitizers watch the run-wise memcpy bounds here
+  const char* csv2 = "1,9,2.5,3,0.5\n4,8,5.5,6,0.25\n";
+  CsvSplitResult* s = dmlc_parse_csv_split(
+      csv2, static_cast<int64_t>(strlen(csv2)), 2, ',', /*label_col=*/1,
+      /*weight_col=*/4);
+  CHECK_TRUE(s != nullptr && s->error == nullptr);
+  CHECK_TRUE(s->n_rows == 2 && s->n_feat_cols == 3);
+  CHECK_TRUE(s->values[0] == 1.0f && s->values[1] == 2.5f &&
+             s->values[2] == 3.0f && s->values[3] == 4.0f);
+  CHECK_TRUE(s->label[0] == 9.0f && s->label[1] == 8.0f);
+  CHECK_TRUE(s->weight[0] == 0.5f && s->weight[1] == 0.25f);
+  dmlc_free_csv_split(s);
+  // guard rails: equal columns and out-of-range columns must error, not
+  // write out of bounds
+  CsvSplitResult* s2 = dmlc_parse_csv_split(
+      csv2, static_cast<int64_t>(strlen(csv2)), 1, ',', 2, 2);
+  CHECK_TRUE(s2 != nullptr && s2->error != nullptr);
+  dmlc_free_csv_split(s2);
+  CsvSplitResult* s3 = dmlc_parse_csv_split(
+      csv2, static_cast<int64_t>(strlen(csv2)), 1, ',', 9, -1);
+  CHECK_TRUE(s3 != nullptr && s3->error != nullptr);
+  dmlc_free_csv_split(s3);
+
   // streaming reader over a temp file, exercised twice (before_first)
   char path[] = "/tmp/dmlc_tpu_smoke_XXXXXX";
   int fd = mkstemp(path);
@@ -215,7 +239,7 @@ int main() {
     remove(cpath);
   }
 
-  CHECK_TRUE(dmlc_native_abi_version() == 12);
+  CHECK_TRUE(dmlc_native_abi_version() == 13);
   if (failures == 0) std::printf("native_smoke: all checks passed\n");
   return failures == 0 ? 0 : 1;
 }
