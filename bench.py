@@ -39,8 +39,11 @@ TARGET_MB = float(os.environ.get("DMLC_BENCH_MB", "64"))
 NUM_COL = 28  # HIGGS has 28 features
 # per-put overhead on a tunneled device is material (~1.1 ms/batch): a
 # larger batch amortizes it at the cost of coarser overlap — tunable for
-# A/B without editing (the framework, not the workload, picks batch size)
-BATCH = int(os.environ.get("DMLC_BENCH_BATCH", "8192"))
+# A/B without editing (the framework, not the workload, picks batch size).
+# Default 16384 (1.8 MB dense puts): halves the dispatch count vs 8192;
+# measured +3-4% at GB scale on the CPU backend (r5), and the dispatch
+# share this amortizes is several-fold larger on the tunneled device
+BATCH = int(os.environ.get("DMLC_BENCH_BATCH", "16384"))
 
 
 def log(msg: str) -> None:
@@ -82,8 +85,13 @@ REPS = 3  # best-of, to tame shared-host + tunnel noise
 from statistics import median as _median  # noqa: E402
 
 
-def host_only_mb_per_sec(path: str, size_mb: float):
-    """Single-threaded parse to RowBlocks on the host (the CPU reference).
+def host_only_mb_per_sec(path: str, size_mb: float, threaded: bool = False,
+                         emit_dense: bool = False):
+    """Host-only parse (threaded=False: the single-thread CPU reference;
+    threaded=True + emit_dense: the PIPELINE'S parse ceiling — the exact
+    native dense-emit path the device leg runs, minus the device_put, so
+    the binding-bound comparison is like-for-like; a CSR-emitting ceiling
+    under-reads it and can even sit below the pipeline itself).
 
     Returns (best, median) MB/s over REPS runs — ambient host speed swings
     2-4x on this shared machine, so both statistics are recorded.
@@ -92,8 +100,13 @@ def host_only_mb_per_sec(path: str, size_mb: float):
 
     rates = []
     for _ in range(REPS):
-        parser = create_parser(path, 0, 1, "libsvm", threaded=False,
+        parser = create_parser(path, 0, 1, "libsvm", threaded=threaded,
                                chunk_bytes=CHUNK_BYTES)
+        if emit_dense and hasattr(parser, "set_emit_dense"):
+            try:
+                parser.set_emit_dense(NUM_COL, batch_rows=BATCH)
+            except TypeError:
+                parser.set_emit_dense(NUM_COL)
         t0 = time.monotonic()
         rows = 0
         for block in parser:
@@ -101,7 +114,9 @@ def host_only_mb_per_sec(path: str, size_mb: float):
         dt = time.monotonic() - t0
         parser.close()
         rates.append(size_mb / dt)
-        log(f"bench: host-only parse {rows} rows in {dt:.2f}s = {size_mb/dt:.1f} MB/s")
+        log(f"bench: host-only parse ({'threaded' if threaded else '1-thread'}"
+            f"{', dense-emit' if emit_dense else ''})"
+            f" {rows} rows in {dt:.2f}s = {size_mb/dt:.1f} MB/s")
     return max(rates), _median(rates)
 
 
@@ -271,6 +286,26 @@ def run_child() -> None:
         line["pct_of_line_rate_median"] = round(dev[1] / floor_med, 3)
         line["device_mb_per_sec"] = round(dev[0], 2)
         line["line_rate_floor_mb_per_sec"] = round(floor_best, 2)
+        # the BINDING bound: the pipeline can go no faster than
+        # min(its parse ceiling, the link) — which resource binds flips
+        # with tunnel weather on this host, so the ">=90%, zero stalls"
+        # claim is judged against the minimum of both, in corpus MB/s.
+        # (pct_of_line_rate alone under-reads a parse-bound pipeline and
+        # says nothing about a link-bound one's parse headroom.)
+        thr_best, thr_med = host_only_mb_per_sec(path, size_mb,
+                                                 threaded=True,
+                                                 emit_dense=True)
+        # floor in corpus units: floor_device * (corpus bytes / device
+        # bytes); value/dev[0] is exactly corpus_mb/s per device_mb/s
+        floor_corpus = floor_best * value / dev[0]
+        bound = min(thr_best, floor_corpus)
+        line["parse_ceiling_mb_per_sec"] = round(thr_best, 2)
+        line["line_rate_corpus_equiv_mb_per_sec"] = round(floor_corpus, 2)
+        line["binding_resource"] = ("link" if floor_corpus < thr_best
+                                    else "parse")
+        line["pct_of_pipeline_bound"] = round(value / bound, 3)
+        line["pct_of_pipeline_bound_median"] = round(
+            med / min(thr_med, floor_med * med / dev[1]), 3)
     except Exception as exc:  # noqa: BLE001 - the headline must still print
         log(f"bench: line-rate floor leg failed: {exc}")
     # bf16 ingest: the C++ repack emits bfloat16 (the MXU's operand width),

@@ -356,6 +356,32 @@ def test_pallas_ell_matvec_matches_xla():
                                rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("D,K", [(512, 32), (1024, 48), (2048, 64)])
+def test_pallas_ell_matvec_candidate_band_parity(D, K):
+    """Interpret-mode parity at EXACTLY the auto-router candidate band
+    (bench_sparse_tpu.py hashed_512/1k/2k shapes): when the hardware A/B
+    finally runs (tunnel-gated since r4), the only open question should
+    be SPEED — numerical identity at these widths is pre-established
+    here, so a winning band can be gated in without a correctness
+    escort."""
+    from dmlc_tpu.ops import ell_matvec
+    from dmlc_tpu.ops.pallas_sparse import ell_matvec_pallas
+    from dmlc_tpu.ops.sparse import EllBatch
+
+    rng = np.random.default_rng(D)
+    B = 256
+    idx = rng.integers(0, D, size=(B, K)).astype(np.int32)
+    val = rng.normal(size=(B, K)).astype(np.float32)
+    w = jnp.asarray(rng.normal(size=D).astype(np.float32))
+    ell = EllBatch(jnp.asarray(idx), jnp.asarray(val),
+                   jnp.zeros(B), jnp.ones(B))
+    want = ell_matvec(w, ell)
+    got = ell_matvec_pallas(w, ell.indices, ell.values,
+                            block_b=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
 def test_pallas_tile_pick_lane_aligned():
     """Compiled-mode tiles must be multiples of 128 (Mosaic lane minimum,
     advisor r3): _pick_block_b returns only {256, 128, 0}, and the raw
