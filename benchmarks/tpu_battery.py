@@ -26,12 +26,20 @@ LOG = os.path.join(REPO, "TPU_BATTERY.log")
 
 
 def run(cmd, env=None, timeout=3600):
+    e = dict(os.environ)
+    e.update(env or {})
     with open(LOG, "a") as log:
+        # the platform pin + tag make CPU smoke runs of this script
+        # unmistakable in the shared log (each bench also prints its
+        # device on stderr, but the section header is what readers scan);
+        # read from the MERGED env — a per-call override must not be
+        # headed as the ambient platform
+        pin = e.get("DMLC_BENCH_PLATFORM", "device")
+        tag = e.get("DMLC_BENCH_TAG", "")
         log.write(f"\n== {time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())} "
+                  f"[{pin}{' ' + tag if tag else ''}] "
                   f"{' '.join(cmd)} (env {env or {}}) ==\n")
         log.flush()
-        e = dict(os.environ)
-        e.update(env or {})
         try:
             proc = subprocess.run(cmd, env=e, cwd=REPO, stdout=log,
                                   stderr=subprocess.STDOUT, timeout=timeout)

@@ -873,6 +873,22 @@ def test_fm_libfm_format_end_to_end(tmp_path):
     assert acc > 0.9, acc
 
 
+def test_device_iter_trace_annotation_path(tmp_path, monkeypatch):
+    """DMLC_TPU_TRACE=1 (SURVEY §5.1): every transfer runs inside a
+    jax.profiler.TraceAnnotation — the wrapper must be a behavioral no-op
+    on the delivered batches (it only tags them for a Perfetto trace)."""
+    monkeypatch.setenv("DMLC_TPU_TRACE", "1")
+    uri = _libsvm_corpus(tmp_path, n=48)
+    parser = create_parser(uri, 0, 1, "libsvm", threaded=False)
+    it = DeviceIter(parser, num_col=6, batch_size=16, layout="dense")
+    assert it._trace is True
+    batches = list(it)
+    it.close()
+    assert len(batches) == 3
+    x, y, w = batches[0]
+    assert x.shape == (16, 6) and isinstance(x, jax.Array)
+
+
 def test_sync_min_single_process():
     from dmlc_tpu.parallel import sync_min
 
