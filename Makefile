@@ -6,16 +6,21 @@
 #   make test         pytest only (fast inner loop)
 #   make sanitize     ASan/UBSan + TSan native runs -> native/SANITIZE.log
 #   make parse-bench  native scanner throughput tool (no device needed)
+#   make fuzz         mutation fuzz of every native parse C-ABI entry point
+#                     (crash-safety; DMLC_FUZZ_ITERS to scale)
 
 PYTHON ?= python
 # bash + pipefail so a failing stage is never masked by the tee into CHECK.log
 SHELL := /bin/bash
 .SHELLFLAGS := -o pipefail -c
 
-.PHONY: check test sanitize parse-bench
+.PHONY: check test sanitize parse-bench fuzz
 
 test:
 	$(PYTHON) -m pytest tests/ -q
+
+fuzz:
+	$(PYTHON) native/test/fuzz_parse.py
 
 sanitize:
 	sh native/run_sanitizers.sh

@@ -364,6 +364,28 @@ def wait_for_device(window_s: float) -> bool:
         time.sleep(60)
 
 
+def _spawn_child(env: dict, timeout: float):
+    """Run one measurement child; return its parsed JSON line (a dict with
+    a 'metric' key) or None. Shared by the supervisor loop and the
+    CPU-fallback leg so the extraction logic cannot diverge."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, stderr=sys.stderr, text=True,
+            timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return "timeout"
+    out_lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    if proc.returncode == 0 and out_lines:
+        try:
+            parsed = json.loads(out_lines[-1])
+        except ValueError:
+            parsed = None
+        if isinstance(parsed, dict) and "metric" in parsed:
+            return parsed
+    return proc.returncode
+
+
 def main() -> int:
     if os.environ.get("DMLC_BENCH_CHILD") == "1":
         run_child()
@@ -388,31 +410,21 @@ def main() -> int:
         attempts = 0
     for attempt in range(1, attempts + 1):
         log(f"bench: attempt {attempt}/{attempts}")
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)], env=env,
-                stdout=subprocess.PIPE, stderr=sys.stderr, text=True,
-                timeout=timeout)
-        except subprocess.TimeoutExpired:
+        result = _spawn_child(env, timeout)
+        if isinstance(result, dict):
+            if attempt > 1:
+                result["infra_retries"] = attempt - 1
+            print(json.dumps(result))
+            return 0
+        if result == "timeout":
             # the tunnel can hang a backend init indefinitely: a timeout is
             # an infra failure, not a bench bug
             last_err = f"timeout after {timeout:.0f}s"
             log(f"bench: child {last_err}")
         else:
-            out_lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
-            if proc.returncode == 0 and out_lines:
-                try:
-                    parsed = json.loads(out_lines[-1])
-                except ValueError:
-                    parsed = None
-                if isinstance(parsed, dict) and "metric" in parsed:
-                    if attempt > 1:
-                        parsed["infra_retries"] = attempt - 1
-                    print(json.dumps(parsed))
-                    return 0
-            last_err = f"rc={proc.returncode}"
+            last_err = f"rc={result}"
             log(f"bench: child failed ({last_err})")
-            if proc.returncode != EX_INFRA:
+            if result != EX_INFRA:
                 # deterministic bench bug: re-running cannot succeed
                 infra = False
                 break
@@ -442,19 +454,18 @@ def main() -> int:
         # it is structural evidence, never the judged TPU metric.
         log("bench: device unavailable — capturing labeled CPU-backend "
             "fallback")
+        # fallback budget: bounded separately so it cannot stack a third
+        # full child timeout onto an outer supervisor's budget (the
+        # battery sizes its outer kill for the probe window + attempts;
+        # it passes DMLC_BENCH_FALLBACK_TIMEOUT to keep the sum inside).
+        # Default covers 64 MB comfortably and GB when the corpus exists;
+        # GB-with-regeneration needs the explicit knob.
+        fb_timeout = float(os.environ.get("DMLC_BENCH_FALLBACK_TIMEOUT",
+                                          str(min(timeout, 1800.0))))
         try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=dict(env, DMLC_BENCH_PLATFORM="cpu"),
-                stdout=subprocess.PIPE, stderr=sys.stderr, text=True,
-                # same budget a regular child gets: at GB scale the
-                # fallback may have to REGENERATE the corpus (the probe
-                # gate means no TPU child ever built it), which alone
-                # outruns a small fixed timeout
-                timeout=timeout)
-            out_lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
-            parsed = json.loads(out_lines[-1]) if out_lines else None
-            if proc.returncode == 0 and isinstance(parsed, dict):
+            parsed = _spawn_child(dict(env, DMLC_BENCH_PLATFORM="cpu"),
+                                  fb_timeout)
+            if isinstance(parsed, dict):
                 for k in ("value", "vs_baseline", "median_vs_baseline",
                           "bf16_vs_baseline", "parse_ceiling_mb_per_sec"):
                     if parsed.get(k) is not None:

@@ -62,14 +62,16 @@ def main() -> int:
     # GB-leg budget clamp (ADVICE r4 #2): bench.py's supervisor defaults to
     # attempts=3 x timeout=max(1800, MB*6)=6144s at 1024 MB, which blows
     # through any sane outer kill and can take the guaranteed JSON line
-    # with it. Cap the supervisor's per-child timeout and attempts so its
-    # worst case (2 children + 2 probe windows + slack) stays under the
-    # outer timeout: 2*2400 + 2*300 + 600 = 6000.
+    # with it. Cap the supervisor's per-child timeout, attempts, AND the
+    # infra CPU-fallback child so the worst case (2 children + 2 probe
+    # windows + fallback + slack = 2*2400 + 2*300 + 900 + 300 = 6900)
+    # stays under the outer timeout of 7200.
     gb_env = {
         "DMLC_BENCH_MB": "1024",
         "DMLC_BENCH_TIMEOUT": "2400",
         "DMLC_BENCH_ATTEMPTS": "2",
         "DMLC_BENCH_PROBE_WINDOW": "300",
+        "DMLC_BENCH_FALLBACK_TIMEOUT": "900",
     }
     # quick, high-value legs first: if the flaky tunnel recovers late in a
     # round, the floor + 64MB configs + sparse A/B (~15 min) land before
@@ -80,8 +82,8 @@ def main() -> int:
         run([py, "benchmarks/bench_libfm_bcoo.py"]),
         run([py, "benchmarks/bench_sparse_tpu.py"],
             env={"DMLC_BENCH_TAG": tag}),
-        run([py, "bench.py"], env=gb_env, timeout=6000),
-        run([py, "benchmarks/bench_libfm_bcoo.py"], env=gb_env, timeout=6000),
+        run([py, "bench.py"], env=gb_env, timeout=7200),
+        run([py, "benchmarks/bench_libfm_bcoo.py"], env=gb_env, timeout=7200),
     ]
     # the GB legs grow the cached corpora in place; drop any oversized ones
     # so the driver's default 64 MB bench regenerates at its own size

@@ -230,6 +230,10 @@ def _declare(lib: ctypes.CDLL) -> None:
     # changes across importlib.reload) — they may fire at interpreter exit
     lib.dmlc_free_block.argtypes = [ctypes.c_void_p]
     lib.dmlc_free_csv.argtypes = [ctypes.c_void_p]
+    lib.dmlc_parse_csv_split.restype = ctypes.POINTER(_CsvSplitResult)
+    lib.dmlc_parse_csv_split.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_char,
+        ctypes.c_int32, ctypes.c_int32]
     lib.dmlc_free_csv_split.argtypes = [ctypes.c_void_p]
     lib.dmlc_native_abi_version.restype = ctypes.c_int
     lib.dmlc_recordio_extract.restype = ctypes.POINTER(_RecordBatchResult)
