@@ -43,6 +43,8 @@ check:
 	$(PYTHON) -m pytest tests/ -q 2>&1 | tee -a CHECK.log
 	@echo "-- sanitizers --" | tee -a CHECK.log
 	sh native/run_sanitizers.sh 2>&1 | tee -a CHECK.log
+	@echo "-- parse fuzz --" | tee -a CHECK.log
+	$(PYTHON) native/test/fuzz_parse.py 2>&1 | tee -a CHECK.log
 	@echo "-- parse bench --" | tee -a CHECK.log
 	$(MAKE) --no-print-directory parse-bench 2>&1 | tee -a CHECK.log
 	@echo "== make check: ALL GREEN ==" | tee -a CHECK.log

@@ -365,9 +365,12 @@ def wait_for_device(window_s: float) -> bool:
 
 
 def _spawn_child(env: dict, timeout: float):
-    """Run one measurement child; return its parsed JSON line (a dict with
-    a 'metric' key) or None. Shared by the supervisor loop and the
-    CPU-fallback leg so the extraction logic cannot diverge."""
+    """Run one measurement child. Returns the parsed JSON line (a dict
+    with a 'metric' key) on success, the string ``"timeout"`` on a child
+    timeout, or the child's int returncode otherwise — callers must
+    isinstance-check for dict, not truthiness (rc=0 is falsy). Shared by
+    the supervisor loop and the CPU-fallback leg so the extraction logic
+    cannot diverge."""
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)], env=env,
@@ -474,6 +477,11 @@ def main() -> int:
                     "identical pipeline, CPU backend: structural evidence "
                     "only — transfers cost host-memory bandwidth, not "
                     "tunnel bandwidth")
+            else:
+                # a failed fallback must say so — a silent no-keys line
+                # reads as "fallback never attempted"
+                log(f"bench: cpu fallback failed ({parsed})")
+                line["cpu_backend_error"] = str(parsed)
         except Exception as exc:  # noqa: BLE001 - fallback must not mask infra
             log(f"bench: cpu fallback failed: {exc}")
     print(json.dumps(line))

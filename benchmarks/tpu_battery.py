@@ -64,8 +64,8 @@ def main() -> int:
     # through any sane outer kill and can take the guaranteed JSON line
     # with it. Cap the supervisor's per-child timeout, attempts, AND the
     # infra CPU-fallback child so the worst case (2 children + 2 probe
-    # windows + fallback + slack = 2*2400 + 2*300 + 900 + 300 = 6900)
-    # stays under the outer timeout of 7200.
+    # windows + fallback = 2*2400 + 2*300 + 900 = 6300) stays under the
+    # outer timeout of 7200 with ~900s slack.
     gb_env = {
         "DMLC_BENCH_MB": "1024",
         "DMLC_BENCH_TIMEOUT": "2400",
