@@ -36,17 +36,43 @@ BENCHES = [
 ]
 
 
+def _tail(stream) -> str:
+    """Last 800 chars of a subprocess stream (str, bytes, or None)."""
+    if stream is None:
+        return ""
+    if isinstance(stream, bytes):
+        stream = stream.decode(errors="replace")
+    return stream[-800:]
+
+
+def _extract_json(entry: dict, stdout) -> None:
+    """Fold the last '{'-prefixed stdout line into ``entry`` (shared by
+    the success and timeout paths so the record shape cannot diverge)."""
+    if stdout is None:
+        return
+    if isinstance(stdout, bytes):
+        stdout = stdout.decode(errors="replace")
+    lines = [ln for ln in stdout.splitlines() if ln.startswith("{")]
+    if lines:
+        try:
+            entry.update(json.loads(lines[-1]))
+        except ValueError:
+            entry["raw"] = lines[-1][:500]
+
+
 def main() -> None:
     tag = os.environ.get("DMLC_BENCH_TAG", "r02")
     results = []
     for script, cwd in BENCHES:
         print(f"== {script} ==", file=sys.stderr, flush=True)
-        # keep bench.py's supervisor (probe window + infra CPU fallback)
-        # inside this runner's own 1800s kill: 300 + 900 + child leaves
-        # headroom at the suite's 64 MB default scale
+        # keep bench.py's ENTIRE supervisor budget (probe window +
+        # attempts x child + infra CPU fallback) inside this runner's
+        # 1800s kill: 300 + 1*500 + 900 = 1700
         env = dict(os.environ)
         if script == "bench.py":
             env.setdefault("DMLC_BENCH_PROBE_WINDOW", "300")
+            env.setdefault("DMLC_BENCH_TIMEOUT", "500")
+            env.setdefault("DMLC_BENCH_ATTEMPTS", "1")
             env.setdefault("DMLC_BENCH_FALLBACK_TIMEOUT", "900")
         try:
             proc = subprocess.run(
@@ -58,27 +84,15 @@ def main() -> None:
             # take the rest of the suite's records down with it — and a
             # JSON line printed before the hang is still a measurement
             entry = {"bench": script, "rc": "timeout_1800s"}
-            out = exc.stdout or ""
-            if isinstance(out, bytes):
-                out = out.decode(errors="replace")
-            lines = [ln for ln in out.splitlines() if ln.startswith("{")]
-            if lines:
-                try:
-                    entry.update(json.loads(lines[-1]))
-                except ValueError:
-                    entry["raw"] = lines[-1][:500]
+            _extract_json(entry, exc.stdout)
+            entry["stderr_tail"] = _tail(exc.stderr)
             results.append(entry)
             print(json.dumps(entry), flush=True)
             continue
-        lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
         entry = {"bench": script, "rc": proc.returncode}
-        if lines:
-            try:
-                entry.update(json.loads(lines[-1]))
-            except ValueError:
-                entry["raw"] = lines[-1][:500]
+        _extract_json(entry, proc.stdout)
         if proc.returncode != 0:
-            entry["stderr_tail"] = proc.stderr[-800:]
+            entry["stderr_tail"] = _tail(proc.stderr)
         results.append(entry)
         print(json.dumps(entry), flush=True)
     out = os.path.join(REPO, f"BENCHMARKS_{tag}.json")
