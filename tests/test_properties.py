@@ -161,6 +161,89 @@ def test_serializer_roundtrip_identity(obj):
 
 
 # ---------------------------------------------------------------------------
+# Checkpoint/resume invariant (SURVEY §5.4): restoring a DeviceIter state
+# captured after ANY number of delivered batches reproduces the remainder
+# of the uninterrupted stream exactly — for any corpus size, batch size,
+# and chunking (block boundaries move; the resumed stream must not care).
+
+@SETTLE
+@given(
+    n_rows=st.integers(min_value=40, max_value=300),
+    batch=st.sampled_from([16, 32, 64]),
+    chunk=st.sampled_from([512, 2048, 8192]),
+    data=st.data(),
+)
+def test_device_iter_resume_any_position(tmp_path_factory, n_rows, batch,
+                                         chunk, data):
+    from dmlc_tpu.data.device import DeviceIter
+
+    d = tmp_path_factory.mktemp("resume")
+    p = d / "c.libsvm"
+    rng = np.random.default_rng(n_rows)
+    lines = []
+    for i in range(n_rows):
+        feats = " ".join(f"{j}:{rng.normal():.4f}" for j in range(4))
+        lines.append(f"{i % 2} {feats}")
+    p.write_text("\n".join(lines) + "\n")
+
+    def make():
+        parser = create_parser(str(p) + "?engine=python", 0, 1, "libsvm",
+                               threaded=False, chunk_bytes=chunk)
+        return DeviceIter(parser, num_col=4, batch_size=batch,
+                          layout="dense")
+
+    it = make()
+    full = [(np.asarray(x), np.asarray(y), np.asarray(w)) for x, y, w in it]
+    it.close()
+    k = data.draw(st.integers(min_value=0, max_value=len(full)))
+
+    it2 = make()
+    for _ in range(k):
+        next(it2)
+    state = it2.state_dict()
+    it2.close()
+
+    it3 = make()
+    it3.load_state(state)
+    rest = [(np.asarray(x), np.asarray(y), np.asarray(w)) for x, y, w in it3]
+    assert len(rest) == len(full) - k
+    for (xa, ya, wa), (xb, yb, wb) in zip(rest, full[k:]):
+        np.testing.assert_allclose(xa, xb)
+        np.testing.assert_allclose(ya, yb)
+        np.testing.assert_allclose(wa, wb)
+    it3.close()
+
+
+# ---------------------------------------------------------------------------
+# RecordIO splitter partition invariant: random binary payloads (incl.
+# magic-embedding, multi-part frames) written through the writer, read
+# back through the SPLIT engine over every partitioning — no record lost,
+# duplicated, or corrupted (recordio_split.cc aligned-magic scan).
+
+@SETTLE
+@given(
+    payloads=st.lists(_payload_st, min_size=1, max_size=30),
+    num_parts=st.integers(min_value=1, max_value=4),
+)
+def test_recordio_split_partition_invariant(tmp_path_factory, payloads,
+                                            num_parts):
+    d = tmp_path_factory.mktemp("recsplit")
+    path = d / "r.rec"
+    with open(path, "wb") as f:
+        w = RecordIOWriter(f)
+        for pl in payloads:
+            w.write_record(pl)
+
+    got = []
+    for part in range(num_parts):
+        s = create_input_split(str(path), part, num_parts, "recordio",
+                               threaded=False)
+        got.extend(bytes(r) for r in s.iter_records())
+        s.close()
+    assert got == payloads
+
+
+# ---------------------------------------------------------------------------
 # Parser engine parity: the native C++ scanner and the numpy engine must
 # produce identical blocks for ANY valid libsvm corpus (the fixed-fixture
 # version lives in test_native_reader.py; this explores row shapes).
