@@ -26,6 +26,17 @@ SETTLE = settings(max_examples=30, deadline=None,
                   suppress_health_check=[HealthCheck.function_scoped_fixture])
 
 
+def _write_libsvm(path, rows, prec: str = ".5g") -> None:
+    """Serialize [(idx, val), ...] feature rows (deduped, sorted) as a
+    libsvm corpus — shared by every property that generates one."""
+    lines = []
+    for i, feats in enumerate(rows):
+        feats = sorted({j: v for j, v in feats}.items())
+        body = " ".join(f"{j}:{v:{prec}}" for j, v in feats)
+        lines.append(f"{i % 2}{' ' if body else ''}{body}")
+    path.write_text("\n".join(lines) + "\n")
+
+
 # ---------------------------------------------------------------------------
 # InputSplit partition invariant: looping all parts == one pass, for ANY
 # corpus layout (src/io.cc:74-130 byte-range sharding; PR#385/PR#452 edge
@@ -244,6 +255,51 @@ def test_recordio_split_partition_invariant(tmp_path_factory, payloads,
 
 
 # ---------------------------------------------------------------------------
+# BCOO shape bucketing is a mathematical no-op for ANY corpus: bucketed
+# batches densify to exactly the unbucketed ones (padding rows empty,
+# padded nnz masked OOB), while every emitted (nse, rows) is quantized.
+
+@SETTLE
+@given(
+    rows=st.lists(
+        st.lists(st.tuples(st.integers(0, 19),
+                           st.floats(-10, 10, width=32)),
+                 min_size=1, max_size=5),
+        min_size=8, max_size=60),
+    nnz_bucket=st.sampled_from([8, 32, 128]),
+    batch=st.sampled_from([8, 16]),
+)
+def test_bcoo_bucketing_noop_random_corpora(tmp_path_factory, rows,
+                                            nnz_bucket, batch):
+    from dmlc_tpu.data.device import DeviceIter
+
+    d = tmp_path_factory.mktemp("bcoo")
+    p = d / "c.libsvm"
+    _write_libsvm(p, rows)
+
+    def run(bucket):
+        parser = create_parser(str(p), 0, 1, "libsvm", threaded=False)
+        it = DeviceIter(parser, num_col=20, batch_size=batch, layout="bcoo",
+                        nnz_bucket=bucket)
+        out = [(np.asarray(m.todense()), np.asarray(y), np.asarray(w),
+                int(m.nse)) for m, y, w in it]
+        it.close()
+        return out
+
+    bucketed = run(nnz_bucket)
+    exact = run(0)
+    assert len(bucketed) == len(exact)
+    for (mb, yb, wb, nse), (me, ye, we, _) in zip(bucketed, exact):
+        assert nse % nnz_bucket == 0
+        # the ROW dimension is quantized too: every batch (tail included)
+        # is padded to batch_size
+        assert mb.shape[0] == batch and yb.shape == (batch,)
+        np.testing.assert_allclose(mb, me, rtol=1e-6)
+        np.testing.assert_allclose(yb, ye)
+        np.testing.assert_allclose(wb, we)
+
+
+# ---------------------------------------------------------------------------
 # Parser engine parity: the native C++ scanner and the numpy engine must
 # produce identical blocks for ANY valid libsvm corpus (the fixed-fixture
 # version lives in test_native_reader.py; this explores row shapes).
@@ -260,12 +316,7 @@ def test_recordio_split_partition_invariant(tmp_path_factory, payloads,
 def test_libsvm_engine_parity_random_corpora(tmp_path_factory, rows):
     d = tmp_path_factory.mktemp("parity")
     p = d / "c.libsvm"
-    lines = []
-    for i, feats in enumerate(rows):
-        feats = sorted({j: v for j, v in feats}.items())
-        body = " ".join(f"{j}:{v:.6g}" for j, v in feats)
-        lines.append(f"{i % 2}{' ' if body else ''}{body}")
-    p.write_text("\n".join(lines) + "\n")
+    _write_libsvm(p, rows, prec=".6g")
 
     def collect(native: bool):
         uri = str(p) + ("" if native else "?engine=python")
