@@ -1,0 +1,79 @@
+"""Summarize TPU_BATTERY.log: the latest JSON line per metric, per
+platform header, newest last — the round-end ingestion aid for updating
+BENCHMARKS_GB_*.json after a late tunnel recovery (the watcher may land
+numbers minutes before the driver snapshot).
+
+Usage: python benchmarks/battery_summary.py [--all]
+Default prints only sections headed [device ...] (real-TPU runs); --all
+includes CPU-smoke sections too.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "TPU_BATTERY.log")
+
+_HDR = re.compile(r"^== (\S+) (?:\[([^\]]+)\] )?(.+?) \((env.*)\) ==$")
+
+
+def main() -> int:
+    if not os.path.exists(LOG):
+        print("no TPU_BATTERY.log")
+        return 1
+    show_all = "--all" in sys.argv
+    sections = []  # (ts, platform_tag, cmd, [json lines])
+    cur = None
+    # pre-r5 sections carry no [platform] header; a '### NOTE' annotation
+    # marks where the r5 CPU-backend smoke began — headerless sections
+    # after it are smoke, before it are real device runs
+    ambient = "device(pre-r5-header)"
+    for raw in open(LOG, errors="replace"):
+        line = raw.rstrip("\n")
+        if line.startswith("### NOTE") and "CPU-BACKEND SMOKE" in line:
+            ambient = "cpu(annotated-smoke)"
+            continue
+        m = _HDR.match(line)
+        if m:
+            # cmd alone does not distinguish the 64MB quick leg from the
+            # GB leg (same script; the env overrides differ) — carry both
+            cur = (m.group(1), m.group(2) or ambient,
+                   f"{m.group(3)} ({m.group(4)})", [])
+            sections.append(cur)
+            continue
+        if cur is not None and line.startswith("{"):
+            try:
+                cur[3].append(json.loads(line))
+            except ValueError:
+                pass
+    # key by (metric, full cmd incl. env): the quick 64MB leg and the GB
+    # leg of the same bench share a metric name and MUST NOT collapse —
+    # presenting a 64MB number for GB ingestion is exactly the mixup this
+    # tool exists to prevent
+    latest: dict = {}
+    for ts, pin, cmd, lines in sections:
+        if not show_all and not pin.startswith("device"):
+            continue
+        for obj in lines:
+            metric = obj.get("metric")
+            if metric:
+                latest[(metric, cmd)] = (ts, pin, obj)
+    if not latest:
+        print("no matching metric lines"
+              + ("" if show_all else " (try --all for CPU sections)"))
+        return 0
+    for (metric, cmd), (ts, pin, obj) in latest.items():
+        keys = {k: obj[k] for k in
+                ("value", "vs_baseline", "median_vs_baseline",
+                 "pct_of_line_rate", "pct_of_pipeline_bound",
+                 "bf16_vs_baseline", "infra") if k in obj}
+        print(f"{metric}  [{pin} @ {ts}]  cmd: {cmd}\n  {json.dumps(keys)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
