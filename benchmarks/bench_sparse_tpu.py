@@ -124,6 +124,22 @@ def main() -> None:
     dev = jax.devices()[0]
     print(f"# device: {dev}", flush=True)
     results: list = []
+    if os.environ.get("DMLC_SPARSE_GRID"):
+        # disentangling grid for the r05 routing decision: the band A/B
+        # showed pallas winning at (D=512,K=32), (D=2048,K=64),
+        # (D=4096,K=64) but losing 3x at (D=1024,K=48) — a full D x K
+        # cross separates "D=1024 is cursed" from "K=48 is cursed"
+        for D in (512, 1024, 2048, 4096):
+            for K in (32, 48, 64):
+                bench_shape(f"grid_d{D}_k{K}", B=8192, K=K, D=D,
+                            results=results)
+        tag = os.environ.get("DMLC_BENCH_TAG", "r05")
+        out_path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), f"SPARSE_TPU_GRID_{tag}.json")
+        with open(out_path, "w") as f:
+            json.dump({"device": str(dev), "results": results}, f, indent=1)
+        print(f"# wrote {out_path}", flush=True)
+        return
     bench_shape("higgs_like", B=8192, K=28, D=28, results=results)
     # the auto-router's candidate band (ops/pallas_sparse.py gate): every
     # threshold decision must be backed by a CURRENT measurement of the

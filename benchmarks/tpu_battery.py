@@ -82,6 +82,11 @@ def main() -> int:
         run([py, "benchmarks/bench_libfm_bcoo.py"]),
         run([py, "benchmarks/bench_sparse_tpu.py"],
             env={"DMLC_BENCH_TAG": tag}),
+        # D x K cross for the pallas routing gate: the r05 band A/B showed
+        # non-monotonic wins (D=512/2048/4096 win, D=1024@K=48 loses 3x) —
+        # the grid separates the D effect from the K effect
+        run([py, "benchmarks/bench_sparse_tpu.py"],
+            env={"DMLC_BENCH_TAG": tag, "DMLC_SPARSE_GRID": "1"}),
         run([py, "bench.py"], env=gb_env, timeout=7200),
         run([py, "benchmarks/bench_libfm_bcoo.py"], env=gb_env, timeout=7200),
     ]

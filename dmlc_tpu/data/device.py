@@ -67,6 +67,30 @@ def rebatch_blocks(
         yield pending.to_block()
 
 
+def _csr_coords_impl(cols, row_ptr):
+    """Rebuild BCOO (row, col) coordinate pairs from the CSR wire format.
+
+    ``row_ptr`` is [rows_padded + 1] with pad rows pointing at the real
+    nnz, so row id of entry j = #{i >= 1 : row_ptr[i] <= j} — computed as
+    a scatter-add of 1 at each row start followed by an inclusive prefix
+    sum. Entries past the real nnz count every row and land on the OOB
+    row rows_padded, which every BCOO op masks (same padding contract as
+    the native (row, col) emit, native/src/api.h CooResult). O(nnz) VPU
+    work per batch in exchange for HALF the coordinate bytes over the
+    host->device link.
+    """
+    import jax.numpy as jnp
+
+    nnz = cols.shape[0]
+    incr = jnp.zeros((nnz + 1,), jnp.int32).at[row_ptr[1:]].add(
+        1, mode="drop")
+    rows = jnp.cumsum(incr)[:nnz]
+    return jnp.stack([rows, cols], axis=1)
+
+
+_csr_coords = jax.jit(_csr_coords_impl)
+
+
 class DeviceIter:
     """Double-buffered host->device batch iterator.
 
@@ -95,6 +119,7 @@ class DeviceIter:
         x_dtype: str = "float32",
         nnz_bucket: Optional[int] = None,
         row_bucket: int = 1024,
+        csr_wire: bool = True,
     ):
         check(layout in ("dense", "ell", "bcoo"), f"unknown layout {layout!r}")
         check(batch_size is not None or layout == "bcoo",
@@ -176,10 +201,23 @@ class DeviceIter:
             # assembly, bucket padding, and unit-value elision move off-GIL
             # into the C++ parse threads; the convert thread then only
             # issues the (async) device_put. Safe to ignore the answer —
-            # _convert handles CooBlock and RowBlock alike.
-            source.set_emit_coo(num_col, row_bucket=self.row_bucket,
-                                nnz_bucket=self.nnz_bucket,
-                                elide_unit=self.elide_unit_values)
+            # _convert handles CooBlock and RowBlock alike. csr_wire
+            # (default) ships cols + row_ptr instead of (row, col) pairs —
+            # half the coordinate bytes over the link; _put_inner rebuilds
+            # the row ids on device (the link is the scarce resource on a
+            # tunneled TPU, the VPU prefix-sum is noise). Requires shape
+            # bucketing: _csr_coords is jit-cached by shape, so exact-shape
+            # mode (bucket 0) would retrace per batch — pair wire there.
+            csr_wire = csr_wire and self.nnz_bucket > 0 and self.row_bucket > 0
+            try:
+                source.set_emit_coo(num_col, row_bucket=self.row_bucket,
+                                    nnz_bucket=self.nnz_bucket,
+                                    elide_unit=self.elide_unit_values,
+                                    csr_wire=bool(csr_wire))
+            except TypeError:  # sources without the extended signature
+                source.set_emit_coo(num_col, row_bucket=self.row_bucket,
+                                    nnz_bucket=self.nnz_bucket,
+                                    elide_unit=self.elide_unit_values)
         if layout == "dense" and hasattr(source, "set_emit_dense"):
             # ask the parser for HBM-ready dense batches (skips CSR), repacked
             # to this batch size (and target dtype) off-GIL when the native
@@ -358,6 +396,9 @@ class DeviceIter:
         if isinstance(block, CooBlock):
             # native COO emit: already device-layout (coords/values/label/
             # weight assembled + bucket-padded off-GIL) — nothing to do here
+            if block.row_ptr is not None:
+                return ("bcoo_csr", block.coords, block.row_ptr,
+                        block.values, block.label, block.weight, block.shape)
             return ("bcoo", block.coords, block.values, block.label,
                     block.weight, block.shape)
         pad = (self.batch_size
@@ -400,6 +441,25 @@ class DeviceIter:
 
     # ---------------- device side ----------------
 
+    def _ones_for(self, n: int):
+        """Device ones for an elided-value batch (binary-feature corpora):
+        created on the SAME device the puts target (BCOO must not mix
+        committed arrays across devices) and CACHED per length — every
+        batch in an nnz bucket shares the identical ones array, so one
+        device allocation serves the whole epoch instead of one dispatch
+        per batch. With nnz_bucket=0 (exact shapes) every batch could pin
+        a new length forever — don't cache there."""
+        dv = self._ones_cache.get(n)
+        if dv is None:
+            if self.device is not None:
+                with jax.default_device(self.device):
+                    dv = jax.numpy.ones(n, jax.numpy.float32)
+            else:
+                dv = jax.numpy.ones(n, jax.numpy.float32)
+            if self.nnz_bucket:
+                self._ones_cache[n] = dv
+        return dv
+
     def _put(self, host_batch):
         # optional tracing hook (SURVEY.md §5.1): annotate transfers so they
         # are attributable in a jax.profiler / Perfetto trace
@@ -412,6 +472,22 @@ class DeviceIter:
 
     def _put_inner(self, host_batch):
         kind = host_batch[0]
+        if kind == "bcoo_csr":
+            from jax.experimental import sparse as jsparse
+
+            cols, row_ptr, vals, label, weight, shape = host_batch[1:]
+            arrs = [cols, row_ptr, label, weight] if vals is None else [
+                vals, cols, row_ptr, label, weight]
+            self.bytes_to_device += sum(a.nbytes for a in arrs)
+            out = (jax.device_put(arrs, self.device)
+                   if self.device is not None else jax.device_put(arrs))
+            if vals is None:
+                dc, dp, dl, dw = out
+                dv = self._ones_for(len(cols))
+            else:
+                dv, dc, dp, dl, dw = out
+            coords = _csr_coords(dc, dp)
+            return jsparse.BCOO((dv, coords), shape=shape), dl, dw
         if kind == "bcoo":
             from jax.experimental import sparse as jsparse
 
@@ -422,26 +498,8 @@ class DeviceIter:
             out = (jax.device_put(arrs, self.device)
                    if self.device is not None else jax.device_put(arrs))
             if vals is None:
-                # binary-feature batch: ones are synthesized on device
-                # (block_to_bcoo_host elided the value array); created on
-                # the SAME device the puts target (BCOO must not mix
-                # committed arrays across devices) and CACHED per length —
-                # every batch in an nnz bucket shares the identical ones
-                # array, so one device allocation serves the whole epoch
-                # instead of one dispatch per batch
                 dc, dl, dw = out
-                dv = self._ones_cache.get(len(coords))
-                if dv is None:
-                    if self.device is not None:
-                        with jax.default_device(self.device):
-                            dv = jax.numpy.ones(len(coords), jax.numpy.float32)
-                    else:
-                        dv = jax.numpy.ones(len(coords), jax.numpy.float32)
-                    if self.nnz_bucket:
-                        # bucketed shapes repeat, so the key space is tiny;
-                        # with nnz_bucket=0 (exact shapes) every batch could
-                        # pin a new length forever — don't cache there
-                        self._ones_cache[len(coords)] = dv
+                dv = self._ones_for(len(coords))
             else:
                 dv, dc, dl, dw = out
             return jsparse.BCOO((dv, dc), shape=shape), dl, dw

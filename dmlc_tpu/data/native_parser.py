@@ -109,6 +109,7 @@ class NativeStreamParser(Parser):
         self._coo_row_bucket = 0
         self._coo_nnz_bucket = 0
         self._coo_elide = False
+        self._coo_csr_wire = False
         self._stall = 0.0
         self._blocks_out = 0  # delivered blocks, for count-based resume
         self._batch_rows = 0
@@ -138,13 +139,18 @@ class NativeStreamParser(Parser):
         return True
 
     def set_emit_coo(self, num_col: int, row_bucket: int = 0,
-                     nnz_bucket: int = 0, elide_unit: bool = False) -> bool:
+                     nnz_bucket: int = 0, elide_unit: bool = False,
+                     csr_wire: bool = False) -> bool:
         """Emit CooBlock batches straight from the native parse: int32
         (row, col) coordinate pairs with OOB bucket padding, optional
         all-ones value elision — the whole convert stage of the BCOO
         pipeline moves off-GIL into the C++ parse threads. One CooBlock per
-        chunk (natural-block mode). Must be called before the first pull.
-        csv has no sparse analog; int32 coords require num_col + 1 < 2^31."""
+        chunk (natural-block mode). ``csr_wire`` ships cols + row_ptr
+        instead of (row, col) pairs — half the coordinate bytes over the
+        host->device link; the DeviceIter consumer rebuilds row ids on
+        device (native/src/api.h CooResult docs). Must be called before the
+        first pull. csv has no sparse analog; int32 coords require
+        num_col + 1 < 2^31."""
         if (self._reader is not None or self.fmt_name == "csv"
                 or int(num_col) + 1 >= (1 << 31)):
             return False
@@ -152,6 +158,7 @@ class NativeStreamParser(Parser):
         self._coo_row_bucket = int(row_bucket)
         self._coo_nnz_bucket = int(nnz_bucket)
         self._coo_elide = bool(elide_unit)
+        self._coo_csr_wire = bool(csr_wire)
         return True
 
     # ---------------- pipeline ----------------
@@ -194,6 +201,7 @@ class NativeStreamParser(Parser):
             row_bucket=self._coo_row_bucket if coo else 0,
             nnz_bucket=self._coo_nnz_bucket if coo else 0,
             elide_unit=self._coo_elide if coo else False,
+            csr_wire=self._coo_csr_wire if coo else False,
         )
         return fmt, kwargs
 
@@ -225,7 +233,8 @@ class NativeStreamParser(Parser):
             return CooBlock(
                 data["coords"], data["values"], data["label"],
                 data["weight"], data["n_rows"], data["nnz"],
-                int(self._emit_coo), hold=data["_owner"])
+                int(self._emit_coo), hold=data["_owner"],
+                row_ptr=data.get("row_ptr"))
         if fmt in (native.FMT_LIBSVM, native.FMT_LIBFM):
             return RowBlock(
                 offset=data["offset"], label=data["label"],

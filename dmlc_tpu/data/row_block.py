@@ -216,11 +216,15 @@ class CooBlock:
     """
 
     __slots__ = ("coords", "values", "label", "weight", "n_rows", "nnz",
-                 "num_col", "hold", "resume_state")
+                 "num_col", "hold", "resume_state", "row_ptr")
 
     def __init__(self, coords: np.ndarray, values: Optional[np.ndarray],
                  label: np.ndarray, weight: np.ndarray, n_rows: int,
-                 nnz: int, num_col: int, hold=None):
+                 nnz: int, num_col: int, hold=None,
+                 row_ptr: Optional[np.ndarray] = None):
+        # csr_wire blocks: coords is cols-only [nnz_padded] and row_ptr is
+        # [rows_padded + 1]; the device consumer rebuilds (row, col) pairs
+        self.row_ptr = row_ptr
         self.coords = coords
         self.values = values
         self.label = label

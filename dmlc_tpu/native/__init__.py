@@ -35,7 +35,7 @@ _HDRS = [os.path.join(_SRC_DIR, f)
          for f in ("api.h", "strtonum.h", "parse_internal.h")]
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 _SO_PATH = os.path.join(_BUILD_DIR, "libdmlc_tpu_native.so")
-_ABI_VERSION = 13
+_ABI_VERSION = 14
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -102,6 +102,8 @@ class _CooResult(ctypes.Structure):
         ("weight", ctypes.POINTER(ctypes.c_float)),
         ("error", ctypes.c_char_p),
         ("values_elided", ctypes.c_int32),
+        ("csr_wire", ctypes.c_int32),
+        ("row_ptr", ctypes.POINTER(ctypes.c_int32)),
     ]
 
 
@@ -243,7 +245,7 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.dmlc_parse_coo.argtypes = [
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-        ctypes.c_int32]
+        ctypes.c_int32, ctypes.c_int32]
     lib.dmlc_free_coo.argtypes = [ctypes.c_void_p]
     lib.dmlc_reader_create.restype = ctypes.c_void_p
     lib.dmlc_reader_create.argtypes = [
@@ -252,7 +254,7 @@ def _declare(lib: ctypes.CDLL) -> None:
         ctypes.c_int64, ctypes.c_int32, ctypes.c_char, ctypes.c_int32,
         ctypes.c_int64, ctypes.c_int32, ctypes.c_int64, ctypes.c_int32,
         ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, ctypes.c_int64,
-        ctypes.c_int32]
+        ctypes.c_int32, ctypes.c_int32]
     lib.dmlc_reader_next.restype = ctypes.c_void_p
     lib.dmlc_reader_next.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32)]
@@ -267,7 +269,7 @@ def _declare(lib: ctypes.CDLL) -> None:
         ctypes.c_int32, ctypes.c_int64, ctypes.c_int32, ctypes.c_char,
         ctypes.c_int32, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
         ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
-        ctypes.c_int64, ctypes.c_int32]
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_int32]
     lib.dmlc_feeder_push.restype = ctypes.c_int32
     lib.dmlc_feeder_push.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
@@ -562,8 +564,11 @@ def _free_coo(lib, addr):
 def _wrap_coo(lib, res):
     """Wrap a CooResult as a dict of zero-copy views.
 
-    ``coords`` is int32 [nnz_padded, 2]; ``values`` is None when the block
-    is all-ones and elision was requested (consumer synthesizes on device);
+    ``coords`` is int32 [nnz_padded, 2] — or, on csr_wire blocks, cols-only
+    int32 [nnz_padded] with ``row_ptr`` int32 [rows_padded + 1] (half the
+    coordinate transfer bytes; the consumer rebuilds row ids on device,
+    data/device.py); ``values`` is None when the block is all-ones and
+    elision was requested (consumer synthesizes on device);
     ``n_rows``/``nnz`` are the REAL counts (shape dims carry bucket pad)."""
     r = res.contents
     if r.error:
@@ -571,14 +576,21 @@ def _wrap_coo(lib, res):
         lib.dmlc_free_coo(res)
         raise DMLCError(msg)
     owner = _Owner(lib, res, _free_coo)
-    coords = _view(r.coords, 2 * r.nnz_padded, np.int32, owner)
-    coords = coords.reshape(r.nnz_padded, 2) if coords is not None \
-        else np.zeros((0, 2), np.int32)
+    if r.csr_wire:
+        coords = _view(r.coords, r.nnz_padded, np.int32, owner)
+        coords = coords if coords is not None else np.zeros((0,), np.int32)
+        row_ptr = _view(r.row_ptr, r.rows_padded + 1, np.int32, owner)
+    else:
+        coords = _view(r.coords, 2 * r.nnz_padded, np.int32, owner)
+        coords = coords.reshape(r.nnz_padded, 2) if coords is not None \
+            else np.zeros((0, 2), np.int32)
+        row_ptr = None
     return {
         "n_rows": int(r.n_rows),
         "nnz": int(r.nnz),
         "rows_padded": int(r.rows_padded),
         "coords": coords,
+        "row_ptr": row_ptr,
         "values": (None if r.values_elided
                    else _view(r.values, r.nnz_padded, np.float32, owner)),
         "label": _view(r.label, r.rows_padded, np.float32, owner),
@@ -623,7 +635,7 @@ class Reader:
                  batch_rows: int = 0, label_col: int = -1,
                  weight_col: int = -1, out_bf16: bool = False,
                  row_bucket: int = 0, nnz_bucket: int = 0,
-                 elide_unit: bool = False):
+                 elide_unit: bool = False, csr_wire: bool = False):
         lib = _load()
         if lib is None:
             raise DMLCError("native core unavailable")
@@ -638,7 +650,8 @@ class Reader:
             indexing_mode, delimiter.encode()[0] if delimiter else b","[0],
             nthread or default_nthread(), chunk_bytes, queue_depth,
             batch_rows, label_col, weight_col, 1 if out_bf16 else 0,
-            row_bucket, nnz_bucket, 1 if elide_unit else 0)
+            row_bucket, nnz_bucket, 1 if elide_unit else 0,
+            1 if csr_wire else 0)
         if not self._h:
             raise DMLCError(
                 "native reader creation failed (out of memory or threads)")
@@ -702,7 +715,7 @@ class Feeder:
                  batch_rows: int = 0, label_col: int = -1,
                  weight_col: int = -1, out_bf16: bool = False,
                  row_bucket: int = 0, nnz_bucket: int = 0,
-                 elide_unit: bool = False):
+                 elide_unit: bool = False, csr_wire: bool = False):
         lib = _load()
         if lib is None:
             raise DMLCError("native core unavailable")
@@ -714,7 +727,8 @@ class Feeder:
             delimiter.encode()[0] if delimiter else b","[0],
             nthread or default_nthread(), chunk_bytes, queue_depth,
             batch_rows, label_col, weight_col, 1 if out_bf16 else 0,
-            row_bucket, nnz_bucket, 1 if elide_unit else 0)
+            row_bucket, nnz_bucket, 1 if elide_unit else 0,
+            1 if csr_wire else 0)
         if not self._h:
             raise DMLCError("native feeder creation failed")
 

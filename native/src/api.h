@@ -87,24 +87,36 @@ struct CooResult {
   int64_t nnz;          // real entries
   int64_t rows_padded;  // label/weight length (>= n_rows)
   int64_t nnz_padded;   // coords rows / values length (>= nnz)
-  int32_t* coords;      // [nnz_padded, 2] row-major (row, col)
+  int32_t* coords;      // [nnz_padded, 2] row-major (row, col), or
+                        // [nnz_padded] cols-only when csr_wire
   float* values;        // [nnz_padded] or NULL when values_elided
   float* label;         // [rows_padded], zeros past n_rows
   float* weight;        // [rows_padded], zeros past n_rows
   char* error;          // null on success
   int32_t values_elided;
+  // CSR wire format (csr_wire=1): coords carries ONLY the column ids and
+  // row_ptr is [rows_padded + 1] with row i spanning entries
+  // [row_ptr[i], row_ptr[i+1]); pad rows all point at nnz (real), so an
+  // on-device prefix-sum rebuild maps every pad entry to the OOB row
+  // rows_padded. Halves the coordinate transfer bytes (4 B/nnz instead of
+  // 8) at the cost of one tiny [rows+1] array and a cheap device-side
+  // scatter+cumsum — on a tunneled TPU the link bytes are the scarce
+  // resource, the VPU cycles are free.
+  int32_t csr_wire;
+  int32_t* row_ptr;     // [rows_padded + 1] when csr_wire, else NULL
 };
 
 // Parse a text chunk (fmt: 0 = libsvm, 3 = libfm) straight to COO.
 // row_bucket/nnz_bucket quantize the padded dims UP to bucket multiples so
 // batch shapes REPEAT across chunks (a novel-shape device_put costs a fresh
 // transfer plan, measured ~100x a repeated-shape one on a tunneled TPU);
-// 0 disables. elide_unit enables the all-ones value elision. Requires
+// 0 disables. elide_unit enables the all-ones value elision. csr_wire
+// emits the cols+row_ptr wire layout (see CooResult). Requires
 // max(num_col, chunk rows) + 1 < 2^31 (int32 coords); callers guard.
 CooResult* dmlc_parse_coo(const char* data, int64_t len, int nthread,
                           int indexing_mode, int fmt, int64_t num_col,
                           int64_t row_bucket, int64_t nnz_bucket,
-                          int32_t elide_unit);
+                          int32_t elide_unit, int32_t csr_wire);
 void dmlc_free_coo(CooResult* r);
 
 // A batch of RecordIO record payloads: record i is
@@ -170,7 +182,8 @@ void* dmlc_reader_create(const char** paths, const int64_t* sizes,
                          int32_t queue_depth, int64_t batch_rows,
                          int32_t label_col, int32_t weight_col,
                          int32_t out_bf16, int64_t row_bucket,
-                         int64_t nnz_bucket, int32_t elide_unit);
+                         int64_t nnz_bucket, int32_t elide_unit,
+                         int32_t csr_wire);
 // Next parsed block; NULL at end-of-partition or on reader error (check
 // dmlc_reader_error). Parse errors ride the result's own error field.
 // Blocks with zero rows are never returned. `fmt_out` (may be NULL)
@@ -227,7 +240,7 @@ void* dmlc_feeder_create(int32_t format, int64_t num_col,
                          int64_t batch_rows, int32_t label_col,
                          int32_t weight_col, int32_t out_bf16,
                          int64_t row_bucket, int64_t nnz_bucket,
-                         int32_t elide_unit);
+                         int32_t elide_unit, int32_t csr_wire);
 // 0 = accepted; -1 = reader stopped/failed (check dmlc_feeder_error).
 int32_t dmlc_feeder_push(void* handle, const char* data, int64_t len);
 // Signal end of input: the pipeline flushes its tail and then next()

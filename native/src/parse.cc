@@ -692,7 +692,8 @@ static int64_t round_up_bucket(int64_t v, int64_t bucket) {
 static CooResult* merge_parts_coo(std::vector<CsrPart>& parts,
                                   int indexing_mode, bool heuristic_needs_field,
                                   int64_t num_col, int64_t row_bucket,
-                                  int64_t nnz_bucket, bool elide_unit) {
+                                  int64_t nnz_bucket, bool elide_unit,
+                                  bool csr_wire) {
   auto* res = static_cast<CooResult*>(calloc(1, sizeof(CooResult)));
   if (!res) return nullptr;
   for (auto& part : parts) {
@@ -746,16 +747,21 @@ static CooResult* merge_parts_coo(std::vector<CsrPart>& parts,
   // malloc(0) may legally return NULL — label-only chunks (nnz == 0 with
   // buckets disabled) must not read as out-of-memory
   const size_t nnz_alloc = nnz_out > 0 ? static_cast<size_t>(nnz_out) : 1;
-  res->coords =
-      static_cast<int32_t*>(malloc(2 * nnz_alloc * sizeof(int32_t)));
+  res->csr_wire = csr_wire ? 1 : 0;
+  res->coords = static_cast<int32_t*>(
+      malloc((csr_wire ? 1 : 2) * nnz_alloc * sizeof(int32_t)));
+  if (csr_wire)
+    res->row_ptr = static_cast<int32_t*>(
+        malloc((rows_out + 1) * sizeof(int32_t)));
   if (!elide)
     res->values = static_cast<float*>(malloc(nnz_alloc * sizeof(float)));
   res->label = static_cast<float*>(malloc(rows_out * sizeof(float)));
   res->weight = static_cast<float*>(malloc(rows_out * sizeof(float)));
-  if (!res->coords || (!elide && !res->values) || !res->label ||
-      !res->weight) {
-    free(res->coords); free(res->values); free(res->label); free(res->weight);
-    res->coords = nullptr; res->values = nullptr;
+  if (!res->coords || (csr_wire && !res->row_ptr) ||
+      (!elide && !res->values) || !res->label || !res->weight) {
+    free(res->coords); free(res->row_ptr); free(res->values);
+    free(res->label); free(res->weight);
+    res->coords = nullptr; res->row_ptr = nullptr; res->values = nullptr;
     res->label = nullptr; res->weight = nullptr;
     res->error = dup_error("parse: out of memory building coo chunk");
     return res;
@@ -782,16 +788,37 @@ static CooResult* merge_parts_coo(std::vector<CsrPart>& parts,
         for (size_t i = 0; i < pn; ++i) res->weight[row + i] = 1.0f;
       }
     }
-    for (size_t i = 0; i < pn; ++i) {
-      const int64_t rn = part.row_nnz[i];
-      const int32_t r32 = static_cast<int32_t>(row + static_cast<int64_t>(i));
-      for (int64_t k = 0; k < rn; ++k) {
-        res->coords[2 * ent] = r32;
-        ++ent;
+    if (csr_wire) {
+      // CSR wire: cumulative row_ptr instead of per-entry row ids —
+      // O(rows) writes instead of O(nnz), and half the coordinate bytes
+      // on the wire; the consumer rebuilds row ids on device
+      for (size_t i = 0; i < pn; ++i) {
+        res->row_ptr[row + static_cast<int64_t>(i)] =
+            static_cast<int32_t>(ent);
+        ent += part.row_nnz[i];
+      }
+    } else {
+      for (size_t i = 0; i < pn; ++i) {
+        const int64_t rn = part.row_nnz[i];
+        const int32_t r32 =
+            static_cast<int32_t>(row + static_cast<int64_t>(i));
+        for (int64_t k = 0; k < rn; ++k) {
+          res->coords[2 * ent] = r32;
+          ++ent;
+        }
       }
     }
     row += static_cast<int64_t>(pn);
   }
+  if (csr_wire) {
+    // rows [n, rows_out] (pad rows + the end sentinel) all start at nnz:
+    // the device-side prefix-sum rebuild then maps every pad entry past
+    // nnz to the OOB row rows_out, which every BCOO op masks
+    for (int64_t i = n; i <= rows_out; ++i)
+      res->row_ptr[i] = static_cast<int32_t>(nnz);
+  }
+  const int64_t cstride = csr_wire ? 1 : 2;
+  const int64_t coff = csr_wire ? 0 : 1;
   // column pass: sequential over each part's index array (better locality
   // than interleaving with the row fill above)
   ent = 0;
@@ -799,7 +826,7 @@ static CooResult* merge_parts_coo(std::vector<CsrPart>& parts,
     const size_t pe = part.index.size();
     for (size_t i = 0; i < pe; ++i) {
       uint64_t c = part.index[i] - off;
-      res->coords[2 * ent + 1] =
+      res->coords[cstride * ent + coff] =
           c > col_max ? static_cast<int32_t>(col_max)
                       : static_cast<int32_t>(c);
       ++ent;
@@ -814,10 +841,16 @@ static CooResult* merge_parts_coo(std::vector<CsrPart>& parts,
       }
     }
   }
-  // padding: OOB coords (rows_out, num_col), zero values/label/weight
+  // padding: OOB coords (rows_out, num_col), zero values/label/weight;
+  // csr_wire pads cols only — the pad rows fall out of the row_ptr
+  // sentinel fill above
   for (int64_t i = nnz; i < nnz_out; ++i) {
-    res->coords[2 * i] = static_cast<int32_t>(rows_out);
-    res->coords[2 * i + 1] = static_cast<int32_t>(col_max);
+    if (csr_wire) {
+      res->coords[i] = static_cast<int32_t>(col_max);
+    } else {
+      res->coords[2 * i] = static_cast<int32_t>(rows_out);
+      res->coords[2 * i + 1] = static_cast<int32_t>(col_max);
+    }
   }
   if (!elide && nnz_out > nnz) {
     memset(res->values + nnz, 0, (nnz_out - nnz) * sizeof(float));
@@ -832,7 +865,7 @@ static CooResult* merge_parts_coo(std::vector<CsrPart>& parts,
 CooResult* dmlc_parse_coo(const char* data, int64_t len, int nthread,
                           int indexing_mode, int fmt, int64_t num_col,
                           int64_t row_bucket, int64_t nnz_bucket,
-                          int32_t elide_unit) {
+                          int32_t elide_unit, int32_t csr_wire) {
   const char* end = data + len;
   data = skip_bom(data, &end);
   if (nthread < 1) nthread = 1;
@@ -851,12 +884,13 @@ CooResult* dmlc_parse_coo(const char* data, int64_t len, int nthread,
     range_fn(ranges[0].first, ranges[0].second, &parts[0]);
   for (auto& t : threads) t.join();
   return merge_parts_coo(parts, indexing_mode, libfm, num_col, row_bucket,
-                         nnz_bucket, elide_unit != 0);
+                         nnz_bucket, elide_unit != 0, csr_wire != 0);
 }
 
 void dmlc_free_coo(CooResult* r) {
   if (!r) return;
-  free(r->coords); free(r->values); free(r->label); free(r->weight);
+  free(r->coords); free(r->row_ptr); free(r->values);
+  free(r->label); free(r->weight);
   free(r->error);
   free(r);
 }
@@ -1086,6 +1120,6 @@ void dmlc_free_csv_split(CsvSplitResult* r) {
   free(r);
 }
 
-int dmlc_native_abi_version() { return 13; }
+int dmlc_native_abi_version() { return 14; }
 
 }  // extern "C"

@@ -183,7 +183,7 @@ class LineReader {
              int64_t chunk_bytes, int queue_depth, int64_t batch_rows,
              int32_t label_col, int32_t weight_col, bool out_bf16 = false,
              int64_t row_bucket = 0, int64_t nnz_bucket = 0,
-             bool elide_unit = false)
+             bool elide_unit = false, bool csr_wire = false)
       : paths_(std::move(paths)),
         format_(format),
         num_col_(num_col),
@@ -198,7 +198,8 @@ class LineReader {
         out_bf16_(out_bf16 && batch_rows > 0),
         row_bucket_(row_bucket > 0 ? row_bucket : 0),
         nnz_bucket_(nnz_bucket > 0 ? nnz_bucket : 0),
-        elide_unit_(elide_unit) {
+        elide_unit_(elide_unit),
+        csr_wire_(csr_wire) {
     file_offset_.push_back(0);
     for (size_t i = 0; i < sizes.size(); ++i) {
       if (is_recordio_fmt(format_) && sizes[i] % 4 != 0) {
@@ -222,7 +223,8 @@ class LineReader {
              int nthread, int64_t chunk_bytes, int queue_depth,
              int64_t batch_rows, int32_t label_col, int32_t weight_col,
              bool out_bf16 = false, int64_t row_bucket = 0,
-             int64_t nnz_bucket = 0, bool elide_unit = false)
+             int64_t nnz_bucket = 0, bool elide_unit = false,
+             bool csr_wire = false)
       : format_(format),
         num_col_(num_col),
         indexing_mode_(indexing_mode),
@@ -237,6 +239,7 @@ class LineReader {
         row_bucket_(row_bucket > 0 ? row_bucket : 0),
         nnz_bucket_(nnz_bucket > 0 ? nnz_bucket : 0),
         elide_unit_(elide_unit),
+        csr_wire_(csr_wire),
         push_mode_(true) {
     file_offset_.push_back(0);
     start();
@@ -608,7 +611,7 @@ class LineReader {
         void* r = dmlc_parse_coo(data, len, nthread_, indexing_mode_,
                                  format_ == kFmtLibfmCoo ? 3 : 0, num_col_,
                                  row_bucket_, nnz_bucket_,
-                                 elide_unit_ ? 1 : 0);
+                                 elide_unit_ ? 1 : 0, csr_wire_ ? 1 : 0);
         if (!r) set_error("coo: out of memory");
         return r;
       }
@@ -1226,6 +1229,7 @@ class LineReader {
   int64_t row_bucket_ = 0;
   int64_t nnz_bucket_ = 0;
   bool elide_unit_ = false;
+  bool csr_wire_ = false;
   DenseResult* cur_ = nullptr;  // in-progress output batch (producer-owned)
   int64_t cur_rows_ = 0;
   bool cur_has_weight_ = false;
@@ -1611,7 +1615,8 @@ void* dmlc_reader_create(const char** paths, const int64_t* sizes,
                          int32_t queue_depth, int64_t batch_rows,
                          int32_t label_col, int32_t weight_col,
                          int32_t out_bf16, int64_t row_bucket,
-                         int64_t nnz_bucket, int32_t elide_unit) {
+                         int64_t nnz_bucket, int32_t elide_unit,
+                         int32_t csr_wire) {
   try {
     std::vector<std::string> p(paths, paths + nfiles);
     std::vector<int64_t> s(sizes, sizes + nfiles);
@@ -1619,7 +1624,7 @@ void* dmlc_reader_create(const char** paths, const int64_t* sizes,
                           format, num_col, indexing_mode, delim, nthread,
                           chunk_bytes, queue_depth, batch_rows, label_col,
                           weight_col, out_bf16 != 0, row_bucket, nnz_bucket,
-                          elide_unit != 0);
+                          elide_unit != 0, csr_wire != 0);
   } catch (...) {
     // alloc/thread-spawn failure must not cross the extern "C" boundary
     // (std::terminate); null tells the caller creation failed
@@ -1653,12 +1658,12 @@ void* dmlc_feeder_create(int32_t format, int64_t num_col,
                          int64_t batch_rows, int32_t label_col,
                          int32_t weight_col, int32_t out_bf16,
                          int64_t row_bucket, int64_t nnz_bucket,
-                         int32_t elide_unit) {
+                         int32_t elide_unit, int32_t csr_wire) {
   try {
     return new LineReader(format, num_col, indexing_mode, delim, nthread,
                           chunk_bytes, queue_depth, batch_rows, label_col,
                           weight_col, out_bf16 != 0, row_bucket, nnz_bucket,
-                          elide_unit != 0);
+                          elide_unit != 0, csr_wire != 0);
   } catch (...) {
     return nullptr;
   }

@@ -91,7 +91,7 @@ def main() -> int:
         for fmt, nc in ((3, 1000), (0, 50)):
             r = lib.dmlc_parse_coo(data, len(data), 2, 0, fmt, nc,
                                    rng.choice([0, 4]), rng.choice([0, 8]),
-                                   rng.randint(0, 1))
+                                   rng.randint(0, 1), rng.randint(0, 1))
             if r:
                 lib.dmlc_free_coo(r)
     print(f"fuzz_parse: {ITERS} iterations x 8 entry points, no crash")

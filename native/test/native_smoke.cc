@@ -98,7 +98,8 @@ int main() {
                                2, 4096, 2, /*batch_rows=*/0,
                                /*label_col=*/-1, /*weight_col=*/-1,
                                /*out_bf16=*/0, /*row_bucket=*/0,
-                               /*nnz_bucket=*/0, /*elide_unit=*/0);
+                               /*nnz_bucket=*/0, /*elide_unit=*/0,
+                               /*csr_wire=*/0);
   CHECK_TRUE(r != nullptr);
   for (int pass = 0; pass < 2; ++pass) {
     int64_t rows = 0;
@@ -187,16 +188,33 @@ int main() {
                                    /*nthread=*/2, /*indexing_mode=*/0,
                                    /*fmt=*/3, /*num_col=*/100,
                                    /*row_bucket=*/4, /*nnz_bucket=*/8,
-                                   /*elide_unit=*/1);
+                                   /*elide_unit=*/1, /*csr_wire=*/0);
     CHECK_TRUE(co != nullptr && co->error == nullptr);
     CHECK_TRUE(co->n_rows == 2 && co->nnz == 3);
     CHECK_TRUE(co->rows_padded == 4 && co->nnz_padded == 8);
     CHECK_TRUE(co->values_elided == 1 && co->values == nullptr);
+    CHECK_TRUE(co->csr_wire == 0 && co->row_ptr == nullptr);
     CHECK_TRUE(co->coords[0] == 0 && co->coords[1] == 10);
     CHECK_TRUE(co->coords[4] == 1 && co->coords[5] == 30);
     CHECK_TRUE(co->coords[6] == 4 && co->coords[7] == 100);  // OOB pad
     CHECK_TRUE(co->weight[1] == 1.0f && co->weight[2] == 0.0f);
     dmlc_free_coo(co);
+
+    // CSR wire: cols-only coords + row_ptr with pad rows pinned at nnz
+    CooResult* cw = dmlc_parse_coo(fm, static_cast<int64_t>(strlen(fm)),
+                                   /*nthread=*/2, /*indexing_mode=*/0,
+                                   /*fmt=*/3, /*num_col=*/100,
+                                   /*row_bucket=*/4, /*nnz_bucket=*/8,
+                                   /*elide_unit=*/1, /*csr_wire=*/1);
+    CHECK_TRUE(cw != nullptr && cw->error == nullptr);
+    CHECK_TRUE(cw->csr_wire == 1 && cw->row_ptr != nullptr);
+    CHECK_TRUE(cw->coords[0] == 10 && cw->coords[1] == 20 &&
+               cw->coords[2] == 30);
+    CHECK_TRUE(cw->coords[3] == 100 && cw->coords[7] == 100);  // OOB pad
+    CHECK_TRUE(cw->row_ptr[0] == 0 && cw->row_ptr[1] == 2 &&
+               cw->row_ptr[2] == 3);
+    CHECK_TRUE(cw->row_ptr[3] == 3 && cw->row_ptr[4] == 3);  // pad rows
+    dmlc_free_coo(cw);
 
     char cpath[] = "/tmp/dmlc_tpu_smoke_coo_XXXXXX";
     int cfd = mkstemp(cpath);
@@ -213,7 +231,8 @@ int main() {
     void* cr = dmlc_reader_create(cpaths, csizes, 1, 0, 1, /*fmt=*/7,
                                   /*num_col=*/128, 0, ',', 2, 4096, 2, 0,
                                   -1, -1, 0, /*row_bucket=*/64,
-                                  /*nnz_bucket=*/256, /*elide_unit=*/1);
+                                  /*nnz_bucket=*/256, /*elide_unit=*/1,
+                                  /*csr_wire=*/0);
     CHECK_TRUE(cr != nullptr);
     for (int pass = 0; pass < 2; ++pass) {
       int64_t rows = 0, nnz = 0;
@@ -239,7 +258,7 @@ int main() {
     remove(cpath);
   }
 
-  CHECK_TRUE(dmlc_native_abi_version() == 13);
+  CHECK_TRUE(dmlc_native_abi_version() == 14);
   if (failures == 0) std::printf("native_smoke: all checks passed\n");
   return failures == 0 ? 0 : 1;
 }

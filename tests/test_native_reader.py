@@ -779,6 +779,86 @@ class TestNativeCooEmit:
         it.close()
         assert total_rows == 400
 
+    def test_csr_wire_matches_pair_wire(self, tmp_path):
+        """csr_wire emit (cols + row_ptr, half the coordinate bytes) must
+        carry exactly the information of the (row, col) pair emit: a host
+        prefix-sum rebuild reproduces the pair coords entry-for-entry,
+        OOB pad tail included (native/src/api.h CooResult csr_wire docs)."""
+        path = self._libfm_corpus(tmp_path)
+        kw = dict(row_bucket=128, nnz_bucket=512, elide_unit=True)
+        pair = self._native_coo_blocks(
+            path + "?format=libfm", "libfm", self.NUM_COL, **kw)
+        csr = self._native_coo_blocks(
+            path + "?format=libfm", "libfm", self.NUM_COL,
+            csr_wire=True, **kw)
+        assert len(pair) == len(csr) and len(csr) > 0
+        for bp, bc in zip(pair, csr):
+            assert bc.row_ptr is not None and bc.coords.ndim == 1
+            rp = np.asarray(bc.row_ptr)
+            rows_padded = len(bc.label)
+            assert rp.shape == (rows_padded + 1,)
+            assert rp[0] == 0 and (np.diff(rp) >= 0).all()
+            # pad rows (and the end sentinel) all point at the real nnz
+            assert (rp[bc.n_rows:] == bc.nnz).all()
+            # row id of entry j = #{i >= 1 : rp[i] <= j}
+            incr = np.zeros(len(bc.coords) + 1, np.int64)
+            np.add.at(incr, rp[1:], 1)
+            rows = np.cumsum(incr)[:len(bc.coords)]
+            assert (rows == bp.coords[:, 0]).all()
+            assert (bc.coords == bp.coords[:, 1]).all()
+            assert (np.asarray(bc.label) == np.asarray(bp.label)).all()
+            assert (np.asarray(bc.weight) == np.asarray(bp.weight)).all()
+
+    def test_csr_wire_device_rebuild_semantics(self, tmp_path):
+        """The jitted consumer rebuild (data/device._csr_coords_impl) must
+        reproduce the pair-wire coords exactly — real entries map to their
+        rows, pad entries land on the OOB row rows_padded."""
+        import jax.numpy as jnp
+
+        from dmlc_tpu.data.device import _csr_coords_impl
+
+        path = self._libfm_corpus(tmp_path)
+        kw = dict(row_bucket=128, nnz_bucket=512, elide_unit=True)
+        pair = self._native_coo_blocks(
+            path + "?format=libfm", "libfm", self.NUM_COL, **kw)
+        csr = self._native_coo_blocks(
+            path + "?format=libfm", "libfm", self.NUM_COL,
+            csr_wire=True, **kw)
+        for bp, bc in zip(pair, csr):
+            got = np.asarray(_csr_coords_impl(
+                jnp.asarray(bc.coords), jnp.asarray(np.asarray(bc.row_ptr))))
+            assert (got == bp.coords).all()
+
+    def test_deviceiter_csr_wire_todense_equal(self, tmp_path):
+        """End-to-end: the default (csr_wire) BCOO pipeline and the pair
+        wire densify to the same matrices, labels, and weights."""
+        from dmlc_tpu.data.device import DeviceIter
+
+        num_col = 512
+        p = tmp_path / "small.libfm"
+        p.write_text("".join(
+            f"{i % 2} " + " ".join(
+                f"{j}:{(i * 97 + j * 31) % num_col}:1" for j in range(5))
+            + "\n" for i in range(300)))
+
+        def batches(csr_wire):
+            parser = create_parser(str(p) + "?format=libfm", 0, 1,
+                                   threaded=True)
+            it = DeviceIter(parser, num_col=num_col, batch_size=None,
+                            layout="bcoo", elide_unit_values=True,
+                            csr_wire=csr_wire)
+            out = [(np.asarray(mat.todense()), np.asarray(y), np.asarray(w))
+                   for mat, y, w in it]
+            it.close()
+            return out
+
+        a, b = batches(True), batches(False)
+        assert len(a) == len(b) and len(a) > 0
+        for (xa, ya, wa), (xb, yb, wb) in zip(a, b):
+            assert (xa == xb).all()
+            assert (ya == yb).all()
+            assert (wa == wb).all()
+
     def test_feeder_coo_path(self, tmp_path):
         """Push-mode (remote) pipeline speaks COO too."""
         path = self._libfm_corpus(tmp_path)
