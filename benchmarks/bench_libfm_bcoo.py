@@ -22,6 +22,14 @@ NNZ = 10
 # 318 MB/s (0.97 of the threaded-parse ceiling) by quartering the put
 # count; on the tunneled device the dispatch share is larger still
 CHUNK_BYTES = int(float(os.environ.get("DMLC_BENCH_CHUNK_MB", "4")) * 2**20)
+# Wire-format knob (r5): csr ships cols+row_ptr (4 B/nnz) and rebuilds row
+# ids on device; pair ships (row, col) int32 pairs (8 B/nnz) with no
+# device-side work. csr wins where link bytes are scarce (the TPU tunnel),
+# pair wins where the transfer is a cheap memcpy (CPU backend measured
+# 292 vs 247 MB/s at 64 MB — the rebuild serializes on this 1-core host).
+# The 64 MB leg A/Bs both on whatever device is present; this knob sets
+# the GB leg's production mode.
+CSR_WIRE = os.environ.get("DMLC_BENCH_CSR_WIRE", "1") != "0"
 
 
 def _line(i: int) -> str:
@@ -48,7 +56,7 @@ def run() -> None:
         p.close()
         assert rows > 0
 
-    def to_device() -> None:
+    def to_device(csr_wire: bool = CSR_WIRE) -> None:
         # the real pipeline: C++ parse threads emit device-ready COO blocks
         # (int32 coords, bucket padding, all-ones value elision — the
         # corpus is ":1"-valued, so the value array never crosses the
@@ -58,7 +66,8 @@ def run() -> None:
         p = create_parser(uri, 0, 1, threaded=True,
                           chunk_bytes=CHUNK_BYTES)
         it = DeviceIter(p, num_col=50_000_000, batch_size=None,
-                        layout="bcoo", elide_unit_values=True)
+                        layout="bcoo", elide_unit_values=True,
+                        csr_wire=csr_wire)
         # block on EVERY array of each batch (not just the last value
         # array) so no in-flight transfer escapes the timed region, but
         # release batches as we go — device memory stays O(prefetch), and
@@ -79,14 +88,28 @@ def run() -> None:
     threaded_base, _, _ = timed_stats(lambda: host_only(True))
     log(f"libfm host-only threaded native: {size_mb / threaded_base:.1f} MB/s")
     t, t_med, times = timed_stats(to_device, reps=5)
-    log(f"libfm -> device BCOO (DeviceIter prefetch): {size_mb / t:.1f} MB/s "
+    log(f"libfm -> device BCOO (DeviceIter prefetch, "
+        f"{'csr' if CSR_WIRE else 'pair'} wire): {size_mb / t:.1f} MB/s "
         f"best, {size_mb / t_med:.1f} MB/s median")
+    extra = {}
+    if size_mb <= 128:
+        # wire-format A/B (cheap at this size): time the OTHER mode too so
+        # each battery pass records, on the device actually present, which
+        # wire the link prefers — the GB leg then runs the winner via
+        # DMLC_BENCH_CSR_WIRE
+        o, o_med, _ = timed_stats(lambda: to_device(not CSR_WIRE), reps=5)
+        key = "pair_wire" if CSR_WIRE else "csr_wire"
+        extra[f"{key}_mb_per_sec"] = round(size_mb / o, 2)
+        extra[f"{key}_median_mb_per_sec"] = round(size_mb / o_med, 2)
+        extra[f"{key}_reps"] = 5
+        log(f"libfm -> device BCOO ({'pair' if CSR_WIRE else 'csr'} wire "
+            f"A/B): {size_mb / o:.1f} MB/s best, {size_mb / o_med:.1f} median")
     emit("libfm_bcoo_mb_per_sec", size_mb / t, "MB/s", size_mb / base,
          vs_threaded_parse=threaded_base / t,
          median=size_mb / t_med,
          median_vs_baseline=(size_mb / t_med) / (size_mb / base_med),
          spread=[round(size_mb / max(times), 2), round(size_mb / min(times), 2)],
-         reps=5)
+         reps=5, wire="csr" if CSR_WIRE else "pair", **extra)
 
 
 if __name__ == "__main__":

@@ -124,6 +124,14 @@ def main() -> None:
     dev = jax.devices()[0]
     print(f"# device: {dev}", flush=True)
     results: list = []
+    def write_results(prefix: str) -> None:
+        tag = os.environ.get("DMLC_BENCH_TAG", "r02")
+        out_path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), f"{prefix}_{tag}.json")
+        with open(out_path, "w") as f:
+            json.dump({"device": str(dev), "results": results}, f, indent=1)
+        print(f"# wrote {out_path}", flush=True)
+
     if os.environ.get("DMLC_SPARSE_GRID"):
         # disentangling grid for the r05 routing decision: the band A/B
         # showed pallas winning at (D=512,K=32), (D=2048,K=64),
@@ -133,12 +141,7 @@ def main() -> None:
             for K in (32, 48, 64):
                 bench_shape(f"grid_d{D}_k{K}", B=8192, K=K, D=D,
                             results=results)
-        tag = os.environ.get("DMLC_BENCH_TAG", "r05")
-        out_path = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), f"SPARSE_TPU_GRID_{tag}.json")
-        with open(out_path, "w") as f:
-            json.dump({"device": str(dev), "results": results}, f, indent=1)
-        print(f"# wrote {out_path}", flush=True)
+        write_results("SPARSE_TPU_GRID")
         return
     bench_shape("higgs_like", B=8192, K=28, D=28, results=results)
     # the auto-router's candidate band (ops/pallas_sparse.py gate): every
@@ -150,12 +153,7 @@ def main() -> None:
     bench_shape("hashed_2k", B=8192, K=64, D=2048, results=results)
     bench_shape("hashed_4k", B=8192, K=64, D=4096, results=results)
     bench_shape("kdd_like", B=8192, K=16, D=1 << 20, results=results)
-    tag = os.environ.get("DMLC_BENCH_TAG", "r02")
-    out_path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), f"SPARSE_TPU_{tag}.json")
-    with open(out_path, "w") as f:
-        json.dump({"device": str(dev), "results": results}, f, indent=1)
-    print(f"# wrote {out_path}", flush=True)
+    write_results("SPARSE_TPU")
 
 
 if __name__ == "__main__":

@@ -7,11 +7,13 @@ loop can run it until the tunnel is healthy:
 
 On success it runs, in order, writing stdout JSON lines to
 ``TPU_BATTERY.log`` at the repo root:
-  1. the sparse layout A/B (-> SPARSE_TPU_$DMLC_BENCH_TAG.json),
+  1. bench_transfer_floor.py (raw device_put line rate),
   2. bench.py at 64 MB (north-star config 1),
-  3. bench_libfm_bcoo.py at 64 MB (config 4),
-  4. bench.py at DMLC_BENCH_MB=1024 (GB-scale config 1),
-  5. bench_libfm_bcoo.py at 1024 MB (GB-scale config 4).
+  3. bench_libfm_bcoo.py at 64 MB (config 4, incl. wire-format A/B),
+  4. the sparse layout A/B (-> SPARSE_TPU_$DMLC_BENCH_TAG.json),
+  5. the sparse D x K grid (-> SPARSE_TPU_GRID_$DMLC_BENCH_TAG.json),
+  6. bench.py at DMLC_BENCH_MB=1024 (GB-scale config 1),
+  7. bench_libfm_bcoo.py at 1024 MB (GB-scale config 4).
 """
 
 import os
