@@ -9,11 +9,12 @@ On success it runs, in order, writing stdout JSON lines to
 ``TPU_BATTERY.log`` at the repo root:
   1. bench_transfer_floor.py (raw device_put line rate),
   2. bench.py at 64 MB (north-star config 1),
-  3. bench_libfm_bcoo.py at 64 MB (config 4, incl. wire-format A/B),
-  4. the sparse layout A/B (-> SPARSE_TPU_$DMLC_BENCH_TAG.json),
-  5. the sparse D x K grid (-> SPARSE_TPU_GRID_$DMLC_BENCH_TAG.json),
-  6. bench.py at DMLC_BENCH_MB=1024 (GB-scale config 1),
-  7. bench_libfm_bcoo.py at 1024 MB (GB-scale config 4).
+  3. bench.py at 64 MB with DMLC_BENCH_BATCH=32768 (dense-batch sweep),
+  4. bench_libfm_bcoo.py at 64 MB (config 4, incl. wire-format A/B),
+  5. the sparse layout A/B (-> SPARSE_TPU_$DMLC_BENCH_TAG.json),
+  6. the sparse D x K grid (-> SPARSE_TPU_GRID_$DMLC_BENCH_TAG.json),
+  7. bench.py at DMLC_BENCH_MB=1024 (GB-scale config 1),
+  8. bench_libfm_bcoo.py at 1024 MB (GB-scale config 4).
 """
 
 import os
@@ -81,6 +82,11 @@ def main() -> int:
     rcs = [
         run([py, "benchmarks/bench_transfer_floor.py"]),
         run([py, "bench.py"]),
+        # dense-batch sweep at 64 MB: per-put dispatch on the tunnel is
+        # ~1.1 ms, so doubling the batch halves the dispatch share — this
+        # cheap leg records whether 32k beats the 16k default on the
+        # link actually present (informs the GB leg's DMLC_BENCH_BATCH)
+        run([py, "bench.py"], env={"DMLC_BENCH_BATCH": "32768"}),
         run([py, "benchmarks/bench_libfm_bcoo.py"]),
         run([py, "benchmarks/bench_sparse_tpu.py"],
             env={"DMLC_BENCH_TAG": tag}),
