@@ -79,7 +79,11 @@ def make_corpus() -> str:
 # interleave parse/convert/transfer best; larger chunks lump the stages and
 # stall the device) and equal-or-better for the baseline
 CHUNK_BYTES = 1 << 20
-REPS = 3  # best-of, to tame shared-host + tunnel noise
+# best-of/median-of rep count, to tame shared-host + tunnel noise. The
+# tunnel's line rate swings 2-4x minute-to-minute, so a 3-rep median can
+# sit entirely inside one bad window; 5 reps cost ~+20s at GB scale and
+# make the median robust to two outliers. Overridable for quick smokes.
+REPS = max(1, int(os.environ.get("DMLC_BENCH_REPS", "5") or 5))
 
 
 from statistics import median as _median  # noqa: E402
