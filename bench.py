@@ -107,8 +107,11 @@ def host_only_mb_per_sec(path: str, size_mb: float, threaded: bool = False,
         parser = create_parser(path, 0, 1, "libsvm", threaded=threaded,
                                chunk_bytes=CHUNK_BYTES)
         if emit_dense and hasattr(parser, "set_emit_dense"):
+            # pack_aux matches the device leg's config so this ceiling
+            # measures the exact same native repack work
             try:
-                parser.set_emit_dense(NUM_COL, batch_rows=BATCH)
+                parser.set_emit_dense(NUM_COL, batch_rows=BATCH,
+                                      pack_aux=True)
             except TypeError:
                 parser.set_emit_dense(NUM_COL)
         t0 = time.monotonic()
@@ -152,9 +155,15 @@ def into_hbm_mb_per_sec(path: str, size_mb: float, x_dtype: str = "float32"):
         t0 = time.monotonic()
         parser = create_parser(path, 0, 1, "libsvm", threaded=True,
                                chunk_bytes=CHUNK_BYTES)
+        # pack_aux: label/weight ride as two trailing x columns — ONE
+        # device_put per batch instead of three arrays (the 3-array put
+        # measured ~2x slower per byte, bench_transfer_floor.py aux leg).
+        # f32 packs automatically (lossless); the bf16 opt-in is sound
+        # HERE because this corpus's labels (0/1) and weights (1.0) are
+        # bf16-exact — general callers must make that call themselves.
         it = DeviceIter(parser, num_col=NUM_COL, batch_size=BATCH,
                         layout="dense", prefetch=4, convert_ahead=6,
-                        x_dtype=x_dtype)
+                        x_dtype=x_dtype, pack_aux=True)
         # the FIRST pull carries pipeline spin-up (producer threads
         # starting, first chunk parsed) — a per-epoch constant. Its time
         # stays IN the throughput wall-clock (no free head start), but the

@@ -50,6 +50,26 @@ def run() -> None:
     b16, m16, _ = timed_stats(leg(x16), reps=5)
     log(f"bf16 device_put: {mb16 / b16:.1f} MB/s best, {mb16 / m16:.1f} median")
 
+    # per-ARRAY overhead probe: the pipeline ships each batch as ONE
+    # device_put call of [x, y, w] (1.8 MB + 64 KB + 64 KB). If the link
+    # charges per array rather than per call, the two small aux arrays tax
+    # every batch and packing label/weight into x's trailing columns
+    # (native repack) would pay; if the delta is noise, packing is
+    # pointless ABI churn. This leg decides with data.
+    y = rng.standard_normal(BATCH).astype(np.float32)
+    w = np.ones(BATCH, np.float32)
+
+    def leg3():
+        handles = [jax.device_put([x32, y, w]) for _ in range(n)]
+        jax.block_until_ready(handles)
+
+    jax.block_until_ready(jax.device_put([x32, y, w]))
+    mb3 = n * (x32.nbytes + y.nbytes + w.nbytes) / 2**20
+    b3, m3, _ = timed_stats(leg3, reps=5)
+    log(f"f32 [x,y,w] device_put: {mb3 / b3:.1f} MB/s best, "
+        f"{mb3 / m3:.1f} median (aux-array overhead vs x-only: "
+        f"{(mb / med) / (mb3 / m3):.3f}x)")
+
     emit("device_put_floor_mb_per_sec", mb / best, "MB/s", 0.0,
          median=mb / med,
          spread=[round(mb / max(times), 2), round(mb / min(times), 2)],
@@ -59,7 +79,10 @@ def run() -> None:
          # corpus-equivalent rates: config #1's text rows are ~110 B and
          # ship as 112 B (f32) / 56 B (bf16) of x — the bf16 wire rate
          # DOUBLES the corpus MB/s the same link can sustain
-         bf16_corpus_equiv=round(2 * mb16 / b16, 2))
+         bf16_corpus_equiv=round(2 * mb16 / b16, 2),
+         xyw_mb_per_sec=round(mb3 / b3, 2),
+         xyw_median=round(mb3 / m3, 2),
+         aux_overhead_median=round((mb / med) / (mb3 / m3), 3))
 
 
 if __name__ == "__main__":

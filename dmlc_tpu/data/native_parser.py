@@ -105,6 +105,7 @@ class NativeStreamParser(Parser):
         self._reader = None
         self._emit_dense: Optional[int] = None
         self._emit_bf16 = False
+        self._pack_aux = False
         self._emit_coo: Optional[int] = None
         self._coo_row_bucket = 0
         self._coo_nnz_bucket = 0
@@ -122,7 +123,8 @@ class NativeStreamParser(Parser):
     # ---------------- configuration ----------------
 
     def set_emit_dense(self, num_col: int, batch_rows: int = 0,
-                       dtype: str = "float32") -> bool:
+                       dtype: str = "float32",
+                       pack_aux: bool = False) -> bool:
         """Emit DenseBlock batches straight from the native dense scanner.
         With ``batch_rows``, the native reader additionally repacks rows
         into exact [batch_rows, num_col] blocks off-GIL (the consumer can
@@ -130,12 +132,17 @@ class NativeStreamParser(Parser):
         makes that repack pass emit bf16 x — half the host->HBM bytes in
         the MXU's preferred operand width. Must be called before the first
         pull (the reader pipeline starts lazily). libfm has no dense
-        analog."""
+        analog. ``pack_aux`` (batch mode only) packs label/weight into two
+        trailing x columns — one [B, D+2] array per batch, ONE device_put
+        instead of three (api.h DenseResult packed_aux docs); in bf16 mode
+        the aux columns are bf16 too, so callers opt in only when their
+        labels/weights are bf16-exact."""
         if self._reader is not None or self.fmt_name == "libfm":
             return False
         self._emit_dense = int(num_col)
         self._batch_rows = int(batch_rows)
         self._emit_bf16 = dtype == "bfloat16"
+        self._pack_aux = bool(pack_aux) and batch_rows > 0
         return True
 
     def set_emit_coo(self, num_col: int, row_bucket: int = 0,
@@ -202,6 +209,7 @@ class NativeStreamParser(Parser):
             nnz_bucket=self._coo_nnz_bucket if coo else 0,
             elide_unit=self._coo_elide if coo else False,
             csr_wire=self._coo_csr_wire if coo else False,
+            pack_aux=bool(repack and self._pack_aux),
         )
         return fmt, kwargs
 
@@ -227,8 +235,8 @@ class NativeStreamParser(Parser):
         self._blocks_out += 1
         fmt, data = out
         if fmt == native.FMT_LIBSVM_DENSE:
-            x, label, weight, owner = data
-            return DenseBlock(x, label, weight, hold=owner)
+            x, label, weight, owner, packed = data
+            return DenseBlock(x, label, weight, hold=owner, packed=packed)
         if fmt in (native.FMT_LIBSVM_COO, native.FMT_LIBFM_COO):
             return CooBlock(
                 data["coords"], data["values"], data["label"],

@@ -302,7 +302,7 @@ class LibSVMParser(TextParserBase):
                 self._emit_dense = None
                 out = None
             if out is not None:
-                x, label, weight, owner = out
+                x, label, weight, owner, _packed = out
                 return DenseBlock(x, label, weight, hold=owner)
         d = native.parse_libsvm(chunk, indexing_mode=self.param.indexing_mode)
         if d is None:

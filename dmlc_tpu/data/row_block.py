@@ -181,14 +181,19 @@ class DenseBlock:
     analog (its parsers always build CSR RowBlocks, src/data/row_block.h).
     """
 
-    __slots__ = ("x", "label", "weight", "hold", "resume_state")
+    __slots__ = ("x", "label", "weight", "hold", "resume_state", "packed")
 
     def __init__(self, x: np.ndarray, label: np.ndarray,
-                 weight: Optional[np.ndarray] = None, hold=None):
+                 weight: Optional[np.ndarray] = None, hold=None,
+                 packed: bool = False):
+        # packed: x is [n, num_col + 2] with label/weight as the trailing
+        # columns (label/weight here alias those columns as views) — the
+        # device path ships the ONE packed array (api.h DenseResult docs)
         self.x = x
         self.label = label
         self.weight = weight
         self.hold = hold
+        self.packed = packed
         self.resume_state = None  # parser position just after this block
 
     def __len__(self) -> int:
@@ -199,7 +204,7 @@ class DenseBlock:
         return DenseBlock(
             self.x[begin:end], self.label[begin:end],
             self.weight[begin:end] if self.weight is not None else None,
-            hold=self.hold)
+            hold=self.hold, packed=self.packed)
 
 
 class CooBlock:

@@ -35,7 +35,7 @@ _HDRS = [os.path.join(_SRC_DIR, f)
          for f in ("api.h", "strtonum.h", "parse_internal.h")]
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 _SO_PATH = os.path.join(_BUILD_DIR, "libdmlc_tpu_native.so")
-_ABI_VERSION = 14
+_ABI_VERSION = 15
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -67,6 +67,7 @@ class _DenseResult(ctypes.Structure):
         ("error", ctypes.c_char_p),
         ("needs_csr", ctypes.c_int32),
         ("x_bf16", ctypes.c_int32),
+        ("packed_aux", ctypes.c_int32),
     ]
 
 
@@ -254,7 +255,7 @@ def _declare(lib: ctypes.CDLL) -> None:
         ctypes.c_int64, ctypes.c_int32, ctypes.c_char, ctypes.c_int32,
         ctypes.c_int64, ctypes.c_int32, ctypes.c_int64, ctypes.c_int32,
         ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, ctypes.c_int64,
-        ctypes.c_int32, ctypes.c_int32]
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32]
     lib.dmlc_reader_next.restype = ctypes.c_void_p
     lib.dmlc_reader_next.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32)]
@@ -269,7 +270,7 @@ def _declare(lib: ctypes.CDLL) -> None:
         ctypes.c_int32, ctypes.c_int64, ctypes.c_int32, ctypes.c_char,
         ctypes.c_int32, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
         ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
-        ctypes.c_int64, ctypes.c_int32, ctypes.c_int32]
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32]
     lib.dmlc_feeder_push.restype = ctypes.c_int32
     lib.dmlc_feeder_push.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
@@ -445,11 +446,18 @@ def _wrap_dense(lib, res, num_col: int):
     x_dtype = bf16_dtype() if r.x_bf16 else np.float32
     if n == 0:
         return (np.zeros((0, num_col), x_dtype),
-                np.empty(0, np.float32), None, owner)
+                np.empty(0, np.float32), None, owner, False)
+    if r.packed_aux:
+        # packed layout: x is [n, num_col + 2] with label/weight as the
+        # trailing columns (ONE device_put per batch downstream); the
+        # label/weight views alias those columns for host-side consumers
+        xp = _view(r.x, n * (num_col + 2), x_dtype, owner).reshape(
+            n, num_col + 2)
+        return xp, xp[:, num_col], xp[:, num_col + 1], owner, True
     x = _view(r.x, n * num_col, x_dtype, owner).reshape(n, num_col)
     label = _view(r.label, n, np.float32, owner)
     weight = _view(r.weight, n, np.float32, owner)
-    return x, label, weight, owner
+    return x, label, weight, owner, False
 
 
 def bf16_dtype():
@@ -635,7 +643,8 @@ class Reader:
                  batch_rows: int = 0, label_col: int = -1,
                  weight_col: int = -1, out_bf16: bool = False,
                  row_bucket: int = 0, nnz_bucket: int = 0,
-                 elide_unit: bool = False, csr_wire: bool = False):
+                 elide_unit: bool = False, csr_wire: bool = False,
+                 pack_aux: bool = False):
         lib = _load()
         if lib is None:
             raise DMLCError("native core unavailable")
@@ -651,7 +660,7 @@ class Reader:
             nthread or default_nthread(), chunk_bytes, queue_depth,
             batch_rows, label_col, weight_col, 1 if out_bf16 else 0,
             row_bucket, nnz_bucket, 1 if elide_unit else 0,
-            1 if csr_wire else 0)
+            1 if csr_wire else 0, 1 if pack_aux else 0)
         if not self._h:
             raise DMLCError(
                 "native reader creation failed (out of memory or threads)")
@@ -715,7 +724,8 @@ class Feeder:
                  batch_rows: int = 0, label_col: int = -1,
                  weight_col: int = -1, out_bf16: bool = False,
                  row_bucket: int = 0, nnz_bucket: int = 0,
-                 elide_unit: bool = False, csr_wire: bool = False):
+                 elide_unit: bool = False, csr_wire: bool = False,
+                 pack_aux: bool = False):
         lib = _load()
         if lib is None:
             raise DMLCError("native core unavailable")
@@ -728,7 +738,7 @@ class Feeder:
             nthread or default_nthread(), chunk_bytes, queue_depth,
             batch_rows, label_col, weight_col, 1 if out_bf16 else 0,
             row_bucket, nnz_bucket, 1 if elide_unit else 0,
-            1 if csr_wire else 0)
+            1 if csr_wire else 0, 1 if pack_aux else 0)
         if not self._h:
             raise DMLCError("native feeder creation failed")
 

@@ -47,6 +47,14 @@ struct DenseResult {
                       // error-message wording.
   int32_t x_bf16;     // 1 = x holds bfloat16 (the TPU-native ingest format:
                       // half the host->HBM bytes, MXU-preferred operand)
+  // 1 = x is [n_rows, n_cols + 2] with label in column n_cols and weight
+  // in column n_cols + 1 (label/weight pointers are then NULL): ONE
+  // device_put per batch instead of three arrays — measured 2x on the
+  // per-array put overhead (benchmarks/bench_transfer_floor.py aux leg).
+  // Only emitted in batch-repack mode on request (pack_aux); in bf16 mode
+  // the aux columns are bf16 too, so callers opt in only when their
+  // labels/weights are bf16-exact.
+  int32_t packed_aux;
 };
 
 // Dense CSV result: cells laid out row-major [n_rows, n_cols].
@@ -183,7 +191,7 @@ void* dmlc_reader_create(const char** paths, const int64_t* sizes,
                          int32_t label_col, int32_t weight_col,
                          int32_t out_bf16, int64_t row_bucket,
                          int64_t nnz_bucket, int32_t elide_unit,
-                         int32_t csr_wire);
+                         int32_t csr_wire, int32_t pack_aux);
 // Next parsed block; NULL at end-of-partition or on reader error (check
 // dmlc_reader_error). Parse errors ride the result's own error field.
 // Blocks with zero rows are never returned. `fmt_out` (may be NULL)
@@ -240,7 +248,8 @@ void* dmlc_feeder_create(int32_t format, int64_t num_col,
                          int64_t batch_rows, int32_t label_col,
                          int32_t weight_col, int32_t out_bf16,
                          int64_t row_bucket, int64_t nnz_bucket,
-                         int32_t elide_unit, int32_t csr_wire);
+                         int32_t elide_unit, int32_t csr_wire,
+                         int32_t pack_aux);
 // 0 = accepted; -1 = reader stopped/failed (check dmlc_feeder_error).
 int32_t dmlc_feeder_push(void* handle, const char* data, int64_t len);
 // Signal end of input: the pipeline flushes its tail and then next()

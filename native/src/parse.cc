@@ -1120,6 +1120,6 @@ void dmlc_free_csv_split(CsvSplitResult* r) {
   free(r);
 }
 
-int dmlc_native_abi_version() { return 14; }
+int dmlc_native_abi_version() { return 15; }
 
 }  // extern "C"

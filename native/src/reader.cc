@@ -183,7 +183,8 @@ class LineReader {
              int64_t chunk_bytes, int queue_depth, int64_t batch_rows,
              int32_t label_col, int32_t weight_col, bool out_bf16 = false,
              int64_t row_bucket = 0, int64_t nnz_bucket = 0,
-             bool elide_unit = false, bool csr_wire = false)
+             bool elide_unit = false, bool csr_wire = false,
+             bool pack_aux = false)
       : paths_(std::move(paths)),
         format_(format),
         num_col_(num_col),
@@ -199,7 +200,8 @@ class LineReader {
         row_bucket_(row_bucket > 0 ? row_bucket : 0),
         nnz_bucket_(nnz_bucket > 0 ? nnz_bucket : 0),
         elide_unit_(elide_unit),
-        csr_wire_(csr_wire) {
+        csr_wire_(csr_wire),
+        pack_aux_(pack_aux && batch_rows > 0) {
     file_offset_.push_back(0);
     for (size_t i = 0; i < sizes.size(); ++i) {
       if (is_recordio_fmt(format_) && sizes[i] % 4 != 0) {
@@ -224,7 +226,7 @@ class LineReader {
              int64_t batch_rows, int32_t label_col, int32_t weight_col,
              bool out_bf16 = false, int64_t row_bucket = 0,
              int64_t nnz_bucket = 0, bool elide_unit = false,
-             bool csr_wire = false)
+             bool csr_wire = false, bool pack_aux = false)
       : format_(format),
         num_col_(num_col),
         indexing_mode_(indexing_mode),
@@ -240,6 +242,7 @@ class LineReader {
         nnz_bucket_(nnz_bucket > 0 ? nnz_bucket : 0),
         elide_unit_(elide_unit),
         csr_wire_(csr_wire),
+        pack_aux_(pack_aux && batch_rows > 0),
         push_mode_(true) {
     file_offset_.push_back(0);
     start();
@@ -974,16 +977,24 @@ class LineReader {
     if (!out) return nullptr;
     out->n_cols = num_col_;
     out->x_bf16 = out_bf16_ ? 1 : 0;
+    out->packed_aux = pack_aux_ ? 1 : 0;
+    // packed mode: label/weight live in two trailing x columns (ONE
+    // device_put per batch downstream; see api.h DenseResult docs)
+    const size_t xcols =
+        static_cast<size_t>(num_col_) + (pack_aux_ ? 2 : 0);
     out->x = static_cast<float*>(
-        malloc(static_cast<size_t>(batch_rows_) * num_col_ *
+        malloc(static_cast<size_t>(batch_rows_) * xcols *
                (out_bf16_ ? sizeof(uint16_t) : sizeof(float))));
-    out->label =
-        static_cast<float*>(malloc(static_cast<size_t>(batch_rows_) * sizeof(float)));
-    bool ok = out->x && out->label;
-    if (ok && cur_has_weight_) {
-      out->weight = static_cast<float*>(
+    bool ok = out->x != nullptr;
+    if (ok && !pack_aux_) {
+      out->label = static_cast<float*>(
           malloc(static_cast<size_t>(batch_rows_) * sizeof(float)));
-      ok = out->weight != nullptr;
+      ok = out->label != nullptr;
+      if (ok && cur_has_weight_) {
+        out->weight = static_cast<float*>(
+            malloc(static_cast<size_t>(batch_rows_) * sizeof(float)));
+        ok = out->weight != nullptr;
+      }
     }
     if (!ok) {
       dmlc_free_dense(out);
@@ -997,6 +1008,7 @@ class LineReader {
   // matching the old accumulator's backfill). false on OOM.
   bool promote_weight() {
     cur_has_weight_ = true;
+    if (pack_aux_) return true;  // weight column always exists when packed
     if (cur_ && !cur_->weight) {
       cur_->weight = static_cast<float*>(
           malloc(static_cast<size_t>(batch_rows_) * sizeof(float)));
@@ -1045,25 +1057,39 @@ class LineReader {
       size_t space = static_cast<size_t>(batch_rows_ - cur_rows_);
       size_t take = n - done < space ? n - done : space;
       const float* src = x + done * stride + off;
+      const size_t ocol = ncol + (pack_aux_ ? 2 : 0);
       if (out_bf16_) {
         // the single repack pass doubles as the f32->bf16 conversion
         uint16_t* dst16 = reinterpret_cast<uint16_t*>(cur_->x) +
-                          static_cast<size_t>(cur_rows_) * ncol;
+                          static_cast<size_t>(cur_rows_) * ocol;
         for (size_t i = 0; i < take; ++i) {
-          convert_row_bf16(dst16 + i * ncol, src + i * stride, ncol);
+          convert_row_bf16(dst16 + i * ocol, src + i * stride, ncol);
+          if (pack_aux_) {
+            dst16[i * ocol + ncol] = f32_to_bf16(label[done + i]);
+            dst16[i * ocol + ncol + 1] =
+                f32_to_bf16(weight ? weight[done + i] : 1.0f);
+          }
         }
       } else {
-        float* dst = cur_->x + static_cast<size_t>(cur_rows_) * ncol;
+        float* dst = cur_->x + static_cast<size_t>(cur_rows_) * ocol;
         for (size_t i = 0; i < take; ++i) {
-          memcpy(dst + i * ncol, src + i * stride, ncol * sizeof(float));
+          memcpy(dst + i * ocol, src + i * stride, ncol * sizeof(float));
+          if (pack_aux_) {
+            dst[i * ocol + ncol] = label[done + i];
+            dst[i * ocol + ncol + 1] = weight ? weight[done + i] : 1.0f;
+          }
         }
       }
-      memcpy(cur_->label + cur_rows_, label + done, take * sizeof(float));
-      if (cur_has_weight_) {
-        if (weight) {
-          memcpy(cur_->weight + cur_rows_, weight + done, take * sizeof(float));
-        } else {
-          for (size_t i = 0; i < take; ++i) cur_->weight[cur_rows_ + i] = 1.0f;
+      if (!pack_aux_) {
+        memcpy(cur_->label + cur_rows_, label + done, take * sizeof(float));
+        if (cur_has_weight_) {
+          if (weight) {
+            memcpy(cur_->weight + cur_rows_, weight + done,
+                   take * sizeof(float));
+          } else {
+            for (size_t i = 0; i < take; ++i)
+              cur_->weight[cur_rows_ + i] = 1.0f;
+          }
         }
       }
       cur_rows_ += static_cast<int64_t>(take);
@@ -1116,27 +1142,39 @@ class LineReader {
       }
       int64_t space = batch_rows_ - cur_rows_;
       int64_t take = n - done < space ? n - done : space;
+      const int64_t ocol = num_col_ + (pack_aux_ ? 2 : 0);
       for (int64_t r = 0; r < take; ++r) {
         const float* row = res->cells + (done + r) * ncol;
-        cur_->label[cur_rows_ + r] = label_col_ >= 0 ? row[label_col_] : 0.0f;
-        if (cur_has_weight_)
-          cur_->weight[cur_rows_ + r] = has_w ? row[weight_col_] : 1.0f;
+        const float lab = label_col_ >= 0 ? row[label_col_] : 0.0f;
+        const float wgt = has_w ? row[weight_col_] : 1.0f;
+        if (!pack_aux_) {
+          cur_->label[cur_rows_ + r] = lab;
+          if (cur_has_weight_) cur_->weight[cur_rows_ + r] = wgt;
+        }
         int64_t k = 0;
         if (out_bf16_) {
           uint16_t* dst16 = reinterpret_cast<uint16_t*>(cur_->x) +
-                            static_cast<size_t>(cur_rows_ + r) * num_col_;
+                            static_cast<size_t>(cur_rows_ + r) * ocol;
           for (int64_t c = 0; c < ncol && k < num_col_; ++c) {
             if (c == label_col_ || c == weight_col_) continue;
             dst16[k++] = f32_to_bf16(row[c]);
           }
           while (k < num_col_) dst16[k++] = 0;  // bf16 zero is all-zero bits
+          if (pack_aux_) {
+            dst16[num_col_] = f32_to_bf16(lab);
+            dst16[num_col_ + 1] = f32_to_bf16(wgt);
+          }
         } else {
-          float* dst = cur_->x + static_cast<size_t>(cur_rows_ + r) * num_col_;
+          float* dst = cur_->x + static_cast<size_t>(cur_rows_ + r) * ocol;
           for (int64_t c = 0; c < ncol && k < num_col_; ++c) {
             if (c == label_col_ || c == weight_col_) continue;
             dst[k++] = row[c];
           }
           while (k < num_col_) dst[k++] = 0.0f;  // x is malloc'd, not zeroed
+          if (pack_aux_) {
+            dst[num_col_] = lab;
+            dst[num_col_ + 1] = wgt;
+          }
         }
       }
       cur_rows_ += take;
@@ -1230,6 +1268,7 @@ class LineReader {
   int64_t nnz_bucket_ = 0;
   bool elide_unit_ = false;
   bool csr_wire_ = false;
+  bool pack_aux_ = false;
   DenseResult* cur_ = nullptr;  // in-progress output batch (producer-owned)
   int64_t cur_rows_ = 0;
   bool cur_has_weight_ = false;
@@ -1616,7 +1655,7 @@ void* dmlc_reader_create(const char** paths, const int64_t* sizes,
                          int32_t label_col, int32_t weight_col,
                          int32_t out_bf16, int64_t row_bucket,
                          int64_t nnz_bucket, int32_t elide_unit,
-                         int32_t csr_wire) {
+                         int32_t csr_wire, int32_t pack_aux) {
   try {
     std::vector<std::string> p(paths, paths + nfiles);
     std::vector<int64_t> s(sizes, sizes + nfiles);
@@ -1624,7 +1663,7 @@ void* dmlc_reader_create(const char** paths, const int64_t* sizes,
                           format, num_col, indexing_mode, delim, nthread,
                           chunk_bytes, queue_depth, batch_rows, label_col,
                           weight_col, out_bf16 != 0, row_bucket, nnz_bucket,
-                          elide_unit != 0, csr_wire != 0);
+                          elide_unit != 0, csr_wire != 0, pack_aux != 0);
   } catch (...) {
     // alloc/thread-spawn failure must not cross the extern "C" boundary
     // (std::terminate); null tells the caller creation failed
@@ -1658,12 +1697,13 @@ void* dmlc_feeder_create(int32_t format, int64_t num_col,
                          int64_t batch_rows, int32_t label_col,
                          int32_t weight_col, int32_t out_bf16,
                          int64_t row_bucket, int64_t nnz_bucket,
-                         int32_t elide_unit, int32_t csr_wire) {
+                         int32_t elide_unit, int32_t csr_wire,
+                         int32_t pack_aux) {
   try {
     return new LineReader(format, num_col, indexing_mode, delim, nthread,
                           chunk_bytes, queue_depth, batch_rows, label_col,
                           weight_col, out_bf16 != 0, row_bucket, nnz_bucket,
-                          elide_unit != 0, csr_wire != 0);
+                          elide_unit != 0, csr_wire != 0, pack_aux != 0);
   } catch (...) {
     return nullptr;
   }

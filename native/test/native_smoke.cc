@@ -99,7 +99,7 @@ int main() {
                                /*label_col=*/-1, /*weight_col=*/-1,
                                /*out_bf16=*/0, /*row_bucket=*/0,
                                /*nnz_bucket=*/0, /*elide_unit=*/0,
-                               /*csr_wire=*/0);
+                               /*csr_wire=*/0, /*pack_aux=*/0);
   CHECK_TRUE(r != nullptr);
   for (int pass = 0; pass < 2; ++pass) {
     int64_t rows = 0;
@@ -232,7 +232,7 @@ int main() {
                                   /*num_col=*/128, 0, ',', 2, 4096, 2, 0,
                                   -1, -1, 0, /*row_bucket=*/64,
                                   /*nnz_bucket=*/256, /*elide_unit=*/1,
-                                  /*csr_wire=*/0);
+                                  /*csr_wire=*/0, /*pack_aux=*/0);
     CHECK_TRUE(cr != nullptr);
     for (int pass = 0; pass < 2; ++pass) {
       int64_t rows = 0, nnz = 0;
@@ -258,7 +258,7 @@ int main() {
     remove(cpath);
   }
 
-  CHECK_TRUE(dmlc_native_abi_version() == 14);
+  CHECK_TRUE(dmlc_native_abi_version() == 15);
   if (failures == 0) std::printf("native_smoke: all checks passed\n");
   return failures == 0 ? 0 : 1;
 }

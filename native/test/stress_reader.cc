@@ -118,7 +118,8 @@ void stress_pull_reader(const std::string& p1, const std::string& p2) {
   // multi-epoch with batch repack, consumer on another thread
   void* h = dmlc_reader_create(paths, sizes, 2, 0, 1, /*fmt dense*/ 1,
                                /*num_col*/ 16, -1, ',', 4, 1 << 16, 4,
-                               /*batch_rows*/ 100, -1, -1, 0, 0, 0, 0, 0);
+                               /*batch_rows*/ 100, -1, -1, 0, 0, 0, 0, 0,
+                               /*pack_aux=*/1);
   CHECK_TRUE(h != nullptr, "reader create");
   for (int epoch = 0; epoch < 3; ++epoch) {
     int64_t rows = 0;
@@ -132,7 +133,7 @@ void stress_pull_reader(const std::string& p1, const std::string& p2) {
   // early destruction with the queue full (stop path racing the producer)
   for (int i = 0; i < 8; ++i) {
     void* h2 = dmlc_reader_create(paths, sizes, 2, 0, 1, 0, 0, -1, ',', 4,
-                                  1 << 14, 2, 0, -1, -1, 0, 0, 0, 0, 0);
+                                  1 << 14, 2, 0, -1, -1, 0, 0, 0, 0, 0, 0);
     int32_t fmt = 0;
     void* res = dmlc_reader_next(h2, &fmt);
     if (res) dmlc_free_block(static_cast<CsrBlockResult*>(res));
@@ -145,7 +146,8 @@ void stress_pull_reader(const std::string& p1, const std::string& p2) {
   for (int part = 0; part < 4; ++part) {
     ts.emplace_back([&, part] {
       void* hp = dmlc_reader_create(paths, sizes, 2, part, 4, 0, 0, -1, ',',
-                                    2, 1 << 14, 2, 0, -1, -1, 0, 0, 0, 0, 0);
+                                    2, 1 << 14, 2, 0, -1, -1, 0, 0, 0, 0, 0,
+                                    0);
       int64_t rows = 0;
       drain_reader(hp, 0, &rows);
       total += rows;
@@ -168,7 +170,8 @@ void stress_feeder(const std::string& p1) {
 
   for (int epoch = 0; epoch < 2; ++epoch) {
     void* h = dmlc_feeder_create(1, 16, -1, ',', 4, 1 << 14, 2, 128, -1, -1,
-                                 /*out_bf16=*/0, 0, 0, 0, /*csr_wire=*/0);
+                                 /*out_bf16=*/0, 0, 0, 0, /*csr_wire=*/0,
+                                 /*pack_aux=*/1);
     CHECK_TRUE(h != nullptr, "feeder create");
     std::thread pusher([&] {
       size_t at = 0;
@@ -196,7 +199,7 @@ void stress_feeder(const std::string& p1) {
   // abort racing an active pusher
   for (int i = 0; i < 8; ++i) {
     void* h = dmlc_feeder_create(0, 0, -1, ',', 2, 1 << 12, 1, 0, -1, -1, 0,
-                                 0, 0, 0, /*csr_wire=*/0);
+                                 0, 0, 0, /*csr_wire=*/0, /*pack_aux=*/0);
     std::thread pusher([&] {
       size_t at = 0;
       while (at < data.size()) {
@@ -229,7 +232,7 @@ void stress_coo(const std::string& p1, const std::string& p2) {
                                     /*num_col=*/64, -1, ',', 2, 1 << 14, 2,
                                     0, -1, -1, 0, /*row_bucket=*/32,
                                     /*nnz_bucket=*/128, /*elide_unit=*/1,
-                                    /*csr_wire=*/1);
+                                    /*csr_wire=*/1, /*pack_aux=*/0);
       int64_t rows = 0;
       drain_reader(hp, 6, &rows);
       total += rows;
@@ -248,7 +251,8 @@ void stress_recordio(const std::string& rec1, const std::string& rec2) {
   for (int part = 0; part < 3; ++part) {
     ts.emplace_back([&, part] {
       void* h = dmlc_reader_create(paths, sizes, 2, part, 3, 4, 0, -1, ',',
-                                   2, 1 << 14, 2, 0, -1, -1, 0, 0, 0, 0, 0);
+                                   2, 1 << 14, 2, 0, -1, -1, 0, 0, 0, 0, 0,
+                                   0);
       int64_t recs = 0;
       drain_reader(h, 4, &recs);
       total += recs;

@@ -476,7 +476,7 @@ def test_native_dense_matches_csr_path(mode):
     text = ("\n".join(lines) + "\n").encode()
     num_col = 40
 
-    x, y, w, _owner = native.parse_libsvm_dense(text, num_col, indexing_mode=mode)
+    x, y, w, _owner, _packed = native.parse_libsvm_dense(text, num_col, indexing_mode=mode)
     d = native.parse_libsvm(text, indexing_mode=mode)
     block = RowBlock(offset=d["offset"], label=d["label"], index=d["index"],
                      value=d["value"], weight=d["weight"], qid=d["qid"],
@@ -491,7 +491,7 @@ def test_native_dense_matches_csr_path(mode):
 def test_native_dense_weight_and_out_of_range():
     from dmlc_tpu import native
 
-    x, y, w, _o = native.parse_libsvm_dense(
+    x, y, w, _o, _p = native.parse_libsvm_dense(
         b"1:0.5 0:2 9:7\n0:2.0 1:4\n", 3, indexing_mode=0)
     np.testing.assert_allclose(x, [[2, 0, 0], [0, 4, 0]])  # idx 9 dropped
     np.testing.assert_allclose(w, [0.5, 2.0])
@@ -579,7 +579,7 @@ def test_view_owner_survives_gc():
 
     from dmlc_tpu import native
 
-    x, y, w, owner = native.parse_libsvm_dense(b"1 0:5 1:6\n", 2, indexing_mode=0)
+    x, y, w, owner, _p = native.parse_libsvm_dense(b"1 0:5 1:6\n", 2, indexing_mode=0)
     del owner, y, w
     gc.collect()
     np.testing.assert_allclose(x, [[5, 6]])

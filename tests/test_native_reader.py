@@ -880,3 +880,70 @@ class TestNativeCooEmit:
         assert blocks
         assert sum(b["n_rows"] for b in blocks) == 400
         assert all(b["values"] is None for b in blocks)
+
+
+class TestPackedAux:
+    """pack_aux: batch repack emits ONE [B, num_col + 2] array with label/
+    weight as trailing columns (api.h DenseResult packed_aux) — must match
+    the split emit column-for-column, f32 and bf16, libsvm and csv."""
+
+    def _corpus(self, tmp_path, weighted=True):
+        f = tmp_path / "p.libsvm"
+        w = lambda i: f":{0.5 + (i % 3)}" if weighted else ""
+        f.write_text("".join(
+            f"{i % 2}{w(i)} 0:{i}.5 2:{(i * 7) % 50}\n" for i in range(500)))
+        return str(f)
+
+    def _collect(self, path, fmt, num_col, pack, dtype="float32", **pk):
+        p = create_parser(path, 0, 1, fmt, threaded=True, chunk_bytes=2048)
+        assert p.set_emit_dense(num_col, batch_rows=64, dtype=dtype,
+                                pack_aux=pack)
+        blocks = []
+        while True:
+            b = p.next_block()
+            if b is None:
+                break
+            blocks.append(b)
+        p.close()
+        return blocks
+
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+    def test_libsvm_packed_matches_split(self, tmp_path, dtype):
+        path = self._corpus(tmp_path)
+        packed = self._collect(path, "libsvm", 4, True, dtype)
+        split = self._collect(path, "libsvm", 4, False, dtype)
+        assert len(packed) == len(split) > 1
+        for bp, bs in zip(packed, split):
+            assert bp.packed and not bs.packed
+            assert bp.x.shape == (len(bs), 6)  # num_col + 2
+            f32 = lambda a: np.asarray(a, np.float32)
+            np.testing.assert_array_equal(f32(bp.x[:, :4]), f32(bs.x))
+            np.testing.assert_array_equal(f32(bp.x[:, 4]), f32(bs.label))
+            np.testing.assert_array_equal(f32(bp.x[:, 5]), f32(bs.weight))
+            # the label/weight attrs alias the packed columns
+            np.testing.assert_array_equal(f32(bp.label), f32(bp.x[:, 4]))
+        # tail block is partial but still packed-width
+        assert len(packed[-1]) == 500 % 64
+        assert packed[-1].x.shape[1] == 6
+
+    def test_unweighted_rows_pack_unit_weight(self, tmp_path):
+        path = self._corpus(tmp_path, weighted=False)
+        packed = self._collect(path, "libsvm", 4, True)
+        assert all((np.asarray(b.x[:, 5]) == 1.0).all() for b in packed)
+
+    def test_csv_packed_matches_split(self, tmp_path):
+        f = tmp_path / "p.csv"
+        f.write_text("".join(
+            f"{i % 2},{i * 0.5},{-i}.25,{(i % 5) + 0.5}\n"
+            for i in range(300)))
+        uri = str(f) + "?format=csv&label_column=0&weight_column=3"
+        packed = self._collect(uri, "csv", 2, True)
+        split = self._collect(uri, "csv", 2, False)
+        for bp, bs in zip(packed, split):
+            assert bp.packed
+            np.testing.assert_array_equal(
+                np.asarray(bp.x[:, :2]), np.asarray(bs.x))
+            np.testing.assert_array_equal(
+                np.asarray(bp.x[:, 2]), np.asarray(bs.label))
+            np.testing.assert_array_equal(
+                np.asarray(bp.x[:, 3]), np.asarray(bs.weight))
