@@ -191,18 +191,21 @@ def ell_matvec_auto(weights: jax.Array, batch: EllBatch,
                     use_pallas: bool = False) -> jax.Array:
     """ELL matvec: XLA gather by default; the pallas kernel is OPT-IN.
 
-    Routing honesty (VERDICT r3 weak #3): the r2 gate routed pallas for
-    D <= 2048 citing wins measured on the UNROLLED-K kernel that r3's
-    grid-K redesign replaced — and the only current measurement inside
-    that band (D=28, SPARSE_TPU_r03) shows the grid-K kernel LOSING to the
-    XLA gather (25.13 us vs 23.39 us). A production default must cite data
-    for the kernel that actually runs, so until a current-kernel A/B
-    (benchmarks/bench_sparse_tpu.py now measures D in {512, 1024, 2048})
-    shows a winning band, the default is the XLA gather everywhere and
-    ``use_pallas=True`` opts in explicitly (shape requirements: [D] table,
-    B a multiple of 128, [D, 128] slab within VMEM — enforced by
-    ell_matvec_pallas). For high D the XLA gather is the right lowering by
-    construction — see the module docstring.
+    Routing data (r5 on-chip A/B, SPARSE_TPU_r05.json, TPU v5 lite): the
+    grid-K kernel WINS at D=512/K=32 (16.1 vs 17.5 us), D=2048/K=64
+    (16.1 vs 33.2 us — 2.06x) and D=4096/K=64 (22.3 vs 24.9 us), loses
+    at D=28/K=28 (23.7 vs 16.2 — dense-in-sparse belongs on the gather or
+    a dense matmul) and, unexplained, at D=1024/K=48 (52.1 vs 17.5 us;
+    same block_b=256 as the winning shapes). Because the win band is
+    non-monotonic in D and the one in-band loss is not yet attributable
+    to D or to K, the production default remains the everywhere-safe XLA
+    gather; ``use_pallas=True`` opts in for shapes a caller has measured
+    (requirements: [D] table, B a multiple of 128, [D, 128] slab within
+    VMEM — enforced by ell_matvec_pallas). The D x K grid leg
+    (bench_sparse_tpu.py with DMLC_SPARSE_GRID=1, queued in the TPU
+    battery) exists to disentangle the two effects before any auto-gate
+    cites this data. For high D the XLA gather is the right lowering by
+    construction — see the module docstring (confirmed at D=1M: 25.9 us).
     """
     if not use_pallas:
         return _xla_ell_matvec(weights, batch)
