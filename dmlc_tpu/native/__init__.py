@@ -32,7 +32,8 @@ _SRC_DIR = os.path.join(_REPO_ROOT, "native", "src")
 _SRCS = [os.path.join(_SRC_DIR, f)
          for f in ("parse.cc", "reader.cc", "recordio.cc")]
 _HDRS = [os.path.join(_SRC_DIR, f)
-         for f in ("api.h", "strtonum.h", "parse_internal.h")]
+         for f in ("api.h", "strtonum.h", "parse_internal.h",
+                   "buffer_pool.h")]
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 _SO_PATH = os.path.join(_BUILD_DIR, "libdmlc_tpu_native.so")
 _ABI_VERSION = 15

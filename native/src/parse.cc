@@ -24,6 +24,7 @@
 #include <vector>
 
 #include "api.h"
+#include "buffer_pool.h"
 #include "parse_internal.h"
 #include "strtonum.h"
 
@@ -748,19 +749,24 @@ static CooResult* merge_parts_coo(std::vector<CsrPart>& parts,
   // buckets disabled) must not read as out-of-memory
   const size_t nnz_alloc = nnz_out > 0 ? static_cast<size_t>(nnz_out) : 1;
   res->csr_wire = csr_wire ? 1 : 0;
+  // bucket-padded sizes repeat across chunks, so these buffers recycle
+  // through the size-keyed pool (buffer_pool.h) instead of paying
+  // glibc's mmap round trip per batch
   res->coords = static_cast<int32_t*>(
-      malloc((csr_wire ? 1 : 2) * nnz_alloc * sizeof(int32_t)));
+      dmlc_pool_alloc((csr_wire ? 1 : 2) * nnz_alloc * sizeof(int32_t)));
   if (csr_wire)
     res->row_ptr = static_cast<int32_t*>(
-        malloc((rows_out + 1) * sizeof(int32_t)));
+        dmlc_pool_alloc((rows_out + 1) * sizeof(int32_t)));
   if (!elide)
-    res->values = static_cast<float*>(malloc(nnz_alloc * sizeof(float)));
-  res->label = static_cast<float*>(malloc(rows_out * sizeof(float)));
-  res->weight = static_cast<float*>(malloc(rows_out * sizeof(float)));
+    res->values =
+        static_cast<float*>(dmlc_pool_alloc(nnz_alloc * sizeof(float)));
+  res->label = static_cast<float*>(dmlc_pool_alloc(rows_out * sizeof(float)));
+  res->weight = static_cast<float*>(dmlc_pool_alloc(rows_out * sizeof(float)));
   if (!res->coords || (csr_wire && !res->row_ptr) ||
       (!elide && !res->values) || !res->label || !res->weight) {
-    free(res->coords); free(res->row_ptr); free(res->values);
-    free(res->label); free(res->weight);
+    dmlc_pool_free(res->coords); dmlc_pool_free(res->row_ptr);
+    dmlc_pool_free(res->values);
+    dmlc_pool_free(res->label); dmlc_pool_free(res->weight);
     res->coords = nullptr; res->row_ptr = nullptr; res->values = nullptr;
     res->label = nullptr; res->weight = nullptr;
     res->error = dup_error("parse: out of memory building coo chunk");
@@ -889,8 +895,9 @@ CooResult* dmlc_parse_coo(const char* data, int64_t len, int nthread,
 
 void dmlc_free_coo(CooResult* r) {
   if (!r) return;
-  free(r->coords); free(r->row_ptr); free(r->values);
-  free(r->label); free(r->weight);
+  dmlc_pool_free(r->coords); dmlc_pool_free(r->row_ptr);
+  dmlc_pool_free(r->values);
+  dmlc_pool_free(r->label); dmlc_pool_free(r->weight);
   free(r->error);
   free(r);
 }
@@ -930,11 +937,14 @@ DenseResult* dmlc_parse_libsvm_dense(const char* data, int64_t len, int nthread,
   const size_t off = convert ? 1 : 0;
   const size_t stride = static_cast<size_t>(num_col) + 1;
   res->n_rows = n;
-  res->x = static_cast<float*>(malloc(static_cast<size_t>(n) * num_col * sizeof(float)));
-  res->label = static_cast<float*>(malloc(n * sizeof(float)));
-  if (any_weight) res->weight = static_cast<float*>(malloc(n * sizeof(float)));
+  res->x = static_cast<float*>(
+      dmlc_pool_alloc(static_cast<size_t>(n) * num_col * sizeof(float)));
+  res->label = static_cast<float*>(dmlc_pool_alloc(n * sizeof(float)));
+  if (any_weight)
+    res->weight = static_cast<float*>(dmlc_pool_alloc(n * sizeof(float)));
   if (!res->x || !res->label || (any_weight && !res->weight)) {
-    free(res->x); free(res->label); free(res->weight);
+    dmlc_pool_free(res->x); dmlc_pool_free(res->label);
+    dmlc_pool_free(res->weight);
     memset(res, 0, sizeof(*res));
     res->n_cols = num_col;
     res->error = dup_error("parse: out of memory merging chunk");
@@ -957,7 +967,8 @@ DenseResult* dmlc_parse_libsvm_dense(const char* data, int64_t len, int nthread,
 
 void dmlc_free_dense(DenseResult* r) {
   if (!r) return;
-  free(r->x); free(r->label); free(r->weight); free(r->error);
+  dmlc_pool_free(r->x); dmlc_pool_free(r->label); dmlc_pool_free(r->weight);
+  free(r->error);
   free(r);
 }
 

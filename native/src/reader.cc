@@ -40,6 +40,10 @@
 #include <vector>
 
 #include "api.h"
+#include "buffer_pool.h"
+
+using dmlc_tpu::dmlc_pool_alloc;
+using dmlc_tpu::dmlc_pool_free;
 #include "parse_internal.h"
 
 namespace {
@@ -982,17 +986,20 @@ class LineReader {
     // device_put per batch downstream; see api.h DenseResult docs)
     const size_t xcols =
         static_cast<size_t>(num_col_) + (pack_aux_ ? 2 : 0);
+    // pooled: every batch of an epoch has the same buffer sizes, so the
+    // freed x of batch i becomes the x of batch i+k without touching
+    // glibc's mmap path (buffer_pool.h)
     out->x = static_cast<float*>(
-        malloc(static_cast<size_t>(batch_rows_) * xcols *
-               (out_bf16_ ? sizeof(uint16_t) : sizeof(float))));
+        dmlc_pool_alloc(static_cast<size_t>(batch_rows_) * xcols *
+                        (out_bf16_ ? sizeof(uint16_t) : sizeof(float))));
     bool ok = out->x != nullptr;
     if (ok && !pack_aux_) {
       out->label = static_cast<float*>(
-          malloc(static_cast<size_t>(batch_rows_) * sizeof(float)));
+          dmlc_pool_alloc(static_cast<size_t>(batch_rows_) * sizeof(float)));
       ok = out->label != nullptr;
       if (ok && cur_has_weight_) {
         out->weight = static_cast<float*>(
-            malloc(static_cast<size_t>(batch_rows_) * sizeof(float)));
+            dmlc_pool_alloc(static_cast<size_t>(batch_rows_) * sizeof(float)));
         ok = out->weight != nullptr;
       }
     }
@@ -1011,7 +1018,7 @@ class LineReader {
     if (pack_aux_) return true;  // weight column always exists when packed
     if (cur_ && !cur_->weight) {
       cur_->weight = static_cast<float*>(
-          malloc(static_cast<size_t>(batch_rows_) * sizeof(float)));
+          dmlc_pool_alloc(static_cast<size_t>(batch_rows_) * sizeof(float)));
       if (!cur_->weight) return false;
       for (int64_t i = 0; i < cur_rows_; ++i) cur_->weight[i] = 1.0f;
     }

@@ -8,8 +8,10 @@
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <vector>
 
 #include "../src/api.h"
+#include "../src/buffer_pool.h"
 
 static int failures = 0;
 #define CHECK_TRUE(cond)                                        \
@@ -256,6 +258,46 @@ int main() {
     }
     dmlc_reader_destroy(cr);
     remove(cpath);
+  }
+
+  // buffer pool (memory.h analog): same-size blocks recycle, depth and
+  // byte caps hold, trim drains. Recycling checks only apply when the
+  // pool is enabled — under DMLC_TPU_POOL=0 (the documented leak-triage
+  // mode) every release goes straight to free() by design.
+  {
+    using dmlc_tpu::dmlc_pool_alloc;
+    using dmlc_tpu::dmlc_pool_free;
+    using dmlc_tpu::pool_detail::kMaxFreePerSize;
+    using dmlc_tpu::pool_detail::kMinPooledBytes;
+    const bool pooling = dmlc_tpu::pool_detail::pool().enabled;
+    dmlc_tpu::dmlc_pool_trim();
+    const size_t big = 1u << 20;
+    void* a = dmlc_pool_alloc(big);
+    CHECK_TRUE(a != nullptr);
+    memset(a, 7, big);  // sanitizers watch the full payload
+    dmlc_pool_free(a);
+    if (pooling) {
+      CHECK_TRUE(dmlc_tpu::dmlc_pool_cached_bytes() == big);
+      void* b = dmlc_pool_alloc(big);
+      CHECK_TRUE(b == a);  // recycled, not re-mmapped
+      CHECK_TRUE(dmlc_tpu::dmlc_pool_cached_bytes() == 0);
+      dmlc_pool_free(b);
+      dmlc_tpu::dmlc_pool_trim();
+    }
+    void* small = dmlc_pool_alloc(kMinPooledBytes / 2);  // below threshold
+    dmlc_pool_free(small);
+    CHECK_TRUE(dmlc_tpu::dmlc_pool_cached_bytes() == 0);
+    // per-size depth cap: free more than kMaxFreePerSize blocks of one
+    // pooled size, cache stays capped at the configured depth
+    const size_t sz = 2 * kMinPooledBytes;
+    const size_t n_many = kMaxFreePerSize + 4;
+    std::vector<void*> many;
+    for (size_t i = 0; i < n_many; ++i) many.push_back(dmlc_pool_alloc(sz));
+    for (void* p : many) dmlc_pool_free(p);
+    CHECK_TRUE(dmlc_tpu::dmlc_pool_cached_bytes() <=
+               kMaxFreePerSize * sz);
+    dmlc_tpu::dmlc_pool_trim();
+    CHECK_TRUE(dmlc_tpu::dmlc_pool_cached_bytes() == 0);
   }
 
   CHECK_TRUE(dmlc_native_abi_version() == 15);
