@@ -19,6 +19,13 @@ while :; do
     echo "== $(date -u +%FT%TZ) battery completed rc=0; watcher done =="
     exit 0
   fi
-  echo "== $(date -u +%FT%TZ) battery rc=$rc; retry in ${INTERVAL}s =="
+  # retry only the tunnel-unreachable probe exit (3); any other failure is
+  # deterministic (bad args, import error) and looping on it would re-run
+  # the full battery forever
+  if [ "$rc" -ne 3 ]; then
+    echo "== $(date -u +%FT%TZ) battery rc=$rc (non-retryable); watcher aborting =="
+    exit "$rc"
+  fi
+  echo "== $(date -u +%FT%TZ) device unreachable; retry in ${INTERVAL}s =="
   sleep "$INTERVAL"
 done

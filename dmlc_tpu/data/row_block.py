@@ -102,8 +102,13 @@ class RowBlock:
         """max feature id + 1 (what downstream sizes weight vectors with)."""
         return int(self.index.max()) + 1 if len(self.index) else 0
 
-    def __getitem__(self, i: int) -> Row:
-        """Row view (RowBlock::operator[], data.h:365-394)."""
+    def __getitem__(self, i):
+        """Row view (RowBlock::operator[], data.h:365-394); a slice returns
+        the :meth:`slice` sub-block, so ``block[10:20]`` reads naturally."""
+        if isinstance(i, slice):
+            check(i.step in (None, 1), "RowBlock: stepped slices unsupported")
+            begin, end, _ = i.indices(len(self))
+            return self.slice(begin, max(begin, end))
         if i < 0:
             i += len(self)
         check(0 <= i < len(self), f"RowBlock: row {i} out of range")

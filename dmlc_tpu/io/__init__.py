@@ -8,7 +8,7 @@ from dmlc_tpu.io.uri import URI, URISpec
 from dmlc_tpu.io.filesystem import (
     FileInfo, FileSystem, LocalFileSystem, MemoryFileSystem, get_filesystem,
 )
-from dmlc_tpu.io.stream import open_stream
+from dmlc_tpu.io.stream import open_stream, read_all, write_all
 from dmlc_tpu.io.recordio import (
     RECORDIO_MAGIC, RecordIOWriter, RecordIOReader, RecordIOChunkReader,
     read_index_file, write_indexed_recordio,
@@ -27,7 +27,8 @@ from dmlc_tpu.io import azure_filesys as _azure_filesys  # replaces the azure://
 
 __all__ = [
     "URI", "URISpec", "FileInfo", "FileSystem", "LocalFileSystem",
-    "MemoryFileSystem", "get_filesystem", "open_stream",
+    "MemoryFileSystem", "get_filesystem", "open_stream", "read_all",
+    "write_all",
     "RECORDIO_MAGIC", "RecordIOWriter", "RecordIOReader", "RecordIOChunkReader",
     "read_index_file", "write_indexed_recordio",
     "ThreadedIter", "InputSplit", "LineSplitter", "RecordIOSplitter",

@@ -58,6 +58,12 @@ def test_row_block_basics():
     assert blk[0].sdot(w) == pytest.approx(0 * 1 + 3 * 2)
     sl = blk.slice(1, 3)
     assert len(sl) == 2 and sl.num_nonzero == 4
+    # slice syntax dispatches to .slice(), including negative/clamped bounds
+    sl2 = blk[1:3]
+    assert len(sl2) == 2 and list(sl2.label) == list(sl.label)
+    assert len(blk[-2:]) == 2 and len(blk[2:99]) == 1 and len(blk[3:1]) == 0
+    with pytest.raises(Exception):
+        blk[::2]
     dense = blk.to_dense()
     assert dense.shape == (3, 5)
     assert dense[2, 2] == 5.0 and dense[2, 4] == 6.0
