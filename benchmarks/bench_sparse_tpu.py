@@ -15,6 +15,7 @@ Writes one JSON line per (shape, path) to stdout and the aggregate to
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import sys
@@ -80,12 +81,30 @@ def bench_shape(name: str, B: int, K: int, D: int, results: list) -> None:
     # viability bound: the [D, bb] slab must fit the 4MB VMEM budget with
     # bb >= 128 (the Mosaic lane-tile minimum) -> D <= 8192
     if D <= 8192:
-        try:
-            record("ell_pallas_onehot", time_op(ell_matvec_pallas, w, idx, val))
-        except Exception as exc:  # noqa: BLE001 - record lowering failures
-            results.append({"shape": name, "path": "ell_pallas_onehot",
-                            "error": str(exc)[:200]})
-            print(f"# ell_pallas_onehot failed: {str(exc)[:120]}", flush=True)
+        # in grid mode also sweep the lane tile explicitly: the r5 A/B's one
+        # in-band loss (D=1024/K=48, 3x) used the default bb=256, and tile
+        # choice vs shape must be attributable before any auto-gate cites
+        # this data (ops/pallas_sparse.py ell_matvec_auto docstring). Each
+        # DISTINCT tile is timed once — the run matching the auto-pick
+        # keeps the canonical label so it stays comparable across legs.
+        from dmlc_tpu.ops.pallas_sparse import _pick_block_b
+
+        auto_bb = _pick_block_b(B, D)
+        bbs = ((0,) if not os.environ.get("DMLC_SPARSE_GRID")
+               else (128, 256))
+        for bb in bbs:
+            label = ("ell_pallas_onehot" if bb in (0, auto_bb)
+                     else f"ell_pallas_bb{bb}")
+            if bb == auto_bb:
+                bb = 0  # exercise the production auto-pick path itself
+            try:
+                record(label, time_op(
+                    functools.partial(ell_matvec_pallas, block_b=bb),
+                    w, idx, val))
+            except Exception as exc:  # noqa: BLE001 - record lowering failures
+                results.append({"shape": name, "path": label,
+                                "error": str(exc)[:200]})
+                print(f"# {label} failed: {str(exc)[:120]}", flush=True)
     else:
         results.append({"shape": name, "path": "ell_pallas_onehot",
                         "skipped": "D beyond VMEM slab budget; XLA gather "
