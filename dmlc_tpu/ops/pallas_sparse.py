@@ -203,7 +203,8 @@ def ell_matvec_auto(weights: jax.Array, batch: EllBatch,
     (requirements: [D] table, B a multiple of 128, [D, 128] slab within
     VMEM — enforced by ell_matvec_pallas). The D x K grid leg
     (bench_sparse_tpu.py with DMLC_SPARSE_GRID=1, queued in the TPU
-    battery) exists to disentangle the two effects before any auto-gate
+    battery; it also times each distinct lane tile, 128 vs the auto-pick)
+    exists to disentangle D, K, and tile effects before any auto-gate
     cites this data. For high D the XLA gather is the right lowering by
     construction — see the module docstring (confirmed at D=1M: 25.9 us).
     """
