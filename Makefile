@@ -1,11 +1,15 @@
 # One-command CI gate — the analog of the reference's travis_script.sh
 # (scripts/travis/travis_script.sh:39-66: gtest suite + TSAN task).
 #
-#   make check        pytest + sanitizers + native parse bench, logged to
-#                     CHECK.log (dated) — the full pre-commit gate
+#   make check        pytest + sanitizers + native parse bench + bench
+#                     smoke, logged to CHECK.log (dated) — the full
+#                     pre-commit gate
 #   make test         pytest only (fast inner loop)
 #   make sanitize     ASan/UBSan + TSan native runs -> native/SANITIZE.log
 #   make parse-bench  native scanner throughput tool (no device needed)
+#   make bench-smoke  bench.py on the CPU backend; fails unless the JSON
+#                     summary line carries the per-stage ingest
+#                     attribution (read/parse/convert/dispatch/transfer)
 #   make fuzz         mutation fuzz of every native parse C-ABI entry point
 #                     (crash-safety; DMLC_FUZZ_ITERS to scale)
 
@@ -14,7 +18,7 @@ PYTHON ?= python
 SHELL := /bin/bash
 .SHELLFLAGS := -o pipefail -c
 
-.PHONY: check test sanitize parse-bench fuzz
+.PHONY: check test sanitize parse-bench bench-smoke fuzz
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -24,6 +28,24 @@ fuzz:
 
 sanitize:
 	sh native/run_sanitizers.sh
+
+# CPU-backend smoke of the driver benchmark: proves the pipeline runs end
+# to end off-chip AND that the stage-attribution contract holds — the one
+# JSON line must carry every named stage plus wall, or the gate fails.
+# Small corpus + 1 rep: this checks the contract, not the throughput.
+bench-smoke:
+	DMLC_BENCH_PLATFORM=cpu DMLC_BENCH_MB=8 DMLC_BENCH_REPS=1 \
+	DMLC_BENCH_ATTEMPTS=1 DMLC_BENCH_TIMEOUT=600 \
+	    $(PYTHON) bench.py > .bench_smoke.json
+	$(PYTHON) -c "import json; \
+	    line = json.load(open('.bench_smoke.json')); \
+	    a = line.get('attribution') or {}; \
+	    missing = [k for k in ('read', 'parse', 'convert', 'dispatch', \
+	        'transfer', 'wall') if k not in a]; \
+	    assert not missing, f'attribution fields missing: {missing}'; \
+	    assert line.get('value'), 'bench smoke produced no throughput'; \
+	    print('bench-smoke: attribution OK:', \
+	          {k: a[k] for k in sorted(a)})"
 
 parse-bench:
 	mkdir -p native/build
@@ -47,4 +69,6 @@ check:
 	$(PYTHON) native/test/fuzz_parse.py 2>&1 | tee -a CHECK.log
 	@echo "-- parse bench --" | tee -a CHECK.log
 	$(MAKE) --no-print-directory parse-bench 2>&1 | tee -a CHECK.log
+	@echo "-- bench smoke (CPU backend + attribution contract) --" | tee -a CHECK.log
+	$(MAKE) --no-print-directory bench-smoke 2>&1 | tee -a CHECK.log
 	@echo "== make check: ALL GREEN ==" | tee -a CHECK.log

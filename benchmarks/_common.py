@@ -56,6 +56,39 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+# canonical stage order for the ingest attribution table (VERDICT r5 weak
+# #4: name the unaccounted share of pipeline bound, per-stage)
+STAGE_ORDER = ("read", "parse", "convert", "dispatch", "transfer")
+
+
+def attribution_line(stats: dict, extra_transfer: float = 0.0) -> dict:
+    """DeviceIter.stats() -> the JSON ``attribution`` object.
+
+    ``extra_transfer`` folds a caller-measured transfer residue (e.g.
+    bench.py's final block_until_ready drain) into the transfer stage and
+    the wall, so the table accounts for the async blind spot end to end.
+    ``coverage`` is sum(stages)/wall — the fraction of wall the named
+    stages explain (the rest is consumer self-time).
+    """
+    stages = dict(stats.get("stages") or {})
+    stages["transfer"] = stages.get("transfer", 0.0) + extra_transfer
+    wall = float(stats.get("wall_seconds") or 0.0) + extra_transfer
+    out = {k: round(stages.get(k, 0.0), 4) for k in STAGE_ORDER}
+    out["wall"] = round(wall, 4)
+    covered = sum(stages.get(k, 0.0) for k in STAGE_ORDER)
+    out["coverage"] = round(covered / wall, 3) if wall > 0 else 0.0
+    return out
+
+
+def attribution_table(attribution: dict) -> str:
+    """Render the attribution object as the human-readable stderr table."""
+    from dmlc_tpu.utils.timer import format_stage_table
+
+    stages = {k: attribution.get(k, 0.0) for k in STAGE_ORDER}
+    return format_stage_table(stages, attribution.get("wall", 0.0),
+                              order=STAGE_ORDER)
+
+
 def emit(metric: str, value: float, unit: str, baseline: float, **extra) -> None:
     """The ONE stdout JSON line, same schema as bench.py (extra keys allowed
     after the required four, e.g. a secondary ratio)."""

@@ -84,19 +84,22 @@ def bench_shape(name: str, B: int, K: int, D: int, results: list) -> None:
         # in grid mode also sweep the lane tile explicitly: the r5 A/B's one
         # in-band loss (D=1024/K=48, 3x) used the default bb=256, and tile
         # choice vs shape must be attributable before any auto-gate cites
-        # this data (ops/pallas_sparse.py ell_matvec_auto docstring). Each
-        # DISTINCT tile is timed once — the run matching the auto-pick
-        # keeps the canonical label so it stays comparable across legs.
-        from dmlc_tpu.ops.pallas_sparse import _pick_block_b
+        # this data (ops/pallas_sparse.py ell_matvec_auto docstring). The
+        # tile list is built from VALIDATED tiles only — skip any bb where
+        # B % bb != 0 or the [D, bb] slab exceeds the VMEM budget (the same
+        # constraints _pick_block_b enforces), so no run can hit the
+        # kernel's bare divisibility assert — and the auto-pick run is
+        # ALWAYS included, so the canonical 'ell_pallas_onehot' label is
+        # guaranteed and cross-leg comparability cannot silently break
+        # (ADVICE.md round-5 finding).
+        from dmlc_tpu.ops.pallas_sparse import _pick_block_b, _valid_block_b
 
         auto_bb = _pick_block_b(B, D)
-        bbs = ((0,) if not os.environ.get("DMLC_SPARSE_GRID")
-               else (128, 256))
-        for bb in bbs:
-            label = ("ell_pallas_onehot" if bb in (0, auto_bb)
-                     else f"ell_pallas_bb{bb}")
-            if bb == auto_bb:
-                bb = 0  # exercise the production auto-pick path itself
+        runs = [(0, "ell_pallas_onehot")]  # the production auto-pick path
+        if os.environ.get("DMLC_SPARSE_GRID"):
+            runs += [(bb, f"ell_pallas_bb{bb}") for bb in (128, 256)
+                     if bb != auto_bb and _valid_block_b(B, D, bb)]
+        for bb, label in runs:
             try:
                 record(label, time_op(
                     functools.partial(ell_matvec_pallas, block_b=bb),

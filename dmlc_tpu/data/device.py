@@ -12,11 +12,23 @@ BASELINE target is ">=90% host->HBM line-rate with zero input-bound stalls".
 
 Layouts: 'dense' (padded [B, D], MXU-friendly), 'ell' (static-shape sparse),
 'bcoo' (jax.experimental.sparse interop). See dmlc_tpu.ops.sparse.
+
+Stage attribution (tf.data's per-stage cost naming, arXiv:2101.12127): every
+second of consumer wall is attributed to a named pipeline stage — read,
+parse, convert, dispatch, transfer — in ``stats()['stages']``, so "the
+pipeline is at X% of bound" always decomposes into which stage owns the gap
+(VERDICT r5 weak #4: a 50% gap with stalls reading 0.000s is an artifact of
+the measurement, not a property of the pipeline). The convert stage runs on
+a small :class:`~dmlc_tpu.io.threaded_iter.OrderedWorkerPool` packing into a
+ring of reusable preallocated host staging buffers, so layout conversion for
+batch N+1 overlaps the dispatch (and DMA) of batch N.
 """
 
 from __future__ import annotations
 
 import os
+import threading
+import weakref
 from collections import deque
 from typing import Iterator, Optional, Tuple
 
@@ -27,12 +39,12 @@ from dmlc_tpu.data.parsers import Parser
 from dmlc_tpu.data.row_block import (
     CooBlock, DenseBlock, RowBlock, RowBlockContainer,
 )
-from dmlc_tpu.io.threaded_iter import ThreadedIter
+from dmlc_tpu.io.threaded_iter import OrderedWorkerPool, ThreadedIter
 from dmlc_tpu.ops.sparse import (
     EllBatch, block_to_bcoo_host, block_to_dense, block_to_ell,
 )
 from dmlc_tpu.utils.check import DMLCError, check
-from dmlc_tpu.utils.timer import get_time
+from dmlc_tpu.utils.timer import StageMeter, get_time
 
 
 # resume marker: yielded by the natural-block producer for skipped blocks
@@ -65,6 +77,92 @@ def rebatch_blocks(
                 pending.push_block(merged.slice(pos, len(merged)))
     if pending_rows and not drop_remainder:
         yield pending.to_block()
+
+
+_RING_FREE = object()  # sentinel: slot never attached / explicitly released
+
+
+class _StagingRing:
+    """Ring of reusable preallocated host staging buffers.
+
+    Convert workers pack batches into these instead of allocating fresh
+    arrays per batch. A slot cycles free -> packing (acquired) -> in-flight
+    (attached to the device array built from it) -> free again when that
+    device array is garbage-collected — reuse is gated on OBJECT LIFETIME
+    via a weakref, never on elapsed time, so a backend that aliases or
+    defers reading the host buffer (zero-copy CPU puts, an in-flight DMA)
+    can never observe a recycled buffer being overwritten. When every slot
+    is busy a fresh unpooled allocation is handed out (counted as a miss):
+    the ring is an allocator fast path, never a blocking resource.
+    """
+
+    def __init__(self, make_bufs, depth: int):
+        self._make = make_bufs
+        self._depth = max(1, int(depth))
+        self._lock = threading.Lock()
+        self._slots: list = []  # [bufs_dict, _RING_FREE | None | weakref]
+        self.hits = 0
+        self.misses = 0
+
+    def acquire(self) -> dict:
+        with self._lock:
+            for slot in self._slots:
+                refs = slot[1]
+                if refs is None:  # acquired, not yet attached: busy
+                    continue
+                if refs is _RING_FREE or all(r() is None for r in refs):
+                    slot[1] = None
+                    self.hits += 1
+                    return slot[0]
+            if len(self._slots) < self._depth:
+                bufs = self._make()
+                self._slots.append([bufs, None])
+                return bufs
+            self.misses += 1
+            return self._make()
+
+    def attach(self, bufs: dict, handles) -> None:
+        """Tie the slot to EVERY device object built from it (a batch can
+        fan one slot's buffers into several arrays — x/y/w — and any one
+        of them staying alive must pin the whole slot); ``handles=None``
+        or empty releases the slot immediately (batch dropped before any
+        transfer, e.g. a resume replay)."""
+        with self._lock:
+            for slot in self._slots:
+                if slot[0] is bufs:
+                    if not handles:
+                        slot[1] = _RING_FREE
+                    else:
+                        try:
+                            slot[1] = [weakref.ref(h) for h in handles]
+                        except TypeError:  # un-weakref-able handle: retire
+                            slot[1] = None  # the slot rather than risk reuse
+                    return
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"depth": len(self._slots), "hits": self.hits,
+                    "misses": self.misses}
+
+
+# dense rebatch part descriptors: ("packed", x2d) carries a [n, D+2] slab
+# (features|label|weight columns), ("arr", x, y, w_or_None) split views,
+# ("blk", RowBlock) defers the CSR->dense scatter to the convert worker
+
+def _plen(part) -> int:
+    if part[0] == "arr":
+        return len(part[2])
+    return len(part[1])
+
+
+def _pslice(part, a: int, b: int):
+    kind = part[0]
+    if kind == "packed":
+        return ("packed", part[1][a:b])
+    if kind == "arr":
+        return ("arr", part[1][a:b], part[2][a:b],
+                part[3][a:b] if part[3] is not None else None)
+    return ("blk", part[1].slice(a, b))
 
 
 def _csr_coords_impl(cols, row_ptr):
@@ -156,12 +254,20 @@ class PackedDenseBatch:
 
 
 class DeviceIter:
-    """Double-buffered host->device batch iterator.
+    """Double-buffered host->device batch iterator with stage attribution.
 
     Pipeline stages, each ahead of the next:
       1. parser/iterator thread (already prefetched upstream),
-      2. host convert thread: rebatch + layout conversion (numpy),
+      2. serial rebatch stage + a ``convert_workers``-wide
+         :class:`OrderedWorkerPool` packing batches into reusable host
+         staging buffers (layout conversion for batch N+1 overlaps the
+         dispatch of batch N),
       3. this object: ``device_put`` issued ``prefetch`` batches ahead.
+
+    ``stats()['stages']`` decomposes consumer wall time into named costs
+    (read / parse / convert / dispatch / transfer) — see the module
+    docstring; ``stats()['stage_busy']`` carries the raw per-stage busy
+    counters the attribution is derived from.
     """
 
     def __init__(
@@ -177,6 +283,8 @@ class DeviceIter:
         max_nnz: Optional[int] = None,
         prefetch: int = 2,
         convert_ahead: int = 4,
+        convert_workers: Optional[int] = None,
+        transfer_sample: Optional[int] = None,
         drop_remainder: bool = False,
         device=None,
         elide_unit_values: bool = False,
@@ -309,8 +417,35 @@ class DeviceIter:
         # converting/transferring (otherwise resume re-transfers whatever
         # the eager pipeline already prefetched)
         self._convert_ahead = convert_ahead
-        self._host_iter_obj: Optional[ThreadedIter] = None
+        # conversion-worker pool width (fixed-batch layouts): >= 1. The
+        # packing work is numpy slice-assignment (GIL released), so two
+        # workers overlap convert-for-N+1 with the consumer's dispatch of
+        # N even before true multi-core parallelism.
+        if convert_workers is None:
+            convert_workers = int(
+                os.environ.get("DMLC_TPU_CONVERT_WORKERS", "2") or 2)
+        self.convert_workers = max(1, int(convert_workers))
+        # transfer-completion sideband: every Nth delivered batch is
+        # block_until_ready'd and the wait recorded as the 'transfer'
+        # stage — the async-dispatch blind spot (bench.py's final-drain
+        # note) sampled instead of invisible. 0 disables.
+        if transfer_sample is None:
+            transfer_sample = int(
+                os.environ.get("DMLC_TPU_TRANSFER_SAMPLE", "32") or 32)
+        self.transfer_sample = max(0, int(transfer_sample))
+        self._host_iter_obj = None  # OrderedWorkerPool | ThreadedIter
         self._inflight: deque = deque()
+        # ---- stage attribution state (module docstring) ----
+        # raw busy/blocked counters, written by pipeline threads:
+        self._busy = StageMeter("read", "parse", "convert", "dispatch")
+        # consumer-wall attribution (the partition stats() reports)
+        self._attr = StageMeter("read", "parse", "convert", "dispatch",
+                                "transfer")
+        self._transfer_samples = 0
+        self._t_first: Optional[float] = None  # first consumer pull
+        self._t_last: Optional[float] = None   # latest consumer activity
+        self._ring: Optional[_StagingRing] = None
+        self._ring_init_lock = threading.Lock()
         # byte-exact resume (SURVEY.md §5.4): blocks annotated by the parser
         # chain carry the source state just after them; the convert thread
         # maps each produced batch to (latest block boundary, rows past it)
@@ -323,14 +458,27 @@ class DeviceIter:
         self._suppress_before_first = False
 
     @property
-    def _host_iter(self) -> ThreadedIter:
+    def _host_iter(self):
         if self._host_iter_obj is None:
-            self._host_iter_obj = ThreadedIter.from_factory(
-                self._host_batches, max_capacity=self._convert_ahead
-            )
+            if self.batch_size is None:
+                # natural-block mode: convert + (async) device_put on ONE
+                # producer thread — puts must not interleave across workers
+                # because the skip-credit resume counts whole blocks
+                self._host_iter_obj = ThreadedIter.from_factory(
+                    self._host_batches, max_capacity=self._convert_ahead
+                )
+            else:
+                self._host_iter_obj = OrderedWorkerPool(
+                    self._serial_batches, self._convert_work,
+                    num_workers=self.convert_workers,
+                    max_ahead=self._convert_ahead,
+                )
         return self._host_iter_obj
 
     # ---------------- host side ----------------
+
+    def _add_busy(self, stage: str, seconds: float) -> None:
+        self._busy.add(stage, seconds)
 
     def _blocks(self) -> Iterator[RowBlock]:
         if self._suppress_before_first:
@@ -338,8 +486,23 @@ class DeviceIter:
             self._suppress_before_first = False
         else:
             self.source.before_first()
+        stage_fn = getattr(self.source, "stage_seconds", None)
         while True:
+            # supply-wait attribution: time blocked on the source, split
+            # read vs parse via the source's own stage counters when it
+            # has them (the Python parser chain); the fused native reader
+            # reports none, so its whole supply cost lands under 'parse'
+            # (read+parse in one C++ pipeline — documented in docs/data.md)
+            s0 = stage_fn() if stage_fn is not None else None
+            t0 = get_time()
             blk = self.source.next_block()
+            dt = get_time() - t0
+            read = 0.0
+            if s0 is not None:
+                s1 = stage_fn()
+                read = min(max(0.0, s1["read"] - s0["read"]), dt)
+            self._add_busy("read", read)
+            self._add_busy("parse", dt - read)
             if blk is None:
                 return
             yield blk
@@ -380,42 +543,67 @@ class DeviceIter:
             {"source": state, "skip_rows": rows_emitted - r})
 
     def _host_batches(self):
-        if self.layout == "dense":
-            yield from self._host_batches_dense()
-            return
-        if self.batch_size is None:
-            # natural-block mode (BCOO interop: nnz varies per batch anyway,
-            # so fixed-shape rebatching buys no compile reuse — skip the
-            # merge/slice copies and convert parser blocks as they come).
-            # device_put is issued HERE on the convert thread (it is async:
-            # returns a handle while the DMA proceeds), so the consumer
-            # thread only pops ready handles — one pipeline thread instead
-            # of a GIL ping-pong between convert and put
-            for block in self._blocks():
-                if self._skip_blocks > 0:
-                    # resume fast-path: skip without converting/transferring
-                    self._skip_blocks -= 1
-                    yield _SKIPPED
-                    continue
-                yield self._put(self._convert(block))
-            return
+        # natural-block mode only (BCOO interop: nnz varies per batch
+        # anyway, so fixed-shape rebatching buys no compile reuse — skip
+        # the merge/slice copies and convert parser blocks as they come).
+        # device_put is issued HERE on the convert thread (it is async:
+        # returns a handle while the DMA proceeds), so the consumer thread
+        # only pops ready handles — one pipeline thread instead of a GIL
+        # ping-pong between convert and put
+        for block in self._blocks():
+            if self._skip_blocks > 0:
+                # resume fast-path: skip without converting/transferring
+                self._skip_blocks -= 1
+                yield _SKIPPED
+                continue
+            t0 = get_time()
+            hb = self._convert(block)
+            self._add_busy("convert", get_time() - t0)
+            yield self._put(hb)
+
+    def _serial_batches(self):
+        """The pool's SERIAL stage: pull blocks, rebatch to fixed size,
+        emit per-batch work descriptors (no per-batch copies here — the
+        packing/conversion runs in the pool's parallel stage). Whatever
+        time this stage spends beyond waiting on the source (merge/slice
+        bookkeeping) is charged to 'convert'."""
+        inner = (self._serial_batches_dense() if self.layout == "dense"
+                 else self._serial_batches_sparse())
+        while True:
+            b0 = self._busy.seconds()
+            t0 = get_time()
+            try:
+                item = next(inner)
+            except StopIteration:
+                return
+            dt = get_time() - t0
+            b1 = self._busy.seconds()
+            supply = (b1["read"] - b0["read"]) + (b1["parse"] - b0["parse"])
+            self._add_busy("convert", max(0.0, dt - supply))
+            yield item
+
+    def _serial_batches_sparse(self):
         emitted = 0
         for block in rebatch_blocks(
             self._tracked_blocks(), self.batch_size, self.drop_remainder
         ):
             emitted += len(block)
             self._push_annot(emitted)
-            yield self._convert(block)
+            # bcoo nnz-bucket planning stays HERE, in stream order: the
+            # tail batch pads its nse into the set of already-emitted
+            # shapes, which must be complete by then — pool workers
+            # convert out of order, so they cannot own this bookkeeping
+            pad = (self._plan_bcoo_pad_nnz(block)
+                   if self.layout == "bcoo" else None)
+            yield ("convert_block", block, pad)
 
-    def _host_batches_dense(self):
-        """Dense layout fast path: convert each block to (x, y, w) immediately
-        (for dense-in-sparse data ``block_to_dense`` is a reshape view, no
-        scatter) and rebatch with one ``np.concatenate`` per emitted batch —
-        instead of merging CSR containers and re-slicing, which costs several
-        copies of all seven RowBlock arrays per batch on the host core."""
+    def _serial_batches_dense(self):
+        """Dense serial stage: group incoming blocks into exact-B part
+        lists using views only (DenseBlock/RowBlock slices); the per-batch
+        copy — one packing pass into a staging-ring buffer — is deferred
+        to the convert workers (:meth:`_pack_dense_parts`)."""
         B = self.batch_size
-        xdt = self._x_np_dtype()
-        parts: list = []  # [(x, y, w)] pending, total rows < B after drain
+        parts: list = []  # part descriptors, total rows pending < B
         pending = 0
         emitted = 0
         for block in self._tracked_blocks():
@@ -425,7 +613,7 @@ class DeviceIter:
                 # work — the whole (x|label|weight) batch is ONE array
                 emitted += B
                 self._push_annot(emitted)
-                yield ("dense_packed", block.x)
+                yield ("dense_ready", block.x)
                 continue
             if (isinstance(block, DenseBlock) and block.packed
                     and not parts and len(block) < B):
@@ -438,62 +626,136 @@ class DeviceIter:
                 if self.drop_remainder:
                     continue
                 n = len(block)
-                xp = np.zeros((B, self.num_col + 2), xdt)
-                xp[:n] = block.x
                 emitted += n
                 self._push_annot(emitted)
-                yield ("dense_packed", xp)
+                yield ("dense_parts", [("packed", block.x)])
                 continue
             if isinstance(block, DenseBlock) and block.packed:
                 # parts pending from non-packed blocks (mixed engines) or
-                # an oversize block: downgrade to split views and fall
-                # through to the generic drain below (a `continue` here
-                # would let `pending` end the stream >= B and break the
-                # tail pad)
-                parts.append((np.asarray(block.x[:, :self.num_col]),
-                              np.asarray(block.label, np.float32),
-                              np.asarray(block.weight, np.float32)))
+                # an oversize block: keep the packed slab as a part — the
+                # pack stage reads its feature/label/weight columns
+                parts.append(("packed", block.x))
             elif isinstance(block, DenseBlock):
-                w = (block.weight if block.weight is not None
-                     else np.ones(len(block), np.float32))
-                x = block.x
-                if x.dtype != xdt:  # python fallback block in target dtype
-                    x = x.astype(xdt)
-                parts.append((x, block.label, w))
+                parts.append(("arr", block.x, block.label, block.weight))
             else:
-                x, y, w = block_to_dense(block, self.num_col, copy=False)
-                if x.dtype != xdt:
-                    x = x.astype(xdt)
-                parts.append((x, y, w))
-            pending += len(parts[-1][1])
+                parts.append(("blk", block))
+            pending += len(block)
             while pending >= B:
-                xs, ys, ws = zip(*parts)
-                x = np.concatenate(xs) if len(xs) > 1 else xs[0]
-                y = np.concatenate(ys) if len(ys) > 1 else ys[0]
-                w = np.concatenate(ws) if len(ws) > 1 else ws[0]
-                pos = 0
-                while pos + B <= len(y):
-                    emitted += B
-                    self._push_annot(emitted)
-                    yield ("dense", x[pos:pos + B], y[pos:pos + B], w[pos:pos + B])
-                    pos += B
-                parts = [(x[pos:], y[pos:], w[pos:])] if pos < len(y) else []
-                pending = len(y) - pos
+                take, need = [], B
+                while need > 0:
+                    p = parts[0]
+                    n = _plen(p)
+                    if n <= need:
+                        take.append(parts.pop(0))
+                        need -= n
+                    else:
+                        take.append(_pslice(p, 0, need))
+                        parts[0] = _pslice(p, need, n)
+                        need = 0
+                pending -= B
+                emitted += B
+                self._push_annot(emitted)
+                yield ("dense_parts", take)
         if pending and not self.drop_remainder:
-            xs, ys, ws = zip(*parts)
-            x = np.concatenate(xs) if len(xs) > 1 else xs[0]
-            y = np.concatenate(ys) if len(ys) > 1 else ys[0]
-            w = np.concatenate(ws) if len(ws) > 1 else ws[0]
-            n = len(y)
-            xp = np.zeros((B, self.num_col), xdt)
-            xp[:n] = x
-            yp = np.zeros(B, np.float32)
-            yp[:n] = y
-            wp = np.zeros(B, np.float32)
-            wp[:n] = w
-            emitted += n
+            emitted += pending
             self._push_annot(emitted)
-            yield ("dense", xp, yp, wp)
+            yield ("dense_parts", parts)
+
+    def _convert_work(self, item):
+        """The pool's PARALLEL stage: per-batch layout conversion/packing.
+        Returns ``(host_batch, staging_bufs_or_None)`` — the bufs ride to
+        :meth:`_put` so the ring slot can be tied to the device array."""
+        t0 = get_time()
+        try:
+            kind = item[0]
+            if kind == "dense_ready":
+                return ("dense_packed", item[1]), None
+            if kind == "dense_parts":
+                return self._pack_dense_parts(item[1])
+            # ("convert_block", block, precomputed bcoo pad plan)
+            return self._convert(item[1], pad_plan=(item[2],)), None
+        finally:
+            self._add_busy("convert", get_time() - t0)
+
+    def _staging_ring(self) -> _StagingRing:
+        # called concurrently by pool workers: double-checked under the
+        # ring-init lock, or two rings would race into existence and the
+        # loser's buffers could never recycle (attach() would scan the
+        # survivor and no-op)
+        if self._ring is None:
+            with self._ring_init_lock:
+                if self._ring is None:
+                    B, nc = self.batch_size, self.num_col
+                    xdt = self._x_np_dtype()
+                    if self.pack_aux:
+                        def make():
+                            return {"packed": np.empty((B, nc + 2), xdt)}
+                    else:
+                        def make():
+                            return {"x": np.empty((B, nc), xdt),
+                                    "y": np.empty(B, np.float32),
+                                    "w": np.empty(B, np.float32)}
+                    # every buffer that can be referenced concurrently:
+                    # pool-ahead converted batches + put-issued prefetch +
+                    # one per worker mid-pack + slack
+                    depth = (self._convert_ahead + self.prefetch
+                             + self.convert_workers + 2)
+                    self._ring = _StagingRing(make, depth)
+        return self._ring
+
+    def _part_xyw(self, part):
+        if part[0] == "arr":
+            return part[1], part[2], part[3]
+        # ("blk", RowBlock): the CSR->dense scatter, on the worker
+        return block_to_dense(part[1], self.num_col, copy=False)
+
+    def _pack_dense_parts(self, parts):
+        """One packing pass: copy part views into a staging-ring buffer
+        (slice assignment casts to the target dtype in the same pass) and
+        zero-fill rows past the parts' total (the epoch-tail pad). Returns
+        the host batch + its ring bufs."""
+        B, nc = self.batch_size, self.num_col
+        bufs = self._staging_ring().acquire()
+        pos = 0
+        if self.pack_aux:
+            xp = bufs["packed"]
+            for p in parts:
+                n = _plen(p)
+                if p[0] == "packed":
+                    xp[pos:pos + n] = p[1]
+                else:
+                    x, y, w = self._part_xyw(p)
+                    xp[pos:pos + n, :nc] = x[:, :nc] if x.shape[1] > nc else x
+                    xp[pos:pos + n, nc] = y
+                    if w is None:
+                        xp[pos:pos + n, nc + 1] = 1.0
+                    else:
+                        xp[pos:pos + n, nc + 1] = w
+                pos += n
+            if pos < B:
+                xp[pos:] = 0  # pad rows: weight 0 -> masked downstream
+            return ("dense_packed", xp), bufs
+        xb, yb, wb = bufs["x"], bufs["y"], bufs["w"]
+        for p in parts:
+            n = _plen(p)
+            if p[0] == "packed":
+                xb[pos:pos + n] = p[1][:, :nc]
+                yb[pos:pos + n] = p[1][:, nc]
+                wb[pos:pos + n] = p[1][:, nc + 1]
+            else:
+                x, y, w = self._part_xyw(p)
+                xb[pos:pos + n] = x[:, :nc] if x.shape[1] > nc else x
+                yb[pos:pos + n] = y
+                if w is None:
+                    wb[pos:pos + n] = 1.0
+                else:
+                    wb[pos:pos + n] = w
+            pos += n
+        if pos < B:
+            xb[pos:] = 0
+            yb[pos:] = 0
+            wb[pos:] = 0
+        return ("dense", xb, yb, wb), bufs
 
     def _x_np_dtype(self):
         if self.x_dtype == "bfloat16":
@@ -502,7 +764,30 @@ class DeviceIter:
             return bf16_dtype()
         return np.dtype(np.float32)
 
-    def _convert(self, block: RowBlock):
+    def _plan_bcoo_pad_nnz(self, block) -> Optional[int]:
+        """nnz-bucket pad target for a fixed-batch bcoo block, with the
+        epoch shape-set bookkeeping (VERDICT r4 #5 / ADVICE r3 #4): the
+        tail batch is row-padded to batch_size, but with fewer rows it
+        carries fewer nnz and would round to a SMALLER bucket multiple
+        than any full batch — one novel shape (fresh transfer plan +
+        downstream jit recompile) on the last batch of every epoch. Pad
+        its nse up to the smallest already-emitted value that fits; full
+        batches keep natural rounding and register their nse. MUST run in
+        stream order (the serial stage) — the tail's lookup assumes every
+        earlier full batch already registered."""
+        if isinstance(block, CooBlock) or not self.nnz_bucket:
+            return None
+        nnz = len(block.index)
+        pad_nnz = -(-max(nnz, 1) // self.nnz_bucket) * self.nnz_bucket
+        if self.batch_size is not None:
+            if len(block) < self.batch_size:
+                fits = [s for s in self._emitted_nse if s >= pad_nnz]
+                if fits:
+                    pad_nnz = min(fits)
+            self._emitted_nse.add(pad_nnz)
+        return pad_nnz
+
+    def _convert(self, block: RowBlock, pad_plan: Optional[tuple] = None):
         if isinstance(block, CooBlock):
             # native COO emit: already device-layout (coords/values/label/
             # weight assembled + bucket-padded off-GIL) — nothing to do here
@@ -525,25 +810,10 @@ class DeviceIter:
         if pad is None and self.batch_size is None and self.row_bucket:
             # natural-block mode: quantize the row dimension too
             pad = -(-len(block) // self.row_bucket) * self.row_bucket
-        nnz = len(block.index)
-        if self.nnz_bucket:
-            pad_nnz = -(-max(nnz, 1) // self.nnz_bucket) * self.nnz_bucket
-            if self.batch_size is not None:
-                # close the epoch's shape set (VERDICT r4 #5 / ADVICE r3
-                # #4): the tail batch is row-padded to batch_size above,
-                # but with fewer rows it carries fewer nnz and would round
-                # to a SMALLER bucket multiple than any full batch — one
-                # novel shape (fresh transfer plan + downstream jit
-                # recompile) on the last batch of every epoch. Pad its nse
-                # up to the smallest already-emitted value that fits; full
-                # batches keep natural rounding and register their nse.
-                if len(block) < self.batch_size:
-                    fits = [s for s in self._emitted_nse if s >= pad_nnz]
-                    if fits:
-                        pad_nnz = min(fits)
-                self._emitted_nse.add(pad_nnz)
-        else:
-            pad_nnz = None
+        # nse planning: precomputed in stream order by the serial stage
+        # (pool mode); computed here for the single-thread natural mode
+        pad_nnz = (pad_plan[0] if pad_plan is not None
+                   else self._plan_bcoo_pad_nnz(block))
         return ("bcoo",) + block_to_bcoo_host(
             block, self.num_col, pad_rows_to=pad,
             unit_values_as_none=self.elide_unit_values,
@@ -570,15 +840,27 @@ class DeviceIter:
                 self._ones_cache[n] = dv
         return dv
 
-    def _put(self, host_batch):
+    def _put(self, host_batch, ring_bufs=None):
         # optional tracing hook (SURVEY.md §5.1): annotate transfers so they
         # are attributable in a jax.profiler / Perfetto trace
-        if self._trace:
-            import jax.profiler
+        t0 = get_time()
+        try:
+            if self._trace:
+                from jax import profiler as _profiler
 
-            with jax.profiler.TraceAnnotation("dmlc_tpu.device_put"):
-                return self._put_inner(host_batch)
-        return self._put_inner(host_batch)
+                with _profiler.TraceAnnotation("dmlc_tpu.device_put"):
+                    out = self._put_inner(host_batch)
+            else:
+                out = self._put_inner(host_batch)
+        finally:
+            self._add_busy("dispatch", get_time() - t0)
+        if ring_bufs is not None and self._ring is not None:
+            # tie the staging slot to ALL device arrays of the batch: the
+            # slot frees only when the consumer has dropped every one of
+            # them (weakrefs), never before — a retained label/weight
+            # array must pin the slot as surely as the feature matrix
+            self._ring.attach(ring_bufs, jax.tree_util.tree_leaves(out))
+        return out
 
     def _put_inner(self, host_batch):
         kind = host_batch[0]
@@ -644,18 +926,52 @@ class DeviceIter:
     def _fill(self) -> None:
         producer_put = self.batch_size is None  # natural-block mode put already
         while len(self._inflight) < self.prefetch:
-            host_batch = self._host_iter.next()
-            if host_batch is None:
+            item = self._host_iter.next()
+            if item is None:
                 return
-            if host_batch is _SKIPPED:
+            if item is _SKIPPED:
                 # resume marker that load_state's drain missed (stream
                 # shorter than the recorded position) — never hand it out
                 continue
-            self._inflight.append(
-                host_batch if producer_put else self._put(host_batch))
+            if producer_put:
+                self._inflight.append(item)
+            else:
+                host_batch, bufs = item
+                self._inflight.append(self._put(host_batch, bufs))
 
     def __iter__(self):
         return self
+
+    def _account_window(self, t0: float, busy0: dict, t1: float) -> None:
+        """Attribute the consumer-wall window [t0, t1] to named stages.
+
+        The window is partitioned: dispatch measured on this thread is
+        charged directly; the remainder (time blocked on the pipeline) is
+        split over the read/parse/convert busy DELTAS the pipeline threads
+        accrued during the window, scaled down when they overlap (pool
+        workers running concurrently can accrue more busy-seconds than the
+        window holds). Whatever the deltas don't explain stays
+        unattributed — it shows up as the 'other' residue against
+        wall_seconds instead of being smeared over stages.
+        """
+        busy1 = self._busy.seconds()
+        d_disp = busy1["dispatch"] - busy0["dispatch"]
+        consumer_put = self.batch_size is not None
+        window = (t1 - t0) - (d_disp if consumer_put else 0.0)
+        weights = {k: busy1[k] - busy0[k]
+                   for k in ("read", "parse", "convert")}
+        if not consumer_put:
+            # natural-block mode dispatches on the producer thread: its put
+            # time is part of what the consumer waited on
+            weights["dispatch"] = d_disp
+        wsum = sum(weights.values())
+        if wsum > 0 and window > 0:
+            scale = min(1.0, window / wsum)
+            for k, v in weights.items():
+                if v > 0:
+                    self._attr.add(k, v * scale)
+        if consumer_put:
+            self._attr.add("dispatch", d_disp)
 
     def __next__(self):
         # stall = wall time the consumer spends in here before a batch is
@@ -664,11 +980,17 @@ class DeviceIter:
         # out"); with the prefetch pipeline keeping up this is ~0.
         # NOTE: device_put is async, so this times the wait for a batch
         # HANDLE — a transfer still in flight at first on-device use is
-        # invisible here (it surfaces at the consumer's block_until_ready;
-        # bench.py reports that residue as the final transfer drain)
+        # invisible here; the sampled transfer sideband below (and
+        # bench.py's final drain) makes that blind spot measurable
         t0 = get_time()
+        if self._t_first is None:
+            self._t_first = t0
+        busy0 = self._busy.seconds()
         self._fill()
         if not self._inflight:
+            t_end = get_time()
+            self._account_window(t0, busy0, t_end)
+            self._t_last = t_end
             raise StopIteration
         out = self._inflight.popleft()
         self.stall_seconds += get_time() - t0
@@ -680,8 +1002,19 @@ class DeviceIter:
             # belongs to the batch just handed out
             self._last_resume = self._annot_fifo.popleft()
         # issue the replacement transfer before handing the batch out —
-        # pipeline work, not consumer stall, so outside the timed region
+        # pipeline work, not consumer stall, so outside the stall metric
+        # (still inside the attribution window: it is consumer wall)
         self._fill()
+        self._account_window(t0, busy0, get_time())
+        if (self.transfer_sample
+                and self.batches_fed % self.transfer_sample == 0):
+            # transfer-completion sideband: block until THIS batch's bytes
+            # actually land — the per-batch residue async dispatch hides
+            ts = get_time()
+            jax.block_until_ready(out)
+            self._attr.add("transfer", get_time() - ts)
+            self._transfer_samples += 1
+        self._t_last = get_time()
         return out
 
     def reset(self) -> None:
@@ -716,6 +1049,9 @@ class DeviceIter:
             self._host_iter_obj.destroy()
             self._host_iter_obj = None
         self._annot_fifo.clear()
+        # drop the staging ring with the producer: slots acquired by
+        # now-dead workers would otherwise stay busy forever
+        self._ring = None
 
     def load_state(self, state: dict) -> None:
         if state.get("kind") == "source":
@@ -742,8 +1078,13 @@ class DeviceIter:
         self._suppress_before_first = False
         self._last_resume = None
         for _ in range(n):
-            if self._host_iter.next() is None:  # replay: nothing transferred
+            item = self._host_iter.next()
+            if item is None:  # replay: nothing transferred
                 break
+            if (self.batch_size is not None and item is not _SKIPPED
+                    and item[1] is not None and self._ring is not None):
+                # replayed batch never reaches _put: free its staging slot
+                self._ring.attach(item[1], None)
             if self._annot_fifo:
                 # keep the 1-push/1-pop pairing: each replayed batch pushed
                 # an annotation; consume it like a delivery would (it also
@@ -758,9 +1099,32 @@ class DeviceIter:
             self.source.close()
 
     def stats(self) -> dict:
+        """Throughput counters + per-stage wall attribution.
+
+        ``stages`` partitions consumer wall (``wall_seconds``, first pull
+        to latest delivery) into read / parse / convert / dispatch /
+        transfer; by construction their sum never exceeds wall, and the
+        difference is unattributed consumer time ('other': the caller's
+        own compute between pulls, e.g. a training step). ``stage_busy``
+        carries the raw per-thread busy counters the attribution is
+        scaled from (these may legitimately exceed wall when pool workers
+        overlap). ``transfer`` is a SAMPLED sideband (every
+        ``transfer_sample`` batches) — multiply by the sample period for
+        a rough whole-stream estimate.
+        """
+        wall = 0.0
+        if self._t_first is not None and self._t_last is not None:
+            wall = max(0.0, self._t_last - self._t_first)
         return {
             "batches": self.batches_fed,
             "bytes_to_device": self.bytes_to_device,
             "stall_seconds": self.stall_seconds,
             "host_stall_seconds": self.host_stall_seconds,
+            "stages": self._attr.seconds(),
+            "stage_busy": self._busy.seconds(),
+            "wall_seconds": wall,
+            "transfer_samples": self._transfer_samples,
+            "convert_workers": self.convert_workers,
+            "staging_ring": (self._ring.stats() if self._ring is not None
+                             else None),
         }

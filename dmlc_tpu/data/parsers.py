@@ -38,6 +38,7 @@ from dmlc_tpu.io.uri import URISpec
 from dmlc_tpu.utils.check import DMLCError, check
 from dmlc_tpu.utils.params import Parameter, field
 from dmlc_tpu.utils.registry import Registry
+from dmlc_tpu.utils.timer import get_time
 
 PARSER_REGISTRY: Registry = Registry.get("parser")
 
@@ -116,6 +117,11 @@ class TextParserBase(Parser):
         self._chunks_in = 0  # chunks consumed, for count-based resume
         self._native = None  # tri-state: None=unprobed, False=off, True=on
         self._emit_dense: Optional[int] = None  # num_col when dense mode is on
+        # cumulative per-stage seconds: chunk fetch (IO) vs chunk->block
+        # parse — the split read/parse attribution DeviceIter.stats() names
+        # (two monotonic reads per ~MB chunk: noise)
+        self._read_seconds = 0.0
+        self._parse_seconds = 0.0
 
     def set_emit_dense(self, num_col: int, batch_rows: int = 0,
                        dtype: str = "float32") -> bool:
@@ -153,14 +159,25 @@ class TextParserBase(Parser):
     def parse_chunk_py(self, chunk: bytes) -> RowBlock:
         raise NotImplementedError
 
+    def stage_seconds(self) -> Dict[str, float]:
+        """Cumulative {read, parse} seconds — the per-stage attribution
+        feed for DeviceIter.stats(). ``read`` is chunk-fetch time at the
+        split (for a threaded split: residual wait on its producer),
+        ``parse`` is chunk->RowBlock conversion."""
+        return {"read": self._read_seconds, "parse": self._parse_seconds}
+
     def next_block(self) -> Optional[RowBlock]:
         while True:
+            t0 = get_time()
             chunk = self.source.next_chunk()
+            self._read_seconds += get_time() - t0
             if chunk is None:
                 return None
             self._bytes += len(chunk)
             self._chunks_in += 1
+            t1 = get_time()
             block = self.parse_chunk(_chunk_bytes(chunk))
+            self._parse_seconds += get_time() - t1
             if len(block) > 0:
                 # annotate with the parser state positioned just AFTER this
                 # block, so downstream prefetch pipelines (ThreadedParser,
@@ -691,6 +708,12 @@ class ThreadedParser(Parser):
     @property
     def stall_seconds(self) -> float:
         return self._iter.stall_seconds if self._iter is not None else 0.0
+
+    def stage_seconds(self) -> Dict[str, float]:
+        # the base parser's counters accrue on the producer thread; for a
+        # consumer blocked on this wrapper they name what the producer was
+        # doing during the wait (read IO vs parse CPU)
+        return self.base.stage_seconds()
 
     def close(self) -> None:
         if self._iter is not None:

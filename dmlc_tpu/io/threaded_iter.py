@@ -225,3 +225,167 @@ class ThreadedIter(Generic[T]):
             state["it"] = iterator_factory()
 
         return ThreadedIter(produce, before_first, max_capacity=max_capacity)
+
+
+class OrderedWorkerPool(Generic[T]):
+    """Serial-pull, parallel-work, in-order-delivery prefetch pool.
+
+    The pool form of :class:`ThreadedIter`'s producer machinery (same
+    consumer contract: ``next() -> item | None`` at end of stream, worker
+    exceptions rethrown on the consumer side, ``destroy()`` joins): items
+    are pulled from ONE serial source iterator — the pull is serialized
+    under a dedicated lock and each pulled item takes a sequence number,
+    so source order is the law — then ``work_fn(item)`` runs CONCURRENTLY
+    across ``num_workers`` threads, and results are handed to the
+    consumer strictly in pull order.
+
+    Built for pipeline stages whose per-item work releases the GIL (numpy
+    packing, host layout conversion): work-for-item-N+1 overlaps whatever
+    the consumer does with item N (DeviceIter's convert/dispatch overlap).
+    ``max_ahead`` bounds pulled-but-undelivered items (backpressure); the
+    instantaneous overshoot is at most ``num_workers`` items already past
+    the window check when it closes.
+    """
+
+    def __init__(
+        self,
+        source_factory: Callable[[], Any],
+        work_fn: Callable[[Any], T],
+        num_workers: int = 2,
+        max_ahead: int = 4,
+    ):
+        self._source = source_factory()
+        self._work = work_fn
+        self._ahead = max(1, int(max_ahead))
+        self._lock = threading.Condition()
+        self._pull_lock = threading.Lock()
+        self._results: dict = {}
+        self._seq = 0    # next sequence number to assign at pull time
+        self._want = 0   # next sequence number the consumer delivers
+        self._produce_end = False
+        self._poisoned = False  # a work_fn error was delivered: terminal
+        self._src_exc: Optional[BaseException] = None
+        self._destroyed = False
+        self.stall_seconds = 0.0  # consumer time waiting on the workers
+        self._threads = [
+            threading.Thread(target=self._worker_loop, daemon=True)
+            for _ in range(max(1, int(num_workers)))
+        ]
+        for t in self._threads:
+            t.start()
+
+    # ---------------- worker side ----------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            with self._lock:
+                self._lock.wait_for(
+                    lambda: self._destroyed or self._produce_end
+                    or (self._seq - self._want) < self._ahead
+                )
+                if self._destroyed or self._produce_end:
+                    return
+            with self._pull_lock:
+                # re-check under the pull lock: another worker may have hit
+                # end-of-stream (or destroy) while this one waited its turn
+                if self._destroyed or self._produce_end:
+                    return
+                try:
+                    item = next(self._source)
+                except StopIteration:
+                    with self._lock:
+                        self._produce_end = True
+                        self._lock.notify_all()
+                    return
+                except BaseException as exc:  # noqa: BLE001 - rethrown on consumer
+                    with self._lock:
+                        self._src_exc = exc
+                        self._produce_end = True
+                        self._lock.notify_all()
+                    return
+                with self._lock:
+                    seq = self._seq
+                    self._seq += 1
+            # the parallel stage: outside every lock
+            try:
+                out = ("ok", self._work(item))
+            except BaseException as exc:  # noqa: BLE001 - rethrown in order
+                out = ("exc", exc)
+            with self._lock:
+                self._results[seq] = out
+                self._lock.notify_all()
+
+    # ---------------- consumer side ----------------
+
+    def next(self) -> Optional[T]:
+        """Pop the next result in source order; None at end of stream.
+
+        A ``work_fn`` exception is rethrown at the position of the item
+        that raised (earlier items still deliver) and POISONS the pool:
+        later calls return None — items past a failure must never be
+        handed out, or a consumer pairing deliveries with per-item
+        bookkeeping (DeviceIter's resume-annotation fifo) would desync by
+        one. A source-iterator exception is rethrown after all
+        successfully pulled items drain.
+        """
+        if self._destroyed:
+            raise DMLCError("OrderedWorkerPool: already destroyed")
+        if self._poisoned:
+            return None
+        t0 = get_time()
+        timeout = _stall_timeout()
+        with self._lock:
+            ready = lambda: (  # noqa: E731
+                self._want in self._results
+                or (self._produce_end and self._want >= self._seq))
+            if timeout > 0:
+                if not self._lock.wait_for(ready, timeout=timeout):
+                    alive = sum(t.is_alive() for t in self._threads)
+                    raise DMLCError(
+                        f"pipeline stalled: no item produced in {timeout:.0f}s "
+                        f"({alive}/{len(self._threads)} workers alive, "
+                        f"waiting for #{self._want} of {self._seq} pulled). "
+                        f"A hung device transfer or remote read is the usual "
+                        f"cause; unset DMLC_PIPELINE_STALL_TIMEOUT to wait "
+                        f"forever")
+            else:
+                self._lock.wait_for(ready)
+            self.stall_seconds += get_time() - t0
+            if self._want in self._results:
+                kind, value = self._results.pop(self._want)
+                self._want += 1
+                self._lock.notify_all()  # window opened: let a worker pull
+                if kind == "exc":
+                    self._produce_end = True
+                    self._poisoned = True
+                    raise value
+                return value
+            if self._src_exc is not None:
+                exc, self._src_exc = self._src_exc, None
+                raise exc
+            return None
+
+    def destroy(self) -> None:
+        """Stop and join the worker threads."""
+        if self._destroyed:
+            return
+        with self._lock:
+            self._destroyed = True
+            self._lock.notify_all()
+        for t in self._threads:
+            t.join(timeout=30.0)
+
+    def __iter__(self):
+        while True:
+            item = self.next()
+            if item is None:
+                return
+            yield item
+
+    def __del__(self):  # pragma: no cover - best effort
+        try:
+            self.destroy()
+        except Exception:
+            pass
+
+

@@ -52,13 +52,15 @@ def _margin_dense(params: LinearParams, x: jax.Array) -> jax.Array:
 
 
 def _margin_ell(params: LinearParams, batch: EllBatch,
-                use_auto: bool = False) -> jax.Array:
+                use_auto: bool = True) -> jax.Array:
     if use_auto:
-        # single-device / replicated-weight case: route through the auto
-        # entry (XLA gather by default; pallas is opt-in until a
-        # current-kernel A/B shows a winning band — ell_matvec_auto's
-        # docstring has the routing-honesty rationale). Sharded weights
-        # stay on ell_matvec — pallas_call is not shard_map-aware here.
+        # single-device / replicated-weight case (the default): route
+        # through the auto entry, which picks the pallas one-hot kernel
+        # in its measured win band — lane-aligned D in [512, 4096] on a
+        # TPU backend (SPARSE_TPU_r05.json; ell_matvec_auto's docstring
+        # carries the A/B numbers and the one known in-band anomaly) —
+        # and the XLA gather everywhere else. Sharded weights stay on
+        # ell_matvec — pallas_call is not shard_map-aware here.
         from dmlc_tpu.ops.pallas_sparse import ell_matvec_auto
 
         return ell_matvec_auto(params.weight, batch) + params.bias

@@ -45,6 +45,7 @@ in SPARSE_TPU_r03.json.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -82,6 +83,17 @@ def _ell_kernel(idx_ref, val_ref, w_ref, out_ref, slab_ref):
                                precision=jax.lax.Precision.HIGHEST)  # [1, bb]
 
 
+def _valid_block_b(num_b: int, num_d: int, bb: int,
+                   slab_budget: int = 4 << 20) -> bool:
+    """Would the hardware kernel accept this lane tile? The single source
+    of truth for the tile constraints — Mosaic lane alignment (bb in
+    {128, 256}), B divisibility, and the [D, bb] float32 slab within the
+    VMEM budget — shared with the bench grid sweep so its tile list can
+    never diverge from what the kernel enforces."""
+    return (bb in (256, 128) and num_b % bb == 0
+            and bb * max(num_d, 1) * 4 <= slab_budget)
+
+
 def _pick_block_b(num_b: int, num_d: int, slab_budget: int = 4 << 20) -> int:
     """Largest lane-aligned tile (128 or 256) dividing B whose [D, bb] slab
     fits the VMEM budget; 0 when none exists.
@@ -90,9 +102,8 @@ def _pick_block_b(num_b: int, num_d: int, slab_budget: int = 4 << 20) -> int:
     and Mosaic requires lane tiles to be multiples of 128 — a smaller bb
     lowers in interpret mode but fails on hardware, so rather than rely on
     caller guards this returns 0 and the entry point refuses loudly."""
-    limit = slab_budget // max(num_d * 4, 1)
     for bb in (256, 128):
-        if bb <= limit and num_b % bb == 0:
+        if _valid_block_b(num_b, num_d, bb, slab_budget):
             return bb
     return 0
 
@@ -187,27 +198,64 @@ def _ell_ad_bwd(interpret, res, g):
 _ell_matvec_pallas_ad.defvjp(_ell_ad_fwd, _ell_ad_bwd)
 
 
+# the measured pallas win band, inclusive (SPARSE_TPU_r05.json): see
+# pallas_band() and the ell_matvec_auto docstring for the evidence
+_BAND_D_LO = 512
+_BAND_D_HI = 4096
+
+
+def _on_tpu_backend() -> bool:
+    """The auto-route's hardware gate (separate so tests can monkeypatch
+    it and exercise the routing wire off-chip in interpret mode)."""
+    return jax.default_backend() == "tpu"
+
+
+def pallas_band(num_b: int, num_d: int, weights_ndim: int = 1) -> bool:
+    """True iff (B, D) sits in the pallas kernel's measured win band.
+
+    The band (SPARSE_TPU_r05.json, TPU v5 lite): lane-aligned
+    D in [512, 4096] — D a multiple of 128 so the [1, D] weight block and
+    the [D, bb] slab tile cleanly — with B lane-aligned and the slab
+    within the VMEM budget (``_pick_block_b`` != 0), and a 1-D weight
+    table (the kernel is a [D]-table matvec only; multinomial [D, C]
+    tables stay on the XLA gather). Everything outside routes to the
+    gather: D=28 dense-in-sparse loses (23.7 vs 16.2 us) and high D is
+    disqualified by construction (module docstring).
+    """
+    return (weights_ndim == 1
+            and _BAND_D_LO <= num_d <= _BAND_D_HI
+            and num_d % 128 == 0
+            and _pick_block_b(num_b, num_d) != 0)
+
+
 def ell_matvec_auto(weights: jax.Array, batch: EllBatch,
-                    use_pallas: bool = False) -> jax.Array:
-    """ELL matvec: XLA gather by default; the pallas kernel is OPT-IN.
+                    use_pallas: Optional[bool] = None) -> jax.Array:
+    """ELL matvec: routes to the pallas kernel in its measured win band
+    on TPU, the XLA gather everywhere else.
 
     Routing data (r5 on-chip A/B, SPARSE_TPU_r05.json, TPU v5 lite): the
     grid-K kernel WINS at D=512/K=32 (16.1 vs 17.5 us), D=2048/K=64
-    (16.1 vs 33.2 us — 2.06x) and D=4096/K=64 (22.3 vs 24.9 us), loses
-    at D=28/K=28 (23.7 vs 16.2 — dense-in-sparse belongs on the gather or
-    a dense matmul) and, unexplained, at D=1024/K=48 (52.1 vs 17.5 us;
-    same block_b=256 as the winning shapes). Because the win band is
-    non-monotonic in D and the one in-band loss is not yet attributable
-    to D or to K, the production default remains the everywhere-safe XLA
-    gather; ``use_pallas=True`` opts in for shapes a caller has measured
-    (requirements: [D] table, B a multiple of 128, [D, 128] slab within
-    VMEM — enforced by ell_matvec_pallas). The D x K grid leg
-    (bench_sparse_tpu.py with DMLC_SPARSE_GRID=1, queued in the TPU
-    battery; it also times each distinct lane tile, 128 vs the auto-pick)
-    exists to disentangle D, K, and tile effects before any auto-gate
-    cites this data. For high D the XLA gather is the right lowering by
-    construction — see the module docstring (confirmed at D=1M: 25.9 us).
+    (16.1 vs 33.2 us — 2.06x) and D=4096/K=64 (22.3 vs 24.9 us); it
+    loses at D=28/K=28 (23.7 vs 16.2 us — dense-in-sparse belongs on the
+    gather or a dense matmul) and for high D the XLA gather is the right
+    lowering by construction — see the module docstring (confirmed at
+    D=1M: 25.9 us). The default (``use_pallas=None``) therefore routes
+    to the kernel exactly for lane-aligned D in [512, 4096]
+    (:func:`pallas_band`) on a TPU backend. Known in-band anomaly: the
+    r5 sweep recorded one loss at D=1024/K=48 (52.1 vs 17.5 us, same
+    block_b=256 as the winning shapes); the D x K x lane-tile grid leg
+    (bench_sparse_tpu.py with DMLC_SPARSE_GRID=1, in the TPU battery)
+    exists to attribute it to shape or tile — if it reproduces as a
+    D-effect the band narrows, if it was tile choice the auto-pick
+    already avoids it. ``use_pallas=True``/``False`` force either path
+    (a forced True off-band still enforces the kernel's shape
+    requirements and raises loudly).
     """
+    if use_pallas is None:
+        use_pallas = (
+            pallas_band(batch.indices.shape[0], weights.shape[0],
+                        weights.ndim)
+            and _on_tpu_backend())
     if not use_pallas:
         return _xla_ell_matvec(weights, batch)
     return _ell_matvec_pallas_ad(

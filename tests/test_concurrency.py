@@ -299,3 +299,91 @@ class TestReviewRegressions:
         # resumed stream must start with the injected join newline, so the
         # overall concatenation parses as a1, a2-noeol, b1, b2
         assert (data + rest).split(b"\n") == [b"a1", b"a2-noeol", b"b1", b"b2", b""]
+
+
+class TestOrderedWorkerPool:
+    """The serial-pull / parallel-work / in-order-delivery pool behind
+    DeviceIter's convert/dispatch overlap (io/threaded_iter.py)."""
+
+    def _pool(self, n=20, workers=3, ahead=4, work=None):
+        from dmlc_tpu.io.threaded_iter import OrderedWorkerPool
+
+        return OrderedWorkerPool(
+            lambda: iter(range(n)), work or (lambda i: i * 2),
+            num_workers=workers, max_ahead=ahead)
+
+    def test_order_preserved_under_parallel_work(self):
+        # adversarial work times: later items finish FIRST, so any
+        # delivery-order bug shows as a permutation
+        pool = self._pool(work=lambda i: (time.sleep(0.002 * (20 - i)), i)[1])
+        assert list(pool) == list(range(20))
+        pool.destroy()
+
+    def test_end_of_stream_is_none_and_stays(self):
+        pool = self._pool(n=3, workers=2)
+        assert [pool.next() for _ in range(3)] == [0, 2, 4]
+        assert pool.next() is None
+        assert pool.next() is None  # terminal, not one-shot
+        pool.destroy()
+
+    def test_work_exception_rethrown_in_order(self):
+        def work(i):
+            if i == 5:
+                raise ValueError("item five")
+            return i
+
+        pool = self._pool(work=work)
+        got = []
+        with pytest.raises(ValueError, match="item five"):
+            while True:
+                item = pool.next()
+                if item is None:
+                    break
+                got.append(item)
+        # every item before the poisoned one was still delivered, and the
+        # pool is TERMINAL afterwards: items past a failure never leak out
+        # (a consumer pairing deliveries with per-item bookkeeping would
+        # desync by one otherwise)
+        assert got == [0, 1, 2, 3, 4]
+        assert pool.next() is None
+        pool.destroy()
+
+    def test_source_exception_rethrown_after_drain(self):
+        def src():
+            yield from range(3)
+            raise RuntimeError("source died")
+
+        from dmlc_tpu.io.threaded_iter import OrderedWorkerPool
+
+        pool = OrderedWorkerPool(src, lambda i: i, num_workers=2)
+        assert [pool.next() for _ in range(3)] == [0, 1, 2]
+        with pytest.raises(RuntimeError, match="source died"):
+            pool.next()
+        pool.destroy()
+
+    def test_backpressure_bounded(self):
+        # a slow consumer must not let the pool pull unboundedly ahead:
+        # pulled-but-undelivered is capped at max_ahead (+ workers already
+        # past the window check)
+        pulled = []
+
+        def src():
+            for i in range(100):
+                pulled.append(i)
+                yield i
+
+        from dmlc_tpu.io.threaded_iter import OrderedWorkerPool
+
+        pool = OrderedWorkerPool(src, lambda i: i, num_workers=2, max_ahead=4)
+        assert pool.next() == 0
+        time.sleep(0.1)  # let workers run as far ahead as they can
+        assert len(pulled) <= 1 + 4 + 2, pulled
+        pool.destroy()
+
+    def test_destroy_joins_and_poisons(self):
+        pool = self._pool(n=1000, work=lambda i: (time.sleep(0.001), i)[1])
+        assert pool.next() == 0
+        pool.destroy()
+        with pytest.raises(DMLCError):
+            pool.next()
+        pool.destroy()  # idempotent

@@ -986,10 +986,10 @@ def test_bcoo_derived_nnz_bucket_capped(tmp_path):
 
 
 def test_ell_matvec_auto_routing_guards():
-    """Default routes the XLA gather for every shape (pallas is opt-in
-    pending a current-kernel winning band); an explicit pallas opt-in with
-    a 2D (multinomial) weight table refuses loudly — the kernel is a
-    [D]-table matvec only."""
+    """Off the TPU backend the auto route stays on the XLA gather even for
+    an in-band shape, 2D (multinomial) weight tables never route to the
+    kernel, and an explicit pallas opt-in with a 2D table refuses loudly —
+    the kernel is a [D]-table matvec only."""
     from dmlc_tpu.ops.pallas_sparse import ell_matvec_auto, ell_matvec_pallas
     from dmlc_tpu.ops.sparse import EllBatch, ell_matvec
 
@@ -1184,3 +1184,187 @@ def test_packed_drop_remainder(tmp_path):
         return n
 
     assert count(True) == count(False) == 70 // 16
+
+
+# ------- stage attribution + convert/dispatch overlap (ISSUE 1 tentpole) -------
+
+@pytest.mark.parametrize("layout", ["dense", "ell"])
+def test_device_iter_stage_attribution_partitions_wall(tmp_path, layout):
+    """stats()['stages'] exposes the five named stages, every value is
+    non-negative, and their sum never exceeds consumer wall (the
+    attribution is a PARTITION of wall, never a double count — overlap
+    shows up in stage_busy, which may exceed wall, not in stages)."""
+    uri = _libsvm_corpus(tmp_path, n=256)
+    parser = create_parser(uri, 0, 1, "libsvm", threaded=True)
+    it = DeviceIter(parser, num_col=6, batch_size=32, layout=layout,
+                    max_nnz=6, convert_workers=2, transfer_sample=2)
+    n = sum(1 for _ in it)
+    s = it.stats()
+    it.close()
+    assert n == 8
+    assert set(s["stages"]) == {"read", "parse", "convert", "dispatch",
+                                "transfer"}
+    assert all(v >= 0.0 for v in s["stages"].values())
+    assert s["wall_seconds"] > 0.0
+    total = sum(s["stages"].values())
+    assert total <= s["wall_seconds"] * 1.02 + 1e-6, (total, s)
+    # the transfer sideband actually sampled (every 2nd of 8 batches)
+    assert s["transfer_samples"] >= 3
+    # raw busy counters ride along for the overlap diagnosis
+    assert set(s["stage_busy"]) >= {"read", "parse", "convert", "dispatch"}
+    assert s["convert_workers"] == 2
+
+
+def test_device_iter_attribution_names_supply_cost(tmp_path):
+    """A pipeline bottlenecked on upstream supply must attribute the
+    consumer's wait to the supply stages (read/parse), not leave it
+    unaccounted — the exact failure VERDICT r5 weak #4 calls out."""
+    from dmlc_tpu.data.parsers import Parser as _Parser
+
+    class SlowSource(_Parser):
+        """Hands out a few blocks with a deliberate per-block delay."""
+
+        def __init__(self):
+            self.i = 0
+
+        def before_first(self):
+            self.i = 0
+
+        def next_block(self):
+            import time as _time
+
+            if self.i >= 4:
+                return None
+            self.i += 1
+            _time.sleep(0.05)
+            rng = np.random.default_rng(self.i)
+            vals = rng.normal(size=(8, 4)).astype(np.float32)
+            idx = np.tile(np.arange(4, dtype=np.uint64), 8)
+            return RowBlock(
+                offset=np.arange(0, 33, 4, dtype=np.int64),
+                label=np.zeros(8, np.float32), index=idx,
+                value=vals.reshape(-1))
+
+    it = DeviceIter(SlowSource(), num_col=4, batch_size=8, layout="dense",
+                    convert_workers=2)
+    assert sum(1 for _ in it) == 4
+    s = it.stats()
+    it.close()
+    # ~0.2s of forced supply stall: the parse stage (the slow source does
+    # not expose a read/parse split) must own the bulk of wall
+    assert s["stages"]["parse"] >= 0.5 * s["wall_seconds"], s
+
+
+def test_device_iter_resume_and_reset_with_convert_pool(tmp_path):
+    """state_dict()/load_state() round-trips and reset() restarts cleanly
+    with the conversion-worker pool active (out-of-order convert must not
+    desync the delivery order or the resume annotations)."""
+    uri = _resume_corpus(tmp_path)
+
+    def make():
+        p = create_parser(uri + "?engine=python", 0, 1, "libsvm",
+                          threaded=True, chunk_bytes=4096)
+        return DeviceIter(p, num_col=6, batch_size=64, layout="dense",
+                          convert_workers=3, convert_ahead=4)
+
+    it = make()
+    full = [np.asarray(b[0]) for b in it]
+    assert len(full) >= 6
+    # epoch reset with the pool: same batches again, in order
+    it.reset()
+    again = [np.asarray(b[0]) for b in it]
+    assert len(again) == len(full)
+    for a, b in zip(full, again):
+        np.testing.assert_allclose(a, b)
+    it.close()
+
+    it2 = make()
+    for _ in range(3):
+        next(it2)
+    state = it2.state_dict()
+    it2.close()
+    assert state["kind"] == "source", state  # byte-exact through the pool
+
+    it3 = make()
+    it3.load_state(state)
+    rest = [np.asarray(b[0]) for b in it3]
+    assert len(rest) == len(full) - 3
+    for a, b in zip(rest, full[3:]):
+        np.testing.assert_allclose(a, b)
+    it3.close()
+
+
+def test_staging_ring_reuses_buffers(tmp_path):
+    """Dropped batches free their staging slots (weakref-gated), so a
+    consume-and-discard epoch runs on a bounded ring instead of one fresh
+    allocation per batch; batches still in use keep their slots pinned."""
+    uri = _libsvm_corpus(tmp_path, n=512)
+    parser = create_parser(uri + "?engine=python", 0, 1, "libsvm",
+                           threaded=False)
+    it = DeviceIter(parser, num_col=6, batch_size=32, layout="dense",
+                    convert_workers=2)
+    kept = []
+    for i, batch in enumerate(it):
+        if i < 2:
+            kept.append(batch)  # pin two batches: their slots must not free
+    s = it.stats()
+    ring = s["staging_ring"]
+    it.close()
+    assert ring is not None
+    # 16 batches through a ring whose depth stays well under batch count
+    assert ring["depth"] <= 2 + 4 + 2 + 2  # prefetch+ahead+workers+slack
+    assert ring["hits"] > 0, ring  # buffers actually recycled
+    assert len(kept) == 2  # the pinned handles stayed valid to the end
+
+
+def test_ell_matvec_auto_band_predicate():
+    """The routing band is exactly lane-aligned D in [512, 4096]
+    (SPARSE_TPU_r05.json): inside routes pallas, outside routes gather."""
+    from dmlc_tpu.ops.pallas_sparse import pallas_band
+
+    B = 8192
+    # the four measured win shapes (and the D=1024 anomaly, kept in-band
+    # pending the grid leg's tile-vs-shape attribution)
+    for D in (512, 1024, 2048, 4096):
+        assert pallas_band(B, D), D
+    # outside: dense-in-sparse, off-alignment, beyond band, high-D
+    for D in (28, 384, 520, 4224, 8192, 1 << 20):
+        assert not pallas_band(B, D), D
+    # B must be lane-aligned for a valid tile
+    assert not pallas_band(200, 2048)
+    assert pallas_band(256, 2048)
+    # 2D (multinomial) tables never route to the kernel
+    assert not pallas_band(B, 2048, weights_ndim=2)
+
+
+def test_ell_matvec_auto_routes_band_on_tpu(monkeypatch):
+    """With the TPU gate forced open (interpret-mode kernel), the auto
+    route hits the pallas kernel exactly in-band and the gather elsewhere
+    — the models/linear.py default path end to end."""
+    import dmlc_tpu.ops.pallas_sparse as ps
+    from dmlc_tpu.ops.sparse import EllBatch, ell_matvec
+
+    monkeypatch.setattr(ps, "_on_tpu_backend", lambda: True)
+    real_kernel = ps.ell_matvec_pallas
+    calls = {"n": 0}
+
+    def forced_interpret(w, i, v, **kw):
+        calls["n"] += 1
+        kw["interpret"] = True  # CPU backend: interpret is the only mode
+        return real_kernel(w, i, v, **kw)
+
+    monkeypatch.setattr(ps, "ell_matvec_pallas", forced_interpret)
+
+    rng = np.random.default_rng(7)
+    B, K = 256, 4
+    for D, expect_pallas in ((512, True), (28, False)):
+        idx = jnp.asarray(rng.integers(0, D, size=(B, K)).astype(np.int32))
+        val = jnp.asarray(rng.normal(size=(B, K)).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=D).astype(np.float32))
+        before = calls["n"]
+        got = ps.ell_matvec_auto(w, EllBatch(idx, val, None, None))
+        assert (calls["n"] > before) == expect_pallas, D
+        np.testing.assert_allclose(
+            np.asarray(got),
+            np.asarray(ell_matvec(w, EllBatch(idx, val, None, None))),
+            rtol=1e-4, atol=1e-5)

@@ -22,11 +22,16 @@ def _python_blocks(md_name):
 
 def test_parameter_md_snippets_run(monkeypatch):
     # the env snippet writes DMLC_TASK_ID and reads DMLC_NUM_WORKER —
-    # isolate both so the exec neither leaks into later tests nor depends
-    # on the ambient environment
-    monkeypatch.delenv("DMLC_NUM_WORKER", raising=False)
-    monkeypatch.delenv("DMLC_TASK_ID", raising=False)
-    monkeypatch.setattr(os, "environ", dict(os.environ))
+    # isolate both through setenv/delenv on the REAL os.environ mapping
+    # (never swap it for a plain dict: code holding a reference to the
+    # real mapping, or relying on putenv sync, would silently bypass the
+    # patch). setenv-then-delenv registers teardown state for a key the
+    # snippet WRITES even when it is absent before the test — delenv
+    # alone records nothing for a missing key, so the exec's write would
+    # leak into later tests.
+    for key in ("DMLC_NUM_WORKER", "DMLC_TASK_ID"):
+        monkeypatch.setenv(key, "sentinel")
+        monkeypatch.delenv(key)
     blocks = _python_blocks("parameter.md")
     assert len(blocks) >= 4, "parameter.md lost its worked example"
     ns = {}
