@@ -12,16 +12,22 @@
 #                     attribution (read/parse/convert/dispatch/transfer)
 #   make fuzz         mutation fuzz of every native parse C-ABI entry point
 #                     (crash-safety; DMLC_FUZZ_ITERS to scale)
+#   make lint-retry   grep gate: no time.sleep inside retry-shaped loops
+#                     outside dmlc_tpu/io/resilience.py (ad-hoc retry
+#                     loops must delegate to the shared RetryPolicy)
 
 PYTHON ?= python
 # bash + pipefail so a failing stage is never masked by the tee into CHECK.log
 SHELL := /bin/bash
 .SHELLFLAGS := -o pipefail -c
 
-.PHONY: check test sanitize parse-bench bench-smoke fuzz
+.PHONY: check test sanitize parse-bench bench-smoke fuzz lint-retry
 
 test:
 	$(PYTHON) -m pytest tests/ -q
+
+lint-retry:
+	$(PYTHON) bin/lint_retry.py
 
 fuzz:
 	$(PYTHON) native/test/fuzz_parse.py
@@ -61,6 +67,8 @@ parse-bench:
 
 check:
 	@echo "== make check $$(date -u +%Y-%m-%dT%H:%M:%SZ) ==" | tee CHECK.log
+	@echo "-- lint-retry (ad-hoc retry loop gate) --" | tee -a CHECK.log
+	$(MAKE) --no-print-directory lint-retry 2>&1 | tee -a CHECK.log
 	@echo "-- pytest --" | tee -a CHECK.log
 	$(PYTHON) -m pytest tests/ -q 2>&1 | tee -a CHECK.log
 	@echo "-- sanitizers --" | tee -a CHECK.log

@@ -151,6 +151,7 @@ def into_hbm_mb_per_sec(path: str, size_mb: float, x_dtype: str = "float32"):
     # corpus MB/s headline whose bytes differ from wire bytes
     best = 0.0
     attribution = None  # per-stage table of the best rep (steady state)
+    resilience = None  # retry/resume/restart counters of the best rep
     for _ in range(REPS):
         t0 = time.monotonic()
         parser = create_parser(path, 0, 1, "libsvm", threaded=True,
@@ -194,8 +195,10 @@ def into_hbm_mb_per_sec(path: str, size_mb: float, x_dtype: str = "float32"):
             # stage attribution of the winning rep, with the final drain
             # folded into the transfer stage (the sampled sideband only
             # sees every Nth batch; the drain is the end-of-epoch residue)
+            stats = it.stats()
             attribution = _bench_common().attribution_line(
-                it.stats(), extra_transfer=drain)
+                stats, extra_transfer=drain)
+            resilience = stats.get("resilience")
         it.close()
         log(
             f"bench: into-HBM {nbatches} batches in {dt:.2f}s = "
@@ -207,7 +210,7 @@ def into_hbm_mb_per_sec(path: str, size_mb: float, x_dtype: str = "float32"):
             f"final transfer drain {drain:.3f}s)"
         )
     return (best, _median(rates), (min(rates), max(rates)), attribution,
-            (max(dev_rates), _median(dev_rates)))
+            (max(dev_rates), _median(dev_rates)), resilience)
 
 
 def device_floor_mbps(x_dtype: str = "float32"):
@@ -273,7 +276,7 @@ def run_child() -> None:
     log(f"bench: corpus {size_mb:.1f} MB")
     base_best, base_med = host_only_mb_per_sec(path, size_mb)
     try:
-        value, med, spread, attribution, dev = into_hbm_mb_per_sec(
+        value, med, spread, attribution, dev, resilience = into_hbm_mb_per_sec(
             path, size_mb)
     except Exception as exc:  # noqa: BLE001 - classify for the supervisor
         msg = f"{type(exc).__name__}: {exc}"
@@ -300,6 +303,14 @@ def run_child() -> None:
         line["attribution"] = attribution
         log("bench: ingest stage attribution (best rep):")
         log(_bench_common().attribution_table(attribution))
+    if resilience is not None:
+        # fault-tolerance counters of the best rep (docs/resilience.md):
+        # a clean run emits zeros — nonzero retries/resumes on a healthy
+        # loopback corpus would flag a regression in the I/O stack
+        line["resilience"] = resilience
+        hot = {k: v for k, v in resilience.items() if v}
+        if hot:
+            log(f"bench: resilience events: {hot}")
     # percent-of-line-rate (VERDICT r4 next #2): the BASELINE framing is
     # ">=90% of host->HBM line rate", which vs-parse-baseline does not
     # measure. Join the raw device_put floor for the same shapes/dtype,
@@ -363,7 +374,7 @@ def run_child() -> None:
     # bf16 ingest: the C++ repack emits bfloat16 (the MXU's operand width),
     # halving host->HBM bytes — reported alongside, headline stays f32
     try:
-        bf16_value, bf16_med, _sp, _, bf16_dev = into_hbm_mb_per_sec(
+        bf16_value, bf16_med, _sp, _, bf16_dev, _res = into_hbm_mb_per_sec(
             path, size_mb, x_dtype="bfloat16")
         line["bf16_mb_per_sec"] = round(bf16_value, 2)
         line["bf16_vs_baseline"] = round(bf16_value / base_best, 3)

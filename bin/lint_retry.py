@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""CI gate: no ad-hoc retry loops outside dmlc_tpu/io/resilience.py.
+
+Two fixed-retry/linear-sleep loops (s3_filesys, azure_filesys) drifted
+apart before the unified fault-tolerance layer existed — one retried auth
+failures, the other didn't, and three filesystems had no retry at all.
+``make lint-retry`` keeps that from creeping back: it FAILS on any
+``time.sleep(`` that sits inside a retry-shaped loop — a ``for``/``while``
+whose header-to-sleep region mentions attempt/retry/retries/backoff/trial
+— anywhere under ``dmlc_tpu/`` except ``io/resilience.py`` (the one
+sanctioned backoff implementation). New retry logic must delegate to
+``dmlc_tpu.io.resilience.RetryPolicy``.
+
+Exit status: 0 clean, 1 with offenders listed as ``path:line``.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+from typing import List, Tuple
+
+ALLOWED = {Path("dmlc_tpu") / "io" / "resilience.py"}
+_SLEEP = re.compile(r"\btime\.sleep\s*\(")
+_LOOP = re.compile(r"^\s*(for|while)\b")
+_RETRY_WORDS = re.compile(r"attempt|retry|retries|backoff|trial", re.I)
+_LOOKBACK = 40  # lines searched upward for the enclosing loop header
+
+
+def scan_source(text: str) -> List[Tuple[int, str]]:
+    """Return (1-based line, reason) for each retry-shaped sleep."""
+    lines = text.splitlines()
+    offenders: List[Tuple[int, str]] = []
+    for i, line in enumerate(lines):
+        if not _SLEEP.search(line) or line.lstrip().startswith("#"):
+            continue
+        indent = len(line) - len(line.lstrip())
+        for j in range(i - 1, max(-1, i - _LOOKBACK), -1):
+            prev = lines[j]
+            if not prev.strip() or prev.lstrip().startswith("#"):
+                continue
+            pindent = len(prev) - len(prev.lstrip())
+            if pindent < indent and _LOOP.match(prev):
+                region = "\n".join(lines[j:i + 1])
+                if _RETRY_WORDS.search(region):
+                    offenders.append((
+                        i + 1,
+                        f"time.sleep inside retry-shaped loop "
+                        f"(header at line {j + 1}: {prev.strip()!r})"))
+                break
+            if pindent < indent and re.match(r"\s*(def|class)\b", prev):
+                break  # left the loop scope without finding a loop
+    return offenders
+
+
+def main(argv: List[str]) -> int:
+    root = Path(argv[1]) if len(argv) > 1 else Path(__file__).resolve().parent.parent
+    bad = 0
+    for path in sorted((root / "dmlc_tpu").rglob("*.py")):
+        rel = path.relative_to(root)
+        if rel in ALLOWED:
+            continue
+        for lineno, reason in scan_source(path.read_text(encoding="utf-8")):
+            print(f"{rel}:{lineno}: {reason} — delegate to "
+                  f"dmlc_tpu.io.resilience.RetryPolicy", file=sys.stderr)
+            bad += 1
+    if bad:
+        print(f"lint-retry: {bad} ad-hoc retry sleep(s) found", file=sys.stderr)
+        return 1
+    print("lint-retry: OK (no ad-hoc retry loops outside resilience.py)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

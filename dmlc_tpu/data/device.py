@@ -39,6 +39,7 @@ from dmlc_tpu.data.parsers import Parser
 from dmlc_tpu.data.row_block import (
     CooBlock, DenseBlock, RowBlock, RowBlockContainer,
 )
+from dmlc_tpu.io import resilience as _resilience
 from dmlc_tpu.io.threaded_iter import OrderedWorkerPool, ThreadedIter
 from dmlc_tpu.ops.sparse import (
     EllBatch, block_to_bcoo_host, block_to_dense, block_to_ell,
@@ -456,6 +457,15 @@ class DeviceIter:
         self._last_resume: Optional[dict] = None
         self._drop_rows = 0                # rows to drop after a seek-restore
         self._suppress_before_first = False
+        # ---- fault tolerance (docs/resilience.md) ----
+        # stream-level retries/resumes happen below, in the filesystems; a
+        # retryable error that ESCAPES them (budget exhausted, producer
+        # died) re-arms the whole host pipeline at the last delivered batch
+        # via the checkpoint machinery, bounded by this policy's attempts.
+        self._retry_policy = _resilience.RetryPolicy.from_env()
+        self._res_base = _resilience.counters_snapshot()
+        self.pipeline_restarts = 0
+        self.pipeline_giveups = 0
 
     @property
     def _host_iter(self):
@@ -923,10 +933,45 @@ class DeviceIter:
             return EllBatch(*out)
         return out  # (x, y, w)
 
+    def _maybe_restart_pipeline(self, exc: BaseException) -> bool:
+        """Bounded consumer-side recovery from a retryable pipeline error.
+
+        The host pipeline (pool/ThreadedIter) is poisoned once an error
+        reaches the consumer; instead of failing the epoch, tear it down
+        and re-arm at the batch after the last one DELIVERED, through the
+        same state_dict/load_state machinery checkpoint resume uses —
+        byte-exact seek when the source chain annotates blocks, a
+        deterministic replay otherwise. Returns True when re-armed (caller
+        keeps pulling); False when ``exc`` must propagate (fatal class, or
+        restart budget exhausted).
+        """
+        verdict = _resilience.restart_verdict(
+            self._retry_policy, self.pipeline_restarts, exc)
+        if verdict == "giveup":
+            self.pipeline_giveups += 1
+            return False
+        if verdict != "restart":
+            return False
+        used = self.pipeline_restarts
+        self.pipeline_restarts += 1
+        _resilience.restart_backoff(self._retry_policy, used, exc)
+        try:
+            self.load_state(self.state_dict())
+        except BaseException as nxt:  # noqa: BLE001 - replay hit the fault
+            # the replay consumed more budget-worthy failures: recurse
+            # (bounded by the same attempts counter) until re-armed or out
+            return self._maybe_restart_pipeline(nxt)
+        return True
+
     def _fill(self) -> None:
         producer_put = self.batch_size is None  # natural-block mode put already
         while len(self._inflight) < self.prefetch:
-            item = self._host_iter.next()
+            try:
+                item = self._host_iter.next()
+            except BaseException as exc:  # noqa: BLE001 - classified below
+                if self._maybe_restart_pipeline(exc):
+                    continue
+                raise
             if item is None:
                 return
             if item is _SKIPPED:
@@ -1029,6 +1074,8 @@ class DeviceIter:
         self._suppress_before_first = False
         self._last_resume = None
         self.batches_fed = 0
+        self.pipeline_restarts = 0  # fresh fault budget per epoch
+        self.pipeline_giveups = 0
 
     # -------- checkpoint / resume (SURVEY.md §5.4 addition) --------
 
@@ -1111,10 +1158,18 @@ class DeviceIter:
         overlap). ``transfer`` is a SAMPLED sideband (every
         ``transfer_sample`` batches) — multiply by the sample period for
         a rough whole-stream estimate.
+
+        ``resilience`` sits next to the stage attribution: retry / resume /
+        giveup counters accrued by the I/O stack since this iterator was
+        built (process-wide deltas — see docs/resilience.md), plus this
+        iterator's own bounded pipeline-restart counts.
         """
         wall = 0.0
         if self._t_first is not None and self._t_last is not None:
             wall = max(0.0, self._t_last - self._t_first)
+        resilience = _resilience.counters_delta(self._res_base)
+        resilience["pipeline_restarts"] = self.pipeline_restarts
+        resilience["pipeline_giveups"] = self.pipeline_giveups
         return {
             "batches": self.batches_fed,
             "bytes_to_device": self.bytes_to_device,
@@ -1127,4 +1182,5 @@ class DeviceIter:
             "convert_workers": self.convert_workers,
             "staging_ring": (self._ring.stats() if self._ring is not None
                              else None),
+            "resilience": resilience,
         }

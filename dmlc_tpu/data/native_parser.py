@@ -383,6 +383,7 @@ class NativeFeedParser(NativeStreamParser):
         self.uri = uri
         self.paths = self.sizes = None
         self._feed_thread = None
+        self._feed_exc = None  # original feed-thread exception (cause chain)
 
     def _make_split(self):
         from dmlc_tpu.io.input_split import LineSplitter
@@ -406,7 +407,13 @@ class NativeFeedParser(NativeStreamParser):
                 feeder.finish()
             except Exception as exc:  # noqa: BLE001
                 # a mid-stream remote failure must NOT look like EOF: record
-                # it so the consumer's next() raises after the queue drains
+                # it so the consumer's next() raises after the queue drains.
+                # The C ABI carries only the message string; keep the
+                # exception OBJECT here so next_block can restore the cause
+                # chain (the resilience classifier walks __cause__ — a
+                # retryable stream fault must stay retryable-class for the
+                # DeviceIter pipeline-restart path).
+                self._feed_exc = exc
                 feeder.fail(f"feed failed: {exc}")
             finally:
                 try:
@@ -434,11 +441,34 @@ class NativeFeedParser(NativeStreamParser):
             self._start_feed()
         return self._reader
 
+    def next_block(self):
+        try:
+            return super().next_block()
+        except DMLCError as exc:
+            cause = self._feed_exc
+            if cause is not None and exc.__cause__ is None:
+                # restore the original exception behind the ABI's string:
+                # classification (retryable vs fatal) needs the real class
+                self._feed_exc = None
+                raise exc from cause
+            raise
+
     def before_first(self) -> None:
+        self._feed_exc = None  # cleared BEFORE the new feed thread starts
         if self._reader is not None:
             self._stop_feed()
-            self._reader.before_first()
-            self._start_feed()
+            if self._reader.error() is not None:
+                # errors are STICKY in the native pipeline (before_first
+                # stays stopped) — a failed feeder cannot restart. Rebuild
+                # it so an epoch reset after a fault (e.g. DeviceIter's
+                # bounded pipeline restart) gets a clean stream instead of
+                # replaying the stale error.
+                self._reader.close()
+                self._reader = None
+                self._ensure_reader()  # fresh feeder + feed thread
+            else:
+                self._reader.before_first()
+                self._start_feed()
         self._blocks_out = 0
 
     def close(self) -> None:

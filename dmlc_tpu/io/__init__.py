@@ -8,6 +8,10 @@ from dmlc_tpu.io.uri import URI, URISpec
 from dmlc_tpu.io.filesystem import (
     FileInfo, FileSystem, LocalFileSystem, MemoryFileSystem, get_filesystem,
 )
+from dmlc_tpu.io.resilience import (
+    ResilientStream, RetryPolicy, classify, default_policy,
+)
+from dmlc_tpu.io.faults import FaultPlan, inject, maybe_fail
 from dmlc_tpu.io.stream import open_stream, read_all, write_all
 from dmlc_tpu.io.recordio import (
     RECORDIO_MAGIC, RecordIOWriter, RecordIOReader, RecordIOChunkReader,
@@ -29,6 +33,8 @@ __all__ = [
     "URI", "URISpec", "FileInfo", "FileSystem", "LocalFileSystem",
     "MemoryFileSystem", "get_filesystem", "open_stream", "read_all",
     "write_all",
+    "ResilientStream", "RetryPolicy", "classify", "default_policy",
+    "FaultPlan", "inject", "maybe_fail",
     "RECORDIO_MAGIC", "RecordIOWriter", "RecordIOReader", "RecordIOChunkReader",
     "read_index_file", "write_indexed_recordio",
     "ThreadedIter", "InputSplit", "LineSplitter", "RecordIOSplitter",

@@ -31,7 +31,6 @@ import hashlib
 import hmac
 import io as _pyio
 import os
-import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -43,11 +42,11 @@ from dmlc_tpu.io.filesystem import (
     DIR_TYPE, FILE_TYPE, FileInfo, FileSystem, register_filesystem,
 )
 from dmlc_tpu.io.http_filesys import HttpReadStream
+from dmlc_tpu.io.resilience import RetryPolicy, default_policy
 from dmlc_tpu.io.uri import URI
 from dmlc_tpu.utils.check import DMLCError, check
 
 _API_VERSION = "2021-08-06"
-_RETRIES = 3  # per request, like the reference's 3x-per-part S3 retry
 
 
 class AzureConfig:
@@ -116,36 +115,47 @@ def _request(cfg: AzureConfig, method: str, path: str,
              query: Optional[Dict[str, str]] = None,
              headers: Optional[Dict[str, str]] = None,
              data: Optional[bytes] = None,
+             op: str = "request",
+             policy: Optional[RetryPolicy] = None,
+             retry: bool = True,
              ) -> Tuple[int, bytes, Dict[str, str]]:
-    """One authenticated request with retry. ``path`` is the container/blob
-    path starting with '/'; returns (status, body, response headers).
-    404 returns instead of raising (directory probes need it)."""
+    """One authenticated request under the shared retry policy. ``path`` is
+    the container/blob path starting with '/'; returns (status, body,
+    response headers). 404 returns instead of raising (directory probes
+    need it). Fault classification lives in the shared classifier (this
+    used to hard-code ``code < 500`` — now 429/408 retry too, with any
+    ``Retry-After`` honored as the backoff floor); each attempt re-signs
+    with a fresh ``x-ms-date``. ``retry=False`` runs one raw attempt for
+    the read stream, whose budget lives in ``_fetch_retry``."""
     query = dict(query or {})
-    hdrs = {"x-ms-date": formatdate(usegmt=True),
-            "x-ms-version": _API_VERSION}
-    hdrs.update(headers or {})
-    if data is not None:
-        hdrs["content-length"] = str(len(data))
-        # set the type explicitly (and sign it): urllib would otherwise
-        # inject application/x-www-form-urlencoded AFTER signing
-        hdrs.setdefault("content-type", "application/octet-stream")
-    qpath = urllib.parse.quote(path)
-    if cfg.key:
-        # CanonicalizedResource is built from the path as it appears in the
-        # request line, i.e. the percent-encoded form
-        auth = sign_shared_key(cfg, method, qpath, query, hdrs)
-        hdrs["Authorization"] = auth
-    elif cfg.sas:
-        query.update(urllib.parse.parse_qsl(cfg.sas))
-    qs = urllib.parse.urlencode(sorted(query.items()))
-    url = cfg.endpoint + qpath + (f"?{qs}" if qs else "")
-    last: Optional[str] = None
-    for attempt in range(_RETRIES):
+    pol = policy or default_policy()
+
+    def attempt() -> Tuple[int, bytes, Dict[str, str]]:
+        q = dict(query)
+        hdrs = {"x-ms-date": formatdate(usegmt=True),
+                "x-ms-version": _API_VERSION}
+        hdrs.update(headers or {})
+        if data is not None:
+            hdrs["content-length"] = str(len(data))
+            # set the type explicitly (and sign it): urllib would otherwise
+            # inject application/x-www-form-urlencoded AFTER signing
+            hdrs.setdefault("content-type", "application/octet-stream")
+        qpath = urllib.parse.quote(path)
+        if cfg.key:
+            # CanonicalizedResource is built from the path as it appears in
+            # the request line, i.e. the percent-encoded form
+            hdrs["Authorization"] = sign_shared_key(cfg, method, qpath, q,
+                                                    hdrs)
+        elif cfg.sas:
+            q.update(urllib.parse.parse_qsl(cfg.sas))
+        qs = urllib.parse.urlencode(sorted(q.items()))
+        url = cfg.endpoint + qpath + (f"?{qs}" if qs else "")
         req = urllib.request.Request(url, data=data, method=method.upper())
         for k, v in hdrs.items():
             req.add_header(k, v)
         try:
-            with urllib.request.urlopen(req, timeout=60) as resp:
+            with urllib.request.urlopen(
+                    req, timeout=pol.attempt_timeout) as resp:
                 # lower-case header keys: HTTP headers are case-insensitive
                 # and proxies/emulators emit e.g. content-length — a
                 # case-sensitive lookup would read size 0 and truncate reads
@@ -154,18 +164,20 @@ def _request(cfg: AzureConfig, method: str, path: str,
         except urllib.error.HTTPError as exc:
             if exc.code == 404:
                 return 404, b"", {}
-            body = b""
             try:
                 body = exc.read()
-            except Exception:  # noqa: BLE001
-                pass
-            last = f"HTTP {exc.code}: {body[:200]!r}"
-            if exc.code < 500:  # auth/clients errors don't heal with retry
-                break
-        except urllib.error.URLError as exc:
-            last = f"unreachable: {exc.reason}"
-        time.sleep(0.1 * (attempt + 1))
-    raise DMLCError(f"azure {method} {path} failed: {last}")
+            except Exception:  # noqa: BLE001 - body already gone
+                body = b""
+            if body:
+                # the actionable detail (AuthenticationFailed code, the
+                # server's string-to-sign) lives in the XML body — fold it
+                # into the message so the surfaced DMLCError keeps it
+                exc.msg = f"{exc.msg}: {body[:200]!r}"
+            raise
+
+    if not retry:
+        return attempt()
+    return pol.call(attempt, op=op, what=f"azure:/{path}")
 
 
 def _parse_azure_uri(path: URI) -> Tuple[str, str]:
@@ -185,7 +197,8 @@ class AzureReadStream(HttpReadStream):
     def _fetch(self, start: int, end: int) -> bytes:
         status, body, _ = _request(
             self._cfg, "GET", self._blob_path,
-            headers={"range": f"bytes={start}-{end - 1}"})
+            headers={"range": f"bytes={start}-{end - 1}"},
+            retry=False)  # the stream-level _fetch_retry owns the budget
         check(status in (200, 206), f"azure range GET -> {status}")
         if status == 200:
             # server/proxy ignored the Range header and sent the whole
@@ -226,7 +239,7 @@ class AzureWriteStream(_pyio.RawIOBase):
             f"{len(self._block_ids):08d}".encode("ascii")).decode("ascii")
         status, _, _ = _request(
             self._cfg, "PUT", self._path,
-            query={"comp": "block", "blockid": bid}, data=chunk)
+            query={"comp": "block", "blockid": bid}, data=chunk, op="write")
         check(status in (200, 201), f"azure Put Block -> {status}")
         self._block_ids.append(bid)
 
@@ -238,7 +251,7 @@ class AzureWriteStream(_pyio.RawIOBase):
             # single-shot Put Blob
             status, _, _ = _request(
                 self._cfg, "PUT", self._path, data=bytes(self._buf),
-                headers={"x-ms-blob-type": "BlockBlob"})
+                headers={"x-ms-blob-type": "BlockBlob"}, op="write")
             check(status in (200, 201), f"azure Put Blob -> {status}")
         else:
             if self._buf:
@@ -248,7 +261,7 @@ class AzureWriteStream(_pyio.RawIOBase):
                    + "</BlockList>").encode("utf-8")
             status, _, _ = _request(
                 self._cfg, "PUT", self._path, query={"comp": "blocklist"},
-                data=xml)
+                data=xml, op="write")
             check(status in (200, 201), f"azure Put Block List -> {status}")
         self._buf = bytearray()
         super().close()
@@ -257,6 +270,8 @@ class AzureWriteStream(_pyio.RawIOBase):
 class AzureFileSystem(FileSystem):
     """Blob-service FileSystem (full surface; the reference stubs all but
     ListDirectory, azure_filesys.h:22-31)."""
+
+    native_resilience = True  # AzureReadStream resumes via _fetch_retry
 
     def __init__(self, cfg: AzureConfig):
         self.cfg = cfg
@@ -277,7 +292,7 @@ class AzureFileSystem(FileSystem):
             if marker:
                 query["marker"] = marker
             status, body, _ = _request(self.cfg, "GET", f"/{container}",
-                                       query=query)
+                                       query=query, op="open")
             check(status == 200, f"azure List Blobs -> {status}")
             root = ET.fromstring(body)
             blobs = root.find("Blobs")
@@ -297,7 +312,7 @@ class AzureFileSystem(FileSystem):
     def get_path_info(self, path: URI) -> FileInfo:
         container, key = _parse_azure_uri(path)
         status, _, headers = _request(self.cfg, "HEAD",
-                                      f"/{container}/{key}")
+                                      f"/{container}/{key}", op="open")
         if status == 200:
             return FileInfo(path, int(headers.get("content-length", 0)),
                             FILE_TYPE)

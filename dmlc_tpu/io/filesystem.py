@@ -39,6 +39,12 @@ class FileInfo:
 class FileSystem:
     """Abstract filesystem — analog of dmlc::io::FileSystem (io.h:582)."""
 
+    # True for filesystems whose read streams already retry + resume at the
+    # current byte offset internally (the remote range-GET clients).
+    # open_stream(resilient=True) skips its ResilientStream wrapper for
+    # these — stacking a second budget on top would multiply retries.
+    native_resilience = False
+
     def get_path_info(self, path: URI) -> FileInfo:
         raise NotImplementedError
 

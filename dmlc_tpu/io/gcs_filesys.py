@@ -33,6 +33,7 @@ from dmlc_tpu.io.filesystem import (
     DIR_TYPE, FILE_TYPE, FileInfo, FileSystem, register_filesystem,
 )
 from dmlc_tpu.io.http_filesys import HttpReadStream
+from dmlc_tpu.io.resilience import RetryPolicy, default_policy
 from dmlc_tpu.io.uri import URI
 from dmlc_tpu.utils.check import DMLCError, check
 
@@ -108,19 +109,21 @@ class GcsReadStream(HttpReadStream):
         super().__init__(cfg.media_url(bucket, key), size=size)
 
     def _fetch(self, start: int, end: int) -> bytes:
+        """One attempt, raw errors (retry/resume live in the inherited
+        ``_fetch_retry``); the bearer token is re-read per attempt so a
+        metadata-server rotation heals mid-stream."""
         headers = {"Range": f"bytes={start}-{end - 1}"}
         headers.update(self._cfg.headers())
         req = urllib.request.Request(self.url, headers=headers)
         try:
-            with urllib.request.urlopen(req, timeout=60) as resp:
+            with urllib.request.urlopen(
+                    req, timeout=self._policy.attempt_timeout) as resp:
                 body = resp.read()
                 return body if resp.status == 206 else body[start:end]
         except urllib.error.HTTPError as exc:
             if exc.code == 416:
                 return b""
-            raise DMLCError(f"gcs read failed: {self.url}: {exc}") from exc
-        except urllib.error.URLError as exc:
-            raise DMLCError(f"gcs read failed: {self.url}: {exc}") from exc
+            raise
 
 
 class GcsWriteStream(_pyio.RawIOBase):
@@ -146,23 +149,29 @@ class GcsWriteStream(_pyio.RawIOBase):
             return
         self._done = True
         url = self._cfg.upload_url(self._bucket, self._key)
-        headers = {"Content-Type": "application/octet-stream"}
-        headers.update(self._cfg.headers())
-        req = urllib.request.Request(
-            url, data=bytes(self._buf), method="POST", headers=headers)
-        try:
-            with urllib.request.urlopen(req, timeout=300) as resp:
+        policy = default_policy()
+
+        def attempt() -> None:
+            # a media upload is a single idempotent PUT-equivalent: safe to
+            # re-POST the whole buffer on a transient failure
+            headers = {"Content-Type": "application/octet-stream"}
+            headers.update(self._cfg.headers())
+            req = urllib.request.Request(
+                url, data=bytes(self._buf), method="POST", headers=headers)
+            with urllib.request.urlopen(
+                    req, timeout=max(policy.attempt_timeout, 300)) as resp:
                 check(resp.status in (200, 201),
                       f"gcs upload failed: {resp.status}")
-        except urllib.error.URLError as exc:
-            raise DMLCError(
-                f"gcs upload failed: {self._bucket}/{self._key}: {exc}"
-            ) from exc
+
+        policy.call(attempt, op="write",
+                    what=f"gs://{self._bucket}/{self._key}")
         super().close()
 
 
 class GcsFileSystem(FileSystem):
     """gs:// FileSystem over the JSON API."""
+
+    native_resilience = True  # GcsReadStream resumes via _fetch_retry
 
     _instance: Optional["GcsFileSystem"] = None
 
@@ -179,14 +188,21 @@ class GcsFileSystem(FileSystem):
 
     def _get_json(self, url: str,
                   cfg: Optional[GcsConfig] = None) -> Tuple[int, dict]:
-        req = urllib.request.Request(url, headers=(cfg or self.cfg).headers())
-        try:
-            with urllib.request.urlopen(req, timeout=60) as resp:
-                return resp.status, json.loads(resp.read() or b"{}")
-        except urllib.error.HTTPError as exc:
-            return exc.code, {}
-        except urllib.error.URLError as exc:
-            raise DMLCError(f"gcs request failed: {url}: {exc}") from exc
+        policy = default_policy()
+
+        def attempt() -> Tuple[int, dict]:
+            req = urllib.request.Request(
+                url, headers=(cfg or self.cfg).headers())
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=policy.attempt_timeout) as resp:
+                    return resp.status, json.loads(resp.read() or b"{}")
+            except urllib.error.HTTPError as exc:
+                if exc.code == 429 or exc.code >= 500:
+                    raise  # transient: let the shared policy retry it
+                return exc.code, {}  # deterministic status: callers branch
+
+        return policy.call(attempt, op="open", what=url)
 
     def get_path_info(self, path: URI,
                       cfg: Optional[GcsConfig] = None) -> FileInfo:

@@ -38,6 +38,7 @@ from dmlc_tpu.io.filesystem import (
     DIR_TYPE, FILE_TYPE, FileInfo, FileSystem, register_filesystem,
 )
 from dmlc_tpu.io.http_filesys import HttpReadStream
+from dmlc_tpu.io.resilience import RetryPolicy, default_policy
 from dmlc_tpu.io.uri import URI
 from dmlc_tpu.utils.check import DMLCError, check
 
@@ -73,20 +74,34 @@ class HdfsConfig:
 
 
 def _request(url: str, method: str = "GET", data: Optional[bytes] = None,
-             timeout: int = 60):
-    req = urllib.request.Request(url, data=data, method=method)
-    try:
-        return urllib.request.urlopen(req, timeout=timeout)
-    except urllib.error.HTTPError as exc:
-        # webhdfs errors carry a RemoteException JSON body
+             op: str = "request",
+             policy: Optional[RetryPolicy] = None, retry: bool = True):
+    """One WebHDFS request under the shared retry policy; returns the live
+    response. Transient statuses raise raw for the classifier; deterministic
+    failures surface the namenode's RemoteException message in one attempt.
+    ``retry=False`` runs a single attempt (the read stream's budget lives
+    in the inherited ``_fetch_retry``)."""
+    pol = policy or default_policy()
+
+    def attempt():
+        req = urllib.request.Request(url, data=data, method=method)
         try:
-            detail = json.loads(exc.read()).get("RemoteException", {})
-            msg = detail.get("message", str(exc))
-        except Exception:  # noqa: BLE001 - non-JSON error body
-            msg = str(exc)
-        raise DMLCError(f"webhdfs {method} failed ({exc.code}): {msg}") from exc
-    except urllib.error.URLError as exc:
-        raise DMLCError(f"webhdfs unreachable: {exc.reason}") from exc
+            return urllib.request.urlopen(req, timeout=pol.attempt_timeout)
+        except urllib.error.HTTPError as exc:
+            if exc.code in (408, 429) or exc.code >= 500:
+                raise  # transient: retried (or resumed) by the caller
+            # webhdfs errors carry a RemoteException JSON body
+            try:
+                detail = json.loads(exc.read()).get("RemoteException", {})
+                msg = detail.get("message", str(exc))
+            except Exception:  # noqa: BLE001 - non-JSON error body
+                msg = str(exc)
+            raise DMLCError(
+                f"webhdfs {method} failed ({exc.code}): {msg}") from exc
+
+    if not retry:
+        return attempt()
+    return pol.call(attempt, op=op, what=url)
 
 
 class HdfsReadStream(HttpReadStream):
@@ -101,7 +116,8 @@ class HdfsReadStream(HttpReadStream):
     def _fetch(self, start: int, end: int) -> bytes:
         url = self._cfg.url(self._path, "OPEN", offset=str(start),
                             length=str(end - start))
-        with _request(url) as resp:
+        # single raw attempt: the inherited _fetch_retry owns the budget
+        with _request(url, retry=False) as resp:
             return resp.read()
 
 
@@ -129,7 +145,7 @@ class HdfsWriteStream(_pyio.RawIOBase):
         self._closed = True
         url = self._cfg.url(self._path, "CREATE",
                             overwrite=self._overwrite, noredirect="true")
-        with _request(url, method="PUT") as resp:
+        with _request(url, method="PUT", op="write") as resp:
             body = resp.read()
             location = resp.headers.get("Location")
         if not location and body:
@@ -139,7 +155,8 @@ class HdfsWriteStream(_pyio.RawIOBase):
                 location = None
         check(location is not None,
               "webhdfs CREATE returned no datanode location")
-        with _request(location, method="PUT", data=bytes(self._buf)):
+        with _request(location, method="PUT", data=bytes(self._buf),
+                      op="write"):
             pass
         self._buf = bytearray()
         super().close()
@@ -155,6 +172,8 @@ class HdfsFileSystem(FileSystem):
     """WebHDFS-backed FileSystem (capability parity with
     src/io/hdfs_filesys.cc, minus the JVM)."""
 
+    native_resilience = True  # HdfsReadStream resumes via _fetch_retry
+
     def __init__(self, cfg: HdfsConfig):
         self.cfg = cfg
 
@@ -164,13 +183,13 @@ class HdfsFileSystem(FileSystem):
 
     def get_path_info(self, path: URI) -> FileInfo:
         url = self.cfg.url(path.name, "GETFILESTATUS")
-        with _request(url) as resp:
+        with _request(url, op="open") as resp:
             st = json.loads(resp.read())["FileStatus"]
         return _info_from_status(path, "", st)
 
     def list_directory(self, path: URI) -> List[FileInfo]:
         url = self.cfg.url(path.name, "LISTSTATUS")
-        with _request(url) as resp:
+        with _request(url, op="open") as resp:
             statuses = json.loads(resp.read())["FileStatuses"]["FileStatus"]
         return [_info_from_status(path, st.get("pathSuffix", ""), st)
                 for st in statuses]

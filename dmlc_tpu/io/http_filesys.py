@@ -5,6 +5,15 @@ S3 module (s3_filesys.cc CURLReadStreamBase: ``Range: bytes=N-`` GETs,
 restart-on-seek, s3_filesys.cc:498-701) — rebuilt on urllib with a buffered
 block reader instead of a curl multi loop.
 
+Every block fetch runs under the shared :class:`RetryPolicy`
+(:mod:`dmlc_tpu.io.resilience`): transient faults (5xx/429, connection
+reset, timeout) are retried with jittered backoff — honoring a 429's
+``Retry-After`` as the backoff floor — and a mid-read failure refetches at
+the CURRENT byte offset, so the consumer resumes mid-file instead of
+restarting the epoch. Fatal classes (4xx auth, malformed URI) surface in
+one attempt. The subclassed cloud streams (s3/gcs/azure/hdfs) inherit all
+of this through ``_fetch_retry``.
+
 Cloud filesystems (gs/s3/hdfs/azure) register their protocol slots here so
 `get_filesystem` gives actionable errors; their signed-auth clients are
 deliberately deferred (a zero-egress build environment cannot exercise them) — the
@@ -22,6 +31,7 @@ from typing import List, Optional
 from dmlc_tpu.io.filesystem import (
     FILE_TYPE, FileInfo, FileSystem, register_filesystem,
 )
+from dmlc_tpu.io.resilience import RetryPolicy, default_policy
 from dmlc_tpu.io.uri import URI
 from dmlc_tpu.utils.check import DMLCError
 
@@ -31,11 +41,14 @@ _BLOCK = 1 << 20  # read-ahead granularity
 class HttpReadStream(_pyio.RawIOBase):
     """Seekable read-only stream over HTTP Range requests."""
 
-    def __init__(self, url: str, size: Optional[int] = None):
+    def __init__(self, url: str, size: Optional[int] = None,
+                 policy: Optional[RetryPolicy] = None):
         super().__init__()
         self.url = url
+        self._policy = policy or default_policy()
         self._pos = 0
-        self._size = size if size is not None else _content_length(url)
+        self._size = (size if size is not None
+                      else _content_length(url, self._policy))
         self._buf = b""
         self._buf_start = 0
 
@@ -58,10 +71,13 @@ class HttpReadStream(_pyio.RawIOBase):
         return self._pos
 
     def _fetch(self, start: int, end: int) -> bytes:
+        """One block attempt. Raises RAW transport errors (except 416 =
+        EOF) — classification and retry live in :meth:`_fetch_retry`."""
         req = urllib.request.Request(
             self.url, headers={"Range": f"bytes={start}-{end - 1}"})
         try:
-            with urllib.request.urlopen(req, timeout=60) as resp:
+            with urllib.request.urlopen(
+                    req, timeout=self._policy.attempt_timeout) as resp:
                 body = resp.read()
                 if resp.status == 206:
                     return body
@@ -74,9 +90,16 @@ class HttpReadStream(_pyio.RawIOBase):
         except urllib.error.HTTPError as exc:
             if exc.code == 416:  # requested range not satisfiable = EOF
                 return b""
-            raise DMLCError(f"http read failed: {self.url}: {exc}") from exc
-        except urllib.error.URLError as exc:
-            raise DMLCError(f"http read failed: {self.url}: {exc}") from exc
+            raise
+
+    def _fetch_retry(self, start: int, end: int) -> bytes:
+        """Fetch a block under the shared retry budget. A retried fetch at
+        ``start > 0`` is a mid-stream RESUME: the refetch re-requests the
+        same byte range, so the consumer's position is exact — the Range
+        machinery is the reopen-at-offset path."""
+        return self._policy.call(
+            lambda: self._fetch(start, end),
+            op="read", what=self.url, resume_offset=start)
 
     def readinto(self, b) -> int:
         # BufferedReader drives RawIOBase through readinto
@@ -101,7 +124,7 @@ class HttpReadStream(_pyio.RawIOBase):
             # refill read-ahead block at current position
             start = self._pos
             end = min(start + max(_BLOCK, n), self._size)
-            fetched = self._fetch(start, end)
+            fetched = self._fetch_retry(start, end)
             if not fetched:
                 break
             # on 200-servers _fetch installed the full body as the buffer;
@@ -112,21 +135,26 @@ class HttpReadStream(_pyio.RawIOBase):
         return bytes(out)
 
 
-def _content_length(url: str) -> int:
-    req = urllib.request.Request(url, method="HEAD")
-    try:
-        with urllib.request.urlopen(req, timeout=60) as resp:
+def _content_length(url: str, policy: Optional[RetryPolicy] = None) -> int:
+    policy = policy or default_policy()
+
+    def attempt() -> int:
+        req = urllib.request.Request(url, method="HEAD")
+        with urllib.request.urlopen(
+                req, timeout=policy.attempt_timeout) as resp:
             length = resp.headers.get("Content-Length")
             if length is None:
                 raise DMLCError(f"http: no Content-Length for {url}")
             return int(length)
-    except urllib.error.URLError as exc:
-        raise DMLCError(f"http HEAD failed: {url}: {exc}") from exc
+
+    return policy.call(attempt, op="open", what=url)
 
 
 class HttpFileSystem(FileSystem):
     """Read-only http/https file access; no listing (like the reference's
     http support: read streams only)."""
+
+    native_resilience = True  # HttpReadStream resumes at the failed offset
 
     _instance: Optional["HttpFileSystem"] = None
 

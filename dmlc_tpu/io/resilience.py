@@ -1,0 +1,420 @@
+"""Unified fault-tolerance layer for the I/O stack.
+
+The reference dmlc-core hard-codes a 3x-per-part retry in its S3 writer
+(s3_filesys.cc:789) and nothing else; this rebuild inherited that unevenly
+(two ad-hoc fixed-retry loops, three filesystems with none), so one
+transient 5xx mid-epoch killed the whole ``DeviceIter`` pipeline. Input
+fault tolerance is a first-class property of a data plane that serves long
+TPU runs (tf.data service, arXiv:2210.14826), so it lives HERE, once:
+
+- :func:`classify` — the single error classifier: transient faults
+  (5xx/429/408, connection reset, timeout, DNS/unreachable) are
+  ``retryable``; everything else (4xx auth, malformed URI, logic errors)
+  is ``fatal`` and must surface in one attempt. Walks ``__cause__`` so a
+  wrapped DMLCError keeps its cause's class.
+- :class:`RetryPolicy` — exponential backoff with FULL jitter (seedable),
+  per-attempt timeout, overall deadline, and an ``Retry-After`` floor.
+  Every retry loop in the package delegates here; ``make lint-retry``
+  fails ad-hoc ``time.sleep``-in-retry-loop patterns anywhere else.
+- :class:`ResilientStream` — resumable reads over any reopenable seekable
+  stream: a mid-read transient fault reopens the source and resumes at
+  the current byte offset (the Range/seek machinery the remote streams
+  already have), consuming retry budget instead of failing the epoch.
+- module counters (:func:`counters_snapshot`) — retry / resume / giveup
+  totals, surfaced by ``DeviceIter.stats()['resilience']`` next to the
+  stage attribution and emitted by ``bench.py``.
+
+Deterministic fault injection for all of this lives in
+:mod:`dmlc_tpu.io.faults`; every guarded attempt calls
+``faults.maybe_fail`` so tier-1 tests exercise each retry/resume/give-up
+path without a network. See docs/resilience.md.
+"""
+
+from __future__ import annotations
+
+import http.client
+import io as _pyio
+import os
+import random
+import threading
+import time
+import urllib.error
+from typing import Callable, Dict, Optional
+
+from dmlc_tpu.io import faults
+from dmlc_tpu.utils.check import DMLCError
+
+RETRYABLE = "retryable"
+FATAL = "fatal"
+
+# HTTP statuses that heal with retry: server-side faults, throttling, and
+# request timeout. Everything else 4xx (auth, malformed request, not found)
+# is deterministic — retrying it only burns budget and hides the bug.
+_RETRYABLE_HTTP = frozenset({408, 429, 500, 502, 503, 504})
+
+
+def classify(exc: BaseException) -> str:
+    """``retryable`` or ``fatal`` for an I/O-stack exception.
+
+    Follows the ``__cause__`` chain so a ``DMLCError`` raised ``from`` a
+    transient urllib error stays retryable through wrapper layers (the
+    stream-level giveup wraps, the pipeline level still wants the class).
+    """
+    import ssl
+
+    seen = 0
+    while exc is not None and seen < 8:
+        # HTTPError subclasses URLError and OSError: check it first
+        if isinstance(exc, urllib.error.HTTPError):
+            return (RETRYABLE if exc.code in _RETRYABLE_HTTP
+                    or exc.code >= 500 else FATAL)
+        if isinstance(exc, urllib.error.URLError):
+            # urllib wraps transport failures as URLError(reason) where
+            # reason is usually an OSError — gaierror for DNS, EHOSTUNREACH
+            # / ECONNREFUSED for routing. All transient at this layer; the
+            # one deterministic member is a certificate-verification
+            # failure (retrying it only re-fails the handshake).
+            if isinstance(exc.reason, ssl.SSLCertVerificationError):
+                return FATAL
+            return RETRYABLE
+        if isinstance(exc, (ConnectionError, TimeoutError)):
+            return RETRYABLE  # reset/aborted/refused, socket.timeout
+        if isinstance(exc, http.client.HTTPException):
+            return RETRYABLE  # IncompleteRead, BadStatusLine, ...
+        if isinstance(exc, (DMLCError, OSError)) and exc.__cause__ is not None:
+            exc = exc.__cause__
+            seen += 1
+            continue
+        return FATAL
+    return FATAL
+
+
+def retry_after_seconds(exc: BaseException) -> float:
+    """Backoff floor from a ``Retry-After`` response header, if any.
+
+    Honors the delta-seconds form (the common throttling shape); an
+    HTTP-date or garbage value is ignored rather than parsed — the jittered
+    backoff still applies, the floor is just 0.
+    """
+    seen = 0
+    while exc is not None and seen < 8:
+        headers = getattr(exc, "headers", None)
+        if headers is not None:
+            try:
+                value = headers.get("Retry-After")
+            except AttributeError:
+                value = None
+            if value is not None:
+                try:
+                    return max(0.0, float(value))
+                except (TypeError, ValueError):
+                    return 0.0
+        exc = exc.__cause__
+        seen += 1
+    return 0.0
+
+
+# ---------------- counters ----------------
+
+class _Counters:
+    """Process-wide resilience event counters (thread-safe).
+
+    ``attempts``  guarded attempts issued
+    ``retries``   failed attempts that were retried
+    ``resumes``   of those, mid-stream reopen-at-offset events
+    ``giveups``   operations abandoned with retry budget exhausted
+    ``fatal``     operations failed on a non-retryable class (one attempt)
+    ``producer_restarts`` / ``producer_giveups``
+                  bounded producer restarts in ThreadedIter/OrderedWorkerPool
+    """
+
+    _KEYS = ("attempts", "retries", "resumes", "giveups", "fatal",
+             "producer_restarts", "producer_giveups")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._n: Dict[str, int] = {k: 0 for k in self._KEYS}
+
+    def bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._n[key] = self._n.get(key, 0) + n
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._n)
+
+    def delta(self, base: Dict[str, int]) -> Dict[str, int]:
+        now = self.snapshot()
+        return {k: now.get(k, 0) - base.get(k, 0) for k in now}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._n = {k: 0 for k in self._KEYS}
+
+
+COUNTERS = _Counters()
+
+
+def counters_snapshot() -> Dict[str, int]:
+    return COUNTERS.snapshot()
+
+
+def counters_delta(base: Dict[str, int]) -> Dict[str, int]:
+    return COUNTERS.delta(base)
+
+
+def reset_counters() -> None:
+    COUNTERS.reset()
+
+
+# ---------------- retry policy ----------------
+
+class RetryPolicy:
+    """Exponential backoff + full jitter, per-attempt timeout, deadline.
+
+    One instance describes the budget for ONE logical operation (a request,
+    a block fetch): ``max_attempts`` total tries, sleeping
+    ``uniform(0, min(max_delay, base_delay * 2**retry))`` between them
+    (full jitter — herd-safe), never less than a server-sent
+    ``Retry-After``. ``deadline`` bounds the whole operation including
+    sleeps; ``attempt_timeout`` is what callers should pass to their
+    transport (urlopen timeout=).
+
+    Env knobs (read by :func:`from_env` / :func:`default_policy`):
+
+    ======================================  =======  ========================
+    ``DMLC_RETRY_MAX_ATTEMPTS``             4        total attempts per op
+    ``DMLC_RETRY_BASE_MS``                  50       first backoff cap (ms)
+    ``DMLC_RETRY_MAX_MS``                   5000     backoff cap ceiling (ms)
+    ``DMLC_RETRY_DEADLINE_S``               0 (off)  per-op wall deadline
+    ``DMLC_RETRY_ATTEMPT_TIMEOUT_S``        60       transport timeout
+    ``DMLC_RETRY_SEED``                     unset    seed the jitter rng
+    ======================================  =======  ========================
+    """
+
+    def __init__(
+        self,
+        max_attempts: int = 4,
+        base_delay: float = 0.05,
+        max_delay: float = 5.0,
+        deadline: Optional[float] = None,
+        attempt_timeout: float = 60.0,
+        seed: Optional[int] = None,
+        sleep_fn: Optional[Callable[[float], None]] = None,
+    ):
+        self.max_attempts = max(1, int(max_attempts))
+        self.base_delay = max(0.0, float(base_delay))
+        self.max_delay = max(self.base_delay, float(max_delay))
+        self.deadline = float(deadline) if deadline else None
+        self.attempt_timeout = float(attempt_timeout)
+        self._rng = random.Random(seed)
+        self._sleep = sleep_fn or time.sleep
+
+    @classmethod
+    def from_env(cls) -> "RetryPolicy":
+        env = os.environ
+        seed = env.get("DMLC_RETRY_SEED")
+        return cls(
+            max_attempts=int(env.get("DMLC_RETRY_MAX_ATTEMPTS", "4") or 4),
+            base_delay=float(env.get("DMLC_RETRY_BASE_MS", "50") or 50) / 1e3,
+            max_delay=float(env.get("DMLC_RETRY_MAX_MS", "5000") or 5000) / 1e3,
+            deadline=float(env.get("DMLC_RETRY_DEADLINE_S", "0") or 0) or None,
+            attempt_timeout=float(
+                env.get("DMLC_RETRY_ATTEMPT_TIMEOUT_S", "60") or 60),
+            seed=int(seed) if seed else None,
+        )
+
+    @classmethod
+    def none(cls) -> "RetryPolicy":
+        """Single attempt, no sleeps — for inner layers whose caller owns
+        the retry loop (stacked policies would multiply budgets)."""
+        return cls(max_attempts=1)
+
+    def backoff(self, retry_index: int, floor: float = 0.0) -> float:
+        """Sleep for the (retry_index+1)-th retry: full-jitter exponential,
+        floored by a server-sent Retry-After. The honored floor is capped
+        at ``max(30s, max_delay)`` — a misbehaving server advertising
+        ``Retry-After: 86400`` must not wedge a reader thread for a day."""
+        floor = min(floor, max(30.0, self.max_delay))
+        cap = min(self.max_delay, self.base_delay * (2 ** retry_index))
+        return max(floor, self._rng.uniform(0.0, cap))
+
+    def sleep(self, seconds: float) -> None:
+        if seconds > 0:
+            self._sleep(seconds)
+
+    def call(
+        self,
+        fn: Callable[[], object],
+        *,
+        op: str = "request",
+        what: str = "",
+        resume_offset: int = 0,
+        on_retry: Optional[Callable[[], None]] = None,
+    ):
+        """Run ``fn`` under this budget.
+
+        Each attempt first passes through the fault-injection seam
+        (``faults.maybe_fail`` for the generic ``connect`` op and for
+        ``op``), so injected faults flow down the same classify/backoff
+        paths as real ones. Fatal-class errors surface immediately (one
+        attempt); retryable ones sleep and retry until the budget or
+        deadline runs out, then raise a ``DMLCError`` chained to the last
+        cause. ``resume_offset > 0`` marks retries as mid-stream resumes
+        in the counters; ``on_retry`` runs before each re-attempt (e.g.
+        drop a broken inner stream).
+        """
+        t0 = time.monotonic()
+        retries = 0
+        while True:
+            COUNTERS.bump("attempts")
+            try:
+                faults.maybe_fail("connect", what)
+                faults.maybe_fail(op, what)
+                return fn()
+            except (KeyboardInterrupt, SystemExit, GeneratorExit):
+                raise  # control-flow exceptions must never be rewrapped
+            except BaseException as exc:  # noqa: BLE001 - classified below
+                if classify(exc) != RETRYABLE:
+                    COUNTERS.bump("fatal")
+                    if isinstance(exc, DMLCError):
+                        raise
+                    raise DMLCError(
+                        f"{op} {what} failed (non-retryable): {exc}") from exc
+                delay = self.backoff(retries, floor=retry_after_seconds(exc))
+                out_of_budget = retries + 1 >= self.max_attempts
+                past_deadline = (
+                    self.deadline is not None
+                    and time.monotonic() - t0 + delay > self.deadline)
+                if out_of_budget or past_deadline:
+                    COUNTERS.bump("giveups")
+                    why = ("deadline exceeded" if past_deadline
+                           else f"retry budget exhausted "
+                                f"({self.max_attempts} attempts)")
+                    raise DMLCError(
+                        f"{op} {what} failed, {why}: {exc}") from exc
+                retries += 1
+                COUNTERS.bump("retries")
+                if resume_offset > 0:
+                    COUNTERS.bump("resumes")
+                self.sleep(delay)
+                if on_retry is not None:
+                    on_retry()
+
+
+def default_policy() -> RetryPolicy:
+    """The env-configured policy (fresh read: knobs may change per test)."""
+    return RetryPolicy.from_env()
+
+
+def restart_verdict(policy: Optional[RetryPolicy], used: int,
+                    exc: BaseException) -> str:
+    """Shared gate for bounded producer/source/pipeline restarts.
+
+    ``'restart'``   retryable class, budget left — consume one unit
+    ``'giveup'``    retryable class, budget (``max_attempts - 1``) spent
+    ``'propagate'`` fatal class or restarts disabled (``policy is None``)
+
+    The caller owns its instance counters and the repositioning; pair a
+    ``'restart'`` with :func:`restart_backoff` before re-arming.
+    """
+    if policy is None or classify(exc) != RETRYABLE:
+        return "propagate"
+    if used >= max(0, policy.max_attempts - 1):
+        return "giveup"
+    return "restart"
+
+
+def restart_backoff(policy: RetryPolicy, used: int,
+                    exc: BaseException) -> None:
+    """Sleep the backoff for the (used+1)-th restart, honoring any
+    Retry-After the triggering error carried."""
+    policy.sleep(policy.backoff(used, floor=retry_after_seconds(exc)))
+
+
+NO_RETRY = RetryPolicy.none()
+
+
+# ---------------- resumable stream wrapper ----------------
+
+class ResilientStream(_pyio.RawIOBase):
+    """Resumable read-only stream over a reopenable source.
+
+    ``open_fn()`` returns a fresh readable (and seekable, for mid-stream
+    resume) binary stream. On a retryable mid-read failure the broken
+    inner stream is dropped, a new one is opened and SEEKED to the current
+    byte offset, and the read resumes — the consumer sees an unbroken byte
+    sequence. Fatal errors and exhausted budgets surface as ``DMLCError``.
+
+    The five remote filesystems implement the same contract natively (their
+    range-GET machinery refetches at the failed offset, see
+    ``HttpReadStream._fetch_retry``); this wrapper extends it to any other
+    stream — local files on flaky network mounts, third-party filesystems
+    registered via ``register_filesystem`` — through
+    ``open_stream(uri, resilient=True)``.
+    """
+
+    def __init__(self, open_fn: Callable[[], object],
+                 policy: Optional[RetryPolicy] = None, what: str = ""):
+        super().__init__()
+        self._open_fn = open_fn
+        self._policy = policy or default_policy()
+        self._what = what
+        self._inner = None
+        self._pos = 0
+        self.reopens = 0  # resume events on THIS stream
+
+    def readable(self) -> bool:
+        return True
+
+    def seekable(self) -> bool:
+        return True
+
+    def _ensure(self):
+        if self._inner is None:
+            self._inner = self._open_fn()
+            if self._pos:
+                self._inner.seek(self._pos)
+                self.reopens += 1
+        return self._inner
+
+    def _drop_inner(self) -> None:
+        inner, self._inner = self._inner, None
+        if inner is not None:
+            try:
+                inner.close()
+            except Exception:  # noqa: BLE001 - already broken
+                pass
+
+    def seek(self, offset: int, whence: int = 0) -> int:
+        def attempt():
+            inner = self._ensure()
+            return inner.seek(offset, whence)
+
+        self._pos = self._policy.call(
+            attempt, op="read", what=self._what,
+            resume_offset=self._pos, on_retry=self._drop_inner)
+        return self._pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def read(self, n: int = -1) -> bytes:
+        def attempt():
+            return self._ensure().read(n)
+
+        data = self._policy.call(
+            attempt, op="read", what=self._what,
+            resume_offset=self._pos, on_retry=self._drop_inner)
+        if data:
+            self._pos += len(data)
+        return data
+
+    def readinto(self, b) -> int:
+        data = self.read(len(b))
+        b[: len(data)] = data
+        return len(data)
+
+    def close(self) -> None:
+        self._drop_inner()
+        super().close()

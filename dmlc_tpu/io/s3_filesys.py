@@ -26,7 +26,6 @@ import hashlib
 import hmac
 import io as _pyio
 import os
-import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -37,6 +36,7 @@ from dmlc_tpu.io.filesystem import (
     DIR_TYPE, FILE_TYPE, FileInfo, FileSystem, register_filesystem,
 )
 from dmlc_tpu.io.http_filesys import HttpReadStream
+from dmlc_tpu.io.resilience import RetryPolicy, default_policy
 from dmlc_tpu.io.uri import URI
 from dmlc_tpu.utils.check import DMLCError, check
 
@@ -193,10 +193,19 @@ def _request(
     query: Optional[Dict[str, str]] = None,
     headers: Optional[Dict[str, str]] = None,
     body: bytes = b"",
-    retries: int = 3,
+    op: str = "request",
+    policy: Optional[RetryPolicy] = None,
+    retry: bool = True,
 ) -> Tuple[int, bytes, Dict[str, str]]:
-    """One signed S3 request with retry (reference retries 3x per part,
-    s3_filesys.cc:789)."""
+    """One signed S3 request under the shared retry policy.
+
+    The reference retries 3x per part uniformly (s3_filesys.cc:789) —
+    auth failures included; here the shared classifier separates transient
+    faults (retried with jittered backoff, re-signed each attempt so the
+    x-amz-date stays fresh) from fatal ones (surfaced in one attempt).
+    ``retry=False`` runs a single raw attempt for callers that own the
+    retry loop (the read stream: its budget lives in ``_fetch_retry``).
+    """
     cfg.require_keys()
     query = dict(query or {})
     url, host, path = cfg.url_for(bucket, key)
@@ -206,8 +215,9 @@ def _request(
             f"{_uri_encode(k)}={_uri_encode(str(v))}"
             for k, v in sorted(query.items()))
     payload_hash = hashlib.sha256(body).hexdigest() if body else _EMPTY_SHA256
-    last_exc: Optional[Exception] = None
-    for attempt in range(retries):
+    pol = policy or default_policy()
+
+    def attempt() -> Tuple[int, bytes, Dict[str, str]]:
         hdrs = sign_v4(
             method, host, path, query, dict(headers or {}), payload_hash,
             cfg.access_key, cfg.secret_key, cfg.region,
@@ -216,7 +226,8 @@ def _request(
         req = urllib.request.Request(url, data=body or None, method=method,
                                      headers=hdrs)
         try:
-            with urllib.request.urlopen(req, timeout=60) as resp:
+            with urllib.request.urlopen(
+                    req, timeout=pol.attempt_timeout) as resp:
                 # lower-cased keys: HTTP headers are case-insensitive and a
                 # proxy/emulator emitting content-length must not read as
                 # size 0 (same normalization as azure_filesys._request)
@@ -224,13 +235,14 @@ def _request(
                     k.lower(): v for k, v in resp.headers.items()}
         except urllib.error.HTTPError as exc:
             if exc.code in (404, 403, 416):
+                # expected-status pass-through: callers branch on these
                 return exc.code, exc.read(), {
                     k.lower(): v for k, v in exc.headers.items()}
-            last_exc = exc
-        except urllib.error.URLError as exc:
-            last_exc = exc
-        time.sleep(0.1 * (attempt + 1))
-    raise DMLCError(f"s3 {method} {bucket}/{key} failed: {last_exc}")
+            raise
+
+    if not retry:
+        return attempt()
+    return pol.call(attempt, op=op, what=f"s3://{bucket}/{key}")
 
 
 # ---------------- streams ----------------
@@ -249,6 +261,7 @@ class S3ReadStream(HttpReadStream):
         status, body, _ = _request(
             self._cfg, "GET", self._bucket, self._key,
             headers={"Range": f"bytes={start}-{end - 1}"},
+            retry=False,  # the stream-level _fetch_retry owns the budget
         )
         if status == 416:
             return b""
@@ -287,7 +300,8 @@ class S3WriteStream(_pyio.RawIOBase):
 
     def _init_multipart(self) -> None:
         status, body, _ = _request(
-            self._cfg, "POST", self._bucket, self._key, query={"uploads": ""})
+            self._cfg, "POST", self._bucket, self._key, query={"uploads": ""},
+            op="write")
         check(status == 200, f"s3 multipart init failed: {status}")
         root = ET.fromstring(body)
         node = root.find(".//{*}UploadId")
@@ -304,7 +318,7 @@ class S3WriteStream(_pyio.RawIOBase):
         status, _, headers = _request(
             self._cfg, "PUT", self._bucket, self._key,
             query={"partNumber": str(part_number), "uploadId": self._upload_id},
-            body=data,
+            body=data, op="write",
         )
         check(status == 200, f"s3 part {part_number} upload failed: {status}")
         self._etags.append(headers.get("etag", ""))
@@ -316,7 +330,8 @@ class S3WriteStream(_pyio.RawIOBase):
         if self._upload_id is None:
             # small object: single PUT
             status, _, _ = _request(
-                self._cfg, "PUT", self._bucket, self._key, body=bytes(self._buf))
+                self._cfg, "PUT", self._bucket, self._key, body=bytes(self._buf),
+                op="write")
             check(status == 200, f"s3 put failed: {status}")
         else:
             if self._buf:
@@ -330,7 +345,7 @@ class S3WriteStream(_pyio.RawIOBase):
                     f"</CompleteMultipartUpload>").encode()
             status, _, _ = _request(
                 self._cfg, "POST", self._bucket, self._key,
-                query={"uploadId": self._upload_id}, body=body)
+                query={"uploadId": self._upload_id}, body=body, op="write")
             check(status == 200, f"s3 multipart complete failed: {status}")
         super().close()
 
@@ -339,6 +354,8 @@ class S3WriteStream(_pyio.RawIOBase):
 
 class S3FileSystem(FileSystem):
     """s3:// FileSystem over the SigV4 client."""
+
+    native_resilience = True  # S3ReadStream resumes via _fetch_retry
 
     _instance: Optional["S3FileSystem"] = None
 
@@ -359,7 +376,7 @@ class S3FileSystem(FileSystem):
         if cfg is None:
             cfg = self.cfg  # snapshot: instance() may swap cfg concurrently
         bucket, key = _parse_s3_uri(path)
-        status, _, headers = _request(cfg, "HEAD", bucket, key)
+        status, _, headers = _request(cfg, "HEAD", bucket, key, op="open")
         if status == 200:
             return FileInfo(path, int(headers.get("content-length", 0)),
                             FILE_TYPE)
@@ -387,7 +404,8 @@ class S3FileSystem(FileSystem):
             }
             if token:
                 query["continuation-token"] = token
-            status, body, _ = _request(cfg, "GET", bucket, "", query=query)
+            status, body, _ = _request(cfg, "GET", bucket, "", query=query,
+                                        op="open")
             check(status == 200, f"s3 list failed: {status}")
             root = ET.fromstring(body)
 

@@ -8,24 +8,40 @@ URI-dispatching factory plus small adapters.
 
 from __future__ import annotations
 
+import io as _pyio
 from typing import BinaryIO
 
 from dmlc_tpu.io.filesystem import get_filesystem
+from dmlc_tpu.io.resilience import ResilientStream
 from dmlc_tpu.io.uri import URI
 from dmlc_tpu.utils.check import DMLCError
 
 
-def open_stream(uri: str, mode: str = "r", allow_null: bool = False) -> BinaryIO | None:
+def open_stream(uri: str, mode: str = "r", allow_null: bool = False,
+                resilient: bool = False) -> BinaryIO | None:
     """Open a binary stream for a URI — analog of Stream::Create (src/io.cc:132).
 
     mode: 'r' read, 'w' write, 'a' append. Returns None when allow_null and
     the target cannot be opened (io.h:57 ``allow_null`` contract).
+
+    ``resilient=True`` (reads only) wraps the stream in
+    :class:`~dmlc_tpu.io.resilience.ResilientStream`: a retryable mid-read
+    failure reopens the source and resumes at the current byte offset. The
+    remote filesystems already resume internally at the range-fetch layer
+    (``native_resilience = True``), so the flag is a no-op for them —
+    wrapping would stack a second retry budget on the one they own. It adds
+    the contract for everything else (local files on flaky network mounts,
+    third-party plugins).
     """
     if mode not in ("r", "w", "a"):
         raise DMLCError(f"open_stream: bad mode {mode!r}")
     parsed = URI(uri)
     try:
         fs = get_filesystem(parsed)
+        if (resilient and mode == "r"
+                and not getattr(fs, "native_resilience", False)):
+            return _pyio.BufferedReader(ResilientStream(
+                lambda: fs.open(parsed, "r"), what=uri))
         return fs.open(parsed, mode)
     except DMLCError:
         if allow_null:

@@ -10,7 +10,12 @@ producer thread fills a bounded queue ahead of the consumer, with
 - exceptions in the producer captured and rethrown on the consumer side
   (threadediter.h:406-436, 490-505),
 - clean destruction joining the thread (kDestroy + ScopedThread,
-  threadediter.h:283-313).
+  threadediter.h:283-313),
+- an OPT-IN bounded producer-restart path (``restart_policy``): a
+  retryable-class source error (see :func:`dmlc_tpu.io.resilience.classify`)
+  consumes restart budget — backoff, reposition via ``restart_fn``, keep
+  producing — instead of poisoning the pipeline; fatal errors and exhausted
+  budgets rethrow on the consumer as before.
 
 The producer callback contract matches the reference's ``next(cell)``:
 ``produce_fn(cell) -> (ok, cell)`` where ``cell`` is a recycled buffer or
@@ -25,6 +30,7 @@ import threading
 from collections import deque
 from typing import Any, Callable, Deque, Generic, Optional, Tuple, TypeVar
 
+from dmlc_tpu.io import resilience as _resilience
 from dmlc_tpu.utils.check import DMLCError
 from dmlc_tpu.utils.timer import get_time
 
@@ -34,6 +40,21 @@ T = TypeVar("T")
 _SIG_PRODUCE = 0
 _SIG_BEFORE_FIRST = 1
 _SIG_DESTROY = 2
+
+
+def _fast_forward(it, n: int):
+    """Skip the first ``n`` items of a freshly rebuilt source — the
+    deterministic replay both restart paths use. A source that yields fewer
+    items than already delivered surfaces loudly (a bare StopIteration
+    leaking into the pipeline would read as silent truncation)."""
+    for _ in range(n):
+        try:
+            next(it)
+        except StopIteration:
+            raise DMLCError(
+                "producer restart: source yielded fewer items than already "
+                "delivered — non-deterministic factory?") from None
+    return it
 
 
 def _stall_timeout() -> float:
@@ -57,6 +78,8 @@ class ThreadedIter(Generic[T]):
         produce_fn: Callable[[Optional[T]], Tuple[bool, Optional[T]]],
         before_first_fn: Optional[Callable[[], None]] = None,
         max_capacity: int = 8,
+        restart_fn: Optional[Callable[[int], None]] = None,
+        restart_policy: Optional["_resilience.RetryPolicy"] = None,
     ):
         self._produce = produce_fn
         self._before_first = before_first_fn
@@ -70,8 +93,56 @@ class ThreadedIter(Generic[T]):
         self._exc: Optional[BaseException] = None
         self._destroyed = False
         self.stall_seconds = 0.0  # consumer time spent waiting on the producer
+        # bounded producer restart (opt-in): on a retryable-class produce
+        # error, back off and call restart_fn(items_produced_this_epoch) to
+        # reposition the source, consuming one unit of the per-epoch budget
+        # (restart_policy.max_attempts - 1). Without restart_fn the produce
+        # callback is simply re-invoked — only correct for producers whose
+        # state survives a failed call (NOT dead generators).
+        self._restart_fn = restart_fn
+        self._restart_policy = (
+            restart_policy if restart_policy is not None
+            else (_resilience.default_policy() if restart_fn else None))
+        self._epoch_produced = 0   # items queued since epoch start
+        self._epoch_restarts = 0   # budget consumed this epoch
+        self.restarts = 0          # lifetime restart count
+        self.restart_giveups = 0   # budget-exhausted poisonings
+        self.last_producer_error: Optional[str] = None
         self._thread = threading.Thread(target=self._producer_loop, daemon=True)
         self._thread.start()
+
+    def _budget_state(self) -> str:
+        """Human retry-budget summary for diagnostics."""
+        pol = self._restart_policy
+        if pol is None:
+            return "producer restart disabled"
+        return (f"producer restarts {self._epoch_restarts}/"
+                f"{max(0, pol.max_attempts - 1)} used this epoch")
+
+    def _try_restart(self, exc: BaseException) -> bool:
+        """Classify a producer error; on a retryable class with budget left,
+        back off, reposition the source, and report True (keep producing)."""
+        with self._lock:
+            if self._signal != _SIG_PRODUCE:  # reset/destroy pending: bail
+                return False
+            used = self._epoch_restarts
+            produced = self._epoch_produced
+        verdict = _resilience.restart_verdict(self._restart_policy, used, exc)
+        if verdict == "giveup":
+            self.restart_giveups += 1
+            _resilience.COUNTERS.bump("producer_giveups")
+            return False
+        if verdict != "restart":
+            return False
+        with self._lock:
+            self._epoch_restarts += 1
+            self.restarts += 1
+        _resilience.COUNTERS.bump("producer_restarts")
+        _resilience.restart_backoff(self._restart_policy, used, exc)
+        if self._restart_fn is not None:
+            # reposition failures propagate to the caller's except branch
+            self._restart_fn(produced)
+        return True
 
     # ---------------- producer side ----------------
 
@@ -96,6 +167,8 @@ class ThreadedIter(Generic[T]):
                         if self._before_first is not None:
                             self._before_first()
                         self._produce_end = False
+                        self._epoch_produced = 0
+                        self._epoch_restarts = 0  # fresh budget per epoch
                     except BaseException as exc:  # noqa: BLE001 - rethrown on consumer
                         self._exc = exc
                         self._produce_end = True
@@ -109,6 +182,18 @@ class ThreadedIter(Generic[T]):
             try:
                 ok, value = self._produce(cell)
             except BaseException as exc:  # noqa: BLE001 - captured for consumer
+                self.last_producer_error = f"{type(exc).__name__}: {exc}"
+                try:
+                    restarted = self._try_restart(exc)
+                except BaseException as exc2:  # noqa: BLE001 - reposition died
+                    restarted = False
+                    exc = exc2
+                    self.last_producer_error = f"{type(exc2).__name__}: {exc2}"
+                if restarted:
+                    with self._lock:
+                        if cell is not None:  # return the borrowed cell
+                            self._free.append(cell)
+                    continue
                 with self._lock:
                     self._exc = exc
                     self._produce_end = True
@@ -117,6 +202,7 @@ class ThreadedIter(Generic[T]):
             with self._lock:
                 if ok:
                     self._queue.append(value)  # type: ignore[arg-type]
+                    self._epoch_produced += 1
                 else:
                     self._produce_end = True
                     if cell is not None:
@@ -140,7 +226,10 @@ class ThreadedIter(Generic[T]):
                     raise DMLCError(
                         f"pipeline stalled: no item produced in {timeout:.0f}s "
                         f"(producer thread {'alive but blocked' if alive else 'dead'}, "
-                        f"queue empty, free cells {len(self._free)}). A hung "
+                        f"queue empty, free cells {len(self._free)}; "
+                        f"last producer error: "
+                        f"{self.last_producer_error or 'none'}; "
+                        f"{self._budget_state()}). A hung "
                         f"device transfer or remote read is the usual cause; "
                         f"unset DMLC_PIPELINE_STALL_TIMEOUT to wait forever"
                     )
@@ -206,12 +295,19 @@ class ThreadedIter(Generic[T]):
 
     @staticmethod
     def from_factory(
-        iterator_factory: Callable[[], Any], max_capacity: int = 8
+        iterator_factory: Callable[[], Any], max_capacity: int = 8,
+        restart_policy: Optional["_resilience.RetryPolicy"] = None,
     ) -> "ThreadedIter":
         """Prefetch over a restartable iterator factory.
 
         Each epoch calls ``iterator_factory()`` for a fresh iterator; this is
         the Pythonic face of the (next_fn, beforefirst_fn) pair.
+
+        With ``restart_policy``, a retryable-class error from the iterator
+        consumes restart budget: a FRESH iterator is built and fast-forwarded
+        past the items already delivered (the factory must be deterministic),
+        so the consumer sees an uninterrupted, in-order stream. Without it, a
+        dead generator would otherwise surface the error and end the epoch.
         """
         state = {"it": iterator_factory()}
 
@@ -224,7 +320,14 @@ class ThreadedIter(Generic[T]):
         def before_first():
             state["it"] = iterator_factory()
 
-        return ThreadedIter(produce, before_first, max_capacity=max_capacity)
+        def restart(produced: int) -> None:
+            # skip what the consumer already has (deterministic factory)
+            state["it"] = _fast_forward(iterator_factory(), produced)
+
+        return ThreadedIter(
+            produce, before_first, max_capacity=max_capacity,
+            restart_fn=restart if restart_policy is not None else None,
+            restart_policy=restart_policy)
 
 
 class OrderedWorkerPool(Generic[T]):
@@ -253,7 +356,9 @@ class OrderedWorkerPool(Generic[T]):
         work_fn: Callable[[Any], T],
         num_workers: int = 2,
         max_ahead: int = 4,
+        restart_policy: Optional["_resilience.RetryPolicy"] = None,
     ):
+        self._source_factory = source_factory
         self._source = source_factory()
         self._work = work_fn
         self._ahead = max(1, int(max_ahead))
@@ -267,12 +372,50 @@ class OrderedWorkerPool(Generic[T]):
         self._src_exc: Optional[BaseException] = None
         self._destroyed = False
         self.stall_seconds = 0.0  # consumer time waiting on the workers
+        # bounded source restart (opt-in, like ThreadedIter): a retryable
+        # pull error rebuilds the source via source_factory() and
+        # fast-forwards past the seq items already pulled, so sequence
+        # numbers — and therefore delivery order — are preserved across a
+        # mid-stream restart. The factory must be deterministic.
+        self._restart_policy = restart_policy
+        self.restarts = 0
+        self.restart_giveups = 0
+        self.last_producer_error: Optional[str] = None
         self._threads = [
             threading.Thread(target=self._worker_loop, daemon=True)
             for _ in range(max(1, int(num_workers)))
         ]
         for t in self._threads:
             t.start()
+
+    def _budget_state(self) -> str:
+        pol = self._restart_policy
+        if pol is None:
+            return "source restart disabled"
+        return (f"source restarts {self.restarts}/"
+                f"{max(0, pol.max_attempts - 1)} used")
+
+    def _try_source_restart(self, exc: BaseException) -> bool:
+        """Called under ``_pull_lock`` after a source pull raised. On a
+        retryable class with budget left: back off, rebuild the source, and
+        skip the ``seq`` items already pulled (order is law — the skip keeps
+        every outstanding sequence number valid)."""
+        verdict = _resilience.restart_verdict(self._restart_policy,
+                                              self.restarts, exc)
+        if verdict == "giveup":
+            self.restart_giveups += 1
+            _resilience.COUNTERS.bump("producer_giveups")
+            return False
+        if verdict != "restart":
+            return False
+        used = self.restarts
+        self.restarts += 1
+        _resilience.COUNTERS.bump("producer_restarts")
+        _resilience.restart_backoff(self._restart_policy, used, exc)
+        with self._lock:
+            pulled = self._seq
+        self._source = _fast_forward(self._source_factory(), pulled)
+        return True
 
     # ---------------- worker side ----------------
 
@@ -298,6 +441,16 @@ class OrderedWorkerPool(Generic[T]):
                         self._lock.notify_all()
                     return
                 except BaseException as exc:  # noqa: BLE001 - rethrown on consumer
+                    self.last_producer_error = f"{type(exc).__name__}: {exc}"
+                    try:
+                        restarted = self._try_source_restart(exc)
+                    except BaseException as exc2:  # noqa: BLE001 - replay died
+                        restarted = False
+                        exc = exc2
+                        self.last_producer_error = (
+                            f"{type(exc2).__name__}: {exc2}")
+                    if restarted:
+                        continue  # releases the pull lock, re-enters the wait
                     with self._lock:
                         self._src_exc = exc
                         self._produce_end = True
@@ -344,7 +497,10 @@ class OrderedWorkerPool(Generic[T]):
                     raise DMLCError(
                         f"pipeline stalled: no item produced in {timeout:.0f}s "
                         f"({alive}/{len(self._threads)} workers alive, "
-                        f"waiting for #{self._want} of {self._seq} pulled). "
+                        f"waiting for #{self._want} of {self._seq} pulled; "
+                        f"last producer error: "
+                        f"{self.last_producer_error or 'none'}; "
+                        f"{self._budget_state()}). "
                         f"A hung device transfer or remote read is the usual "
                         f"cause; unset DMLC_PIPELINE_STALL_TIMEOUT to wait "
                         f"forever")

@@ -779,6 +779,15 @@ class Feeder:
         if self._h is not None:
             self._lib.dmlc_feeder_before_first(self._h)
 
+    def error(self):
+        """The sticky pipeline error string, or None. Errors survive
+        before_first (the native reader stays stopped) — callers that want
+        a clean restart after a failure must rebuild the Feeder."""
+        if self._h is None:
+            return None
+        err = self._lib.dmlc_feeder_error(self._h)
+        return err.decode() if err else None
+
     @property
     def bytes_read(self) -> int:
         return (self._lib.dmlc_feeder_bytes_read(self._h)

@@ -12,6 +12,7 @@ import urllib.parse
 
 import pytest
 
+from dmlc_tpu.io import faults, resilience
 from dmlc_tpu.io.filesystem import get_filesystem
 from dmlc_tpu.io.s3_filesys import (
     S3Config,
@@ -22,6 +23,18 @@ from dmlc_tpu.io.s3_filesys import (
 )
 from dmlc_tpu.io.uri import URI
 from dmlc_tpu.utils.check import DMLCError
+
+
+@pytest.fixture(autouse=True)
+def _fast_retry_env(monkeypatch):
+    """Millisecond backoffs + clean fault/counter state for every test."""
+    monkeypatch.setenv("DMLC_RETRY_BASE_MS", "1")
+    monkeypatch.setenv("DMLC_RETRY_MAX_MS", "5")
+    monkeypatch.delenv("DMLC_FAULT_PLAN", raising=False)
+    faults.reset()
+    resilience.reset_counters()
+    yield
+    faults.reset()
 
 
 class TestSigV4:
@@ -94,6 +107,7 @@ class _FakeS3Handler(http.server.BaseHTTPRequestHandler):
     store = {}       # (bucket, key) -> bytes
     uploads = {}     # upload_id -> {part_number: bytes}
     auth_seen = []
+    flaky_503 = 0    # next N ranged GETs answer 503 (transient-fault tests)
 
     def log_message(self, *a):  # quiet
         pass
@@ -146,6 +160,12 @@ class _FakeS3Handler(http.server.BaseHTTPRequestHandler):
             self.end_headers()
             return
         rng = self.headers.get("Range")
+        if rng and type(self).flaky_503 > 0:
+            type(self).flaky_503 -= 1
+            self.send_response(503)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
         if rng:
             spec = rng.split("=")[1]
             lo, hi = spec.split("-")
@@ -212,6 +232,7 @@ def fake_s3(monkeypatch):
     _FakeS3Handler.store = {}
     _FakeS3Handler.uploads = {}
     _FakeS3Handler.auth_seen = []
+    _FakeS3Handler.flaky_503 = 0
     server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _FakeS3Handler)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
@@ -1121,3 +1142,119 @@ class TestAzureFileSystem:
             f"azure://cont/pg/f{i:02d}.bin" for i in range(7)]
         assert [i.size for i in infos] == list(range(1, 8))
         assert fake_azure.auth_failures == []
+
+
+# ---------------- fault tolerance across every remote fs ----------------
+# (docs/resilience.md: fail-then-succeed, fatal-fails-fast, and mid-read
+# resume at the exact byte offset — each filesystem's stream runs under
+# the shared RetryPolicy through HttpReadStream._fetch_retry)
+
+_FAULT_PAYLOAD = bytes(range(256)) * 256  # 64 KiB
+
+
+class _FaultMixin:
+    def _read_fail_then_succeed(self, fs, uri):
+        with faults.inject("read@1..2=http-503") as plan:
+            with fs.open_for_read(URI(uri)) as f:
+                assert f.read() == _FAULT_PAYLOAD
+        assert plan.fired() == 2
+        snap = resilience.counters_snapshot()
+        assert snap["retries"] >= 2 and snap["giveups"] == 0
+
+    def _fatal_fails_fast(self, fs, uri):
+        with faults.inject("open@1=http-403"):
+            with pytest.raises(DMLCError):
+                fs.open_for_read(URI(uri))
+        snap = resilience.counters_snapshot()
+        assert snap["fatal"] == 1 and snap["retries"] == 0
+
+    def _midread_resume(self, fs, uri, monkeypatch):
+        from dmlc_tpu.io import http_filesys
+
+        monkeypatch.setattr(http_filesys, "_BLOCK", 4096)
+        with fs.open_for_read(URI(uri)) as f:
+            assert f.read(64) == _FAULT_PAYLOAD[:64]
+            f.seek(50000)
+            with faults.inject("read@1=reset") as plan:
+                assert f.read(128) == _FAULT_PAYLOAD[50000:50128]
+            assert plan.fired() == 1
+        assert resilience.counters_snapshot()["resumes"] >= 1
+
+
+class TestS3FaultTolerance(_FaultMixin):
+    def _fs(self, fake_s3):
+        fake_s3.store[("bkt", "ft.bin")] = _FAULT_PAYLOAD
+        return S3FileSystem(S3Config()), "s3://bkt/ft.bin"
+
+    def test_read_fail_then_succeed(self, fake_s3):
+        self._read_fail_then_succeed(*self._fs(fake_s3))
+
+    def test_server_side_503s_heal(self, fake_s3):
+        """Real HTTPError 503s from the (fake) server, no injection."""
+        fs, uri = self._fs(fake_s3)
+        fake_s3.flaky_503 = 2
+        with fs.open_for_read(URI(uri)) as f:
+            assert f.read() == _FAULT_PAYLOAD
+        snap = resilience.counters_snapshot()
+        assert snap["retries"] == 2 and snap["giveups"] == 0
+
+    def test_fatal_fails_fast(self, fake_s3):
+        self._fatal_fails_fast(*self._fs(fake_s3))
+
+    def test_midread_resume_exact_offset(self, fake_s3, monkeypatch):
+        fs, uri = self._fs(fake_s3)
+        self._midread_resume(fs, uri, monkeypatch)
+
+
+class TestGcsFaultTolerance(_FaultMixin):
+    def _fs(self, fake_gcs):
+        from dmlc_tpu.io.gcs_filesys import GcsConfig, GcsFileSystem
+
+        fake_gcs.store[("bkt", "ft.bin")] = _FAULT_PAYLOAD
+        return GcsFileSystem(GcsConfig()), "gs://bkt/ft.bin"
+
+    def test_read_fail_then_succeed(self, fake_gcs):
+        self._read_fail_then_succeed(*self._fs(fake_gcs))
+
+    def test_fatal_fails_fast(self, fake_gcs):
+        self._fatal_fails_fast(*self._fs(fake_gcs))
+
+    def test_midread_resume_exact_offset(self, fake_gcs, monkeypatch):
+        fs, uri = self._fs(fake_gcs)
+        self._midread_resume(fs, uri, monkeypatch)
+
+
+class TestHdfsFaultTolerance(_FaultMixin):
+    def _fs(self, fake_webhdfs):
+        from dmlc_tpu.io.hdfs_filesys import HdfsConfig, HdfsFileSystem
+
+        fake_webhdfs.store["/ft.bin"] = _FAULT_PAYLOAD
+        return HdfsFileSystem(HdfsConfig()), "hdfs://nn/ft.bin"
+
+    def test_read_fail_then_succeed(self, fake_webhdfs):
+        self._read_fail_then_succeed(*self._fs(fake_webhdfs))
+
+    def test_fatal_fails_fast(self, fake_webhdfs):
+        self._fatal_fails_fast(*self._fs(fake_webhdfs))
+
+    def test_midread_resume_exact_offset(self, fake_webhdfs, monkeypatch):
+        fs, uri = self._fs(fake_webhdfs)
+        self._midread_resume(fs, uri, monkeypatch)
+
+
+class TestAzureFaultTolerance(_FaultMixin):
+    def _fs(self, fake_azure):
+        from dmlc_tpu.io.azure_filesys import AzureConfig, AzureFileSystem
+
+        fake_azure.store[("cont", "ft.bin")] = _FAULT_PAYLOAD
+        return AzureFileSystem(AzureConfig()), "azure://cont/ft.bin"
+
+    def test_read_fail_then_succeed(self, fake_azure):
+        self._read_fail_then_succeed(*self._fs(fake_azure))
+
+    def test_fatal_fails_fast(self, fake_azure):
+        self._fatal_fails_fast(*self._fs(fake_azure))
+
+    def test_midread_resume_exact_offset(self, fake_azure, monkeypatch):
+        fs, uri = self._fs(fake_azure)
+        self._midread_resume(fs, uri, monkeypatch)

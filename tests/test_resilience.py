@@ -1,0 +1,781 @@
+"""Unified fault-tolerance layer tests (docs/resilience.md).
+
+Covers the shared classifier, RetryPolicy (backoff/jitter/Retry-After/
+deadline), the fault-plan grammar, ResilientStream byte-exact resume, the
+bounded producer-restart path in ThreadedIter/OrderedWorkerPool, the
+stall diagnostic, the lint-retry gate, and the acceptance criteria: a
+DeviceIter epoch over an HTTP source under injected fault plans.
+"""
+
+import email.message
+import http.server
+import importlib.util
+import io as _pyio
+import os
+import threading
+import urllib.error
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.io import faults, resilience
+from dmlc_tpu.io.resilience import (
+    FATAL, RETRYABLE, ResilientStream, RetryPolicy, classify,
+    retry_after_seconds,
+)
+from dmlc_tpu.io.threaded_iter import OrderedWorkerPool, ThreadedIter
+from dmlc_tpu.utils.check import DMLCError
+
+
+def _http_error(code, headers=None):
+    hdrs = email.message.Message()
+    for k, v in (headers or {}).items():
+        hdrs[k] = v
+    return urllib.error.HTTPError("http://x/y", code, "msg", hdrs,
+                                  _pyio.BytesIO(b""))
+
+
+@pytest.fixture(autouse=True)
+def _fast_retries(monkeypatch):
+    """Millisecond backoffs + clean counters/plans for every test here."""
+    monkeypatch.setenv("DMLC_RETRY_BASE_MS", "1")
+    monkeypatch.setenv("DMLC_RETRY_MAX_MS", "5")
+    monkeypatch.delenv("DMLC_RETRY_MAX_ATTEMPTS", raising=False)
+    monkeypatch.delenv("DMLC_FAULT_PLAN", raising=False)
+    faults.reset()
+    resilience.reset_counters()
+    yield
+    faults.reset()
+
+
+class TestClassifier:
+    @pytest.mark.parametrize("code,kind", [
+        (500, RETRYABLE), (502, RETRYABLE), (503, RETRYABLE),
+        (504, RETRYABLE), (429, RETRYABLE), (408, RETRYABLE),
+        (400, FATAL), (401, FATAL), (403, FATAL), (404, FATAL),
+        (416, FATAL),
+    ])
+    def test_http_codes(self, code, kind):
+        assert classify(_http_error(code)) == kind
+
+    def test_connection_and_timeout_classes(self):
+        assert classify(ConnectionResetError()) == RETRYABLE
+        assert classify(ConnectionRefusedError()) == RETRYABLE
+        assert classify(TimeoutError()) == RETRYABLE
+        import socket
+        assert classify(socket.timeout()) == RETRYABLE
+        import http.client as hc
+        assert classify(hc.IncompleteRead(b"x")) == RETRYABLE
+        assert classify(urllib.error.URLError("dns broke")) == RETRYABLE
+
+    def test_urlerror_realistic_reasons(self):
+        """urllib wraps transport failures as URLError(OSError): DNS is a
+        socket.gaierror, routing is an errno OSError — both transient. The
+        one deterministic member is a certificate failure."""
+        import errno
+        import socket
+        import ssl
+
+        dns = urllib.error.URLError(
+            socket.gaierror(-2, "Name or service not known"))
+        assert classify(dns) == RETRYABLE
+        unreach = urllib.error.URLError(
+            OSError(errno.EHOSTUNREACH, "No route to host"))
+        assert classify(unreach) == RETRYABLE
+        refused = urllib.error.URLError(ConnectionRefusedError(111, "refused"))
+        assert classify(refused) == RETRYABLE
+        cert = urllib.error.URLError(
+            ssl.SSLCertVerificationError("certificate verify failed"))
+        assert classify(cert) == FATAL
+        # the faults.py 'unreachable' class must land retryable
+        plan = faults.FaultPlan("read@1=unreachable")
+        assert classify(plan.check("read")) == RETRYABLE
+
+    def test_deterministic_errors_are_fatal(self):
+        assert classify(ValueError("bad uri")) == FATAL
+        assert classify(DMLCError("malformed")) == FATAL
+        assert classify(FileNotFoundError("gone")) == FATAL
+
+    def test_cause_chain_preserves_class(self):
+        wrapped = DMLCError("read failed")
+        wrapped.__cause__ = _http_error(503)
+        assert classify(wrapped) == RETRYABLE
+        double = DMLCError("outer")
+        double.__cause__ = wrapped
+        assert classify(double) == RETRYABLE
+        fatal = DMLCError("auth")
+        fatal.__cause__ = _http_error(403)
+        assert classify(fatal) == FATAL
+
+    def test_retry_after_header_parse(self):
+        assert retry_after_seconds(_http_error(429, {"Retry-After": "2"})) == 2.0
+        assert retry_after_seconds(_http_error(429)) == 0.0
+        # HTTP-date form: ignored, not crashed on
+        assert retry_after_seconds(
+            _http_error(429, {"Retry-After": "Wed, 21 Oct 2026 07:28:00 GMT"})
+        ) == 0.0
+        wrapped = DMLCError("w")
+        wrapped.__cause__ = _http_error(429, {"Retry-After": "0.5"})
+        assert retry_after_seconds(wrapped) == 0.5
+
+
+class TestRetryPolicy:
+    def test_succeeds_after_transient_failures(self):
+        pol = RetryPolicy(max_attempts=4, base_delay=0.001, seed=7)
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionResetError("flake")
+            return "ok"
+
+        assert pol.call(fn, op="t", what="w") == "ok"
+        assert calls["n"] == 3
+        snap = resilience.counters_snapshot()
+        assert snap["retries"] == 2 and snap["giveups"] == 0
+
+    def test_fatal_fails_in_one_attempt(self):
+        pol = RetryPolicy(max_attempts=5, base_delay=0.001)
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            raise _http_error(403)
+
+        with pytest.raises(DMLCError, match="non-retryable"):
+            pol.call(fn, op="t", what="w")
+        assert calls["n"] == 1
+        snap = resilience.counters_snapshot()
+        assert snap["fatal"] == 1 and snap["retries"] == 0
+
+    def test_budget_exhausted_wraps_with_cause(self):
+        pol = RetryPolicy(max_attempts=3, base_delay=0.001)
+
+        def fn():
+            raise TimeoutError("always")
+
+        with pytest.raises(DMLCError, match="budget exhausted") as ei:
+            pol.call(fn, op="read", what="u")
+        assert isinstance(ei.value.__cause__, TimeoutError)
+        # the wrapper keeps the retryable class for outer layers
+        assert classify(ei.value) == RETRYABLE
+        assert resilience.counters_snapshot()["giveups"] == 1
+
+    def test_backoff_jitter_bounds_and_floor(self):
+        pol = RetryPolicy(base_delay=0.1, max_delay=1.0, seed=42)
+        for i in range(6):
+            d = pol.backoff(i)
+            assert 0.0 <= d <= min(1.0, 0.1 * 2 ** i)
+        assert pol.backoff(0, floor=0.5) >= 0.5
+        # a server-sent Retry-After cannot wedge a reader thread: the
+        # honored floor caps at max(30s, max_delay)
+        assert pol.backoff(0, floor=86400.0) <= 30.0
+
+    def test_retry_after_is_backoff_floor(self):
+        sleeps = []
+        pol = RetryPolicy(max_attempts=2, base_delay=0.0001, seed=0,
+                          sleep_fn=sleeps.append)
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise _http_error(429, {"Retry-After": "0.25"})
+            return "ok"
+
+        assert pol.call(fn, op="t") == "ok"
+        assert sleeps and sleeps[0] >= 0.25
+
+    def test_deadline_gives_up(self):
+        pol = RetryPolicy(max_attempts=50, base_delay=1.0, max_delay=1.0,
+                          deadline=0.01, sleep_fn=lambda s: None)
+
+        def fn():
+            raise ConnectionResetError("x")
+
+        with pytest.raises(DMLCError, match="deadline exceeded"):
+            pol.call(fn, op="t")
+
+    def test_resume_offset_counts_resumes(self):
+        pol = RetryPolicy(max_attempts=3, base_delay=0.001)
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ConnectionResetError("mid")
+            return b"data"
+
+        pol.call(fn, op="read", what="u", resume_offset=4096)
+        snap = resilience.counters_snapshot()
+        assert snap["retries"] == 1 and snap["resumes"] == 1
+
+    def test_from_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("DMLC_RETRY_MAX_ATTEMPTS", "7")
+        monkeypatch.setenv("DMLC_RETRY_BASE_MS", "10")
+        monkeypatch.setenv("DMLC_RETRY_MAX_MS", "200")
+        monkeypatch.setenv("DMLC_RETRY_DEADLINE_S", "9")
+        monkeypatch.setenv("DMLC_RETRY_ATTEMPT_TIMEOUT_S", "33")
+        pol = RetryPolicy.from_env()
+        assert pol.max_attempts == 7
+        assert pol.base_delay == pytest.approx(0.01)
+        assert pol.max_delay == pytest.approx(0.2)
+        assert pol.deadline == pytest.approx(9.0)
+        assert pol.attempt_timeout == pytest.approx(33.0)
+
+
+class TestFaultPlan:
+    def test_grammar_single_range_openended(self):
+        plan = faults.FaultPlan("read@2;open@1..3=reset;connect@5+=timeout")
+        # read: only call 2 fails (default http-503)
+        assert plan.check("read") is None
+        exc = plan.check("read")
+        assert isinstance(exc, urllib.error.HTTPError) and exc.code == 503
+        assert plan.check("read") is None
+        # open: calls 1..3 fail with reset
+        for _ in range(3):
+            assert isinstance(plan.check("open"), ConnectionResetError)
+        assert plan.check("open") is None
+        # connect: every call from the 5th on
+        for _ in range(4):
+            assert plan.check("connect") is None
+        for _ in range(10):
+            assert isinstance(plan.check("connect"), TimeoutError)
+        assert plan.fired() == 1 + 3 + 10
+
+    def test_substring_filter(self):
+        plan = faults.FaultPlan("read~part-1@1=reset")
+        assert plan.check("read", "http://h/part-0") is None
+        assert isinstance(plan.check("read", "http://h/part-1"),
+                          ConnectionResetError)
+
+    def test_error_classes(self):
+        plan = faults.FaultPlan("a@1=http-429;b@1=unreachable")
+        exc = plan.check("a", "u")
+        assert isinstance(exc, urllib.error.HTTPError) and exc.code == 429
+        assert isinstance(plan.check("b"), urllib.error.URLError)
+
+    def test_bad_clause_rejected(self):
+        with pytest.raises(DMLCError, match="bad clause"):
+            faults.FaultPlan("read@@2")
+        with pytest.raises(DMLCError, match="unknown error class"):
+            faults.FaultPlan("read@1=kaboom")
+
+    def test_inject_context_and_nesting(self):
+        assert faults.active_plan() is None
+        with faults.inject("read@1=reset") as outer:
+            assert faults.active_plan() is outer
+            with faults.inject("open@1=timeout") as inner:
+                assert faults.active_plan() is inner
+            assert faults.active_plan() is outer
+        assert faults.active_plan() is None
+
+    def test_env_hook(self, monkeypatch):
+        monkeypatch.setenv("DMLC_FAULT_PLAN", "read@1=reset")
+        with pytest.raises(ConnectionResetError):
+            faults.maybe_fail("read", "x")
+        faults.maybe_fail("read", "x")  # counter advanced: no refire
+        # plan swap via env is picked up
+        monkeypatch.setenv("DMLC_FAULT_PLAN", "open@1=timeout")
+        with pytest.raises(TimeoutError):
+            faults.maybe_fail("open", "y")
+
+    def test_injected_faults_flow_through_policy(self):
+        pol = RetryPolicy(max_attempts=3, base_delay=0.001)
+        with faults.inject("read@1..2=http-503") as plan:
+            out = pol.call(lambda: "ok", op="read", what="u")
+        assert out == "ok" and plan.fired() == 2
+        snap = resilience.counters_snapshot()
+        assert snap["retries"] == 2
+
+
+class TestResilientStream:
+    @staticmethod
+    def _flaky_open(data, state):
+        opens = []
+
+        def open_fn():
+            bio = _pyio.BytesIO(data)
+            opens.append(bio)
+            orig = bio.read
+
+            def read(n=-1):
+                if state.get("fails", 0) > 0 and bio.tell() >= state["at"]:
+                    state["fails"] -= 1
+                    raise ConnectionResetError("mid-read flake")
+                return orig(n)
+
+            bio.read = read
+            return bio
+
+        return open_fn, opens
+
+    def test_mid_read_resume_exact_offset(self):
+        data = bytes(range(256)) * 64  # 16 KiB
+        state = {"fails": 1, "at": 6000}
+        open_fn, opens = self._flaky_open(data, state)
+        rs = ResilientStream(
+            open_fn, policy=RetryPolicy(max_attempts=3, base_delay=0.001),
+            what="mem://flaky")
+        out = bytearray()
+        while True:
+            chunk = rs.read(4096)
+            if not chunk:
+                break
+            out += chunk
+        assert bytes(out) == data  # unbroken byte sequence across the fault
+        assert rs.reopens == 1 and len(opens) == 2
+        snap = resilience.counters_snapshot()
+        assert snap["resumes"] == 1  # the retry happened at offset > 0
+
+    def test_seek_then_resume(self):
+        data = b"0123456789" * 2000
+        state = {"fails": 1, "at": 0}  # first read after (re)open fails once
+        open_fn, opens = self._flaky_open(data, state)
+        rs = ResilientStream(
+            open_fn, policy=RetryPolicy(max_attempts=3, base_delay=0.001))
+        rs.seek(12345)
+        assert rs.read(10) == data[12345:12355]
+        assert rs.tell() == 12355
+
+    def test_fatal_open_propagates_once(self):
+        calls = {"n": 0}
+
+        def open_fn():
+            calls["n"] += 1
+            raise ValueError("malformed")
+
+        rs = ResilientStream(open_fn,
+                             policy=RetryPolicy(max_attempts=5,
+                                                base_delay=0.001))
+        with pytest.raises(DMLCError, match="non-retryable"):
+            rs.read(10)
+        assert calls["n"] == 1
+
+    def test_budget_exhausted(self):
+        def open_fn():
+            raise ConnectionResetError("always down")
+
+        rs = ResilientStream(open_fn,
+                             policy=RetryPolicy(max_attempts=3,
+                                                base_delay=0.001))
+        with pytest.raises(DMLCError, match="budget exhausted"):
+            rs.read(10)
+
+    def test_open_stream_resilient_flag(self, tmp_path):
+        from dmlc_tpu.io import open_stream
+
+        path = tmp_path / "f.bin"
+        payload = b"resilient local bytes" * 100
+        path.write_bytes(payload)
+        with open_stream(str(path), "r", resilient=True) as f:
+            assert isinstance(f.raw, ResilientStream)
+            assert f.read() == payload
+
+    def test_open_stream_resilient_noop_for_native_fs(self, http_files):
+        """Remote filesystems already resume internally — the flag must NOT
+        stack a second retry budget on top of the one they own."""
+        handler, base = http_files
+        handler.files["/n.bin"] = b"native resume"
+        from dmlc_tpu.io import open_stream
+
+        with open_stream(f"{base}/n.bin", "r", resilient=True) as f:
+            assert not isinstance(f.raw, ResilientStream)
+            assert f.read() == b"native resume"
+
+
+class TestThreadedIterRestart:
+    @staticmethod
+    def _flaky_factory(fail_at, n_failures, n_items=10,
+                       exc=ConnectionResetError):
+        state = {"fails": n_failures}
+
+        def factory():
+            def gen():
+                for i in range(n_items):
+                    if i == fail_at and state["fails"] > 0:
+                        state["fails"] -= 1
+                        raise exc("producer flake")
+                    yield i
+            return gen()
+
+        return factory
+
+    def test_restart_preserves_order_and_counts(self):
+        it = ThreadedIter.from_factory(
+            self._flaky_factory(4, 1),
+            restart_policy=RetryPolicy(max_attempts=3, base_delay=0.001))
+        assert list(it) == list(range(10))
+        assert it.restarts == 1 and it.restart_giveups == 0
+        assert resilience.counters_snapshot()["producer_restarts"] == 1
+        it.destroy()
+
+    def test_budget_exhausted_rethrows(self):
+        it = ThreadedIter.from_factory(
+            self._flaky_factory(2, 99),
+            restart_policy=RetryPolicy(max_attempts=2, base_delay=0.001))
+        with pytest.raises(ConnectionResetError):
+            list(it)
+        assert it.restarts == 1 and it.restart_giveups == 1
+        it.destroy()
+
+    def test_fatal_not_restarted(self):
+        it = ThreadedIter.from_factory(
+            self._flaky_factory(2, 1, exc=ValueError),
+            restart_policy=RetryPolicy(max_attempts=4, base_delay=0.001))
+        with pytest.raises(ValueError):
+            list(it)
+        assert it.restarts == 0
+        it.destroy()
+
+    def test_disabled_by_default(self):
+        it = ThreadedIter.from_factory(self._flaky_factory(2, 1))
+        with pytest.raises(ConnectionResetError):
+            list(it)
+        assert it.restarts == 0
+        it.destroy()
+
+    def test_epoch_reset_refreshes_budget(self):
+        factory = self._flaky_factory(3, 1)
+        it = ThreadedIter.from_factory(
+            factory, restart_policy=RetryPolicy(max_attempts=2,
+                                                base_delay=0.001))
+        assert list(it) == list(range(10))  # consumed the 1-restart budget
+        it.before_first()
+        assert list(it) == list(range(10))  # clean epoch, fresh budget
+        assert it.restarts == 1
+        it.destroy()
+
+    def test_stall_diagnostic_reports_error_and_budget(self, monkeypatch):
+        monkeypatch.setenv("DMLC_PIPELINE_STALL_TIMEOUT", "0.3")
+        gate = threading.Event()
+
+        def produce(cell):
+            gate.wait(30)
+            return False, None
+
+        it = ThreadedIter(
+            produce, restart_policy=RetryPolicy(max_attempts=4))
+        with pytest.raises(DMLCError) as ei:
+            it.next()
+        msg = str(ei.value)
+        assert "last producer error: none" in msg
+        assert "producer restarts 0/3 used" in msg
+        gate.set()
+        it.destroy()
+
+
+class TestOrderedWorkerPoolRestart:
+    @staticmethod
+    def _flaky_source(fail_at, n_failures, n_items=24):
+        state = {"fails": n_failures}
+
+        def factory():
+            def gen():
+                for i in range(n_items):
+                    if i == fail_at and state["fails"] > 0:
+                        state["fails"] -= 1
+                        raise TimeoutError("source flake")
+                    yield i
+            return gen()
+
+        return factory
+
+    def test_ordering_preserved_across_midstream_restart(self):
+        pool = OrderedWorkerPool(
+            self._flaky_source(9, 1), lambda x: x * x, num_workers=3,
+            restart_policy=RetryPolicy(max_attempts=3, base_delay=0.001))
+        assert list(pool) == [i * i for i in range(24)]
+        assert pool.restarts == 1
+        pool.destroy()
+
+    def test_giveup_rethrows_on_consumer(self):
+        pool = OrderedWorkerPool(
+            self._flaky_source(3, 99), lambda x: x, num_workers=2,
+            restart_policy=RetryPolicy(max_attempts=2, base_delay=0.001))
+        out = []
+        with pytest.raises(TimeoutError):
+            for v in pool:
+                out.append(v)
+        assert out == [0, 1, 2]  # pre-fault items still delivered in order
+        assert pool.restarts == 1 and pool.restart_giveups == 1
+        pool.destroy()
+
+    def test_disabled_by_default(self):
+        pool = OrderedWorkerPool(self._flaky_source(3, 1), lambda x: x)
+        with pytest.raises(TimeoutError):
+            list(pool)
+        assert pool.restarts == 0
+        pool.destroy()
+
+
+class TestLintRetryGate:
+    @staticmethod
+    def _scan(src):
+        spec = importlib.util.spec_from_file_location(
+            "lint_retry", os.path.join(os.path.dirname(__file__), os.pardir,
+                                       "bin", "lint_retry.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.scan_source(src)
+
+    def test_flags_ad_hoc_retry_sleep(self):
+        bad = (
+            "import time\n"
+            "def fetch():\n"
+            "    for attempt in range(3):\n"
+            "        try:\n"
+            "            return do()\n"
+            "        except OSError:\n"
+            "            pass\n"
+            "        time.sleep(0.1 * attempt)\n"
+        )
+        assert self._scan(bad)
+
+    def test_ignores_non_retry_sleep(self):
+        ok = (
+            "import time\n"
+            "def poll():\n"
+            "    for tick in range(3):\n"
+            "        time.sleep(1.0)  # fixed-rate heartbeat\n"
+        )
+        assert self._scan(ok) == []
+
+    def test_repo_is_clean(self):
+        import subprocess
+        import sys
+
+        root = os.path.join(os.path.dirname(__file__), os.pardir)
+        out = subprocess.run(
+            [sys.executable, os.path.join(root, "bin", "lint_retry.py"),
+             root], capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+
+
+# ---------------- HTTP end-to-end (acceptance criteria) ----------------
+
+
+class _HttpFilesHandler(http.server.BaseHTTPRequestHandler):
+    files: dict = {}
+    flaky_503 = 0          # next N ranged GETs answer 503
+    flaky_429 = 0          # next N ranged GETs answer 429 + Retry-After
+    retry_after = "0.01"
+
+    def log_message(self, *a):
+        pass
+
+    def do_HEAD(self):
+        data = self.files.get(self.path)
+        if data is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+
+    def do_GET(self):
+        data = self.files.get(self.path)
+        if data is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        cls = type(self)
+        if cls.flaky_503 > 0:
+            cls.flaky_503 -= 1
+            self.send_response(503)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        if cls.flaky_429 > 0:
+            cls.flaky_429 -= 1
+            self.send_response(429)
+            self.send_header("Retry-After", cls.retry_after)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        rng = self.headers.get("Range")
+        if rng:
+            lo, hi = rng.split("=")[1].split("-")
+            lo = int(lo)
+            if lo >= len(data):
+                self.send_response(416)
+                self.end_headers()
+                return
+            chunk = data[lo:int(hi) + 1] if hi else data[lo:]
+            self.send_response(206)
+        else:
+            chunk = data
+            self.send_response(200)
+        self.send_header("Content-Length", str(len(chunk)))
+        self.end_headers()
+        self.wfile.write(chunk)
+
+
+@pytest.fixture()
+def http_files():
+    _HttpFilesHandler.files = {}
+    _HttpFilesHandler.flaky_503 = 0
+    _HttpFilesHandler.flaky_429 = 0
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                             _HttpFilesHandler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    port = server.server_address[1]
+    yield _HttpFilesHandler, f"http://127.0.0.1:{port}"
+    server.shutdown()
+    server.server_close()
+
+
+class TestHttpStreamResilience:
+    def test_server_503s_then_succeed(self, http_files):
+        handler, base = http_files
+        payload = bytes(range(256)) * 200
+        handler.files["/data.bin"] = payload
+        from dmlc_tpu.io import read_all
+
+        handler.flaky_503 = 2  # REAL HTTPError path, not injection
+        assert read_all(f"{base}/data.bin") == payload
+        snap = resilience.counters_snapshot()
+        assert snap["retries"] == 2 and snap["giveups"] == 0
+
+    def test_429_retry_after_honored(self, http_files):
+        handler, base = http_files
+        handler.files["/t.bin"] = b"throttled payload"
+        from dmlc_tpu.io import read_all
+
+        handler.flaky_429 = 1
+        assert read_all(f"{base}/t.bin") == b"throttled payload"
+        assert resilience.counters_snapshot()["retries"] == 1
+
+    def test_midread_resume_exact_byte_offset(self, http_files, monkeypatch):
+        from dmlc_tpu.io import http_filesys
+        from dmlc_tpu.io.filesystem import get_filesystem
+        from dmlc_tpu.io.uri import URI
+
+        monkeypatch.setattr(http_filesys, "_BLOCK", 4096)
+        handler, base = http_files
+        payload = bytes(range(256)) * 128  # 32 KiB -> several blocks
+        handler.files["/big.bin"] = payload
+        fs = get_filesystem(f"{base}/big.bin")
+        with fs.open(URI(f"{base}/big.bin"), "r") as f:
+            assert f.read(100) == payload[:100]
+            f.seek(20000)
+            # fail the NEXT block fetch once: the refetch must resume at
+            # the exact offset, invisibly to the consumer
+            with faults.inject("read@1=reset") as plan:
+                assert f.read(128) == payload[20000:20128]
+            assert plan.fired() == 1
+        snap = resilience.counters_snapshot()
+        assert snap["resumes"] >= 1
+
+    def test_fatal_403_fails_fast(self, http_files):
+        handler, base = http_files
+        handler.files["/secret.bin"] = b"x"
+        from dmlc_tpu.io import read_all
+
+        with faults.inject("open@1=http-403") as plan:
+            with pytest.raises(DMLCError, match="non-retryable"):
+                read_all(f"{base}/secret.bin")
+        assert plan.fired() == 1
+        snap = resilience.counters_snapshot()
+        assert snap["fatal"] == 1 and snap["retries"] == 0
+
+
+def _make_libsvm(n_rows=400, num_col=4, seed=3):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n_rows):
+        feats = " ".join(f"{j}:{rng.normal():.5f}" for j in range(num_col))
+        lines.append(f"{i % 2} {feats}")
+    return ("\n".join(lines) + "\n").encode()
+
+
+def _collect_epoch(url, num_col=4, batch_size=64):
+    from dmlc_tpu.data import create_parser
+    from dmlc_tpu.data.device import DeviceIter
+
+    parser = create_parser(url, 0, 1, "libsvm", chunk_bytes=2048)
+    it = DeviceIter(parser, num_col=num_col, batch_size=batch_size,
+                    layout="dense", pack_aux=False)
+    batches = [(np.asarray(x), np.asarray(y), np.asarray(w))
+               for x, y, w in it]
+    stats = it.stats()
+    it.close()
+    return batches, stats
+
+
+def _assert_batches_equal(a, b):
+    assert len(a) == len(b)
+    for (x1, y1, w1), (x2, y2, w2) in zip(a, b):
+        np.testing.assert_array_equal(x1, x2)
+        np.testing.assert_array_equal(y1, y2)
+        np.testing.assert_array_equal(w1, w2)
+
+
+class TestDeviceIterAcceptance:
+    """ISSUE 2 acceptance: fail-twice-then-succeed completes byte-identical
+    with exact counters; a fatal fault surfaces in <= 1 attempt; a fault
+    that exhausts the stream budget is healed by the bounded pipeline
+    restart."""
+
+    def test_fail_twice_then_succeed_byte_identical(self, http_files,
+                                                    monkeypatch):
+        from dmlc_tpu.io import http_filesys
+
+        monkeypatch.setattr(http_filesys, "_BLOCK", 2048)
+        handler, base = http_files
+        handler.files["/corpus.libsvm"] = _make_libsvm()
+        url = f"{base}/corpus.libsvm"
+
+        clean, clean_stats = _collect_epoch(url)
+        assert clean_stats["resilience"]["retries"] == 0
+        resilience.reset_counters()
+
+        with faults.inject("read@2..3=http-503") as plan:
+            faulted, stats = _collect_epoch(url)
+        _assert_batches_equal(clean, faulted)
+        res = stats["resilience"]
+        assert plan.fired() == 2
+        assert res["retries"] == 2           # exactly the injected faults
+        assert res["resumes"] == 2           # both hit a mid-stream fetch
+        assert res["giveups"] == 0 and res["pipeline_restarts"] == 0
+
+    def test_fatal_fault_surfaces_in_one_attempt(self, http_files):
+        handler, base = http_files
+        handler.files["/corpus.libsvm"] = _make_libsvm()
+        url = f"{base}/corpus.libsvm"
+
+        with faults.inject("open@1=http-403") as plan:
+            with pytest.raises(DMLCError):
+                _collect_epoch(url)
+        assert plan.fired() == 1
+        snap = resilience.counters_snapshot()
+        assert snap["fatal"] >= 1 and snap["retries"] == 0
+
+    def test_pipeline_restart_heals_exhausted_stream_budget(
+            self, http_files, monkeypatch):
+        from dmlc_tpu.io import http_filesys
+
+        monkeypatch.setattr(http_filesys, "_BLOCK", 2048)
+        monkeypatch.setenv("DMLC_RETRY_MAX_ATTEMPTS", "3")
+        handler, base = http_files
+        handler.files["/corpus.libsvm"] = _make_libsvm()
+        url = f"{base}/corpus.libsvm"
+
+        clean, _ = _collect_epoch(url)
+        resilience.reset_counters()
+
+        # 6 consecutive read faults: the stream gives up after 3 attempts
+        # (twice); the DeviceIter-level bounded restart re-arms the host
+        # pipeline at the last delivered batch each time, and the epoch
+        # still completes byte-identical.
+        with faults.inject("read@2..7=http-503") as plan:
+            healed, stats = _collect_epoch(url)
+        _assert_batches_equal(clean, healed)
+        res = stats["resilience"]
+        assert plan.fired() == 6
+        assert res["giveups"] == 2
+        assert res["pipeline_restarts"] == 2
+        assert res["pipeline_giveups"] == 0
