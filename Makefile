@@ -21,9 +21,13 @@ PYTHON ?= python
 SHELL := /bin/bash
 .SHELLFLAGS := -o pipefail -c
 
-.PHONY: check test sanitize parse-bench bench-smoke fuzz lint-retry
+.PHONY: check test test-all sanitize parse-bench bench-smoke fuzz lint-retry
 
+# the tier-1 contract: slow-marked scale/soak tests are opt-in (test-all)
 test:
+	$(PYTHON) -m pytest tests/ -q -m 'not slow'
+
+test-all:
 	$(PYTHON) -m pytest tests/ -q
 
 lint-retry:
@@ -36,8 +40,9 @@ sanitize:
 	sh native/run_sanitizers.sh
 
 # CPU-backend smoke of the driver benchmark: proves the pipeline runs end
-# to end off-chip AND that the stage-attribution contract holds — the one
-# JSON line must carry every named stage plus wall, or the gate fails.
+# to end off-chip AND that the measurement contracts hold — the one JSON
+# line must carry every named attribution stage plus wall, the parse
+# fan-out width, and the workers scaling curve, or the gate fails.
 # Small corpus + 1 rep: this checks the contract, not the throughput.
 bench-smoke:
 	DMLC_BENCH_PLATFORM=cpu DMLC_BENCH_MB=8 DMLC_BENCH_REPS=1 \
@@ -50,8 +55,16 @@ bench-smoke:
 	        'transfer', 'wall') if k not in a]; \
 	    assert not missing, f'attribution fields missing: {missing}'; \
 	    assert line.get('value'), 'bench smoke produced no throughput'; \
+	    assert line.get('parse_workers'), 'parse_workers missing'; \
+	    curve = line.get('parse_scaling') or {}; \
+	    missing_w = [w for w in ('1', '4') if w not in curve]; \
+	    assert not missing_w, f'parse_scaling widths missing: {missing_w}'; \
+	    assert line.get('parse_ceiling_workers_4'), \
+	        'parse_ceiling_workers_4 missing'; \
 	    print('bench-smoke: attribution OK:', \
-	          {k: a[k] for k in sorted(a)})"
+	          {k: a[k] for k in sorted(a)}); \
+	    print('bench-smoke: parse scaling OK:', curve, \
+	          'workers =', line['parse_workers'])"
 
 parse-bench:
 	mkdir -p native/build
@@ -70,7 +83,7 @@ check:
 	@echo "-- lint-retry (ad-hoc retry loop gate) --" | tee -a CHECK.log
 	$(MAKE) --no-print-directory lint-retry 2>&1 | tee -a CHECK.log
 	@echo "-- pytest --" | tee -a CHECK.log
-	$(PYTHON) -m pytest tests/ -q 2>&1 | tee -a CHECK.log
+	$(PYTHON) -m pytest tests/ -q -m 'not slow' 2>&1 | tee -a CHECK.log
 	@echo "-- sanitizers --" | tee -a CHECK.log
 	sh native/run_sanitizers.sh 2>&1 | tee -a CHECK.log
 	@echo "-- parse fuzz --" | tee -a CHECK.log

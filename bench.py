@@ -127,6 +127,43 @@ def host_only_mb_per_sec(path: str, size_mb: float, threaded: bool = False,
     return max(rates), _median(rates)
 
 
+def parse_fanout_mb_per_sec(path: str, size_mb: float, workers: int) -> float:
+    """One drain of the PYTHON-ENGINE parse path at a given fan-out width
+    (``parse_workers=1`` is the single-producer parse-ahead thread — the
+    pre-fan-out engine; >1 is the ParallelTextParser pool over the
+    zero-copy mmap chunk source). ``engine=python`` pins the route so the
+    curve measures the fan-out, not the native reader (which keeps its own
+    C++ threading and ignores the knob)."""
+    from dmlc_tpu.data import create_parser
+
+    parser = create_parser(path + "?engine=python", 0, 1, "libsvm",
+                           threaded=True, parse_workers=workers,
+                           chunk_bytes=CHUNK_BYTES)
+    try:
+        t0 = time.monotonic()
+        rows = 0
+        while (block := parser.next_block()) is not None:
+            rows += len(block)
+        dt = time.monotonic() - t0
+    finally:
+        parser.close()  # a mid-drain error must not leak the worker pool
+    log(f"bench: parse fan-out workers={workers} {rows} rows in {dt:.2f}s "
+        f"= {size_mb/dt:.1f} MB/s")
+    return size_mb / dt
+
+
+def parse_scaling_curve(path: str, size_mb: float, workers=(1, 2, 4)):
+    """Host-only parse ceiling at each fan-out width, INTERLEAVED across
+    reps so this host's 2-4x ambient swings hit every width evenly —
+    the scaling ratio is the stable quantity, not the absolutes. Returns
+    {workers: (best, median)}."""
+    rates = {w: [] for w in workers}
+    for _ in range(REPS):
+        for w in workers:
+            rates[w].append(parse_fanout_mb_per_sec(path, size_mb, w))
+    return {w: (max(v), _median(v)) for w, v in rates.items()}
+
+
 def into_hbm_mb_per_sec(path: str, size_mb: float, x_dtype: str = "float32"):
     """Full async pipeline into device HBM."""
     import jax
@@ -152,6 +189,7 @@ def into_hbm_mb_per_sec(path: str, size_mb: float, x_dtype: str = "float32"):
     best = 0.0
     attribution = None  # per-stage table of the best rep (steady state)
     resilience = None  # retry/resume/restart counters of the best rep
+    parallel = None  # parse fan-out sideband of the best rep
     for _ in range(REPS):
         t0 = time.monotonic()
         parser = create_parser(path, 0, 1, "libsvm", threaded=True,
@@ -199,6 +237,11 @@ def into_hbm_mb_per_sec(path: str, size_mb: float, x_dtype: str = "float32"):
             attribution = _bench_common().attribution_line(
                 stats, extra_transfer=drain)
             resilience = stats.get("resilience")
+            parallel = {
+                "parse_workers": stats.get("parse_workers"),
+                "parse_parallelism_efficiency":
+                    stats.get("parse_parallelism_efficiency"),
+            }
         it.close()
         log(
             f"bench: into-HBM {nbatches} batches in {dt:.2f}s = "
@@ -210,7 +253,7 @@ def into_hbm_mb_per_sec(path: str, size_mb: float, x_dtype: str = "float32"):
             f"final transfer drain {drain:.3f}s)"
         )
     return (best, _median(rates), (min(rates), max(rates)), attribution,
-            (max(dev_rates), _median(dev_rates)), resilience)
+            (max(dev_rates), _median(dev_rates)), resilience, parallel)
 
 
 def device_floor_mbps(x_dtype: str = "float32"):
@@ -276,8 +319,8 @@ def run_child() -> None:
     log(f"bench: corpus {size_mb:.1f} MB")
     base_best, base_med = host_only_mb_per_sec(path, size_mb)
     try:
-        value, med, spread, attribution, dev, resilience = into_hbm_mb_per_sec(
-            path, size_mb)
+        (value, med, spread, attribution, dev, resilience,
+         parallel) = into_hbm_mb_per_sec(path, size_mb)
     except Exception as exc:  # noqa: BLE001 - classify for the supervisor
         msg = f"{type(exc).__name__}: {exc}"
         if any(m in msg for m in _INFRA_MARKERS):
@@ -311,6 +354,34 @@ def run_child() -> None:
         hot = {k: v for k, v in resilience.items() if v}
         if hot:
             log(f"bench: resilience events: {hot}")
+    if parallel is not None:
+        # the pipeline's parse fan-out width + measured parallel efficiency
+        # (docs/data.md parse_workers; the native reader reports its C++
+        # thread count with no efficiency instrumentation)
+        line["parse_workers"] = parallel.get("parse_workers")
+        line["parse_parallelism_efficiency"] = parallel.get(
+            "parse_parallelism_efficiency")
+    # parse fan-out scaling curve (ISSUE 3): the host parse ceiling of the
+    # PYTHON engine at 1/2/4 workers, interleaved so ambient drift cancels
+    # in the ratio. parse_ceiling_workers_1 is the pre-fan-out engine;
+    # parse_ceiling_workers_4 over it is the PR's raised ceiling.
+    try:
+        curve = parse_scaling_curve(path, size_mb)
+        scaling = {}
+        for w, (cbest, cmed) in sorted(curve.items()):
+            line[f"parse_ceiling_workers_{w}"] = round(cbest, 2)
+            scaling[str(w)] = {"best": round(cbest, 2),
+                               "median": round(cmed, 2)}
+        line["parse_scaling"] = scaling
+        ws = sorted(curve)
+        lo, hi = curve[ws[0]], curve[ws[-1]]
+        line["parse_parallel_speedup"] = round(hi[0] / lo[0], 3)
+        line["parse_parallel_speedup_median"] = round(hi[1] / lo[1], 3)
+        log(f"bench: parse fan-out scaling (best): "
+            + ", ".join(f"{w}w={curve[w][0]:.1f}" for w in ws)
+            + f" MB/s -> speedup x{hi[0]/lo[0]:.2f}")
+    except Exception as exc:  # noqa: BLE001 - the headline must still print
+        log(f"bench: parse scaling leg failed: {exc}")
     # percent-of-line-rate (VERDICT r4 next #2): the BASELINE framing is
     # ">=90% of host->HBM line rate", which vs-parse-baseline does not
     # measure. Join the raw device_put floor for the same shapes/dtype,
@@ -374,8 +445,8 @@ def run_child() -> None:
     # bf16 ingest: the C++ repack emits bfloat16 (the MXU's operand width),
     # halving host->HBM bytes — reported alongside, headline stays f32
     try:
-        bf16_value, bf16_med, _sp, _, bf16_dev, _res = into_hbm_mb_per_sec(
-            path, size_mb, x_dtype="bfloat16")
+        (bf16_value, bf16_med, _sp, _, bf16_dev, _res,
+         _par) = into_hbm_mb_per_sec(path, size_mb, x_dtype="bfloat16")
         line["bf16_mb_per_sec"] = round(bf16_value, 2)
         line["bf16_vs_baseline"] = round(bf16_value / base_best, 3)
         line["bf16_median_vs_baseline"] = round(bf16_med / base_med, 3)
@@ -524,7 +595,13 @@ def main() -> int:
                                   fb_timeout)
             if isinstance(parsed, dict):
                 for k in ("value", "vs_baseline", "median_vs_baseline",
-                          "bf16_vs_baseline", "parse_ceiling_mb_per_sec"):
+                          "bf16_vs_baseline", "parse_ceiling_mb_per_sec",
+                          "parse_workers", "parse_parallelism_efficiency",
+                          "parse_ceiling_workers_1",
+                          "parse_ceiling_workers_2",
+                          "parse_ceiling_workers_4", "parse_scaling",
+                          "parse_parallel_speedup",
+                          "parse_parallel_speedup_median"):
                     if parsed.get(k) is not None:
                         line[f"cpu_backend_{k}"] = parsed[k]
                 line["cpu_backend_note"] = (

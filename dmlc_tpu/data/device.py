@@ -1163,6 +1163,11 @@ class DeviceIter:
         giveup counters accrued by the I/O stack since this iterator was
         built (process-wide deltas — see docs/resilience.md), plus this
         iterator's own bounded pipeline-restart counts.
+
+        ``parse_workers`` / ``parse_parallelism_efficiency`` (with the full
+        ``parse_parallel`` sideband) report the source chain's data-parallel
+        parse fan-out — how many chunk-parse lanes fed this pipeline and
+        how fully they ran in parallel (docs/data.md ``parse_workers``).
         """
         wall = 0.0
         if self._t_first is not None and self._t_last is not None:
@@ -1170,6 +1175,16 @@ class DeviceIter:
         resilience = _resilience.counters_delta(self._res_base)
         resilience["pipeline_restarts"] = self.pipeline_restarts
         resilience["pipeline_giveups"] = self.pipeline_giveups
+        # parse-parallelism sideband: the source chain reports its fan-out
+        # width + measured efficiency (ParallelTextParser / the native
+        # reader); single-lane sources report 1 worker, no efficiency
+        pstats = None
+        fn = getattr(self.source, "parallel_stats", None)
+        if callable(fn):
+            try:
+                pstats = fn()
+            except Exception:  # noqa: BLE001 - stats must never break stats
+                pstats = None
         return {
             "batches": self.batches_fed,
             "bytes_to_device": self.bytes_to_device,
@@ -1180,6 +1195,10 @@ class DeviceIter:
             "wall_seconds": wall,
             "transfer_samples": self._transfer_samples,
             "convert_workers": self.convert_workers,
+            "parse_workers": (pstats or {}).get("parse_workers", 1),
+            "parse_parallelism_efficiency": (pstats or {}).get(
+                "parse_parallelism_efficiency"),
+            "parse_parallel": pstats,
             "staging_ring": (self._ring.stats() if self._ring is not None
                              else None),
             "resilience": resilience,

@@ -196,6 +196,7 @@ def create_row_block_iter(
     type_: str = "auto",
     index_dtype=np.uint64,
     silent: bool = False,
+    parse_workers: Optional[int] = None,
     **parser_kw,
 ) -> RowBlockIter:
     """RowBlockIter factory — analog of RowBlockIter::Create
@@ -203,6 +204,11 @@ def create_row_block_iter(
 
     A ``#cachefile`` URI suffix selects the disk-cached iterator; the cache
     path is partition-qualified ``.splitN.partK`` (uri_spec.h:47-53).
+
+    ``parse_workers`` sizes the Python engine's data-parallel chunk-parse
+    fan-out exactly as in :func:`~dmlc_tpu.data.parsers.create_parser`
+    (1 = single-producer parse-ahead; None = auto) — it applies to the
+    load/cache-build pass; cached epochs read pre-parsed pages.
     """
     spec = URISpec(uri, part_index, num_parts)
     # the cache here is the parsed-page cache (DiskRowIter); strip it before
@@ -210,10 +216,12 @@ def create_row_block_iter(
     parser_uri = uri.split("#", 1)[0]
     if spec.cache_file is None:
         parser = create_parser(parser_uri, part_index, num_parts, type_,
-                               index_dtype=index_dtype, **parser_kw)
+                               index_dtype=index_dtype,
+                               parse_workers=parse_workers, **parser_kw)
         return BasicRowIter(parser, silent=silent)
     if os.path.exists(spec.cache_file):
         return DiskRowIter(None, spec.cache_file, silent=silent)
     parser = create_parser(parser_uri, part_index, num_parts, type_,
-                           index_dtype=index_dtype, **parser_kw)
+                           index_dtype=index_dtype,
+                           parse_workers=parse_workers, **parser_kw)
     return DiskRowIter(parser, spec.cache_file, silent=silent)

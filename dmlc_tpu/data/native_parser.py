@@ -323,6 +323,27 @@ class NativeStreamParser(Parser):
         """Consumer-side wait on the native pipeline."""
         return self._stall
 
+    @property
+    def parse_workers(self) -> int:
+        """The native reader's own C++ parse-thread count — it keeps its
+        own threading and ignores the Python engine's ``parse_workers``
+        knob (docs/data.md)."""
+        from dmlc_tpu import native
+
+        return native.default_nthread()
+
+    def parallel_stats(self) -> dict:
+        """Scaling sideband in the same shape ParallelTextParser reports
+        (DeviceIter.stats() consumes either): the C++ core does not expose
+        per-thread busy seconds, so efficiency is unmeasured here."""
+        return {
+            "parse_workers": self.parse_workers,
+            "parse_busy_seconds": None,
+            "parse_span_seconds": None,
+            "parse_parallelism_efficiency": None,
+            "engine": "native",
+        }
+
     def close(self) -> None:
         if self._reader is not None:
             self._reader.close()

@@ -23,17 +23,20 @@ Semantics matched to the reference:
 from __future__ import annotations
 
 import os
+import threading
 from typing import Dict, Iterator, Optional
 
 import numpy as np
 
 from dmlc_tpu.data.row_block import DenseBlock, RowBlock
+from dmlc_tpu.io import resilience as _resilience
 from dmlc_tpu.io.input_split import (
     DEFAULT_CHUNK_BYTES,
     InputSplit,
     create_input_split,
+    create_mmap_text_split,
 )
-from dmlc_tpu.io.threaded_iter import ThreadedIter
+from dmlc_tpu.io.threaded_iter import OrderedWorkerPool, ThreadedIter
 from dmlc_tpu.io.uri import URISpec
 from dmlc_tpu.utils.check import DMLCError, check
 from dmlc_tpu.utils.params import Parameter, field
@@ -109,6 +112,20 @@ class TextParserBase(Parser):
     # parse_chunk_* directly via __new__) behave
     _emit_dense: Optional[int] = None
     _native = None
+    # per-chunk native scanner threads: 0 = the native default
+    # (cores/2-ish). The data-parallel fan-out pins this to 1 — chunk-level
+    # parallelism across pool workers replaces intra-chunk threading, whose
+    # per-chunk thread spawn measured slower than a single lane anyway.
+    _parse_nthread: int = 0
+    # fast-path probing state: a corpus whose first chunks ALL reject the
+    # _token_table signature (label:weight everywhere, all-binary
+    # features) stops paying the qualification scan; one qualifying chunk
+    # pins probing on for good. Both fields are advisory and updated
+    # RACILY by pool workers — _fast_saw_hit is a monotonic plain store
+    # and lost _fast_rejects increments merely delay the give-up, so races
+    # cost at most a few extra qualification scans, never wrong output.
+    _fast_rejects: int = 0
+    _fast_saw_hit: bool = False
 
     def __init__(self, source: InputSplit, index_dtype=np.uint64):
         self.source = source
@@ -144,13 +161,16 @@ class TextParserBase(Parser):
     def parse_chunk_native(self, chunk: bytes) -> Optional[RowBlock]:
         return None
 
-    def parse_chunk(self, chunk: bytes) -> RowBlock:
+    def parse_chunk(self, chunk) -> RowBlock:
+        """chunk: bytes or memoryview. The native engines consume a view's
+        buffer zero-copy (length-bounded C scanners); the numpy engine
+        materializes bytes once, here."""
         if self.use_native():
             block = self.parse_chunk_native(chunk)
             if block is not None:
                 return block
         try:
-            return self.parse_chunk_py(chunk)
+            return self.parse_chunk_py(_chunk_bytes(chunk))
         except (ValueError, TypeError) as exc:
             # numpy conversion failures (e.g. astype on a malformed token)
             # surface as the same error type the native engine raises
@@ -166,28 +186,42 @@ class TextParserBase(Parser):
         ``parse`` is chunk->RowBlock conversion."""
         return {"read": self._read_seconds, "parse": self._parse_seconds}
 
+    def _pull_chunk(self):
+        """One serial chunk pull with the bookkeeping every consumer needs:
+        read-seconds accrual, byte/chunk counters, and the byte-exact
+        resume annotation positioned just AFTER the chunk (SURVEY.md §5.4)
+        — shared by :meth:`next_block` and the parallel fan-out's serial
+        source stage so the checkpoint schema cannot diverge. Returns
+        ``(chunk, annot_or_None)``; ``(None, None)`` at end of stream."""
+        t0 = get_time()
+        chunk = self.source.next_chunk()
+        self._read_seconds += get_time() - t0
+        if chunk is None:
+            return None, None
+        self._bytes += len(chunk)
+        self._chunks_in += 1
+        annot = None
+        split_state = getattr(self.source, "chunk_resume_state", None)
+        if split_state is not None:
+            annot = {"kind": "split", "split": split_state,
+                     "chunks": self._chunks_in}
+        return chunk, annot
+
     def next_block(self) -> Optional[RowBlock]:
         while True:
-            t0 = get_time()
-            chunk = self.source.next_chunk()
-            self._read_seconds += get_time() - t0
+            chunk, annot = self._pull_chunk()
             if chunk is None:
                 return None
-            self._bytes += len(chunk)
-            self._chunks_in += 1
             t1 = get_time()
-            block = self.parse_chunk(_chunk_bytes(chunk))
+            block = self.parse_chunk(chunk)
             self._parse_seconds += get_time() - t1
             if len(block) > 0:
-                # annotate with the parser state positioned just AFTER this
-                # block, so downstream prefetch pipelines (ThreadedParser,
+                # the annotation marks the position just AFTER this block,
+                # so downstream prefetch pipelines (ThreadedParser,
                 # DeviceIter) can checkpoint byte-exactly even though their
-                # own view runs behind this producer (SURVEY.md §5.4)
-                split_state = getattr(self.source, "chunk_resume_state", None)
-                if split_state is not None:
-                    block.resume_state = {"kind": "split",
-                                          "split": split_state,
-                                          "chunks": self._chunks_in}
+                # own view runs behind this producer
+                if annot is not None:
+                    block.resume_state = annot
                 return block
 
     def before_first(self) -> None:
@@ -288,6 +322,99 @@ def _apply_indexing_mode(index: np.ndarray, mode: int) -> np.ndarray:
     return index
 
 
+# bytes.split() whitespace, as a byte-indexed lookup table
+_WS_LUT = np.zeros(256, bool)
+_WS_LUT[[9, 10, 11, 12, 13, 32]] = True
+
+# _token_table rejections (with no success yet) before a parser stops
+# trying the fast path for good — the corpus structure never qualifies
+_FAST_PATH_GIVEUP = 4
+
+
+def _token_table(chunk: bytes, stride: int):
+    """Vectorized structure scan for simple ``label f f f...`` text chunks.
+
+    Splits the whole chunk ONCE on whitespace+colon into a single token
+    array reused for label / index / value extraction, and derives the
+    per-line structure (feature counts, label positions) from numpy mask
+    scans instead of a per-line Python loop. ``stride`` is sub-tokens per
+    feature (2 = libsvm ``idx:val``, 3 = libfm ``field:idx:val``).
+
+    Returns ``(tokens, nnz, first_idx)`` or None when the chunk needs the
+    general path (comments, qid, label:weight, binary/mixed features — any
+    line whose token/colon counts break the uniform stride). The general
+    path materializes the chunk ~3x via join + replace blobs; this one
+    costs a single colon->space replace + split.
+    """
+    if b"#" in chunk or b"qid:" in chunk:
+        return None
+    if chunk.startswith(b"\xef\xbb\xbf"):
+        chunk = chunk[3:]
+    if b"\r" in chunk:
+        chunk = chunk.replace(b"\r", b"\n")
+    if not chunk:
+        return None
+    # structure checks run on zero-copy mask scans FIRST; the Python-level
+    # replace/split/array-build — the expensive part — happens only after
+    # the chunk has qualified, so a rejecting chunk costs numpy scans only
+    a = np.frombuffer(chunk, np.uint8)
+    iscolon = a == 0x3A
+    issep = _WS_LUT[a] | iscolon  # colons become separators in the split
+    cpos = np.nonzero(iscolon)[0]
+    if len(cpos):
+        # every colon must be GLUED to non-separator bytes on both sides:
+        # '2: 3' / '2 :3' / '2::3' / a chunk-edge colon all split into
+        # tokens whose counts alias a clean 'idx:val' signature while the
+        # general path reads them as missing-value/binary/malformed
+        if cpos[0] == 0 or cpos[-1] == len(a) - 1:
+            return None
+        if issep[cpos - 1].any() or issep[cpos + 1].any():
+            return None
+    prev = np.empty_like(issep)
+    prev[0] = True
+    prev[1:] = issep[:-1]
+    tstart = ~issep & prev
+    if not tstart.any():
+        return None
+    lid = np.cumsum(a == 0x0A)  # line id = newlines before each byte
+    nlines = int(lid[-1]) + 1
+    counts = np.bincount(lid[tstart], minlength=nlines)
+    ccounts = np.bincount(lid[iscolon], minlength=nlines)
+    live = counts > 0
+    if np.any(ccounts[~live] > 0):
+        # colons on a token-less line (e.g. ':::') — the general path
+        # rejects these loudly; never swallow them here
+        return None
+    lc, cc = counts[live], ccounts[live]
+    # every live line must be exactly label + nnz uniform features
+    nnz, rem = np.divmod(lc - 1, stride)
+    if rem.any() or not np.array_equal(cc, (stride - 1) * nnz):
+        return None
+    first_idx = np.zeros(len(lc), np.int64)
+    np.cumsum(lc[:-1], out=first_idx[1:])
+    # every colon must belong to a FEATURE token: a colon attached to a
+    # line's first token is a label colon (label:weight — or malformed),
+    # whose sub-tokens would otherwise alias a uniform feature signature
+    # (e.g. libsvm '1:2 3' = weighted label + binary feature parses with
+    # the same token/colon counts as 'label idx:val'). tok_before[i] is
+    # the index of the token the byte at i follows.
+    line_first = np.full(nlines, -1, np.int64)
+    line_first[np.nonzero(live)[0]] = first_idx
+    tok_before = np.cumsum(tstart) - 1
+    if np.any(tok_before[iscolon] == line_first[lid[iscolon]]):
+        return None
+    tokens = np.array(chunk.replace(b":", b" ").split())
+    return tokens, nnz, first_idx
+
+
+def _split_label_feats(tokens: np.ndarray, first_idx: np.ndarray):
+    """(labels f32, feature sub-token array) from a :func:`_token_table`
+    result — the one extraction both fast-path engines share."""
+    label_mask = np.zeros(len(tokens), bool)
+    label_mask[first_idx] = True
+    return tokens[first_idx].astype(np.float32), tokens[~label_mask]
+
+
 class LibSVMParser(TextParserBase):
     """libsvm text -> RowBlock (libsvm_parser.h:85-169)."""
 
@@ -308,10 +435,13 @@ class LibSVMParser(TextParserBase):
     def parse_chunk_native(self, chunk: bytes) -> Optional[RowBlock]:
         from dmlc_tpu import native
 
-        if self._emit_dense is not None:
+        # snapshot once: a concurrent worker's NeedsCsrError fallback may
+        # null _emit_dense between the check and the call (pool fan-out)
+        num_col = self._emit_dense
+        if num_col is not None:
             try:
                 out = native.parse_libsvm_dense(
-                    chunk, self._emit_dense,
+                    chunk, num_col, nthread=self._parse_nthread,
                     indexing_mode=self.param.indexing_mode)
             except native.NeedsCsrError:
                 # data the dense scanner can't express (qid rows):
@@ -321,7 +451,8 @@ class LibSVMParser(TextParserBase):
             if out is not None:
                 x, label, weight, owner, _packed = out
                 return DenseBlock(x, label, weight, hold=owner)
-        d = native.parse_libsvm(chunk, indexing_mode=self.param.indexing_mode)
+        d = native.parse_libsvm(chunk, nthread=self._parse_nthread,
+                                indexing_mode=self.param.indexing_mode)
         if d is None:
             return None
         return RowBlock(
@@ -331,10 +462,30 @@ class LibSVMParser(TextParserBase):
         )
 
     def parse_chunk_py(self, chunk: bytes) -> RowBlock:
+        fast = (_token_table(chunk, stride=2)
+                if self._fast_saw_hit
+                or self._fast_rejects < _FAST_PATH_GIVEUP else None)
+        if fast is not None:
+            self._fast_saw_hit = True
+            # one splitted-token array serves label, index AND value
+            tokens, nnz, first_idx = fast
+            labels, feats = _split_label_feats(tokens, first_idx)
+            if len(feats) == 0:
+                return RowBlock(
+                    offset=np.concatenate([[0], np.cumsum(nnz)]),
+                    label=labels, index=np.empty(0, self.index_dtype))
+            index = _apply_indexing_mode(
+                feats[0::2].astype(np.int64), self.param.indexing_mode)
+            return RowBlock(
+                offset=np.concatenate([[0], np.cumsum(nnz)]),
+                label=labels,
+                index=index.astype(self.index_dtype, copy=False),
+                value=feats[1::2].astype(np.float32),
+            )
+        self._fast_rejects += 1
         lines = _tokenize_lines(chunk)
         n = len(lines)
         label_toks = []
-        weight_vals: list = []
         qid_vals: list = []
         has_qid = False
         nnz = np.empty(n, dtype=np.int64)
@@ -350,13 +501,16 @@ class LibSVMParser(TextParserBase):
                 raise DMLCError("libsvm: qid must appear on every row or none")
             nnz[i] = len(f)
             feat_toks.extend(f)
+        if has_qid and len(qid_vals) != n:
+            # qid first appeared on a LATER row: rows before it had none —
+            # the per-row check above only trips once has_qid is set
+            raise DMLCError("libsvm: qid must appear on every row or none")
         if n == 0:
             return RowBlock(np.zeros(1, np.int64), np.empty(0, np.float32),
                             np.empty(0, self.index_dtype))
         # labels (with optional :weight)
         label_arr = np.array(label_toks)
-        label_blob = b" ".join(label_toks)
-        if b":" in label_blob:
+        if any(b":" in t for t in label_toks):
             pairs = np.char.partition(label_arr, b":")
             labels = pairs[:, 0].astype(np.float32)
             wcol = pairs[:, 2]
@@ -368,21 +522,21 @@ class LibSVMParser(TextParserBase):
             weights = None
         # features idx[:val]
         if feat_toks:
-            feat_arr = np.array(feat_toks)
             blob = b" ".join(feat_toks)
             ncolon = blob.count(b":")
             if ncolon == len(feat_toks):
-                # fast path: every feature has a value
+                # every feature has a value: one splitted-token array,
+                # index/value extracted as strided views of it
                 nums = np.array(blob.replace(b":", b" ").split())
                 index = nums[0::2].astype(np.int64)
                 value = nums[1::2].astype(np.float32)
             elif ncolon == 0:
                 # all-binary features
-                index = feat_arr.astype(np.int64)
+                index = np.array(feat_toks).astype(np.int64)
                 value = None
             else:
                 # mixed: treat missing values as 1.0
-                parts = np.char.partition(feat_arr, b":")
+                parts = np.char.partition(np.array(feat_toks), b":")
                 index = parts[:, 0].astype(np.int64)
                 vals = parts[:, 2]
                 value = np.where(vals == b"", b"1", vals).astype(np.float32)
@@ -432,7 +586,8 @@ class CSVParser(TextParserBase):
     def parse_chunk_native(self, chunk: bytes) -> Optional[RowBlock]:
         from dmlc_tpu import native
 
-        out = native.parse_csv(chunk, delimiter=self.param.delimiter)
+        out = native.parse_csv(chunk, delimiter=self.param.delimiter,
+                               nthread=self._parse_nthread)
         if out is None:
             return None
         cells, owner = out
@@ -497,27 +652,38 @@ def csv_cells_to_dense(cells: np.ndarray, n: int, ncol: int, num_col: int,
 # synthetic CSR skeletons for CSV blocks: every row has the same k column
 # indices and k-strided offsets, and block geometry repeats (chunk-sized
 # blocks), so one (n, k) build serves the whole stream — rebuilding them
-# per block was ~2 array builds per MB of corpus on the hot path
+# per block was ~2 array builds per MB of corpus on the hot path.
+# Lock-guarded: chunks parse on multiple ParallelTextParser workers, and
+# an unguarded clear()+insert raced (one worker could evict the entry
+# another was inserting, or two could size-check a half-updated dict).
 _CSV_SKELETON_CACHE: dict = {}
+_CSV_SKELETON_LOCK = threading.Lock()
 
 
 def _csv_skeleton(n: int, k: int, index_dtype):
     key = (n, k, np.dtype(index_dtype).str)
-    hit = _CSV_SKELETON_CACHE.get(key)
-    if hit is None:
-        if len(_CSV_SKELETON_CACHE) > 64:  # block geometries are few
-            _CSV_SKELETON_CACHE.clear()
-        index = np.tile(np.arange(k, dtype=index_dtype), n)
-        # k == 0 (every column is label/weight) is a legal degenerate: all
-        # offsets are 0 — np.arange with step 0 would raise instead
-        offset = (np.arange(0, (n + 1) * k, k, dtype=np.int64)
-                  if k else np.zeros(n + 1, np.int64))
-        # shared across every block of the stream — freeze so an
-        # accidental in-place edit cannot corrupt sibling blocks
-        index.flags.writeable = False
-        offset.flags.writeable = False
-        hit = (index, offset)
-        _CSV_SKELETON_CACHE[key] = hit
+    with _CSV_SKELETON_LOCK:
+        hit = _CSV_SKELETON_CACHE.get(key)
+        if hit is not None:
+            return hit
+    # build OUTSIDE the lock (array builds are the expensive part);
+    # concurrent builders of the same key converge on whichever insert wins
+    index = np.tile(np.arange(k, dtype=index_dtype), n)
+    # k == 0 (every column is label/weight) is a legal degenerate: all
+    # offsets are 0 — np.arange with step 0 would raise instead
+    offset = (np.arange(0, (n + 1) * k, k, dtype=np.int64)
+              if k else np.zeros(n + 1, np.int64))
+    # shared across every block of the stream — freeze so an
+    # accidental in-place edit cannot corrupt sibling blocks
+    index.flags.writeable = False
+    offset.flags.writeable = False
+    with _CSV_SKELETON_LOCK:
+        hit = _CSV_SKELETON_CACHE.get(key)
+        if hit is None:
+            if len(_CSV_SKELETON_CACHE) > 64:  # block geometries are few
+                _CSV_SKELETON_CACHE.clear()
+            hit = (index, offset)
+            _CSV_SKELETON_CACHE[key] = hit
     return hit
 
 
@@ -564,7 +730,8 @@ class LibFMParser(TextParserBase):
     def parse_chunk_native(self, chunk: bytes) -> Optional[RowBlock]:
         from dmlc_tpu import native
 
-        d = native.parse_libfm(chunk, indexing_mode=self.param.indexing_mode)
+        d = native.parse_libfm(chunk, nthread=self._parse_nthread,
+                               indexing_mode=self.param.indexing_mode)
         if d is None:
             return None
         return RowBlock(
@@ -573,31 +740,48 @@ class LibFMParser(TextParserBase):
         )
 
     def parse_chunk_py(self, chunk: bytes) -> RowBlock:
-        lines = _tokenize_lines(chunk)
-        n = len(lines)
-        if n == 0:
-            return RowBlock(np.zeros(1, np.int64), np.empty(0, np.float32),
-                            np.empty(0, self.index_dtype))
-        label_toks = []
-        nnz = np.empty(n, dtype=np.int64)
-        feat_toks: list = []
-        for i, toks in enumerate(lines):
-            label_toks.append(toks[0])
-            nnz[i] = len(toks) - 1
-            feat_toks.extend(toks[1:])
-        labels = np.array(label_toks).astype(np.float32)
-        if feat_toks:
-            blob = b" ".join(feat_toks)
-            check(blob.count(b":") == 2 * len(feat_toks),
-                  "libfm: features must be field:index:value triples")
-            nums = np.array(blob.replace(b":", b" ").split())
-            fields = nums[0::3].astype(np.int64)
-            index = nums[1::3].astype(np.int64)
-            value = nums[2::3].astype(np.float32)
+        fast = (_token_table(chunk, stride=3)
+                if self._fast_saw_hit
+                or self._fast_rejects < _FAST_PATH_GIVEUP else None)
+        if fast is not None:
+            self._fast_saw_hit = True
+            tokens, nnz, first_idx = fast
+            labels, feats = _split_label_feats(tokens, first_idx)
+            if len(feats):
+                fields = feats[0::3].astype(np.int64)
+                index = feats[1::3].astype(np.int64)
+                value = feats[2::3].astype(np.float32)
+            else:
+                fields = np.empty(0, np.int64)
+                index = np.empty(0, np.int64)
+                value = None
         else:
-            fields = np.empty(0, np.int64)
-            index = np.empty(0, np.int64)
-            value = None
+            self._fast_rejects += 1
+            lines = _tokenize_lines(chunk)
+            n = len(lines)
+            if n == 0:
+                return RowBlock(np.zeros(1, np.int64), np.empty(0, np.float32),
+                                np.empty(0, self.index_dtype))
+            label_toks = []
+            nnz = np.empty(n, dtype=np.int64)
+            feat_toks: list = []
+            for i, toks in enumerate(lines):
+                label_toks.append(toks[0])
+                nnz[i] = len(toks) - 1
+                feat_toks.extend(toks[1:])
+            labels = np.array(label_toks).astype(np.float32)
+            if feat_toks:
+                blob = b" ".join(feat_toks)
+                check(blob.count(b":") == 2 * len(feat_toks),
+                      "libfm: features must be field:index:value triples")
+                nums = np.array(blob.replace(b":", b" ").split())
+                fields = nums[0::3].astype(np.int64)
+                index = nums[1::3].astype(np.int64)
+                value = nums[2::3].astype(np.float32)
+            else:
+                fields = np.empty(0, np.int64)
+                index = np.empty(0, np.int64)
+                value = None
         mode = self.param.indexing_mode
         # heuristic applies to BOTH field and index (libfm_parser.h:130-143)
         if len(index):
@@ -613,7 +797,81 @@ class LibFMParser(TextParserBase):
         )
 
 
-class ThreadedParser(Parser):
+class _WrappedParserMixin:
+    """The delegation + checkpoint contract shared by the parse-ahead
+    wrappers (:class:`ThreadedParser`, :class:`ParallelTextParser`): both
+    decorate a :class:`TextParserBase`, deliver its blocks with resume
+    annotations riding along, and restore via byte-exact seek
+    (``kind='split'``) or deterministic block replay (``kind='blocks'``).
+    Subclasses provide ``_started()`` (background production running?) and
+    ``_quiesce()`` (stop it; the next pull re-arms lazily)."""
+
+    base: TextParserBase
+    _delivered: int
+    _last_annot: Optional[dict]
+
+    def _started(self) -> bool:
+        raise NotImplementedError
+
+    def _quiesce(self) -> None:
+        raise NotImplementedError
+
+    def set_emit_dense(self, num_col: int, batch_rows: int = 0,
+                       dtype: str = "float32") -> bool:
+        if self._started():
+            # production already running: flipping block kinds mid-stream
+            # would mix racily, so decline — callers handle RowBlocks too
+            return False
+        try:
+            return self.base.set_emit_dense(num_col, batch_rows, dtype)
+        except TypeError:  # legacy one-arg bases keep working when wrapped
+            return self.base.set_emit_dense(num_col)
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        # quiesce production before re-pointing the base
+        self._quiesce()
+        self.base.reset_partition(part_index, num_parts)
+        self._delivered = 0
+        self._last_annot = None
+
+    def state_dict(self) -> dict:
+        if self._last_annot is not None:
+            return dict(self._last_annot, blocks=self._delivered)
+        # no annotation (epoch start, or a base without them): count
+        # delivered blocks and replay on restore
+        return {"kind": "blocks", "blocks": self._delivered}
+
+    def load_state(self, state: dict) -> None:
+        self._quiesce()
+        if state.get("kind") == "split":
+            # seek, don't replay: the base parser restores the split's
+            # byte-exact position and production continues from there
+            self.base.load_state(state)
+            self._delivered = int(state.get("blocks", 0))
+            self._last_annot = {k: v for k, v in state.items()
+                                if k != "blocks"}
+            return
+        n = int(state["blocks"])
+        self.base.before_first()
+        for _ in range(n):
+            if self.base.next_block() is None:
+                break
+        # re-quiesce: the serial replay accrued base parse seconds, which
+        # must not contaminate a subclass's post-restore efficiency span
+        self._quiesce()
+        self._delivered = n
+        self._last_annot = None
+
+    @property
+    def bytes_read(self) -> int:
+        return self.base.bytes_read
+
+    def close(self) -> None:
+        self._quiesce()
+        self.base.close()
+
+
+class ThreadedParser(_WrappedParserMixin, Parser):
     """Parse-ahead decorator — analog of ThreadedParser (parser.h:70-126,
     ThreadedIter capacity 8)."""
 
@@ -627,6 +885,14 @@ class ThreadedParser(Parser):
         # racing blocks already in flight
         self._iter: Optional[ThreadedIter] = None
 
+    def _started(self) -> bool:
+        return self._iter is not None
+
+    def _quiesce(self) -> None:
+        if self._iter is not None:
+            self._iter.destroy()
+            self._iter = None
+
     def _ensure_iter(self) -> ThreadedIter:
         if self._iter is None:
             self._iter = ThreadedIter(
@@ -639,17 +905,6 @@ class ThreadedParser(Parser):
         if block is None:
             return False, None
         return True, block
-
-    def set_emit_dense(self, num_col: int, batch_rows: int = 0,
-                       dtype: str = "float32") -> bool:
-        if self._iter is not None:
-            # producer already running: flipping modes mid-stream would mix
-            # block kinds racily, so decline — callers handle RowBlocks too
-            return False
-        try:
-            return self.base.set_emit_dense(num_col, batch_rows, dtype)
-        except TypeError:  # legacy one-arg bases keep working when wrapped
-            return self.base.set_emit_dense(num_col)
 
     def next_block(self) -> Optional[RowBlock]:
         block = self._ensure_iter().next()
@@ -666,45 +921,6 @@ class ThreadedParser(Parser):
         self._delivered = 0
         self._last_annot = None
 
-    def reset_partition(self, part_index: int, num_parts: int) -> None:
-        # quiesce the producer before re-pointing the base
-        if self._iter is not None:
-            self._iter.destroy()
-            self._iter = None
-        self.base.reset_partition(part_index, num_parts)
-        self._delivered = 0
-        self._last_annot = None
-
-    def state_dict(self) -> dict:
-        if self._last_annot is not None:
-            return dict(self._last_annot, blocks=self._delivered)
-        # no annotation (epoch start, or a base without them): count
-        # delivered blocks and replay on restore
-        return {"kind": "blocks", "blocks": self._delivered}
-
-    def load_state(self, state: dict) -> None:
-        if self._iter is not None:
-            self._iter.destroy()
-            self._iter = None
-        if state.get("kind") == "split":
-            # seek, don't replay: the base parser restores the split's
-            # byte-exact position and production continues from there
-            self.base.load_state(state)
-            self._delivered = int(state.get("blocks", 0))
-            self._last_annot = {k: v for k, v in state.items() if k != "blocks"}
-            return
-        n = int(state["blocks"])
-        self.base.before_first()
-        for _ in range(n):
-            if self.base.next_block() is None:
-                break
-        self._delivered = n
-        self._last_annot = None
-
-    @property
-    def bytes_read(self) -> int:
-        return self.base.bytes_read
-
     @property
     def stall_seconds(self) -> float:
         return self._iter.stall_seconds if self._iter is not None else 0.0
@@ -715,16 +931,269 @@ class ThreadedParser(Parser):
         # doing during the wait (read IO vs parse CPU)
         return self.base.stage_seconds()
 
-    def close(self) -> None:
-        if self._iter is not None:
-            self._iter.destroy()
-        self.base.close()
+
+class ParallelTextParser(_WrappedParserMixin, Parser):
+    """Data-parallel chunk-parse fan-out — the N-worker successor of
+    :class:`ThreadedParser`'s single producer thread (the reference fans
+    every chunk across OS threads, text_parser.h:110-146; tf.data names
+    parallel input parsing the canonical fix for host-bound pipelines,
+    arXiv:2101.12127).
+
+    Chunks are pulled SERIALLY from the base parser's ``InputSplit`` (split
+    reads stay ordered and checkpointable — the pull is the
+    :class:`OrderedWorkerPool`'s serialized source stage, and each chunk's
+    ``chunk_resume_state`` is captured at pull time, before fan-out), then
+    ``parse_chunk`` runs concurrently across ``num_workers`` threads with
+    the per-chunk native scanner pinned to one lane (chunk-level
+    parallelism replaces intra-chunk threading). Blocks deliver strictly
+    in pull order, so the three contracts layered on parsing hold
+    unchanged:
+
+    - byte-exact ``resume_state`` annotations ride each block exactly as
+      :class:`TextParserBase` attaches them (state captured at pull time +
+      in-order delivery == the serial annotation stream);
+    - ``stage_seconds()`` stays the {read, parse} attribution feed, now
+      aggregated thread-safely across workers, with a
+      :meth:`parallel_stats` sideband (``parse_workers`` /
+      ``parse_parallelism_efficiency``) so the scaling is measurable;
+    - fault tolerance: stream-level retries happen below (ResilientStream
+      in the filesystems), errors escaping them rethrow in delivery order
+      for DeviceIter's bounded pipeline restart, and an opt-in
+      ``restart_policy`` additionally heals retryable chunk-pull errors
+      in-pool via the shared fast-forward machinery (restarts bump the
+      ``parse_restarts`` / ``parse_giveups`` resilience counters).
+    """
+
+    def __init__(self, base: TextParserBase, num_workers: int = 2,
+                 max_ahead: Optional[int] = None,
+                 restart_policy: Optional["_resilience.RetryPolicy"] = None):
+        self.base = base
+        self.num_workers = max(1, int(num_workers))
+        # a couple of chunks in flight per worker: enough to ride out
+        # parse-time variance without ballooning peak memory
+        self._ahead = (int(max_ahead) if max_ahead is not None
+                       else max(4, 2 * self.num_workers))
+        self._restart_policy = restart_policy
+        # chunk-level fan-out replaces intra-chunk scanner threads
+        base._parse_nthread = 1 if self.num_workers > 1 else 0
+        self._pool: Optional[OrderedWorkerPool] = None
+        self._delivered = 0
+        self._last_annot = None  # resume_state of the last delivered block
+        # thread-safe stage aggregation: the serial pull accrues 'read' on
+        # whichever worker holds the pull lock, 'parse' accrues on every
+        # worker concurrently — all under one lock, into the base's
+        # counters so count-replay paths (which parse on the base) share
+        # the same books
+        self._stage_lock = threading.Lock()
+        self._parse_t_first: Optional[float] = None
+        self._parse_t_last: Optional[float] = None
+        # busy seconds at the current span's start: efficiency is scoped
+        # to the span since the last quiesce (epoch reset / repartition /
+        # restore), not diluted by inter-epoch idle wall
+        self._parse_busy0 = base._parse_seconds
+
+    # ---------------- pool plumbing ----------------
+
+    def _chunk_stream(self):
+        """The pool's SERIAL source: the base parser's own pull-and-
+        annotate step (one shared implementation — the checkpoint schema
+        cannot diverge between engines). Runs under the pool's pull lock,
+        so the split sees a single-threaded consumer and the base's
+        read/byte counters have one writer."""
+        while True:
+            chunk, annot = self.base._pull_chunk()
+            if chunk is None:
+                return
+            yield (chunk, annot)
+
+    def _parse_work(self, item):
+        """The pool's PARALLEL stage: chunk -> RowBlock (+ annotation)."""
+        chunk, annot = item
+        t0 = get_time()
+        try:
+            block = self.base.parse_chunk(chunk)
+        finally:
+            t1 = get_time()
+            with self._stage_lock:
+                self.base._parse_seconds += t1 - t0
+                if self._parse_t_first is None or t0 < self._parse_t_first:
+                    self._parse_t_first = t0
+                if self._parse_t_last is None or t1 > self._parse_t_last:
+                    self._parse_t_last = t1
+        if annot is not None and len(block) > 0:
+            block.resume_state = annot
+        return block
+
+    def _ensure_pool(self) -> OrderedWorkerPool:
+        if self._pool is None:
+            src = self.base.source
+            # the position this pool's stream starts at, for deterministic
+            # restart replay: a live state_dict when the source has one,
+            # else the chunk-synchronized state a seek-restore left behind
+            # (ThreadedInputSplit exposes no state_dict but its
+            # chunk_resume_state IS the restored position after
+            # load_state). With neither — and the stream not at its
+            # start — a before_first() rewind would replay from the WRONG
+            # origin, so pool-level restart is disabled and errors
+            # propagate to the outer healers (DeviceIter re-arms through
+            # the same checkpoint machinery, which stays byte-exact).
+            origin = None
+            if hasattr(src, "state_dict"):
+                try:
+                    origin = src.state_dict()
+                except (DMLCError, AttributeError):
+                    origin = None
+            if origin is None:
+                origin = getattr(src, "chunk_resume_state", None)
+            at_start = self.base._chunks_in == 0 and self._delivered == 0
+            policy = (self._restart_policy
+                      if (origin is not None and hasattr(src, "load_state"))
+                      or at_start else None)
+            counters0 = (self.base._bytes, self.base._chunks_in)
+            first = [True]
+
+            def factory():
+                if not first[0]:
+                    # bounded source restart: reposition at this pool's
+                    # origin (NOT the epoch start — the pool may have been
+                    # armed mid-stream by a seek-restore); the pool then
+                    # fast-forwards the already-pulled chunks, which the
+                    # counter rewind below makes re-countable
+                    self.base._bytes, self.base._chunks_in = counters0
+                    if origin is not None and hasattr(src, "load_state"):
+                        src.load_state(origin)
+                    else:
+                        src.before_first()
+                first[0] = False
+                return self._chunk_stream()
+
+            self._pool = OrderedWorkerPool(
+                factory, self._parse_work,
+                num_workers=self.num_workers, max_ahead=self._ahead,
+                restart_policy=policy, counter_label="parse")
+        return self._pool
+
+    def _started(self) -> bool:
+        return self._pool is not None
+
+    def _quiesce(self) -> None:
+        if self._pool is not None:
+            self._pool.destroy()
+            self._pool = None
+        with self._stage_lock:
+            # start a fresh efficiency span: the gap until the next epoch
+            # parses is consumer idle, not worker inefficiency
+            self._parse_t_first = None
+            self._parse_t_last = None
+            self._parse_busy0 = self.base._parse_seconds
+
+    # ---------------- Parser contract ----------------
+    # (set_emit_dense / reset_partition / state_dict / load_state / close
+    # come from _WrappedParserMixin — identical contract to ThreadedParser)
+
+    def next_block(self) -> Optional[RowBlock]:
+        pool = self._ensure_pool()
+        while True:
+            block = pool.next()
+            if block is None:
+                return None
+            if len(block) == 0:
+                continue  # empty chunks produce no block (base parity)
+            self._delivered += 1
+            self._last_annot = getattr(block, "resume_state", None)
+            return block
+
+    def before_first(self) -> None:
+        self._quiesce()
+        self.base.before_first()
+        self._delivered = 0
+        self._last_annot = None
+
+    # ---------------- metrics ----------------
+
+    def stage_seconds(self) -> Dict[str, float]:
+        with self._stage_lock:
+            return dict(self.base.stage_seconds())
+
+    def parallel_stats(self) -> dict:
+        """The scaling sideband: worker count plus measured parallel
+        efficiency — parse busy-seconds over the CURRENT span (since the
+        last epoch reset / repartition / restore) / (span * workers);
+        1.0 = every worker parsing the whole span, None before any parse.
+        ``parse_busy_seconds`` stays cumulative, matching
+        ``stage_seconds()['parse']``."""
+        with self._stage_lock:
+            busy = self.base._parse_seconds
+            span_busy = busy - self._parse_busy0
+            span = ((self._parse_t_last - self._parse_t_first)
+                    if self._parse_t_first is not None
+                    and self._parse_t_last is not None else 0.0)
+        eff = (min(1.0, span_busy / (span * self.num_workers))
+               if span > 0 else None)
+        return {
+            "parse_workers": self.num_workers,
+            "parse_busy_seconds": busy,
+            "parse_span_seconds": span,
+            "parse_parallelism_efficiency": eff,
+        }
+
+    @property
+    def stall_seconds(self) -> float:
+        return self._pool.stall_seconds if self._pool is not None else 0.0
 
 
 # ---------------- factory & registry (src/data.cc) ----------------
 
+def _resolve_parse_workers(parse_workers: Optional[int]) -> int:
+    """None -> DMLC_TPU_PARSE_WORKERS env, else min(4, cpu count); 1 keeps
+    today's single-producer ThreadedParser path."""
+    if parse_workers is not None:
+        return max(1, int(parse_workers))
+    env = os.environ.get("DMLC_TPU_PARSE_WORKERS", "").strip()
+    if env:
+        return max(1, int(env))
+    return max(1, min(4, os.cpu_count() or 1))
+
+
+def _parallel_chunk_source(uri: str, part_index: int, num_parts: int,
+                           **split_kw) -> InputSplit:
+    """Chunk source for the parse fan-out. Plain SINGLE-FILE local text
+    corpora get the zero-copy mmap reader (the serial pull must stay far
+    above the pool's aggregate parse rate, and the stream engine's copying
+    pull costs a core per ~500 MB/s; single-file windows make its chunk
+    grouping byte-identical to the stream engine's, so per-chunk-sensitive
+    semantics — indexing_mode=-1 auto-detection, per-chunk validation —
+    cannot diverge between parse_workers settings). Everything else —
+    multi-file corpora, remote URIs, chunk caches, shuffle decorators —
+    keeps the standard split stack, whose chunks ARE the workers=1
+    engine's."""
+    plain = ("#" not in uri
+             and not any(split_kw.get(k) for k in
+                         ("shuffle", "num_shuffle_parts", "index_uri",
+                          "recurse_directories")))
+    if plain and uri.split("?", 1)[0] not in ("stdin",):
+        try:
+            split = create_mmap_text_split(
+                uri, part_index, num_parts,
+                chunk_bytes=split_kw.get("chunk_bytes", DEFAULT_CHUNK_BYTES))
+            if len(split.files) == 1:
+                return split
+            split.close()  # multi-file: joins change chunk grouping
+        except (DMLCError, OSError, ValueError):
+            pass  # not local / not mappable: the stream stack handles it
+    return create_input_split(
+        uri, part_index, num_parts, "text", threaded=True, **split_kw)
+
+
 def _make_text_parser(cls, threaded_default: bool):
-    def factory(uri, args, part_index, num_parts, index_dtype, threaded, **split_kw):
+    def factory(uri, args, part_index, num_parts, index_dtype, threaded,
+                parse_workers=None, **split_kw):
+        workers = _resolve_parse_workers(parse_workers)
+        if threaded and threaded_default and workers > 1:
+            source = _parallel_chunk_source(
+                uri, part_index, num_parts, **split_kw)
+            base = cls(source, args, index_dtype=index_dtype)
+            return ParallelTextParser(base, num_workers=workers)
         source = create_input_split(
             uri, part_index, num_parts, "text",
             threaded=threaded, **split_kw,
@@ -754,12 +1223,19 @@ def create_parser(
     type_: str = "auto",
     index_dtype=np.uint64,
     threaded: bool = True,
+    parse_workers: Optional[int] = None,
     **split_kw,
 ) -> Parser:
     """Parser factory — analog of dmlc::Parser::Create (src/data.cc:62-85).
 
     ``type_='auto'`` resolves from the URI's ``format=`` arg, defaulting to
     libsvm (data.cc:70-76). URI args (``?k=v``) flow into the parser params.
+
+    ``parse_workers`` sizes the Python engine's data-parallel chunk-parse
+    fan-out (:class:`ParallelTextParser`): 1 keeps the single-producer
+    :class:`ThreadedParser`, None auto-sizes to ``DMLC_TPU_PARSE_WORKERS``
+    or ``min(4, cpu count)``. The fully-native reader keeps its own C++
+    threading and ignores the knob (docs/data.md).
     """
     spec = URISpec(uri, part_index, num_parts)
     if type_ == "auto":
@@ -801,5 +1277,6 @@ def create_parser(
     if "#" in uri:
         split_uri = f"{spec.uri}#{uri.split('#', 1)[1]}"
     return entry.body(
-        split_uri, spec.args, part_index, num_parts, index_dtype, threaded, **split_kw
+        split_uri, spec.args, part_index, num_parts, index_dtype, threaded,
+        parse_workers=parse_workers, **split_kw
     )

@@ -19,8 +19,9 @@ from dmlc_tpu.io.recordio import (
 )
 from dmlc_tpu.io.threaded_iter import ThreadedIter
 from dmlc_tpu.io.input_split import (
-    InputSplit, LineSplitter, RecordIOSplitter, IndexedRecordIOSplitter,
-    ThreadedInputSplit, create_input_split,
+    InputSplit, LineSplitter, MmapLineSplit, RecordIOSplitter,
+    IndexedRecordIOSplitter, ThreadedInputSplit, create_input_split,
+    create_mmap_text_split,
 )
 from dmlc_tpu.io.cached_split import CachedInputSplit
 from dmlc_tpu.io import http_filesys as _http_filesys  # registers http/cloud slots
@@ -37,6 +38,7 @@ __all__ = [
     "FaultPlan", "inject", "maybe_fail",
     "RECORDIO_MAGIC", "RecordIOWriter", "RecordIOReader", "RecordIOChunkReader",
     "read_index_file", "write_indexed_recordio",
-    "ThreadedIter", "InputSplit", "LineSplitter", "RecordIOSplitter",
-    "IndexedRecordIOSplitter", "ThreadedInputSplit", "create_input_split",
+    "ThreadedIter", "InputSplit", "LineSplitter", "MmapLineSplit",
+    "RecordIOSplitter", "IndexedRecordIOSplitter", "ThreadedInputSplit",
+    "create_input_split", "create_mmap_text_split",
 ]

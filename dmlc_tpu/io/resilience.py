@@ -126,10 +126,15 @@ class _Counters:
     ``fatal``     operations failed on a non-retryable class (one attempt)
     ``producer_restarts`` / ``producer_giveups``
                   bounded producer restarts in ThreadedIter/OrderedWorkerPool
+    ``parse_restarts`` / ``parse_giveups``
+                  bounded chunk-source restarts inside the data-parallel
+                  parse fan-out (ParallelTextParser's OrderedWorkerPool,
+                  which labels its restart counters ``parse``)
     """
 
     _KEYS = ("attempts", "retries", "resumes", "giveups", "fatal",
-             "producer_restarts", "producer_giveups")
+             "producer_restarts", "producer_giveups",
+             "parse_restarts", "parse_giveups")
 
     def __init__(self) -> None:
         self._lock = threading.Lock()

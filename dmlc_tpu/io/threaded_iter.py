@@ -357,6 +357,7 @@ class OrderedWorkerPool(Generic[T]):
         num_workers: int = 2,
         max_ahead: int = 4,
         restart_policy: Optional["_resilience.RetryPolicy"] = None,
+        counter_label: str = "producer",
     ):
         self._source_factory = source_factory
         self._source = source_factory()
@@ -372,6 +373,11 @@ class OrderedWorkerPool(Generic[T]):
         self._src_exc: Optional[BaseException] = None
         self._destroyed = False
         self.stall_seconds = 0.0  # consumer time waiting on the workers
+        # which resilience counters this pool's restarts bump: the generic
+        # "producer_*" pair by default; the parse fan-out labels its pool
+        # "parse" so parse-source restarts are distinguishable in
+        # DeviceIter.stats()['resilience'] / the bench JSON
+        self._counter_label = counter_label
         # bounded source restart (opt-in, like ThreadedIter): a retryable
         # pull error rebuilds the source via source_factory() and
         # fast-forwards past the seq items already pulled, so sequence
@@ -404,13 +410,13 @@ class OrderedWorkerPool(Generic[T]):
                                               self.restarts, exc)
         if verdict == "giveup":
             self.restart_giveups += 1
-            _resilience.COUNTERS.bump("producer_giveups")
+            _resilience.COUNTERS.bump(f"{self._counter_label}_giveups")
             return False
         if verdict != "restart":
             return False
         used = self.restarts
         self.restarts += 1
-        _resilience.COUNTERS.bump("producer_restarts")
+        _resilience.COUNTERS.bump(f"{self._counter_label}_restarts")
         _resilience.restart_backoff(self._restart_policy, used, exc)
         with self._lock:
             pulled = self._seq

@@ -370,22 +370,51 @@ def _free_csv_split(lib, addr):
     lib.dmlc_free_csv_split(addr)
 
 
-def parse_libsvm(chunk: bytes, nthread: int = 0, indexing_mode: int = 0):
-    """Parse a libsvm chunk natively; returns dict of numpy arrays or None."""
+def _chunk_buf(chunk):
+    """``bytes | memoryview`` -> (c_char_p-compatible arg, length, keepalive).
+
+    A memoryview (e.g. an mmap slice from the zero-copy chunk reader)
+    passes its buffer ADDRESS straight through — no bytes() copy, no GIL
+    held for a memcpy. Safe because every native scanner is strictly
+    ``[data, data + len)`` bounded and copies what it keeps (the result
+    arrays are its own mallocs). ``keepalive`` must stay referenced until
+    the call returns.
+    """
+    if isinstance(chunk, bytes):
+        return chunk, len(chunk), chunk
+    if isinstance(chunk, bytearray):
+        # c_char_p argtypes reject bytearray: materialize once
+        data = bytes(chunk)
+        return data, len(data), data
+    view = memoryview(chunk)
+    if view.nbytes == 0 or not view.c_contiguous:
+        data = bytes(view)
+        return data, len(data), data
+    arr = np.frombuffer(view, np.uint8)
+    return ctypes.c_char_p(arr.ctypes.data), arr.nbytes, (view, arr)
+
+
+def parse_libsvm(chunk, nthread: int = 0, indexing_mode: int = 0):
+    """Parse a libsvm chunk (bytes or memoryview) natively; returns dict
+    of numpy arrays or None."""
     lib = _load()
     if lib is None:
         return None
+    buf, n, keep = _chunk_buf(chunk)
     res = lib.dmlc_parse_libsvm(
-        chunk, len(chunk), nthread or default_nthread(), indexing_mode)
+        buf, n, nthread or default_nthread(), indexing_mode)
+    del keep
     return _wrap_block(lib, res)
 
 
-def parse_libfm(chunk: bytes, nthread: int = 0, indexing_mode: int = 0):
+def parse_libfm(chunk, nthread: int = 0, indexing_mode: int = 0):
     lib = _load()
     if lib is None:
         return None
+    buf, n, keep = _chunk_buf(chunk)
     res = lib.dmlc_parse_libfm(
-        chunk, len(chunk), nthread or default_nthread(), indexing_mode)
+        buf, n, nthread or default_nthread(), indexing_mode)
+    del keep
     return _wrap_block(lib, res)
 
 
@@ -419,7 +448,7 @@ def _free_dense(lib, addr):
     lib.dmlc_free_dense(addr)
 
 
-def parse_libsvm_dense(chunk: bytes, num_col: int, nthread: int = 0,
+def parse_libsvm_dense(chunk, num_col: int, nthread: int = 0,
                        indexing_mode: int = -1):
     """Parse libsvm straight to the dense device layout.
 
@@ -430,8 +459,10 @@ def parse_libsvm_dense(chunk: bytes, num_col: int, nthread: int = 0,
     lib = _load()
     if lib is None:
         return None
+    buf, n, keep = _chunk_buf(chunk)
     res = lib.dmlc_parse_libsvm_dense(
-        chunk, len(chunk), nthread or default_nthread(), num_col, indexing_mode)
+        buf, n, nthread or default_nthread(), num_col, indexing_mode)
+    del keep
     return _wrap_dense(lib, res, num_col)
 
 
@@ -469,17 +500,20 @@ def bf16_dtype():
     return np.dtype(ml_dtypes.bfloat16)
 
 
-def parse_csv(chunk: bytes, delimiter: str = ",", nthread: int = 0):
-    """Parse a csv chunk natively -> (cells [n, ncol] float32, owner) or None.
+def parse_csv(chunk, delimiter: str = ",", nthread: int = 0):
+    """Parse a csv chunk (bytes or memoryview) natively -> (cells [n, ncol]
+    float32, owner) or None.
 
     The caller must keep ``owner`` referenced while using ``cells``.
     """
     lib = _load()
     if lib is None:
         return None
+    buf, n, keep = _chunk_buf(chunk)
     res = lib.dmlc_parse_csv(
-        chunk, len(chunk), nthread or default_nthread(),
+        buf, n, nthread or default_nthread(),
         delimiter.encode()[0] if delimiter else b","[0])
+    del keep
     return _wrap_csv(lib, res)
 
 

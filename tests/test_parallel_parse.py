@@ -1,0 +1,706 @@
+"""Data-parallel chunk parsing (ISSUE 3): the ParallelTextParser fan-out,
+the zero-copy mmap chunk source under it, and the contracts layered on
+parsing — byte-exact resume annotations, restart_policy fault healing,
+thread-safe stage attribution with the parse_workers scaling sideband.
+
+The A/B parity suite asserts the parallel parser's epoch output is
+byte-identical to parse_workers=1 for libsvm/csv/libfm (qid, label:weight,
+dense-emit modes included), clean AND under an injected
+fail-twice-then-succeed fault plan with exact resilience counters.
+"""
+
+import http.server
+import threading
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.data.parsers import (
+    LibSVMParser,
+    ParallelTextParser,
+    ThreadedParser,
+    _CSV_SKELETON_CACHE,
+    _csv_skeleton,
+    create_parser,
+)
+from dmlc_tpu.io import faults, resilience
+from dmlc_tpu.io.input_split import (
+    MmapLineSplit,
+    create_input_split,
+    create_mmap_text_split,
+)
+from dmlc_tpu.utils.check import DMLCError
+
+
+@pytest.fixture(autouse=True)
+def _fast_retries(monkeypatch):
+    monkeypatch.setenv("DMLC_RETRY_BASE_MS", "1")
+    monkeypatch.setenv("DMLC_RETRY_MAX_MS", "5")
+    monkeypatch.delenv("DMLC_RETRY_MAX_ATTEMPTS", raising=False)
+    monkeypatch.delenv("DMLC_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("DMLC_TPU_PARSE_WORKERS", raising=False)
+    faults.reset()
+    resilience.reset_counters()
+    yield
+    faults.reset()
+
+
+# ---------------- corpora ----------------
+
+def _libsvm_text(n=300, d=6, qid=False, weight=False, seed=0):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n):
+        label = f"{i % 2}:{rng.random():.3f}" if weight else f"{i % 2}"
+        q = f" qid:{i // 10}" if qid else ""
+        feats = " ".join(f"{j}:{rng.normal():.5f}" for j in range(d))
+        lines.append(f"{label}{q} {feats}")
+    return ("\n".join(lines) + "\n").encode()
+
+
+def _libfm_text(n=300, d=5, seed=1):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n):
+        feats = " ".join(
+            f"{j % 3}:{j}:{rng.normal():.5f}" for j in range(d))
+        lines.append(f"{i % 2} {feats}")
+    return ("\n".join(lines) + "\n").encode()
+
+
+def _csv_text(n=300, d=5, seed=2):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n):
+        cells = ",".join(f"{rng.normal():.5f}" for _ in range(d))
+        lines.append(f"{i % 2},{cells}")
+    return ("\n".join(lines) + "\n").encode()
+
+
+def _write(tmp_path, name, data):
+    p = tmp_path / name
+    p.write_bytes(data)
+    return str(p)
+
+
+def _drain_arrays(parser):
+    """Concatenated epoch output: every array a RowBlock/DenseBlock can
+    carry, in delivery order — the byte-identity comparator."""
+    out = {}
+
+    def add(key, arr):
+        if arr is not None:
+            out.setdefault(key, []).append(np.asarray(arr))
+
+    while (b := parser.next_block()) is not None:
+        if hasattr(b, "offset"):  # RowBlock
+            add("label", b.label)
+            add("index", b.index)
+            add("value", b.value)
+            add("weight", b.weight)
+            add("qid", b.qid)
+            add("field", b.field)
+            # offsets are chunk-relative; compare per-row nnz instead
+            add("nnz", np.diff(np.asarray(b.offset)))
+        else:  # DenseBlock
+            add("label", b.label)
+            add("weight", b.weight)
+            add("x", np.asarray(b.x, np.float32).reshape(-1))
+    return {k: np.concatenate(v) for k, v in out.items()}
+
+
+def _assert_same(a, b):
+    assert set(a) == set(b), (sorted(a), sorted(b))
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+# ---------------- A/B parity suite ----------------
+
+class TestParityAB:
+    @pytest.mark.parametrize("fmt,data,uri_args", [
+        ("libsvm", _libsvm_text(), ""),
+        ("libsvm", _libsvm_text(qid=True), ""),
+        ("libsvm", _libsvm_text(weight=True), ""),
+        ("libsvm", _libsvm_text(d=3, seed=7), "&indexing_mode=-1"),
+        ("libfm", _libfm_text(), ""),
+        ("csv", _csv_text(), "&label_column=0"),
+        ("csv", _csv_text(seed=9), "&label_column=0&weight_column=1"),
+    ])
+    def test_epoch_byte_identical(self, tmp_path, fmt, data, uri_args):
+        path = _write(tmp_path, f"c.{fmt}", data)
+        uri = f"{path}?engine=python{uri_args}"
+
+        def run(workers):
+            p = create_parser(uri, 0, 1, fmt, threaded=True,
+                              parse_workers=workers, chunk_bytes=2048)
+            try:
+                return _drain_arrays(p)
+            finally:
+                p.close()
+
+        one = run(1)
+        four = run(4)
+        _assert_same(one, four)
+
+    def test_dense_emit_mode_parity(self, tmp_path):
+        path = _write(tmp_path, "d.libsvm", _libsvm_text(d=4))
+        uri = path + "?engine=python"
+
+        def run(workers):
+            p = create_parser(uri, 0, 1, "libsvm", threaded=True,
+                              parse_workers=workers, chunk_bytes=2048)
+            on = p.set_emit_dense(4)
+            try:
+                return on, _drain_arrays(p)
+            finally:
+                p.close()
+
+        on1, one = run(1)
+        on4, four = run(4)
+        assert on1 == on4  # both engines answer the dense opt-in alike
+        _assert_same(one, four)
+
+    def test_unterminated_tail_chunk_grouping_parity(self, tmp_path):
+        """A corpus whose final line lacks '\\n' must group chunks exactly
+        like the stream engine (the tail line is its OWN chunk) — with
+        indexing_mode=-1 the per-chunk auto-shift would otherwise diverge
+        between parse_workers settings."""
+        rng = np.random.default_rng(3)
+        lines = [f"{i % 2} " + " ".join(
+            f"{j}:{rng.normal():.4f}" for j in range(3)) for i in range(300)]
+        data = ("\n".join(lines) + "\n1 1:9.0").encode()  # no trailing \n
+        path = _write(tmp_path, "tail.libsvm", data)
+        uri = f"{path}?engine=python&indexing_mode=-1"
+
+        def run(workers):
+            p = create_parser(uri, 0, 1, "libsvm", threaded=True,
+                              parse_workers=workers, chunk_bytes=2048)
+            try:
+                return _drain_arrays(p)
+            finally:
+                p.close()
+
+        _assert_same(run(1), run(4))
+
+    def test_multi_partition_parity(self, tmp_path):
+        path = _write(tmp_path, "p.libsvm", _libsvm_text(n=500))
+        uri = path + "?engine=python"
+        for part in range(3):
+            one = create_parser(uri, part, 3, "libsvm", threaded=True,
+                                parse_workers=1, chunk_bytes=1024)
+            four = create_parser(uri, part, 3, "libsvm", threaded=True,
+                                 parse_workers=4, chunk_bytes=1024)
+            _assert_same(_drain_arrays(one), _drain_arrays(four))
+            one.close()
+            four.close()
+
+
+# ---------------- fault plan A/B (contract b) ----------------
+
+class _HttpFiles(http.server.BaseHTTPRequestHandler):
+    files: dict = {}
+
+    def log_message(self, *a):
+        pass
+
+    def do_HEAD(self):
+        data = self.files.get(self.path)
+        if data is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+
+    def do_GET(self):
+        data = self.files.get(self.path)
+        if data is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        rng = self.headers.get("Range")
+        if rng:
+            lo, hi = rng.split("=")[1].split("-")
+            lo = int(lo)
+            if lo >= len(data):
+                self.send_response(416)
+                self.end_headers()
+                return
+            chunk = data[lo:int(hi) + 1] if hi else data[lo:]
+            self.send_response(206)
+        else:
+            chunk = data
+            self.send_response(200)
+        self.send_header("Content-Length", str(len(chunk)))
+        self.end_headers()
+        self.wfile.write(chunk)
+
+
+@pytest.fixture()
+def http_corpus():
+    _HttpFiles.files = {"/c.libsvm": _libsvm_text(n=400, d=4)}
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _HttpFiles)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{server.server_address[1]}/c.libsvm"
+    server.shutdown()
+    server.server_close()
+
+
+class TestFaultPlanParity:
+    def test_fail_twice_then_succeed_byte_identical(self, http_corpus,
+                                                    monkeypatch):
+        from dmlc_tpu.io import http_filesys
+
+        monkeypatch.setattr(http_filesys, "_BLOCK", 2048)
+        uri = http_corpus + "?engine=python"
+
+        def run(workers):
+            p = create_parser(uri, 0, 1, "libsvm", threaded=True,
+                              parse_workers=workers, chunk_bytes=2048)
+            try:
+                return _drain_arrays(p)
+            finally:
+                p.close()
+
+        clean = run(1)
+        assert resilience.counters_snapshot()["retries"] == 0
+        resilience.reset_counters()
+
+        with faults.inject("read@2..3=http-503") as plan:
+            faulted = run(4)
+        _assert_same(clean, faulted)
+        snap = resilience.counters_snapshot()
+        assert plan.fired() == 2
+        assert snap["retries"] == 2          # exactly the injected faults
+        assert snap["giveups"] == 0
+        assert snap["parse_restarts"] == 0   # healed below the pool
+        assert snap["parse_giveups"] == 0
+
+
+class TestPoolRestart:
+    def test_restart_policy_heals_flaky_chunk_source(self, tmp_path):
+        """A retryable chunk-pull error inside a worker consumes pool
+        restart budget and heals via the fast-forward machinery — the
+        epoch is byte-identical and the parse_* counters record it."""
+        # ~13 chunks at the 4096-byte chunk floor: room for two faults
+        # plus their fast-forward replays
+        path = _write(tmp_path, "r.libsvm", _libsvm_text(n=1200, d=4))
+
+        def make_base():
+            src = create_mmap_text_split(path, 0, 1, chunk_bytes=1024)
+            return LibSVMParser(src, {})
+
+        clean = ParallelTextParser(make_base(), num_workers=3)
+        want = _drain_arrays(clean)
+        clean.close()
+
+        base = make_base()
+        src = base.source
+        orig = src.next_chunk
+        pulls = {"n": 0}
+
+        def flaky():
+            pulls["n"] += 1
+            # two NON-adjacent transient faults: the restart's fast-forward
+            # replays earlier pulls, so adjacent injections would fire
+            # inside the replay itself (a reposition failure, not a second
+            # healable fault)
+            if pulls["n"] in (3, 8):
+                raise ConnectionResetError(104, "flaky chunk source")
+            return orig()
+
+        src.next_chunk = flaky
+        resilience.reset_counters()
+        p = ParallelTextParser(base, num_workers=3,
+                               restart_policy=resilience.RetryPolicy(
+                                   max_attempts=4, base_delay=0.001,
+                                   max_delay=0.002))
+        got = _drain_arrays(p)
+        p.close()
+        _assert_same(want, got)
+        snap = resilience.counters_snapshot()
+        assert snap["parse_restarts"] == 2
+        assert snap["parse_giveups"] == 0
+
+    def test_fatal_error_propagates_in_order(self, tmp_path):
+        path = _write(tmp_path, "f.libsvm",
+                      _libsvm_text(n=60, d=3) + b"0 not_an_index:x\n")
+        p = create_parser(path + "?engine=python", 0, 1, "libsvm",
+                          threaded=True, parse_workers=4, chunk_bytes=512)
+        with pytest.raises(DMLCError, match="malformed"):
+            while p.next_block() is not None:
+                pass
+        p.close()
+
+
+# ---------------- resume / checkpoint contracts ----------------
+
+class TestParallelResume:
+    def _uri(self, tmp_path):
+        # big enough for ~16 chunks at the 4096-byte hint_chunk_size floor
+        return _write(tmp_path, "s.libsvm",
+                      _libsvm_text(n=1500, d=4)) + "?engine=python"
+
+    def test_byte_exact_seek_resume(self, tmp_path):
+        uri = self._uri(tmp_path)
+
+        def make():
+            return create_parser(uri, 0, 1, "libsvm", threaded=True,
+                                 parse_workers=4, chunk_bytes=1024)
+
+        p = make()
+        full = []
+        while (b := p.next_block()) is not None:
+            full.append(np.asarray(b.label))
+        p.close()
+        assert len(full) >= 6
+
+        p2 = make()
+        for _ in range(3):
+            p2.next_block()
+        state = p2.state_dict()
+        p2.close()
+        assert state["kind"] == "split", state
+
+        p3 = make()
+        p3.load_state(state)
+        rest = []
+        while (b := p3.next_block()) is not None:
+            rest.append(np.asarray(b.label))
+        assert len(rest) == len(full) - 3
+        for a, b_ in zip(rest, full[3:]):
+            np.testing.assert_array_equal(a, b_)
+        p3.close()
+
+    def test_epoch_reset_and_repartition(self, tmp_path):
+        uri = self._uri(tmp_path)
+        p = create_parser(uri, 0, 2, "libsvm", threaded=True,
+                          parse_workers=4, chunk_bytes=1024)
+        first = _drain_arrays(p)
+        p.before_first()
+        again = _drain_arrays(p)
+        _assert_same(first, again)
+        p.reset_partition(1, 2)
+        other = _drain_arrays(p)
+        assert len(other["label"]) > 0
+        assert (len(first["label"]) + len(other["label"])) == 1500
+        p.close()
+
+    def test_stage_seconds_and_parallel_stats(self, tmp_path):
+        uri = self._uri(tmp_path)
+        p = create_parser(uri, 0, 1, "libsvm", threaded=True,
+                          parse_workers=4, chunk_bytes=1024)
+        assert isinstance(p, ParallelTextParser)
+        _drain_arrays(p)
+        stages = p.stage_seconds()
+        assert set(stages) == {"read", "parse"}
+        assert stages["parse"] > 0
+        ps = p.parallel_stats()
+        assert ps["parse_workers"] == 4
+        assert ps["parse_busy_seconds"] == pytest.approx(stages["parse"])
+        assert ps["parse_span_seconds"] > 0
+        assert 0 < ps["parse_parallelism_efficiency"] <= 1.0
+        p.close()
+
+    def test_device_iter_stats_carry_parse_workers(self, tmp_path):
+        from dmlc_tpu.data.device import DeviceIter
+
+        uri = self._uri(tmp_path)
+
+        def run(workers):
+            p = create_parser(uri, 0, 1, "libsvm", threaded=True,
+                              parse_workers=workers, chunk_bytes=1024)
+            it = DeviceIter(p, num_col=4, batch_size=64, layout="dense",
+                            pack_aux=False)
+            batches = [(np.asarray(x), np.asarray(y)) for x, y, w in it]
+            stats = it.stats()
+            it.close()
+            return batches, stats
+
+        b1, s1 = run(1)
+        b4, s4 = run(4)
+        assert len(b1) == len(b4)
+        for (x1, y1), (x4, y4) in zip(b1, b4):
+            np.testing.assert_array_equal(x1, x4)
+            np.testing.assert_array_equal(y1, y4)
+        assert s1["parse_workers"] == 1
+        assert s4["parse_workers"] == 4
+        assert 0 < s4["parse_parallelism_efficiency"] <= 1.0
+        # the attribution contract holds under the parallel path: stages
+        # sum to no more than consumer wall
+        assert sum(s4["stages"].values()) <= s4["wall_seconds"] + 1e-6
+        # counters intact (clean loopback run: all zeros)
+        assert s4["resilience"]["retries"] == 0
+        assert s4["resilience"]["parse_restarts"] == 0
+
+
+# ---------------- mmap chunk source ----------------
+
+class TestMmapLineSplit:
+    def test_partition_parity_with_stream_engine(self, tmp_path):
+        path = _write(tmp_path, "m.libsvm", _libsvm_text(n=700, d=3))
+        for nparts in (1, 3):
+            for part in range(nparts):
+                a = create_mmap_text_split(path, part, nparts,
+                                           chunk_bytes=1500)
+                b = create_input_split(path, part, nparts, "text",
+                                       threaded=False, chunk_bytes=1500)
+                ca = b"".join(bytes(c) for c in iter(a.next_chunk, None))
+                cb = b"".join(bytes(c) for c in iter(b.next_chunk, None))
+                assert ca.rstrip(b"\n") == cb.rstrip(b"\n")
+                a.before_first()
+                ra = [bytes(r) for r in a.iter_records()]
+                b.before_first()
+                rb = [bytes(r) for r in b.iter_records()]
+                assert ra == rb
+                a.close()
+                b.close()
+
+    def test_empty_after_adjustment_partition(self, tmp_path):
+        """A partition whose record-boundary adjustment empties it must
+        yield NOTHING — never a mid-record fragment (the stream engine's
+        offset_begin >= offset_end guard, mirrored)."""
+        path = _write(tmp_path, "one_long.libsvm", b"3 " + b"1:1 " * 9 + b"\nbb 1:2\n")
+        for nparts in (3, 5):
+            for part in range(nparts):
+                a = create_mmap_text_split(path, part, nparts)
+                b = create_input_split(path, part, nparts, "text",
+                                       threaded=False)
+                ca = b"".join(bytes(c) for c in iter(a.next_chunk, None))
+                cb = b"".join(bytes(c) for c in iter(b.next_chunk, None))
+                assert ca.rstrip(b"\n") == cb.rstrip(b"\n"), (nparts, part)
+                # an epoch rewind must not resurrect the fragment either
+                a.before_first()
+                ca2 = b"".join(bytes(c) for c in iter(a.next_chunk, None))
+                assert ca2 == ca
+                a.close()
+                b.close()
+        # end-to-end through the factory: w1 == w4 row sets per part
+        for part in range(3):
+            one = create_parser(path + "?engine=python", part, 3, "libsvm",
+                                threaded=True, parse_workers=1)
+            four = create_parser(path + "?engine=python", part, 3, "libsvm",
+                                 threaded=True, parse_workers=4)
+            _assert_same(_drain_arrays(one), _drain_arrays(four))
+            one.close()
+            four.close()
+
+    def test_multi_file_joins(self, tmp_path):
+        # second file lacks a trailing newline: the join must still be a
+        # record boundary (the stream engine injects '\n' there)
+        p1 = _write(tmp_path, "a.txt", b"1 0:1\n2 0:2\n")
+        _write(tmp_path, "b.txt", b"3 0:3\n4 0:4")
+        uri = str(tmp_path)
+        a = create_mmap_text_split(uri, 0, 1)
+        b = create_input_split(uri, 0, 1, "text", threaded=False)
+        ra = [bytes(r) for r in a.iter_records()]
+        rb = [bytes(r) for r in b.iter_records()]
+        assert ra == rb and len(ra) == 4, (ra, rb)
+        a.close()
+        b.close()
+        assert p1  # silence unused
+
+    def test_state_roundtrip_and_cross_engine(self, tmp_path):
+        path = _write(tmp_path, "x.libsvm", _libsvm_text(n=400, d=3))
+        a = create_mmap_text_split(path, 0, 1, chunk_bytes=1024)
+        a.next_chunk()
+        st = a.state_dict()
+        assert st["kind"] == "byte"
+        rest_a = b"".join(bytes(c) for c in iter(a.next_chunk, None))
+        # same state into a fresh mmap split
+        a2 = create_mmap_text_split(path, 0, 1, chunk_bytes=1024)
+        a2.load_state(st)
+        assert b"".join(bytes(c)
+                        for c in iter(a2.next_chunk, None)) == rest_a
+        # stream-engine state into the mmap split (cross-engine restore)
+        b = create_input_split(path, 0, 1, "text", threaded=False,
+                               chunk_bytes=1024)
+        b.next_chunk()
+        stb = b.chunk_resume_state
+        rest_b = b"".join(bytes(c) for c in iter(b.next_chunk, None))
+        a3 = create_mmap_text_split(path, 0, 1, chunk_bytes=1024)
+        a3.load_state(stb)
+        got = b"".join(bytes(c) for c in iter(a3.next_chunk, None))
+        assert got.rstrip(b"\n") == rest_b.rstrip(b"\n")
+        for s in (a, a2, a3, b):
+            s.close()
+
+    def test_refuses_pending_chunk_state(self, tmp_path):
+        path = _write(tmp_path, "y.libsvm", _libsvm_text(n=100, d=3))
+        b = create_input_split(path, 0, 1, "text", threaded=False,
+                               chunk_bytes=512)
+        b.next_record()  # mid-record iteration: pending chunk tail
+        st = b.state_dict()
+        assert st["chunk"]
+        a = create_mmap_text_split(path, 0, 1)
+        with pytest.raises(DMLCError, match="pending chunk"):
+            a.load_state(st)
+        a.close()
+        b.close()
+
+    def test_parallel_parser_routes_to_mmap_source(self, tmp_path):
+        path = _write(tmp_path, "z.libsvm", _libsvm_text(n=50, d=3))
+        p = create_parser(path + "?engine=python", 0, 1, "libsvm",
+                          threaded=True, parse_workers=2)
+        assert isinstance(p, ParallelTextParser)
+        assert isinstance(p.base.source, MmapLineSplit)
+        p.close()
+        # workers=1 keeps today's single-producer path
+        p1 = create_parser(path + "?engine=python", 0, 1, "libsvm",
+                           threaded=True, parse_workers=1)
+        assert isinstance(p1, ThreadedParser)
+        p1.close()
+
+    def test_multi_file_corpus_keeps_stream_chunking(self, tmp_path):
+        """Multi-file corpora must NOT route to the mmap source: its
+        never-span-a-join chunk grouping could flip per-chunk-sensitive
+        semantics (indexing_mode=-1 auto-detect) vs parse_workers=1."""
+        d = tmp_path / "many"
+        d.mkdir()
+        (d / "a.libsvm").write_bytes(_libsvm_text(n=40, d=3, seed=1))
+        (d / "b.libsvm").write_bytes(_libsvm_text(n=40, d=3, seed=2))
+        p = create_parser(str(d) + "?engine=python", 0, 1, "libsvm",
+                          threaded=True, parse_workers=4)
+        assert isinstance(p, ParallelTextParser)
+        assert not isinstance(p.base.source, MmapLineSplit)
+        one = create_parser(str(d) + "?engine=python", 0, 1, "libsvm",
+                            threaded=True, parse_workers=1)
+        _assert_same(_drain_arrays(one), _drain_arrays(p))
+        p.close()
+        one.close()
+
+
+# ---------------- fast-path / general-path parity edges ----------------
+
+class TestTokenTableEdges:
+    """The vectorized fast chunk path must agree with the general path on
+    every structure that ALIASES its token/colon signature — weighted
+    labels with binary features, label colons, token-less colon runs."""
+
+    def _svm(self):
+        from dmlc_tpu.data.parsers import LibSVMParserParam
+
+        p = LibSVMParser.__new__(LibSVMParser)
+        p.param = LibSVMParserParam()
+        p.param.init({})
+        p.index_dtype = np.uint64
+        return p
+
+    def test_label_weight_plus_binary_features(self):
+        # 'label:weight idx' has the same per-line token/colon counts as
+        # 'label idx:val' — must take the general path, not misparse
+        p = self._svm()
+        b = p.parse_chunk_py(b"1:2 3\n1:5 7\n")
+        np.testing.assert_array_equal(b.label, [1.0, 1.0])
+        np.testing.assert_array_equal(b.weight, [2.0, 5.0])
+        np.testing.assert_array_equal(np.asarray(b.index), [3, 7])
+        assert b.value is None  # binary features
+
+    def test_mixed_label_weight_rejected(self):
+        p = self._svm()
+        with pytest.raises(DMLCError, match="label:weight"):
+            p.parse_chunk_py(b"1 2:3\n1:2 3\n")
+
+    def test_whitespace_adjacent_colons_fall_back(self):
+        # '2: 3' aliases a clean 'idx:val' signature once colons split —
+        # must take the general path: missing value -> 1.0 + binary feat
+        p = self._svm()
+        b = p.parse_chunk_py(b"1 2: 3\n")
+        np.testing.assert_array_equal(np.asarray(b.index), [2, 3])
+        np.testing.assert_array_equal(b.value, [1.0, 1.0])
+        # ' :3' is malformed — the general path must get to raise
+        p2 = self._svm()
+        with pytest.raises((DMLCError, ValueError)):
+            p2.parse_chunk_py(b"1 2 :3\n")
+
+    def test_tokenless_colon_line_rejected(self):
+        # the numpy engine (fast path must fall back, then error loudly);
+        # the native scanner's own tolerance for this input is unchanged
+        p = self._svm()
+        with pytest.raises((DMLCError, ValueError)):
+            p.parse_chunk_py(b"1 2:3\n:::\n1 4:5\n")
+
+    def test_libfm_malformed_label_rejected(self):
+        from dmlc_tpu.data.parsers import LibFMParser, LibFMParserParam
+
+        p = LibFMParser.__new__(LibFMParser)
+        p.param = LibFMParserParam()
+        p.param.init({})
+        p.index_dtype = np.uint64
+        with pytest.raises((DMLCError, ValueError)):
+            p.parse_chunk_py(b"1:2:3 4\n")
+
+
+# ---------------- satellite bug regressions ----------------
+
+class TestQidValidation:
+    def test_qid_missing_on_first_row_raises(self):
+        chunk = b"1 0:1\n0 qid:2 0:2\n1 qid:3 0:3\n"
+        p = LibSVMParser.__new__(LibSVMParser)
+        from dmlc_tpu.data.parsers import LibSVMParserParam
+
+        p.param = LibSVMParserParam()
+        p.param.init({})
+        p.index_dtype = np.uint64
+        with pytest.raises(DMLCError, match="qid"):
+            p.parse_chunk_py(chunk)
+
+    def test_qid_missing_on_later_row_still_raises(self):
+        chunk = b"1 qid:1 0:1\n0 0:2\n"
+        p = LibSVMParser.__new__(LibSVMParser)
+        from dmlc_tpu.data.parsers import LibSVMParserParam
+
+        p.param = LibSVMParserParam()
+        p.param.init({})
+        p.index_dtype = np.uint64
+        with pytest.raises(DMLCError, match="qid"):
+            p.parse_chunk_py(chunk)
+
+
+class TestSkeletonCacheConcurrency:
+    def test_concurrent_access_is_safe(self):
+        """64 geometries x 8 threads hammering lookup + the >64 eviction:
+        no lost inserts, no dict-size races, consistent arrays."""
+        _CSV_SKELETON_CACHE.clear()
+        errors = []
+
+        def run(tid):
+            try:
+                for rep in range(30):
+                    for n in range(1, 24):
+                        idx, off = _csv_skeleton(n, (tid + rep) % 7 + 1,
+                                                 np.uint64)
+                        k = (tid + rep) % 7 + 1
+                        assert len(idx) == n * k
+                        assert off[-1] == n * k
+                        assert not idx.flags.writeable
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [threading.Thread(target=run, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+
+
+# ---------------- scale (slow tier) ----------------
+
+@pytest.mark.slow
+def test_fanout_scale_soak(tmp_path):
+    """Larger-corpus soak of the fan-out: row counts and checksums match
+    the serial engine. Excluded from tier-1 via the slow marker."""
+    data = _libsvm_text(n=20000, d=12, seed=11)
+    path = _write(tmp_path, "big.libsvm", data)
+    uri = path + "?engine=python"
+    one = create_parser(uri, 0, 1, "libsvm", threaded=True,
+                        parse_workers=1, chunk_bytes=1 << 16)
+    four = create_parser(uri, 0, 1, "libsvm", threaded=True,
+                         parse_workers=4, chunk_bytes=1 << 16)
+    _assert_same(_drain_arrays(one), _drain_arrays(four))
+    one.close()
+    four.close()
