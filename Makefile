@@ -9,7 +9,10 @@
 #   make parse-bench  native scanner throughput tool (no device needed)
 #   make bench-smoke  bench.py on the CPU backend; fails unless the JSON
 #                     summary line carries the per-stage ingest
-#                     attribution (read/parse/convert/dispatch/transfer)
+#                     attribution (read/cache_read/parse/convert/dispatch/
+#                     transfer) and the block-cache epoch-pair fields
+#                     (warm_epoch_mb_per_sec/warm_vs_cold_speedup/
+#                     cache_state)
 #   make fuzz         mutation fuzz of every native parse C-ABI entry point
 #                     (crash-safety; DMLC_FUZZ_ITERS to scale)
 #   make lint-retry   grep gate: no time.sleep inside retry-shaped loops
@@ -61,10 +64,19 @@ bench-smoke:
 	    assert not missing_w, f'parse_scaling widths missing: {missing_w}'; \
 	    assert line.get('parse_ceiling_workers_4'), \
 	        'parse_ceiling_workers_4 missing'; \
+	    assert line.get('warm_epoch_mb_per_sec'), \
+	        'warm_epoch_mb_per_sec missing'; \
+	    assert line.get('warm_vs_cold_speedup'), \
+	        'warm_vs_cold_speedup missing'; \
+	    assert line.get('cache_state') == 'warm', \
+	        f\"cache_state {line.get('cache_state')!r} != 'warm'\"; \
 	    print('bench-smoke: attribution OK:', \
 	          {k: a[k] for k in sorted(a)}); \
 	    print('bench-smoke: parse scaling OK:', curve, \
-	          'workers =', line['parse_workers'])"
+	          'workers =', line['parse_workers']); \
+	    print('bench-smoke: block cache OK:', \
+	          line['warm_epoch_mb_per_sec'], 'MB/s warm, speedup x', \
+	          line['warm_vs_cold_speedup'])"
 
 parse-bench:
 	mkdir -p native/build

@@ -58,7 +58,8 @@ def log(msg: str) -> None:
 
 # canonical stage order for the ingest attribution table (VERDICT r5 weak
 # #4: name the unaccounted share of pipeline bound, per-stage)
-STAGE_ORDER = ("read", "parse", "convert", "dispatch", "transfer")
+STAGE_ORDER = ("read", "cache_read", "parse", "convert", "dispatch",
+               "transfer")
 
 
 def attribution_line(stats: dict, extra_transfer: float = 0.0) -> dict:

@@ -8,7 +8,7 @@ turns them into HBM-resident jax.Array / BCOO batches.
 from dmlc_tpu.data.row_block import Row, RowBlock, RowBlockContainer
 from dmlc_tpu.data.parsers import (
     Parser, LibSVMParser, CSVParser, LibFMParser, ThreadedParser,
-    ParallelTextParser, create_parser,
+    ParallelTextParser, BlockCacheIter, create_parser,
 )
 from dmlc_tpu.data.iterators import (
     RowBlockIter, BasicRowIter, DiskRowIter, create_row_block_iter,
@@ -17,6 +17,6 @@ from dmlc_tpu.data.iterators import (
 __all__ = [
     "Row", "RowBlock", "RowBlockContainer",
     "Parser", "LibSVMParser", "CSVParser", "LibFMParser", "ThreadedParser",
-    "ParallelTextParser", "create_parser",
+    "ParallelTextParser", "BlockCacheIter", "create_parser",
     "RowBlockIter", "BasicRowIter", "DiskRowIter", "create_row_block_iter",
 ]

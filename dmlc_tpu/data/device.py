@@ -15,7 +15,7 @@ Layouts: 'dense' (padded [B, D], MXU-friendly), 'ell' (static-shape sparse),
 
 Stage attribution (tf.data's per-stage cost naming, arXiv:2101.12127): every
 second of consumer wall is attributed to a named pipeline stage — read,
-parse, convert, dispatch, transfer — in ``stats()['stages']``, so "the
+cache_read, parse, convert, dispatch, transfer — in ``stats()['stages']``, so "the
 pipeline is at X% of bound" always decomposes into which stage owns the gap
 (VERDICT r5 weak #4: a 50% gap with stalls reading 0.000s is an artifact of
 the measurement, not a property of the pipeline). The convert stage runs on
@@ -437,11 +437,13 @@ class DeviceIter:
         self._host_iter_obj = None  # OrderedWorkerPool | ThreadedIter
         self._inflight: deque = deque()
         # ---- stage attribution state (module docstring) ----
-        # raw busy/blocked counters, written by pipeline threads:
-        self._busy = StageMeter("read", "parse", "convert", "dispatch")
+        # raw busy/blocked counters, written by pipeline threads
+        # (cache_read: warm block-cache supply, docs/data.md block cache):
+        self._busy = StageMeter("read", "cache_read", "parse", "convert",
+                                "dispatch")
         # consumer-wall attribution (the partition stats() reports)
-        self._attr = StageMeter("read", "parse", "convert", "dispatch",
-                                "transfer")
+        self._attr = StageMeter("read", "cache_read", "parse", "convert",
+                                "dispatch", "transfer")
         self._transfer_samples = 0
         self._t_first: Optional[float] = None  # first consumer pull
         self._t_last: Optional[float] = None   # latest consumer activity
@@ -507,12 +509,20 @@ class DeviceIter:
             t0 = get_time()
             blk = self.source.next_block()
             dt = get_time() - t0
-            read = 0.0
+            read = cache_read = 0.0
             if s0 is not None:
                 s1 = stage_fn()
                 read = min(max(0.0, s1["read"] - s0["read"]), dt)
+                # warm block-cache supply (mmap read + crc) reports under
+                # its own stage — a warm epoch's "parse" is then honestly
+                # ~zero, which is the whole claim of the cache
+                cache_read = min(
+                    max(0.0, s1.get("cache_read", 0.0)
+                        - s0.get("cache_read", 0.0)),
+                    dt - read)
             self._add_busy("read", read)
-            self._add_busy("parse", dt - read)
+            self._add_busy("cache_read", cache_read)
+            self._add_busy("parse", dt - read - cache_read)
             if blk is None:
                 return
             yield blk
@@ -1004,7 +1014,7 @@ class DeviceIter:
         consumer_put = self.batch_size is not None
         window = (t1 - t0) - (d_disp if consumer_put else 0.0)
         weights = {k: busy1[k] - busy0[k]
-                   for k in ("read", "parse", "convert")}
+                   for k in ("read", "cache_read", "parse", "convert")}
         if not consumer_put:
             # natural-block mode dispatches on the producer thread: its put
             # time is part of what the consumer waited on
@@ -1149,8 +1159,8 @@ class DeviceIter:
         """Throughput counters + per-stage wall attribution.
 
         ``stages`` partitions consumer wall (``wall_seconds``, first pull
-        to latest delivery) into read / parse / convert / dispatch /
-        transfer; by construction their sum never exceeds wall, and the
+        to latest delivery) into read / cache_read / parse / convert /
+        dispatch / transfer; by construction their sum never exceeds wall, and the
         difference is unattributed consumer time ('other': the caller's
         own compute between pulls, e.g. a training step). ``stage_busy``
         carries the raw per-thread busy counters the attribution is
@@ -1188,6 +1198,10 @@ class DeviceIter:
         return {
             "batches": self.batches_fed,
             "bytes_to_device": self.bytes_to_device,
+            # block-cache mode of the source chain: 'cold' (parsing +
+            # shadow-writing), 'warm' (serving mmap'd parsed blocks), or
+            # None when no block cache is armed (docs/data.md)
+            "cache_state": getattr(self.source, "cache_state", None),
             "stall_seconds": self.stall_seconds,
             "host_stall_seconds": self.host_stall_seconds,
             "stages": self._attr.seconds(),

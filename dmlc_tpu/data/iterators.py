@@ -197,6 +197,7 @@ def create_row_block_iter(
     index_dtype=np.uint64,
     silent: bool = False,
     parse_workers: Optional[int] = None,
+    block_cache: Optional[str] = None,
     **parser_kw,
 ) -> RowBlockIter:
     """RowBlockIter factory — analog of RowBlockIter::Create
@@ -209,19 +210,29 @@ def create_row_block_iter(
     fan-out exactly as in :func:`~dmlc_tpu.data.parsers.create_parser`
     (1 = single-producer parse-ahead; None = auto) — it applies to the
     load/cache-build pass; cached epochs read pre-parsed pages.
+
+    ``block_cache`` (or a ``#blockcache=<path>`` URI suffix, or the
+    ``DMLC_TPU_BLOCK_CACHE`` env directory) arms the parse-once columnar
+    block cache on the parser the iterator drains: the first load parses
+    text once, later loads serve mmap-backed parsed blocks
+    (:class:`~dmlc_tpu.data.parsers.BlockCacheIter`, docs/data.md).
     """
     spec = URISpec(uri, part_index, num_parts)
     # the cache here is the parsed-page cache (DiskRowIter); strip it before
-    # the parser so the split layer does not also chunk-cache to the same path
-    parser_uri = uri.split("#", 1)[0]
+    # the parser so the split layer does not also chunk-cache to the same
+    # path — but a #blockcache= fragment belongs to the parser factory,
+    # which resolves (and strips) it itself
+    parser_uri = uri if spec.block_cache is not None else uri.split("#", 1)[0]
     if spec.cache_file is None:
         parser = create_parser(parser_uri, part_index, num_parts, type_,
                                index_dtype=index_dtype,
-                               parse_workers=parse_workers, **parser_kw)
+                               parse_workers=parse_workers,
+                               block_cache=block_cache, **parser_kw)
         return BasicRowIter(parser, silent=silent)
     if os.path.exists(spec.cache_file):
         return DiskRowIter(None, spec.cache_file, silent=silent)
     parser = create_parser(parser_uri, part_index, num_parts, type_,
                            index_dtype=index_dtype,
-                           parse_workers=parse_workers, **parser_kw)
+                           parse_workers=parse_workers,
+                           block_cache=block_cache, **parser_kw)
     return DiskRowIter(parser, spec.cache_file, silent=silent)

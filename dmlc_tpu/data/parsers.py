@@ -22,6 +22,8 @@ Semantics matched to the reference:
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import threading
 from typing import Dict, Iterator, Optional
@@ -38,7 +40,7 @@ from dmlc_tpu.io.input_split import (
 )
 from dmlc_tpu.io.threaded_iter import OrderedWorkerPool, ThreadedIter
 from dmlc_tpu.io.uri import URISpec
-from dmlc_tpu.utils.check import DMLCError, check
+from dmlc_tpu.utils.check import CacheCorruptionError, DMLCError, check
 from dmlc_tpu.utils.params import Parameter, field
 from dmlc_tpu.utils.registry import Registry
 from dmlc_tpu.utils.timer import get_time
@@ -1142,6 +1144,310 @@ class ParallelTextParser(_WrappedParserMixin, Parser):
         return self._pool.stall_seconds if self._pool is not None else 0.0
 
 
+class BlockCacheIter(Parser):
+    """Parse-once decorator: cold epochs tee parsed RowBlocks into the
+    columnar on-disk block cache (:mod:`dmlc_tpu.io.block_cache`); warm
+    epochs serve the blocks back as zero-copy mmap-backed numpy views,
+    bypassing the parser — and the source filesystem — entirely.
+
+    One layer above :class:`~dmlc_tpu.io.cached_split.CachedInputSplit`:
+    that cache stores raw chunks *before* the parser (warm passes still
+    re-pay the full text-parse cost); this one stores the parsed arrays,
+    the tf.data ``cache()`` position (arXiv:2101.12127).
+
+    ``base`` is a :class:`Parser` or a zero-arg factory for one — the
+    factory is only invoked on a cold pass (or a healing rebuild), so warm
+    epochs never construct the parser chain. Selected by the
+    ``block_cache=`` knob of :func:`create_parser` /
+    :func:`~dmlc_tpu.data.iterators.create_row_block_iter`, the
+    ``DMLC_TPU_BLOCK_CACHE`` env directory, or a ``#blockcache=<path>``
+    URI suffix (docs/data.md).
+
+    Contracts preserved across cold and warm epochs:
+
+    - **byte-exact checkpoints**: each cold block's ``resume_state``
+      annotation is stored in the cache footer and re-attached to the
+      warm-served block, so a ``DeviceIter`` checkpoint taken warm equals
+      one taken cold at the same row; :meth:`load_state` accepts both the
+      warm ``block_cache`` kind and the parser chain's ``split`` kind
+      (mapped to a block index by annotation match).
+    - **stage attribution**: warm supply cost reports as the
+      ``cache_read`` stage (``stage_seconds()``), which
+      ``DeviceIter.stats()`` carries next to read/parse; ``cache_state``
+      reports ``cold``/``warm``.
+    - **fault tolerance**: a failed per-block CRC is a classified cache
+      fault (:class:`~dmlc_tpu.utils.check.CacheCorruptionError`): the bad
+      cache is dropped, the source re-parsed (skipping already-delivered
+      blocks), a fresh cache rewritten, and ``cache_corruptions`` /
+      ``cache_rebuilds`` counted in the resilience counters — consumers
+      see an unbroken, byte-identical block stream.
+    """
+
+    def __init__(self, base, cache_file: str, signature: Optional[dict] = None,
+                 verify: bool = True):
+        from dmlc_tpu.io import block_cache as _block_cache
+
+        self._bc = _block_cache
+        self._base_factory = base if callable(base) else (lambda: base)
+        self._base: Optional[Parser] = base if not callable(base) else None
+        self.cache_file = cache_file
+        self._signature = signature
+        self._verify = verify
+        self._reader = None
+        self._writer = None
+        self._mode = "cold"
+        self._pos = 0        # warm: next block index to serve
+        self._skip = 0       # cold: blocks to shadow-write but not deliver
+        self._shadow = True  # shadow-writing allowed for the current pass
+        self._delivered = 0
+        self._last_annot: Optional[dict] = None
+        self._bytes = 0      # warm bytes served from the cache
+        self._cache_read_seconds = 0.0
+        self._open_reader()
+
+    # ---------------- mode plumbing ----------------
+
+    @property
+    def cache_state(self) -> str:
+        """``warm`` when blocks come from the cache, else ``cold`` —
+        surfaced by ``DeviceIter.stats()['cache_state']``."""
+        return "warm" if self._mode == "warm" else "cold"
+
+    @property
+    def base(self) -> Parser:
+        if self._base is None:
+            self._base = self._base_factory()
+        return self._base
+
+    def _open_reader(self) -> bool:
+        reader = self._bc.open_block_cache(
+            self.cache_file, self._signature, verify=self._verify)
+        if reader is None:
+            self._mode = "cold"
+            return False
+        self._reader = reader
+        self._mode = "warm"
+        self._pos = 0
+        return True
+
+    def _drop_reader(self) -> None:
+        reader, self._reader = self._reader, None
+        if reader is not None:
+            reader.close()
+
+    def _abort_writer(self) -> None:
+        writer, self._writer = self._writer, None
+        if writer is not None:
+            writer.abort()
+
+    def _ensure_writer(self):
+        if self._writer is None and self._shadow:
+            self._writer = self._bc.BlockCacheWriter(
+                self.cache_file, signature=self._signature)
+        return self._writer
+
+    # ---------------- block delivery ----------------
+
+    def next_block(self) -> Optional[RowBlock]:
+        if self._mode == "warm":
+            return self._next_warm()
+        return self._next_cold()
+
+    def _next_warm(self) -> Optional[RowBlock]:
+        reader = self._reader
+        if self._pos >= reader.num_blocks:
+            return None
+        t0 = get_time()
+        try:
+            segments = reader.load_segments(self._pos)
+        except CacheCorruptionError:
+            self._cache_read_seconds += get_time() - t0
+            self._heal_corruption()
+            return self._next_cold()
+        block = RowBlock.from_segments(segments, hold=reader.hold)
+        annot = reader.resume(self._pos)
+        if annot is not None:
+            block.resume_state = annot
+        self._bytes += reader.block_nbytes(self._pos)
+        self._cache_read_seconds += get_time() - t0
+        self._pos += 1
+        self._delivered += 1
+        self._last_annot = annot
+        return block
+
+    def _heal_corruption(self) -> None:
+        """Warm block ``self._pos`` failed its integrity check: drop the
+        bad cache, re-parse the source (skipping the blocks already
+        delivered this epoch — chunk grouping is deterministic, so block k
+        cold is block k warm), rewrite the full cache, and resume delivery
+        exactly at the broken block."""
+        _resilience.COUNTERS.bump("cache_corruptions")
+        _resilience.COUNTERS.bump("cache_rebuilds")
+        self._drop_reader()
+        try:
+            os.remove(self.cache_file)
+        except OSError:
+            pass
+        self._abort_writer()
+        self._mode = "cold"
+        self._shadow = True
+        self._skip = self._pos
+        self._pos = 0
+        self.base.before_first()
+
+    def _next_cold(self) -> Optional[RowBlock]:
+        while True:
+            block = self.base.next_block()
+            if block is None:
+                writer, self._writer = self._writer, None
+                if writer is not None:
+                    writer.finish()  # fsync + atomic publish
+                return None
+            if not hasattr(block, "to_segments"):
+                # non-RowBlock emits (a base with dense/COO mode already
+                # armed): pass through uncached — the cache stores the
+                # columnar CSR layout only
+                self._abort_writer()
+                self._shadow = False
+            annot = getattr(block, "resume_state", None)
+            writer = self._ensure_writer()
+            if writer is not None:
+                writer.add_block(block.to_segments(), rows=len(block),
+                                 num_col=block.num_col, resume=annot)
+            if self._skip > 0:
+                self._skip -= 1
+                continue
+            self._delivered += 1
+            self._last_annot = annot
+            return block
+
+    def before_first(self) -> None:
+        # an interrupted cold pass cannot publish: drop the partial tmp
+        self._abort_writer()
+        self._skip = 0
+        self._delivered = 0
+        self._last_annot = None
+        if self._mode == "warm":
+            self._pos = 0
+            return
+        if self._open_reader():
+            return  # the completed cold pass published: serve warm now
+        self._shadow = True
+        self.base.before_first()
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        raise DMLCError(
+            "BlockCacheIter does not support reset_partition; the cache is "
+            "bound to one partition (use the partition-qualified "
+            ".splitN.partK cache per part)")
+
+    # -------- checkpoint / resume --------
+
+    def state_dict(self) -> dict:
+        if self._mode == "warm":
+            return {"kind": "block_cache", "block": self._pos}
+        if hasattr(self.base, "state_dict"):
+            return self.base.state_dict()
+        return {"kind": "blocks", "blocks": self._delivered}
+
+    @staticmethod
+    def _annot_key(state: dict) -> str:
+        norm = {k: v for k, v in state.items() if k != "blocks"}
+        return json.dumps(norm, sort_keys=True, default=str)
+
+    def _find_block(self, state: dict) -> Optional[int]:
+        """Block index to resume at for a parser-chain annotation: the
+        stored annotations mark the position just AFTER each block, so a
+        match at block i resumes at i + 1."""
+        if not state.get("chunks") and not state.get("blocks"):
+            return 0  # epoch-start state
+        key = self._annot_key(state)
+        reader = self._reader
+        for i in range(reader.num_blocks):
+            annot = reader.resume(i)
+            if annot is not None and self._annot_key(annot) == key:
+                return i + 1
+        return None
+
+    def load_state(self, state: dict) -> None:
+        kind = state.get("kind")
+        if kind == "block_cache":
+            n = int(state["block"])
+            self._abort_writer()
+            if self._mode == "warm" or self._open_reader():
+                self._pos = n
+                self._delivered = n
+                self._last_annot = self._reader.resume(n - 1) if n else None
+                return
+            # cache gone: rebuild from source, shadow-writing the skipped
+            # prefix so the rebuilt cache is still complete
+            self._shadow = True
+            self._skip = n
+            self._delivered = n
+            self._last_annot = None
+            self.base.before_first()
+            return
+        if self._mode == "warm":
+            if kind == "blocks":
+                # a delivered-block count maps 1:1 onto cache block indices
+                # (warm serves the exact cold block sequence)
+                n = int(state["blocks"])
+                self._pos = n
+                self._delivered = n
+                self._last_annot = (self._reader.resume(n - 1)
+                                    if n else None)
+                return
+            idx = self._find_block(state)
+            if idx is not None:
+                self._pos = idx
+                self._delivered = idx
+                self._last_annot = (self._reader.resume(idx - 1)
+                                    if idx else None)
+                return
+            # annotation unknown to this cache (foreign/stale state):
+            # fall back to the parser chain
+            self._drop_reader()
+            self._mode = "cold"
+        # cold mid-stream seek: this pass can no longer produce a complete
+        # cache — disable shadow-writing until the next epoch start
+        self._abort_writer()
+        self._shadow = False
+        self._skip = 0
+        self.base.load_state(state)
+        self._delivered = int(state.get("blocks", state.get("chunks", 0))
+                              or 0)
+        self._last_annot = None
+
+    # ---------------- metrics ----------------
+
+    def stage_seconds(self) -> Dict[str, float]:
+        out = {"read": 0.0, "parse": 0.0}
+        if self._base is not None:
+            fn = getattr(self._base, "stage_seconds", None)
+            if callable(fn):
+                out.update(fn())
+        out["cache_read"] = self._cache_read_seconds
+        return out
+
+    def parallel_stats(self) -> Optional[dict]:
+        if self._mode != "warm" and self._base is not None:
+            fn = getattr(self._base, "parallel_stats", None)
+            if callable(fn):
+                return fn()
+        return None
+
+    @property
+    def bytes_read(self) -> int:
+        cold = self._base.bytes_read if self._base is not None else 0
+        return cold + self._bytes
+
+    def close(self) -> None:
+        self._abort_writer()
+        self._drop_reader()
+        if self._base is not None:
+            self._base.close()
+
+
 # ---------------- factory & registry (src/data.cc) ----------------
 
 def _resolve_parse_workers(parse_workers: Optional[int]) -> int:
@@ -1216,6 +1522,28 @@ PARSER_REGISTRY.register("csv", "dense csv format")(
     _make_text_parser(CSVParser, True))
 
 
+def _resolve_block_cache(spec: URISpec, part_index: int, num_parts: int,
+                         explicit: Optional[str]) -> Optional[str]:
+    """Block-cache path resolution: explicit ``block_cache=`` knob, then
+    the ``#blockcache=<path>`` URI suffix, then the ``DMLC_TPU_BLOCK_CACHE``
+    env **directory** (cache file auto-named from a hash of the URI+args).
+    Multi-part loads get the same ``.splitN.partK`` qualification as
+    ``#cachefile`` so parts never collide."""
+    path = explicit if explicit is not None else spec.block_cache
+    if path is None:
+        env_dir = os.environ.get("DMLC_TPU_BLOCK_CACHE", "").strip()
+        if env_dir:
+            key_src = spec.uri + "?" + "&".join(
+                f"{k}={v}" for k, v in sorted(spec.args.items()))
+            key = hashlib.sha1(key_src.encode()).hexdigest()[:16]
+            path = os.path.join(env_dir, f"{key}.blockcache")
+    if path is None:
+        return None
+    if num_parts != 1:
+        path = f"{path}.split{num_parts}.part{part_index}"
+    return path
+
+
 def create_parser(
     uri: str,
     part_index: int = 0,
@@ -1224,6 +1552,7 @@ def create_parser(
     index_dtype=np.uint64,
     threaded: bool = True,
     parse_workers: Optional[int] = None,
+    block_cache: Optional[str] = None,
     **split_kw,
 ) -> Parser:
     """Parser factory — analog of dmlc::Parser::Create (src/data.cc:62-85).
@@ -1236,10 +1565,67 @@ def create_parser(
     :class:`ThreadedParser`, None auto-sizes to ``DMLC_TPU_PARSE_WORKERS``
     or ``min(4, cpu count)``. The fully-native reader keeps its own C++
     threading and ignores the knob (docs/data.md).
+
+    ``block_cache`` names a parse-once columnar block cache
+    (:class:`BlockCacheIter`): the first epoch shadow-writes parsed
+    blocks, warm epochs serve them back as zero-copy mmap views without
+    parsing. Also selectable via a ``#blockcache=<path>`` URI suffix or
+    the ``DMLC_TPU_BLOCK_CACHE`` env directory; the cache self-invalidates
+    when the source files, partition, or parser config drift
+    (docs/data.md block cache section).
     """
     spec = URISpec(uri, part_index, num_parts)
     if type_ == "auto":
         type_ = spec.args.get("format", "libsvm")
+    bc_path = _resolve_block_cache(spec, part_index, num_parts, block_cache)
+    if spec.block_cache is not None:
+        # the fragment is block-cache routing sugar, not a chunk cachefile:
+        # strip it so downstream engines see a plain URI
+        uri = uri.split("#", 1)[0]
+    if bc_path is None:
+        return _create_parser_uncached(
+            uri, spec, part_index, num_parts, type_, index_dtype, threaded,
+            parse_workers, **split_kw)
+    check(not split_kw.get("shuffle") and not split_kw.get("num_shuffle_parts"),
+          "block_cache and shuffle decorators cannot be combined: the cache "
+          "would freeze the first epoch's order into every warm epoch")
+    from dmlc_tpu.io import block_cache as _block_cache
+
+    # engine/worker knobs (threaded, parse_workers, engine=) are
+    # deliberately OUTSIDE the signature: every engine emits byte-identical
+    # blocks AND identical chunk grouping (the A/B parity suites), so a
+    # cache written by one serves them all. Split-layer config that CHANGES
+    # the grouping or content — chunk_bytes above all: the heal and
+    # count-based resume paths skip re-parsed blocks by index, which is
+    # only sound when re-parse grouping matches the cached grouping — is
+    # INSIDE it, so a drifted config invalidates instead of mis-serving.
+    signature = _block_cache.source_signature(
+        spec.uri, part_index, num_parts,
+        format=type_, args=dict(spec.args),
+        index_dtype=np.dtype(index_dtype).str,
+        chunk_bytes=int(split_kw.get("chunk_bytes", DEFAULT_CHUNK_BYTES)),
+        split={k: v for k, v in sorted(split_kw.items())
+               if k != "chunk_bytes"})
+
+    def build() -> Parser:
+        return _create_parser_uncached(
+            uri, spec, part_index, num_parts, type_, index_dtype, threaded,
+            parse_workers, **split_kw)
+
+    return BlockCacheIter(build, bc_path, signature=signature)
+
+
+def _create_parser_uncached(
+    uri: str,
+    spec: URISpec,
+    part_index: int,
+    num_parts: int,
+    type_: str,
+    index_dtype,
+    threaded: bool,
+    parse_workers: Optional[int],
+    **split_kw,
+) -> Parser:
     # hot path: fully-native streaming pipeline (read+chunk+parse in C++)
     # for plain local text corpora; decorated/remote/unsupported URIs take
     # the Python engine below (identical chunk semantics, tested A/B)

@@ -159,6 +159,30 @@ class RowBlock:
         out[rows[keep], self.index[keep]] = vals[keep]
         return out
 
+    # -- columnar segment round trip (io/block_cache.py format) --
+
+    def to_segments(self) -> dict:
+        """The block's arrays as the named columnar segments the block
+        cache serializes (:mod:`dmlc_tpu.io.block_cache` SEGMENT_NAMES);
+        absent optional arrays map to None."""
+        return {
+            "offset": self.offset, "label": self.label, "weight": self.weight,
+            "qid": self.qid, "field": self.field, "index": self.index,
+            "value": self.value,
+        }
+
+    @staticmethod
+    def from_segments(segments: dict, hold=None) -> "RowBlock":
+        """Rebuild a block from :meth:`to_segments` output. Segment dtypes
+        already match the block layout, so mmap-backed views pass through
+        zero-copy; ``hold`` pins their buffer owner (the reader's mmap)."""
+        return RowBlock(
+            offset=segments["offset"], label=segments["label"],
+            index=segments["index"], value=segments.get("value"),
+            weight=segments.get("weight"), qid=segments.get("qid"),
+            field=segments.get("field"), hold=hold,
+        )
+
     # -- binary round trip (row_block.h:189-215) --
 
     def save(self, stream: BinaryIO) -> None:

@@ -24,6 +24,9 @@ from dmlc_tpu.io.input_split import (
     create_mmap_text_split,
 )
 from dmlc_tpu.io.cached_split import CachedInputSplit
+from dmlc_tpu.io.block_cache import (
+    BlockCacheReader, BlockCacheWriter, open_block_cache, source_signature,
+)
 from dmlc_tpu.io import http_filesys as _http_filesys  # registers http/cloud slots
 from dmlc_tpu.io import s3_filesys as _s3_filesys  # replaces the s3:// slot
 from dmlc_tpu.io import gcs_filesys as _gcs_filesys  # replaces the gs:// slot
@@ -41,4 +44,6 @@ __all__ = [
     "ThreadedIter", "InputSplit", "LineSplitter", "MmapLineSplit",
     "RecordIOSplitter", "IndexedRecordIOSplitter", "ThreadedInputSplit",
     "create_input_split", "create_mmap_text_split",
+    "BlockCacheReader", "BlockCacheWriter", "open_block_cache",
+    "source_signature", "CachedInputSplit",
 ]

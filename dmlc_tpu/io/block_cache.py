@@ -1,0 +1,381 @@
+"""Parse-once columnar RowBlock cache: the on-disk format, writer, reader.
+
+The chunk cache (:mod:`dmlc_tpu.io.cached_split`) caches raw bytes BEFORE
+the parser, so warm passes still re-pay the full text-parse cost every
+epoch. This module caches AFTER the parser — the highest-leverage point in
+the pipeline per tf.data's ``cache()`` study (arXiv:2101.12127 §5) and the
+preprocessing/training decoupling argument of the tf.data-service paper
+(arXiv:2210.14826): the first (cold) epoch shadow-writes each parsed
+block's columnar arrays; warm epochs serve the arrays back as zero-copy
+mmap-backed numpy views, bypassing the parser entirely.
+
+This module owns the FORMAT only — it moves named 1-D numpy segments, not
+RowBlocks (the RowBlock <-> segments conversion lives in
+:meth:`dmlc_tpu.data.row_block.RowBlock.to_segments`, keeping the io layer
+free of data-layer imports). The pipeline integration —
+``BlockCacheIter`` — lives in :mod:`dmlc_tpu.data.parsers`.
+
+Format v1 (pinned by ``tests/data/blockcache_v1.golden``)::
+
+    [header]   magic "DMLCBC01" (8B) + version u32 LE + 4 zero pad bytes
+    [segments] per block, per present array: raw little-endian bytes,
+               each array start padded to 64-byte alignment (mmap-friendly
+               for numpy views)
+    [footer]   utf-8 JSON (sort_keys): {"version", "signature", "num_col",
+               "rows", "blocks": [{"pos", "end", "rows", "crc", "resume",
+               "arrays": {name: [dtype_str, abs_offset, nbytes]}}, ...]}
+    [tail]     u64 footer_offset + u64 footer_len + u32 footer_crc LE
+               + magic "DMLCBC01"
+
+Integrity: each block carries a crc32 over its whole ``[pos, end)`` span
+(checked on every warm read — zlib crc runs at GB/s, noise next to the
+text parse it replaces), the footer carries its own crc, and both file
+ends carry the magic so truncation is detected structurally. The writer
+streams to ``<path>.tmp``, fsyncs, and atomically publishes with
+``os.replace`` — a crash can never leave a torn-but-valid-looking cache.
+
+Staleness: a cache is keyed by a **source signature** (file sizes+mtimes,
+partition ``splitN.partK``, parser/format/engine config —
+:func:`source_signature`). :func:`open_block_cache` returns ``None`` for a
+missing, unreadable, or signature-mismatched cache (dropping the stale
+file and counting a ``cache_invalidations`` resilience event), so callers
+simply rebuild.
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+import os
+import struct
+import zlib
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from dmlc_tpu.io import faults
+from dmlc_tpu.io import resilience as _resilience
+from dmlc_tpu.utils.check import CacheCorruptionError, DMLCError, check
+
+BLOCK_CACHE_MAGIC = b"DMLCBC01"
+BLOCK_CACHE_VERSION = 1
+_HEADER = BLOCK_CACHE_MAGIC + struct.pack("<I", BLOCK_CACHE_VERSION) + b"\0" * 4
+_TAIL_FMT = "<QQI"  # footer offset, footer length, footer crc32
+_TAIL_LEN = struct.calcsize(_TAIL_FMT) + len(BLOCK_CACHE_MAGIC)
+_ALIGN = 64
+
+# canonical segment order (fixed so the golden layout is deterministic);
+# optional arrays are simply absent from a block's footer entry
+SEGMENT_NAMES = ("offset", "label", "weight", "qid", "field", "index", "value")
+
+
+def _pad_to(f, align: int) -> int:
+    pos = f.tell()
+    rem = pos % align
+    if rem:
+        f.write(b"\0" * (align - rem))
+        pos += align - rem
+    return pos
+
+
+class BlockCacheWriter:
+    """Streams checksummed columnar block segments to ``<path>.tmp``;
+    :meth:`finish` writes the footer, fsyncs, and atomically publishes."""
+
+    def __init__(self, path: str, signature: Optional[dict] = None):
+        self.path = path
+        self.tmp_path = path + ".tmp"
+        self._sig = signature or {}
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._f = open(self.tmp_path, "wb")
+        self._f.write(_HEADER)
+        self._entries: List[dict] = []
+        self._num_col = 0
+        self._rows = 0
+        self._finished = False
+
+    def add_block(self, segments: Dict[str, Optional[np.ndarray]],
+                  rows: int, num_col: int = 0,
+                  resume: Optional[dict] = None) -> None:
+        """Append one block. ``segments`` maps :data:`SEGMENT_NAMES` to 1-D
+        arrays (``None`` = absent); ``resume`` is the block's JSON-friendly
+        resume annotation (position just after the block), stored so warm
+        epochs can re-attach byte-exact checkpoint states."""
+        check(self._f is not None and not self._finished,
+              "BlockCacheWriter: writer already finished/aborted")
+        f = self._f
+        pos = _pad_to(f, _ALIGN)
+        crc = 0
+        arrays: Dict[str, list] = {}
+        for name in SEGMENT_NAMES:
+            arr = segments.get(name)
+            if arr is None:
+                continue
+            arr = np.ascontiguousarray(arr)
+            start = f.tell()
+            rem = start % _ALIGN
+            if rem:
+                padding = b"\0" * (_ALIGN - rem)
+                f.write(padding)
+                crc = zlib.crc32(padding, crc)
+                start += len(padding)
+            raw = arr.tobytes()  # canonical C-order little-endian payload
+            f.write(raw)
+            crc = zlib.crc32(raw, crc)
+            arrays[name] = [arr.dtype.str, start, len(raw)]
+        end = f.tell()
+        # resume annotations round-trip through JSON (tuples -> lists,
+        # dict order normalized) so cold- and warm-served states compare
+        # equal byte for byte
+        resume_json = (json.loads(json.dumps(resume))
+                       if resume is not None else None)
+        self._entries.append({
+            "pos": pos, "end": end, "rows": int(rows),
+            "crc": crc & 0xFFFFFFFF, "resume": resume_json,
+            "arrays": arrays,
+        })
+        self._rows += int(rows)
+        self._num_col = max(self._num_col, int(num_col))
+
+    def finish(self) -> None:
+        """Write footer + tail, fsync, atomically publish at ``path``."""
+        check(self._f is not None and not self._finished,
+              "BlockCacheWriter: writer already finished/aborted")
+        f = self._f
+        footer = {
+            "version": BLOCK_CACHE_VERSION,
+            "signature": self._sig,
+            "num_col": self._num_col,
+            "rows": self._rows,
+            "blocks": self._entries,
+        }
+        payload = json.dumps(footer, sort_keys=True,
+                             separators=(",", ":")).encode()
+        off = _pad_to(f, _ALIGN)
+        f.write(payload)
+        f.write(struct.pack(_TAIL_FMT, off, len(payload),
+                            zlib.crc32(payload) & 0xFFFFFFFF))
+        f.write(BLOCK_CACHE_MAGIC)
+        # fsync BEFORE the atomic rename: without it a crash between write
+        # and rename can publish a complete-looking file whose data blocks
+        # never hit the platter (same protocol as CachedInputSplit)
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        self._f = None
+        os.replace(self.tmp_path, self.path)
+        self._finished = True
+
+    def abort(self) -> None:
+        """Drop the partial tmp file (interrupted cold pass)."""
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+        try:
+            os.remove(self.tmp_path)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        if not self._finished:
+            self.abort()
+
+
+class BlockCacheReader:
+    """mmap-backed reader: blocks decode to zero-copy numpy views.
+
+    Views returned by :meth:`load_segments` alias the mmap — callers keep
+    the reader's ``buffer`` (exposed as ``hold``) alive for as long as the
+    views are; the mmap itself is closed only by GC once every view died.
+    """
+
+    def __init__(self, path: str, signature: Optional[dict] = None,
+                 verify: bool = True):
+        self.path = path
+        self.verify = verify
+        self._file = None
+        self._mm = None
+        try:
+            size = os.path.getsize(path)
+            check(size >= len(_HEADER) + _TAIL_LEN, "block cache too short")
+            self._file = open(path, "rb")
+            self._mm = mmap.mmap(self._file.fileno(), 0,
+                                 access=mmap.ACCESS_READ)
+        except (OSError, DMLCError) as exc:
+            self.close()  # the fd must not leak when the mmap fails
+            raise DMLCError(f"block cache {path}: unreadable: {exc}") from exc
+        try:
+            head = self._mm[: len(_HEADER)]
+            check(head[:8] == BLOCK_CACHE_MAGIC,
+                  f"block cache {path}: bad magic")
+            (version,) = struct.unpack("<I", head[8:12])
+            check(version == BLOCK_CACHE_VERSION,
+                  f"block cache {path}: version {version} != "
+                  f"{BLOCK_CACHE_VERSION}")
+            tail = self._mm[size - _TAIL_LEN:]
+            check(tail[-8:] == BLOCK_CACHE_MAGIC,
+                  f"block cache {path}: truncated (no tail magic)")
+            off, length, crc = struct.unpack(
+                _TAIL_FMT, tail[: struct.calcsize(_TAIL_FMT)])
+            check(off + length <= size - _TAIL_LEN,
+                  f"block cache {path}: footer out of range")
+            with memoryview(self._mm)[off: off + length] as mv:
+                payload_crc = zlib.crc32(mv) & 0xFFFFFFFF
+                payload = bytes(mv)  # json needs bytes; footer is small
+            check(payload_crc == crc,
+                  f"block cache {path}: footer crc mismatch")
+            footer = json.loads(payload)
+            self.signature = footer.get("signature") or {}
+            self.num_col = int(footer.get("num_col", 0))
+            self.rows = int(footer.get("rows", 0))
+            self._blocks = footer["blocks"]
+            if signature is not None and self.signature != _normalize(
+                    signature):
+                raise DMLCError(
+                    f"block cache {path}: source signature mismatch "
+                    f"(stale cache)")
+        except Exception:
+            self.close()
+            raise
+
+    # ---------------- accessors ----------------
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self._blocks)
+
+    @property
+    def hold(self):
+        """The buffer owner views must pin (the mmap)."""
+        return self._mm
+
+    def resume(self, i: int) -> Optional[dict]:
+        """The stored resume annotation of block ``i`` (position just
+        after it), or None when the producing parser had none."""
+        return self._blocks[i]["resume"]
+
+    def block_rows(self, i: int) -> int:
+        return int(self._blocks[i]["rows"])
+
+    def block_nbytes(self, i: int) -> int:
+        e = self._blocks[i]
+        return int(e["end"]) - int(e["pos"])
+
+    def load_segments(self, i: int) -> Dict[str, np.ndarray]:
+        """Decode block ``i`` to {name: zero-copy read-only numpy view}.
+
+        Raises :class:`CacheCorruptionError` on a crc mismatch (or when a
+        ``cache_read`` fault is injected) — callers heal by dropping the
+        cache and re-parsing the source.
+        """
+        faults.maybe_fail("cache_read", self.path)
+        entry = self._blocks[i]
+        if self.verify:
+            # checksum straight off the page cache: slicing the mmap would
+            # memcpy the whole block span; a memoryview slice does not
+            with memoryview(self._mm)[
+                    int(entry["pos"]): int(entry["end"])] as span:
+                ok = zlib.crc32(span) & 0xFFFFFFFF == int(entry["crc"])
+            if not ok:
+                raise CacheCorruptionError(
+                    f"block cache {self.path}: crc mismatch on block {i}")
+        out: Dict[str, np.ndarray] = {}
+        for name, (dtype_str, off, nbytes) in entry["arrays"].items():
+            dt = np.dtype(dtype_str)
+            out[name] = np.frombuffer(self._mm, dtype=dt,
+                                      count=nbytes // dt.itemsize,
+                                      offset=int(off))
+        return out
+
+    def close(self) -> None:
+        # best-effort: the mmap cannot close while exported views are
+        # alive (BufferError) — GC reclaims it once the last view dies
+        mm = getattr(self, "_mm", None)
+        if mm is not None:
+            try:
+                mm.close()
+                self._mm = None
+            except BufferError:
+                pass
+        f = getattr(self, "_file", None)
+        if f is not None:
+            self._file = None
+            f.close()
+
+
+# ---------------- cache-key signature + open helper ----------------
+
+def _normalize(obj):
+    """JSON round-trip: the stored signature is what JSON preserves."""
+    return json.loads(json.dumps(obj, sort_keys=True))
+
+
+def source_signature(uri: str, part_index: int, num_parts: int,
+                     **config) -> dict:
+    """The staleness key a block cache is bound to.
+
+    Captures the source file set with sizes and mtimes (local paths; remote
+    URIs record sizes via the filesystem layer, mtime ``None``), the
+    partition identity, and whatever parser/format/engine ``config`` the
+    caller passes — any drift invalidates the cache on open.
+    """
+    base = uri.split("#", 1)[0].split("?", 1)[0]
+    files: List[list] = []
+    for part in base.split(";"):
+        if not part:
+            continue
+        local = part[7:] if part.startswith("file://") else (
+            part if "://" not in part else None)
+        if local is not None:
+            if os.path.isdir(local):
+                for name in sorted(os.listdir(local)):
+                    fp = os.path.join(local, name)
+                    if os.path.isfile(fp):
+                        st = os.stat(fp)
+                        files.append([fp, st.st_size, st.st_mtime_ns])
+            elif os.path.exists(local):
+                st = os.stat(local)
+                files.append([local, st.st_size, st.st_mtime_ns])
+            else:
+                files.append([part, None, None])
+            continue
+        try:  # remote: sizes from the filesystem layer, no mtimes
+            from dmlc_tpu.io.filesystem import get_filesystem
+            from dmlc_tpu.io.uri import URI
+
+            fs = get_filesystem(part)
+            info = fs.get_path_info(URI(part))
+            if info.type == "directory":
+                for f in fs.list_directory(info.path):
+                    if f.type == "file":
+                        files.append([str(f.path), f.size, None])
+            else:
+                files.append([str(info.path), info.size, None])
+        except Exception:  # noqa: BLE001 - unreachable source: path-only key
+            files.append([part, None, None])
+    return _normalize({
+        "cache_version": BLOCK_CACHE_VERSION,
+        "files": files,
+        "partition": [int(part_index), int(num_parts)],
+        "config": config,
+    })
+
+
+def open_block_cache(path: str, signature: Optional[dict] = None,
+                     verify: bool = True) -> Optional[BlockCacheReader]:
+    """Open a published cache, or None when it is missing or must be
+    rebuilt (unreadable / wrong version / signature mismatch — the stale
+    file is dropped and a ``cache_invalidations`` resilience event
+    counted)."""
+    if not os.path.exists(path):
+        return None
+    try:
+        return BlockCacheReader(path, signature=signature, verify=verify)
+    except DMLCError:
+        _resilience.COUNTERS.bump("cache_invalidations")
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return None

@@ -1,35 +1,54 @@
 """Chunk-cache decorator for input splits.
 
 Equivalent of reference src/io/cached_input_split.h: the first pass serves
-chunks while writing them to a local cache file (``[u64 size][bytes]``
-frames, InitPreprocIter, cached_input_split.h:148-164); later passes stream
+chunks while writing them to a local cache file; later passes stream
 straight from the cache (InitCachedIter, cached_input_split.h:166-189),
 skipping filesystem/remote reads entirely. Selected by a ``#cachefile`` URI
 suffix (src/io.cc:119-123) with the partition-qualified ``.splitN.partK``
 name from URISpec.
 
-Improvement over the reference: the cache is written to ``<file>.tmp`` and
-renamed on completion, so a crashed first pass can never leave a truncated
-cache that later passes would read as valid.
+Improvements over the reference:
+
+- the cache is written to ``<file>.tmp``, **fsynced**, and renamed on
+  completion — a crashed first pass can never leave a truncated cache, and
+  a crash between write and rename can never publish a cache whose frames
+  never hit the platter;
+- cache format v1 is versioned (``DMLCCHK1`` header) and every frame is
+  ``[u64 size][u32 crc32][bytes]`` — a warm pass verifies each frame, and
+  a failed check is a classified **cache fault**
+  (:class:`~dmlc_tpu.utils.check.CacheCorruptionError`, retryable), not a
+  bare struct error: the bad cache is dropped, chunks re-read from the
+  source, the cache rewritten, and the event counted under
+  ``cache_corruptions`` / ``cache_rebuilds`` (docs/resilience.md).
+  Headerless caches from older builds invalidate cleanly at open
+  (rebuilt from source, counted under ``cache_invalidations``).
 """
 
 from __future__ import annotations
 
 import os
 import struct
+import zlib
 from typing import Iterator, Optional
 
+from dmlc_tpu.io import faults
+from dmlc_tpu.io import resilience as _resilience
 from dmlc_tpu.io.input_split import InputSplit, InputSplitBase, _Chunk
 from dmlc_tpu.io.threaded_iter import ThreadedIter
-from dmlc_tpu.utils.check import DMLCError, check
+from dmlc_tpu.utils.check import CacheCorruptionError, DMLCError, check
+
+CHUNK_CACHE_MAGIC = b"DMLCCHK1"
+_FRAME_FMT = "<QI"  # payload size, payload crc32
+_FRAME_LEN = struct.calcsize(_FRAME_FMT)
 
 
 class CachedInputSplit(InputSplit):
     """Serve-and-cache on the first pass, cache-only afterwards.
 
     ``base`` may be a live InputSplitBase or a zero-arg factory for one; the
-    factory is only invoked when the cache is missing, so a warm cache never
-    touches the source filesystem (the files may be gone or remote).
+    factory is only invoked when the cache is missing (or needs a healing
+    rebuild), so a healthy warm cache never touches the source filesystem
+    (the files may be gone or remote).
     """
 
     def __init__(self, base, cache_file: str, capacity: int = 16,
@@ -46,8 +65,28 @@ class CachedInputSplit(InputSplit):
         self._capacity = capacity
         self._chunk: Optional[_Chunk] = None
         self._iter: Optional[ThreadedIter] = None
-        self._mode = "cached" if os.path.exists(cache_file) else "preproc"
+        self._mode = "cached" if self._cache_usable() else "preproc"
         self._start_iter()
+
+    def _cache_usable(self) -> bool:
+        """A published cache with the current format header. A header from
+        another format/version (including the headerless v0 layout) is a
+        stale cache: drop it and rebuild from source."""
+        if not os.path.exists(self.cache_file):
+            return False
+        try:
+            with open(self.cache_file, "rb") as fi:
+                head = fi.read(len(CHUNK_CACHE_MAGIC))
+        except OSError:
+            head = b""
+        if head == CHUNK_CACHE_MAGIC:
+            return True
+        _resilience.COUNTERS.bump("cache_invalidations")
+        try:
+            os.remove(self.cache_file)
+        except OSError:
+            pass
+        return False
 
     @property
     def base(self) -> InputSplitBase:
@@ -73,29 +112,77 @@ class CachedInputSplit(InputSplit):
     def _preproc_chunks(self) -> Iterator[bytes]:
         """First pass: pull from base, tee every chunk to the cache file."""
         with open(self._tmp_file, "wb") as fo:
+            fo.write(CHUNK_CACHE_MAGIC)
             while True:
                 chunk = self.base.next_chunk()
                 if chunk is None:
                     break
                 data = bytes(chunk) if not isinstance(chunk, bytes) else chunk
-                fo.write(struct.pack("<Q", len(data)))
+                fo.write(struct.pack(_FRAME_FMT, len(data),
+                                     zlib.crc32(data) & 0xFFFFFFFF))
                 fo.write(data)
                 yield data
+            # fsync BEFORE the atomic rename: os.replace orders the rename
+            # against nothing — without the fsync a crash in the window can
+            # publish a complete-looking cache whose frames were never
+            # flushed (torn frames that later passes would read as valid)
+            fo.flush()
+            os.fsync(fo.fileno())
         os.replace(self._tmp_file, self.cache_file)
         self._mode = "cached"
 
     def _cached_chunks(self) -> Iterator[bytes]:
-        with open(self.cache_file, "rb") as fi:
-            while True:
-                header = fi.read(8)
-                if not header:
-                    return
-                check(len(header) == 8,
-                      f"{self.cache_file} has invalid cache file format")
-                (size,) = struct.unpack("<Q", header)
-                data = fi.read(size)
-                check(len(data) == size,
-                      f"{self.cache_file} has invalid cache file format")
+        served_bytes = 0
+        try:
+            with open(self.cache_file, "rb") as fi:
+                head = fi.read(len(CHUNK_CACHE_MAGIC))
+                if head != CHUNK_CACHE_MAGIC:
+                    raise CacheCorruptionError(
+                        f"{self.cache_file}: bad chunk-cache header")
+                while True:
+                    faults.maybe_fail("cache_read", self.cache_file)
+                    header = fi.read(_FRAME_LEN)
+                    if not header:
+                        return
+                    if len(header) != _FRAME_LEN:
+                        raise CacheCorruptionError(
+                            f"{self.cache_file}: torn frame header")
+                    size, crc = struct.unpack(_FRAME_FMT, header)
+                    data = fi.read(size)
+                    if len(data) != size:
+                        raise CacheCorruptionError(
+                            f"{self.cache_file}: torn frame payload")
+                    if zlib.crc32(data) & 0xFFFFFFFF != crc:
+                        raise CacheCorruptionError(
+                            f"{self.cache_file}: frame crc mismatch")
+                    yield data
+                    served_bytes += size
+        except CacheCorruptionError:
+            # classified cache fault (resilience.classify -> retryable):
+            # drop the bad cache, fall back to re-reading the source,
+            # rewrite the cache, and resume the stream where it broke —
+            # consumers see an unbroken chunk sequence, never the error.
+            # The resume skips BYTES, not frames: the re-read may group
+            # chunks differently (e.g. the split's chunk_bytes changed
+            # since the cache was built) but the concatenated byte stream
+            # is identical, and every frame boundary sits on a record
+            # boundary, so a mid-chunk tail still starts at a record head
+            _resilience.COUNTERS.bump("cache_corruptions")
+            _resilience.COUNTERS.bump("cache_rebuilds")
+            try:
+                os.remove(self.cache_file)
+            except OSError:
+                pass
+            self._mode = "preproc"
+            self.base.before_first()
+            skip = served_bytes
+            for data in self._preproc_chunks():
+                if skip >= len(data):
+                    skip -= len(data)
+                    continue
+                if skip:
+                    data = data[skip:]
+                    skip = 0
                 yield data
 
     def _start_iter(self) -> None:

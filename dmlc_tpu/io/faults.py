@@ -15,13 +15,17 @@ Plan grammar (``;``-separated clauses)::
     op         := 'read' | 'open' | 'write' | 'request' | 'connect' | ...
     occurrence := N | N '..' M | N '+'        (1-based, per clause)
     error      := 'http-<code>' | 'reset' | 'timeout' | 'unreachable'
-                  (default: 'http-503')
+                  | 'corrupt'                 (default: 'http-503')
 
 The op is the call-site label passed to ``maybe_fail``: ``read`` fires on
 stream block fetches, ``open`` on metadata/stat/open requests, ``write``
 on upload requests, ``request`` on other control requests, and
 ``connect`` on EVERY guarded attempt regardless of label (the lowest
-seam). ``~substr`` restricts a clause to calls whose subject (URL/path)
+seam). ``cache_read`` fires on cache-frame/segment reads (the chunk cache
+and the block cache), where the natural error class is ``corrupt`` — a
+:class:`~dmlc_tpu.utils.check.CacheCorruptionError` that exercises the
+drop-cache/re-parse/rewrite healing path without touching bytes on disk.
+``~substr`` restricts a clause to calls whose subject (URL/path)
 contains the substring; occurrences are counted per clause over its
 matching calls only, so plans are deterministic under interleaving from
 other streams.
@@ -49,7 +53,7 @@ import urllib.error
 from contextlib import contextmanager
 from typing import List, Optional
 
-from dmlc_tpu.utils.check import DMLCError
+from dmlc_tpu.utils.check import CacheCorruptionError, DMLCError
 
 _CLAUSE_RE = re.compile(
     r"^(?P<op>[A-Za-z_][\w-]*)"
@@ -72,6 +76,9 @@ def _build_error(spec: str, what: str) -> BaseException:
         return TimeoutError("injected timeout")
     if spec == "unreachable":
         return urllib.error.URLError(OSError("injected: host unreachable"))
+    if spec == "corrupt":
+        return CacheCorruptionError(
+            f"injected cache corruption: {what or 'fault://injected'}")
     raise DMLCError(f"fault plan: unknown error class {spec!r}")
 
 

@@ -42,7 +42,7 @@ import urllib.error
 from typing import Callable, Dict, Optional
 
 from dmlc_tpu.io import faults
-from dmlc_tpu.utils.check import DMLCError
+from dmlc_tpu.utils.check import CacheCorruptionError, DMLCError
 
 RETRYABLE = "retryable"
 FATAL = "fatal"
@@ -64,6 +64,11 @@ def classify(exc: BaseException) -> str:
 
     seen = 0
     while exc is not None and seen < 8:
+        if isinstance(exc, CacheCorruptionError):
+            # cache faults heal: drop the bad cache, re-read/re-parse the
+            # source, rewrite — retryable by construction (the retry IS
+            # the rebuild), never a fatal structural error
+            return RETRYABLE
         # HTTPError subclasses URLError and OSError: check it first
         if isinstance(exc, urllib.error.HTTPError):
             return (RETRYABLE if exc.code in _RETRYABLE_HTTP
@@ -130,11 +135,22 @@ class _Counters:
                   bounded chunk-source restarts inside the data-parallel
                   parse fan-out (ParallelTextParser's OrderedWorkerPool,
                   which labels its restart counters ``parse``)
+    ``cache_corruptions``
+                  cache integrity-check failures (CRC mismatch / torn
+                  frame) detected while serving a warm cache
+    ``cache_invalidations``
+                  stale caches dropped at open time (signature mismatch,
+                  unreadable/legacy format) — rebuilt from source
+    ``cache_rebuilds``
+                  healing rebuilds triggered by a mid-stream corruption:
+                  the bad cache was dropped, the source re-read/re-parsed,
+                  and a fresh cache rewritten
     """
 
     _KEYS = ("attempts", "retries", "resumes", "giveups", "fatal",
              "producer_restarts", "producer_giveups",
-             "parse_restarts", "parse_giveups")
+             "parse_restarts", "parse_giveups",
+             "cache_corruptions", "cache_invalidations", "cache_rebuilds")
 
     def __init__(self) -> None:
         self._lock = threading.Lock()

@@ -46,15 +46,32 @@ class URI:
 
 
 class URISpec:
-    """URI sugar: ``real_uri?k=v&k2=v2#cache_file`` (uri_spec.h:42-75)."""
+    """URI sugar: ``real_uri?k=v&k2=v2#cache_file`` (uri_spec.h:42-75).
+
+    Extension over the reference: a fragment of the form
+    ``#blockcache=<path>`` selects the parse-once columnar **block cache**
+    (docs/data.md) instead of the raw chunk cache — ``block_cache`` then
+    carries the raw path (partition qualification happens at the resolver,
+    :func:`dmlc_tpu.data.parsers.create_parser`) and ``cache_file`` stays
+    None.
+    """
 
     def __init__(self, uri: str, part_index: int = 0, num_parts: int = 1):
         name_cache = uri.split("#")
+        self.block_cache: str | None = None
         if len(name_cache) == 2:
             cache = name_cache[1]
-            if num_parts != 1:
-                cache = f"{cache}.split{num_parts}.part{part_index}"
-            self.cache_file: str | None = cache
+            if cache.startswith("blockcache="):
+                path = cache[len("blockcache="):]
+                if not path:
+                    raise DMLCError(
+                        "empty path in `#blockcache=` URI suffix")
+                self.block_cache = path
+                self.cache_file: str | None = None
+            else:
+                if num_parts != 1:
+                    cache = f"{cache}.split{num_parts}.part{part_index}"
+                self.cache_file = cache
         elif len(name_cache) == 1:
             self.cache_file = None
         else:

@@ -17,6 +17,15 @@ class DMLCError(RuntimeError):
     """Raised by failed checks — analog of ``dmlc::Error`` (logging.h:29)."""
 
 
+class CacheCorruptionError(DMLCError):
+    """An on-disk cache integrity check failed (CRC mismatch, torn frame,
+    bad framing). Classified RETRYABLE by the resilience layer: the owner
+    of the cache drops it, falls back to re-reading/re-parsing the source,
+    and rewrites — the fault heals instead of failing the epoch (counted
+    under ``cache_corruptions`` / ``cache_rebuilds``, docs/resilience.md).
+    """
+
+
 _LOGGER: logging.Logger | None = None
 
 
