@@ -10,21 +10,27 @@
 #   make bench-smoke  bench.py on the CPU backend; fails unless the JSON
 #                     summary line carries the per-stage ingest
 #                     attribution (read/cache_read/parse/convert/dispatch/
-#                     transfer) and the block-cache epoch-pair fields
+#                     transfer), the block-cache epoch-pair fields
 #                     (warm_epoch_mb_per_sec/warm_vs_cold_speedup/
-#                     cache_state)
+#                     cache_state), and the telemetry contract
+#                     (telemetry_schema_version + per-stage span counts)
 #   make fuzz         mutation fuzz of every native parse C-ABI entry point
 #                     (crash-safety; DMLC_FUZZ_ITERS to scale)
 #   make lint-retry   grep gate: no time.sleep inside retry-shaped loops
 #                     outside dmlc_tpu/io/resilience.py (ad-hoc retry
 #                     loops must delegate to the shared RetryPolicy)
+#   make lint-metrics grep gate: no direct COUNTERS.bump / ad-hoc
+#                     time.monotonic() stage timing outside
+#                     dmlc_tpu/utils/{telemetry,timer}.py (bookkeeping
+#                     must live on the telemetry registry/span tracer)
 
 PYTHON ?= python
 # bash + pipefail so a failing stage is never masked by the tee into CHECK.log
 SHELL := /bin/bash
 .SHELLFLAGS := -o pipefail -c
 
-.PHONY: check test test-all sanitize parse-bench bench-smoke fuzz lint-retry
+.PHONY: check test test-all sanitize parse-bench bench-smoke fuzz \
+	lint-retry lint-metrics
 
 # the tier-1 contract: slow-marked scale/soak tests are opt-in (test-all)
 test:
@@ -35,6 +41,9 @@ test-all:
 
 lint-retry:
 	$(PYTHON) bin/lint_retry.py
+
+lint-metrics:
+	$(PYTHON) bin/lint_metrics.py
 
 fuzz:
 	$(PYTHON) native/test/fuzz_parse.py
@@ -70,6 +79,16 @@ bench-smoke:
 	        'warm_vs_cold_speedup missing'; \
 	    assert line.get('cache_state') == 'warm', \
 	        f\"cache_state {line.get('cache_state')!r} != 'warm'\"; \
+	    assert line.get('telemetry_schema_version') == 1, \
+	        'telemetry_schema_version missing/mismatched'; \
+	    assert line.get('trace_spans'), 'trace_spans missing/zero'; \
+	    sc = line.get('trace_span_counts') or {}; \
+	    missing_s = [s for s in ('read', 'parse', 'convert', 'dispatch', \
+	        'cache_read') if not sc.get(s)]; \
+	    assert not missing_s, f'span counts missing stages: {missing_s}'; \
+	    print('bench-smoke: telemetry OK: schema', \
+	          line['telemetry_schema_version'], 'spans', \
+	          line['trace_spans'], sc); \
 	    print('bench-smoke: attribution OK:', \
 	          {k: a[k] for k in sorted(a)}); \
 	    print('bench-smoke: parse scaling OK:', curve, \
@@ -94,6 +113,8 @@ check:
 	@echo "== make check $$(date -u +%Y-%m-%dT%H:%M:%SZ) ==" | tee CHECK.log
 	@echo "-- lint-retry (ad-hoc retry loop gate) --" | tee -a CHECK.log
 	$(MAKE) --no-print-directory lint-retry 2>&1 | tee -a CHECK.log
+	@echo "-- lint-metrics (ad-hoc bookkeeping gate) --" | tee -a CHECK.log
+	$(MAKE) --no-print-directory lint-metrics 2>&1 | tee -a CHECK.log
 	@echo "-- pytest --" | tee -a CHECK.log
 	$(PYTHON) -m pytest tests/ -q -m 'not slow' 2>&1 | tee -a CHECK.log
 	@echo "-- sanitizers --" | tee -a CHECK.log

@@ -539,6 +539,15 @@ def run_child() -> None:
             bf16_dev[1] / bf_floor_med, 3)
     except Exception as exc:  # noqa: BLE001 - the headline must still print
         log(f"bench: bf16 leg failed: {exc}")
+    # always-on telemetry contract (docs/observability.md): the schema
+    # version + per-stage span counts ride the JSON line, proving the span
+    # tracer covered the whole measurement (make bench-smoke gates these)
+    from dmlc_tpu.utils import telemetry as _telemetry
+
+    line["telemetry_schema_version"] = _telemetry.SCHEMA_VERSION
+    counts = _telemetry.span_counts()
+    line["trace_spans"] = int(sum(counts.values()))
+    line["trace_span_counts"] = {k: int(v) for k, v in sorted(counts.items())}
     print(json.dumps(line))
 
 
@@ -687,7 +696,9 @@ def main() -> int:
                           "parse_parallel_speedup_median",
                           "cold_epoch_mb_per_sec", "warm_epoch_mb_per_sec",
                           "warm_vs_cold_speedup", "cache_state",
-                          "warm_vs_parse_ceiling"):
+                          "warm_vs_parse_ceiling",
+                          "telemetry_schema_version", "trace_spans",
+                          "trace_span_counts"):
                     if parsed.get(k) is not None:
                         line[f"cpu_backend_{k}"] = parsed[k]
                 line["cpu_backend_note"] = (

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""CI gate: no bookkeeping beside the telemetry layer.
+
+The telemetry PR centralized every stage clock and event counter onto
+``dmlc_tpu/utils/telemetry.py`` (the registry + span tracer) with
+``dmlc_tpu/utils/timer.py`` as the sanctioned clock (``get_time`` /
+``StageMeter``). Before that, stage timing and counters were scattered
+point solutions — process-global counters that let concurrent pipelines
+contaminate each other, and ``time.monotonic()`` stopwatches whose
+numbers never reached ``stats()`` or a trace. ``make lint-metrics`` keeps
+that from creeping back. It FAILS on, anywhere under ``dmlc_tpu/`` except
+the two sanctioned modules:
+
+- ``COUNTERS.bump(`` — direct resilience-counter mutation; new events
+  must go through ``dmlc_tpu.io.resilience.record_event`` (which stamps
+  the pipeline scope on) or a registry counter.
+- ``time.monotonic(`` — ad-hoc stage timing; use
+  ``dmlc_tpu.utils.timer.get_time`` (so the reading can be paired with a
+  ``telemetry.record_span``) or ``telemetry.span``.
+
+Exit status: 0 clean, 1 with offenders listed as ``path:line``.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+from typing import List, Tuple
+
+ALLOWED = {
+    Path("dmlc_tpu") / "utils" / "telemetry.py",
+    Path("dmlc_tpu") / "utils" / "timer.py",
+}
+
+_PATTERNS = (
+    (re.compile(r"\bCOUNTERS\.bump\s*\("),
+     "direct COUNTERS.bump — use resilience.record_event / a registry "
+     "counter"),
+    (re.compile(r"\btime\.monotonic\s*\("),
+     "ad-hoc time.monotonic() stage timing — use utils.timer.get_time / "
+     "telemetry.span"),
+)
+
+
+def scan_source(text: str) -> List[Tuple[int, str]]:
+    """Return (1-based line, reason) for each ad-hoc bookkeeping site."""
+    offenders: List[Tuple[int, str]] = []
+    for i, line in enumerate(text.splitlines()):
+        stripped = line.lstrip()
+        if stripped.startswith("#"):
+            continue
+        for pattern, reason in _PATTERNS:
+            if pattern.search(line):
+                offenders.append((i + 1, reason))
+    return offenders
+
+
+def main(argv: List[str]) -> int:
+    root = Path(argv[1]) if len(argv) > 1 else \
+        Path(__file__).resolve().parent.parent
+    bad = 0
+    for path in sorted((root / "dmlc_tpu").rglob("*.py")):
+        rel = path.relative_to(root)
+        if rel in ALLOWED:
+            continue
+        for lineno, reason in scan_source(path.read_text(encoding="utf-8")):
+            print(f"{rel}:{lineno}: {reason}", file=sys.stderr)
+            bad += 1
+    if bad:
+        print(f"lint-metrics: {bad} ad-hoc bookkeeping site(s) found",
+              file=sys.stderr)
+        return 1
+    print("lint-metrics: OK (stage timing and counters live on the "
+          "telemetry layer)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -44,6 +44,7 @@ from dmlc_tpu.io.threaded_iter import OrderedWorkerPool, ThreadedIter
 from dmlc_tpu.ops.sparse import (
     EllBatch, block_to_bcoo_host, block_to_dense, block_to_ell,
 )
+from dmlc_tpu.utils import telemetry as _telemetry
 from dmlc_tpu.utils.check import DMLCError, check
 from dmlc_tpu.utils.timer import StageMeter, get_time
 
@@ -294,6 +295,7 @@ class DeviceIter:
         row_bucket: int = 1024,
         csr_wire: bool = True,
         pack_aux: Optional[bool] = None,
+        pipeline_label: Optional[str] = None,
     ):
         check(layout in ("dense", "ell", "bcoo"), f"unknown layout {layout!r}")
         check(batch_size is not None or layout == "bcoo",
@@ -367,8 +369,18 @@ class DeviceIter:
         self.host_stall_seconds = 0.0   # of which: waiting on host convert
         self.batches_fed = 0
         self.bytes_to_device = 0
-        # DMLC_TPU_TRACE=1 wraps each transfer in a profiler annotation
-        self._trace = os.environ.get("DMLC_TPU_TRACE", "0") == "1"
+        # the telemetry scope every span/metric this pipeline causes is
+        # labeled with — down to filesystem retries on producer threads.
+        # Two concurrent DeviceIters therefore keep fully disjoint books
+        # (docs/observability.md).
+        self.pipeline_label = (pipeline_label
+                               or _telemetry.new_pipeline_label())
+        # DMLC_TPU_TRACE modes (docs/data.md): '1' wraps transfer /
+        # convert / dispatch / cache_read in jax profiler annotations;
+        # 'chrome:<path>' dumps the span rings as a Chrome trace on close
+        trace_mode, trace_path = _telemetry.trace_mode()
+        self._trace = trace_mode == "annotate"
+        self._trace_export = trace_path if trace_mode == "chrome" else None
         if (layout == "bcoo" and batch_size is None
                 and hasattr(source, "set_emit_coo")):
             # ask the parser for device-ready COO batches: coordinate
@@ -438,12 +450,19 @@ class DeviceIter:
         self._inflight: deque = deque()
         # ---- stage attribution state (module docstring) ----
         # raw busy/blocked counters, written by pipeline threads
-        # (cache_read: warm block-cache supply, docs/data.md block cache):
+        # (cache_read: warm block-cache supply, docs/data.md block cache).
+        # Both meters are registry-backed under this pipeline's label, so
+        # stats(), the pod snapshot, and the trace all read one set of
+        # books (docs/observability.md).
         self._busy = StageMeter("read", "cache_read", "parse", "convert",
-                                "dispatch")
+                                "dispatch",
+                                metric=_telemetry.STAGE_BUSY_METRIC,
+                                scope=self.pipeline_label)
         # consumer-wall attribution (the partition stats() reports)
         self._attr = StageMeter("read", "cache_read", "parse", "convert",
-                                "dispatch", "transfer")
+                                "dispatch", "transfer",
+                                metric=_telemetry.STAGE_WALL_METRIC,
+                                scope=self.pipeline_label)
         self._transfer_samples = 0
         self._t_first: Optional[float] = None  # first consumer pull
         self._t_last: Optional[float] = None   # latest consumer activity
@@ -465,7 +484,10 @@ class DeviceIter:
         # died) re-arms the whole host pipeline at the last delivered batch
         # via the checkpoint machinery, bounded by this policy's attempts.
         self._retry_policy = _resilience.RetryPolicy.from_env()
-        self._res_base = _resilience.counters_snapshot()
+        # resilience deltas are scoped to THIS pipeline's label: events
+        # from a concurrent pipeline (or ambient filesystem use) can no
+        # longer contaminate stats()['resilience']
+        self._res_base = _resilience.counters_snapshot(self.pipeline_label)
         self.pipeline_restarts = 0
         self.pipeline_giveups = 0
 
@@ -509,7 +531,7 @@ class DeviceIter:
             t0 = get_time()
             blk = self.source.next_block()
             dt = get_time() - t0
-            read = cache_read = 0.0
+            read = cache_read = parse_delta = 0.0
             if s0 is not None:
                 s1 = stage_fn()
                 read = min(max(0.0, s1["read"] - s0["read"]), dt)
@@ -520,6 +542,15 @@ class DeviceIter:
                     max(0.0, s1.get("cache_read", 0.0)
                         - s0.get("cache_read", 0.0)),
                     dt - read)
+                parse_delta = max(0.0, s1.get("parse", 0.0)
+                                  - s0.get("parse", 0.0))
+            if read + cache_read + parse_delta <= 0.0 and dt > 0.0:
+                # fused native supply (read+parse in one C++ pipeline,
+                # with or without a BlockCacheIter in front): no parser-
+                # side span sites fired in this window, so record the
+                # supply wait as the 'parse' span — exactly what the busy
+                # attribution charges it to below
+                _telemetry.record_span("parse", t0, dt)
             self._add_busy("read", read)
             self._add_busy("cache_read", cache_read)
             self._add_busy("parse", dt - read - cache_read)
@@ -578,7 +609,9 @@ class DeviceIter:
                 continue
             t0 = get_time()
             hb = self._convert(block)
-            self._add_busy("convert", get_time() - t0)
+            dt = get_time() - t0
+            self._add_busy("convert", dt)
+            _telemetry.record_span("convert", t0, dt)
             yield self._put(hb)
 
     def _serial_batches(self):
@@ -598,8 +631,15 @@ class DeviceIter:
                 return
             dt = get_time() - t0
             b1 = self._busy.seconds()
-            supply = (b1["read"] - b0["read"]) + (b1["parse"] - b0["parse"])
-            self._add_busy("convert", max(0.0, dt - supply))
+            # supply = everything the SOURCE spent inside this pull —
+            # including warm cache reads, which previously leaked into
+            # 'convert' and inflated it by the cache_read amount
+            supply = ((b1["read"] - b0["read"])
+                      + (b1["parse"] - b0["parse"])
+                      + (b1["cache_read"] - b0["cache_read"]))
+            residue = max(0.0, dt - supply)
+            self._add_busy("convert", residue)
+            _telemetry.record_span("convert", t0, residue)
             yield item
 
     def _serial_batches_sparse(self):
@@ -687,15 +727,19 @@ class DeviceIter:
         :meth:`_put` so the ring slot can be tied to the device array."""
         t0 = get_time()
         try:
-            kind = item[0]
-            if kind == "dense_ready":
-                return ("dense_packed", item[1]), None
-            if kind == "dense_parts":
-                return self._pack_dense_parts(item[1])
-            # ("convert_block", block, precomputed bcoo pad plan)
-            return self._convert(item[1], pad_plan=(item[2],)), None
+            with _telemetry.profiler_annotation("dmlc_tpu.convert",
+                                                self._trace):
+                kind = item[0]
+                if kind == "dense_ready":
+                    return ("dense_packed", item[1]), None
+                if kind == "dense_parts":
+                    return self._pack_dense_parts(item[1])
+                # ("convert_block", block, precomputed bcoo pad plan)
+                return self._convert(item[1], pad_plan=(item[2],)), None
         finally:
-            self._add_busy("convert", get_time() - t0)
+            dt = get_time() - t0
+            self._add_busy("convert", dt)
+            _telemetry.record_span("convert", t0, dt)
 
     def _staging_ring(self) -> _StagingRing:
         # called concurrently by pool workers: double-checked under the
@@ -865,15 +909,13 @@ class DeviceIter:
         # are attributable in a jax.profiler / Perfetto trace
         t0 = get_time()
         try:
-            if self._trace:
-                from jax import profiler as _profiler
-
-                with _profiler.TraceAnnotation("dmlc_tpu.device_put"):
-                    out = self._put_inner(host_batch)
-            else:
+            with _telemetry.profiler_annotation("dmlc_tpu.device_put",
+                                                self._trace):
                 out = self._put_inner(host_batch)
         finally:
-            self._add_busy("dispatch", get_time() - t0)
+            dt = get_time() - t0
+            self._add_busy("dispatch", dt)
+            _telemetry.record_span("dispatch", t0, dt)
         if ring_bufs is not None and self._ring is not None:
             # tie the staging slot to ALL device arrays of the batch: the
             # slot frees only when the consumer has dropped every one of
@@ -1029,6 +1071,12 @@ class DeviceIter:
             self._attr.add("dispatch", d_disp)
 
     def __next__(self):
+        # every consumer-side step runs under this pipeline's telemetry
+        # scope, so the pools/threads it lazily creates inherit the label
+        with _telemetry.scope(self.pipeline_label):
+            return self._next_scoped()
+
+    def _next_scoped(self):
         # stall = wall time the consumer spends in here before a batch is
         # available (covers host-parse waits AND device-side transfer setup
         # — everything between "consumer wants a batch" and "batch handed
@@ -1067,7 +1115,9 @@ class DeviceIter:
             # actually land — the per-batch residue async dispatch hides
             ts = get_time()
             jax.block_until_ready(out)
-            self._attr.add("transfer", get_time() - ts)
+            dt = get_time() - ts
+            self._attr.add("transfer", dt)
+            _telemetry.record_span("transfer", ts, dt)
             self._transfer_samples += 1
         self._t_last = get_time()
         return out
@@ -1111,6 +1161,10 @@ class DeviceIter:
         self._ring = None
 
     def load_state(self, state: dict) -> None:
+        with _telemetry.scope(self.pipeline_label):
+            self._load_state_scoped(state)
+
+    def _load_state_scoped(self, state: dict) -> None:
         if state.get("kind") == "source":
             # byte-exact restore: seek the source (parser -> split) to the
             # block boundary, drop the few rows into it, rebatch from there
@@ -1149,11 +1203,29 @@ class DeviceIter:
                 self._last_resume = self._annot_fifo.popleft()
         self.batches_fed = n
 
+    def dump_trace(self, path: str) -> int:
+        """Export the span rings as a Chrome-trace/Perfetto JSON at
+        ``path`` (docs/observability.md trace-export workflow). Returns
+        the number of span events written. The trace covers the whole
+        process — load it in Perfetto / ``chrome://tracing`` and filter by
+        the ``pipeline`` arg to isolate this iterator's spans."""
+        return _telemetry.export_chrome_trace(path)
+
     def close(self) -> None:
         if self._host_iter_obj is not None:
             self._host_iter_obj.destroy()
         if hasattr(self.source, "close"):
             self.source.close()
+        if self._trace_export:
+            # DMLC_TPU_TRACE=chrome:<path> — dump on close, when every
+            # stage has finished writing spans
+            try:
+                self.dump_trace(self._trace_export)
+            except OSError as exc:
+                from dmlc_tpu.utils.check import get_logger
+
+                get_logger().warning("trace export to %s failed: %s",
+                                     self._trace_export, exc)
 
     def stats(self) -> dict:
         """Throughput counters + per-stage wall attribution.
@@ -1182,7 +1254,10 @@ class DeviceIter:
         wall = 0.0
         if self._t_first is not None and self._t_last is not None:
             wall = max(0.0, self._t_last - self._t_first)
-        resilience = _resilience.counters_delta(self._res_base)
+        # scoped to this pipeline's label: a concurrent DeviceIter's
+        # retries/restarts no longer bleed into this one's delta
+        resilience = _resilience.counters_delta(self._res_base,
+                                                self.pipeline_label)
         resilience["pipeline_restarts"] = self.pipeline_restarts
         resilience["pipeline_giveups"] = self.pipeline_giveups
         # parse-parallelism sideband: the source chain reports its fan-out
@@ -1198,6 +1273,9 @@ class DeviceIter:
         return {
             "batches": self.batches_fed,
             "bytes_to_device": self.bytes_to_device,
+            # the telemetry scope label every span/metric of this
+            # pipeline carries (docs/observability.md)
+            "pipeline": self.pipeline_label,
             # block-cache mode of the source chain: 'cold' (parsing +
             # shadow-writing), 'warm' (serving mmap'd parsed blocks), or
             # None when no block cache is armed (docs/data.md)

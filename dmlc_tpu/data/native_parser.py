@@ -16,7 +16,6 @@ semantics with this path (both mirror input_split_base.cc).
 
 from __future__ import annotations
 
-import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -33,7 +32,9 @@ from dmlc_tpu.data.parsers import (
 from dmlc_tpu.data.row_block import CooBlock, DenseBlock, RowBlock
 from dmlc_tpu.io.filesystem import LocalFileSystem, get_filesystem
 from dmlc_tpu.io.input_split import DEFAULT_CHUNK_BYTES, LineSplitter
+from dmlc_tpu.utils import telemetry as _telemetry
 from dmlc_tpu.utils.check import DMLCError, check
+from dmlc_tpu.utils.timer import get_time
 
 
 def list_partition_files(uri: str) -> Tuple[List[str], List[int]]:
@@ -227,9 +228,9 @@ class NativeStreamParser(Parser):
         from dmlc_tpu import native
 
         reader = self._ensure_reader()
-        t0 = time.monotonic()
+        t0 = get_time()
         out = reader.next()
-        self._stall += time.monotonic() - t0
+        self._stall += get_time() - t0
         if out is None:
             return None
         self._blocks_out += 1
@@ -442,8 +443,11 @@ class NativeFeedParser(NativeStreamParser):
                 except Exception:  # noqa: BLE001
                     pass
 
+        # the feed thread inherits the creator's pipeline scope so its
+        # retries/resumes land under the owning pipeline's label
         self._feed_thread = threading.Thread(
-            target=run, name="dmlc-feed", daemon=True)
+            target=_telemetry.scoped_target(run), name="dmlc-feed",
+            daemon=True)
         self._feed_thread.start()
 
     def _stop_feed(self) -> None:

@@ -40,6 +40,7 @@ from dmlc_tpu.io.input_split import (
 )
 from dmlc_tpu.io.threaded_iter import OrderedWorkerPool, ThreadedIter
 from dmlc_tpu.io.uri import URISpec
+from dmlc_tpu.utils import telemetry as _telemetry
 from dmlc_tpu.utils.check import CacheCorruptionError, DMLCError, check
 from dmlc_tpu.utils.params import Parameter, field
 from dmlc_tpu.utils.registry import Registry
@@ -197,7 +198,11 @@ class TextParserBase(Parser):
         ``(chunk, annot_or_None)``; ``(None, None)`` at end of stream."""
         t0 = get_time()
         chunk = self.source.next_chunk()
-        self._read_seconds += get_time() - t0
+        dt = get_time() - t0
+        self._read_seconds += dt
+        # span twin of the read-seconds accrual: same start, same duration
+        # (the trace timeline and stage_seconds() can never disagree)
+        _telemetry.record_span("read", t0, dt)
         if chunk is None:
             return None, None
         self._bytes += len(chunk)
@@ -216,7 +221,9 @@ class TextParserBase(Parser):
                 return None
             t1 = get_time()
             block = self.parse_chunk(chunk)
-            self._parse_seconds += get_time() - t1
+            dt = get_time() - t1
+            self._parse_seconds += dt
+            _telemetry.record_span("parse", t1, dt)
             if len(block) > 0:
                 # the annotation marks the position just AFTER this block,
                 # so downstream prefetch pipelines (ThreadedParser,
@@ -1016,6 +1023,7 @@ class ParallelTextParser(_WrappedParserMixin, Parser):
             block = self.base.parse_chunk(chunk)
         finally:
             t1 = get_time()
+            _telemetry.record_span("parse", t0, t1 - t0)
             with self._stage_lock:
                 self.base._parse_seconds += t1 - t0
                 if self._parse_t_first is None or t0 < self._parse_t_first:
@@ -1203,6 +1211,9 @@ class BlockCacheIter(Parser):
         self._last_annot: Optional[dict] = None
         self._bytes = 0      # warm bytes served from the cache
         self._cache_read_seconds = 0.0
+        # DMLC_TPU_TRACE=1 extends profiler annotations to the warm cache
+        # path (docs/data.md trace modes); cached once, not per block
+        self._annotate = _telemetry.trace_mode()[0] == "annotate"
         self._open_reader()
 
     # ---------------- mode plumbing ----------------
@@ -1259,9 +1270,13 @@ class BlockCacheIter(Parser):
             return None
         t0 = get_time()
         try:
-            segments = reader.load_segments(self._pos)
+            with _telemetry.profiler_annotation("dmlc_tpu.cache_read",
+                                                self._annotate):
+                segments = reader.load_segments(self._pos)
         except CacheCorruptionError:
-            self._cache_read_seconds += get_time() - t0
+            dt = get_time() - t0
+            self._cache_read_seconds += dt
+            _telemetry.record_span("cache_read", t0, dt)
             self._heal_corruption()
             return self._next_cold()
         block = RowBlock.from_segments(segments, hold=reader.hold)
@@ -1269,7 +1284,9 @@ class BlockCacheIter(Parser):
         if annot is not None:
             block.resume_state = annot
         self._bytes += reader.block_nbytes(self._pos)
-        self._cache_read_seconds += get_time() - t0
+        dt = get_time() - t0
+        self._cache_read_seconds += dt
+        _telemetry.record_span("cache_read", t0, dt)
         self._pos += 1
         self._delivered += 1
         self._last_annot = annot
@@ -1281,8 +1298,8 @@ class BlockCacheIter(Parser):
         delivered this epoch — chunk grouping is deterministic, so block k
         cold is block k warm), rewrite the full cache, and resume delivery
         exactly at the broken block."""
-        _resilience.COUNTERS.bump("cache_corruptions")
-        _resilience.COUNTERS.bump("cache_rebuilds")
+        _resilience.record_event("cache_corruptions")
+        _resilience.record_event("cache_rebuilds")
         self._drop_reader()
         try:
             os.remove(self.cache_file)

@@ -55,7 +55,9 @@ import numpy as np
 
 from dmlc_tpu.io import faults
 from dmlc_tpu.io import resilience as _resilience
+from dmlc_tpu.utils import telemetry as _telemetry
 from dmlc_tpu.utils.check import CacheCorruptionError, DMLCError, check
+from dmlc_tpu.utils.timer import get_time
 
 BLOCK_CACHE_MAGIC = b"DMLCBC01"
 BLOCK_CACHE_VERSION = 1
@@ -104,6 +106,7 @@ class BlockCacheWriter:
         epochs can re-attach byte-exact checkpoint states."""
         check(self._f is not None and not self._finished,
               "BlockCacheWriter: writer already finished/aborted")
+        t_span = get_time()
         f = self._f
         pos = _pad_to(f, _ALIGN)
         crc = 0
@@ -137,6 +140,11 @@ class BlockCacheWriter:
         })
         self._rows += int(rows)
         self._num_col = max(self._num_col, int(num_col))
+        # the shadow-write's own cost, visible on the trace timeline next
+        # to the parse spans it rides behind (cold-epoch overhead is a
+        # real stage even though stats() folds it into supply wall)
+        _telemetry.record_span("cache_write", t_span, get_time() - t_span,
+                               rows=int(rows))
 
     def finish(self) -> None:
         """Write footer + tail, fsync, atomically publish at ``path``."""
@@ -373,7 +381,7 @@ def open_block_cache(path: str, signature: Optional[dict] = None,
     try:
         return BlockCacheReader(path, signature=signature, verify=verify)
     except DMLCError:
-        _resilience.COUNTERS.bump("cache_invalidations")
+        _resilience.record_event("cache_invalidations")
         try:
             os.remove(path)
         except OSError:

@@ -81,7 +81,7 @@ class CachedInputSplit(InputSplit):
             head = b""
         if head == CHUNK_CACHE_MAGIC:
             return True
-        _resilience.COUNTERS.bump("cache_invalidations")
+        _resilience.record_event("cache_invalidations")
         try:
             os.remove(self.cache_file)
         except OSError:
@@ -167,8 +167,8 @@ class CachedInputSplit(InputSplit):
             # since the cache was built) but the concatenated byte stream
             # is identical, and every frame boundary sits on a record
             # boundary, so a mid-chunk tail still starts at a record head
-            _resilience.COUNTERS.bump("cache_corruptions")
-            _resilience.COUNTERS.bump("cache_rebuilds")
+            _resilience.record_event("cache_corruptions")
+            _resilience.record_event("cache_rebuilds")
             try:
                 os.remove(self.cache_file)
             except OSError:

@@ -23,7 +23,6 @@ from __future__ import annotations
 import io as _pyio
 import json
 import os
-import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -36,6 +35,7 @@ from dmlc_tpu.io.http_filesys import HttpReadStream
 from dmlc_tpu.io.resilience import RetryPolicy, default_policy
 from dmlc_tpu.io.uri import URI
 from dmlc_tpu.utils.check import DMLCError, check
+from dmlc_tpu.utils.timer import get_time
 
 _METADATA_TOKEN_URL = ("http://metadata.google.internal/computeMetadata/v1/"
                        "instance/service-accounts/default/token")
@@ -49,7 +49,7 @@ def _auth_token() -> Optional[str]:
     # TPU-VM / GCE metadata server: cache the token until shortly before its
     # expiry; cache a miss too (the probe hangs nowhere but costs a timeout)
     global _metadata_token, _metadata_expiry
-    now = time.monotonic()
+    now = get_time()
     if now < _metadata_expiry:
         return _metadata_token
     req = urllib.request.Request(

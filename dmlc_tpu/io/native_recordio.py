@@ -27,6 +27,7 @@ from dmlc_tpu.io.input_split import (
     InputSplit,
     RecordIOSplitter,
 )
+from dmlc_tpu.utils import telemetry as _telemetry
 from dmlc_tpu.utils.check import DMLCError, check
 
 
@@ -437,7 +438,8 @@ class NativeFeedRecordIOSplit(NativeRecordIOSplit):
                     pass
 
         self._feed_thread = threading.Thread(
-            target=run, name="dmlc-rec-feed", daemon=True)
+            target=_telemetry.scoped_target(run),
+            name="dmlc-rec-feed", daemon=True)
         self._feed_thread.start()
 
     def _stop_feed(self) -> None:

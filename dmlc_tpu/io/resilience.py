@@ -22,7 +22,13 @@ TPU runs (tf.data service, arXiv:2210.14826), so it lives HERE, once:
   already have), consuming retry budget instead of failing the epoch.
 - module counters (:func:`counters_snapshot`) — retry / resume / giveup
   totals, surfaced by ``DeviceIter.stats()['resilience']`` next to the
-  stage attribution and emitted by ``bench.py``.
+  stage attribution and emitted by ``bench.py``. The books live on the
+  telemetry metrics registry (:mod:`dmlc_tpu.utils.telemetry`), with every
+  event stamped by the recording thread's pipeline scope — so per-pipeline
+  slices (``counters_snapshot(pipeline=...)``) stay disjoint between
+  concurrent pipelines while the process-wide API stays byte-compatible.
+  New events go through :func:`record_event` (``make lint-metrics`` bans
+  direct counter mutation elsewhere). See docs/observability.md.
 
 Deterministic fault injection for all of this lives in
 :mod:`dmlc_tpu.io.faults`; every guarded attempt calls
@@ -36,13 +42,14 @@ import http.client
 import io as _pyio
 import os
 import random
-import threading
 import time
 import urllib.error
 from typing import Callable, Dict, Optional
 
 from dmlc_tpu.io import faults
+from dmlc_tpu.utils import telemetry as _telemetry
 from dmlc_tpu.utils.check import CacheCorruptionError, DMLCError
+from dmlc_tpu.utils.timer import get_time
 
 RETRYABLE = "retryable"
 FATAL = "fatal"
@@ -120,9 +127,28 @@ def retry_after_seconds(exc: BaseException) -> float:
 
 
 # ---------------- counters ----------------
+#
+# Since the telemetry PR the books live in the metrics registry
+# (dmlc_tpu.utils.telemetry.REGISTRY): every event is ONE registry
+# counter under RESILIENCE_METRIC, labeled with the event key and the
+# pipeline scope active on the recording thread. The public
+# counters_snapshot / counters_delta / reset_counters API is
+# byte-compatible (process-wide totals, same keys); the new
+# ``pipeline=`` filter is what lets two concurrent DeviceIters keep
+# disjoint books (docs/observability.md).
+
+def record_event(key: str, n: int = 1) -> None:
+    """Count one resilience event — the ONE sanctioned bump path
+    (``make lint-metrics`` fails direct counter mutation elsewhere). The
+    active pipeline scope is stamped on automatically, so the event shows
+    up both process-wide and under its pipeline's label."""
+    _telemetry.REGISTRY.counter(
+        _telemetry.RESILIENCE_METRIC, event=key,
+        pipeline=_telemetry.current_scope() or "").inc(n)
+
 
 class _Counters:
-    """Process-wide resilience event counters (thread-safe).
+    """Resilience event counters (registry facade, thread-safe).
 
     ``attempts``  guarded attempts issued
     ``retries``   failed attempts that were retried
@@ -152,36 +178,41 @@ class _Counters:
              "parse_restarts", "parse_giveups",
              "cache_corruptions", "cache_invalidations", "cache_rebuilds")
 
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._n: Dict[str, int] = {k: 0 for k in self._KEYS}
-
     def bump(self, key: str, n: int = 1) -> None:
-        with self._lock:
-            self._n[key] = self._n.get(key, 0) + n
+        record_event(key, n)
 
-    def snapshot(self) -> Dict[str, int]:
-        with self._lock:
-            return dict(self._n)
+    def snapshot(self, pipeline: Optional[str] = None) -> Dict[str, int]:
+        """Totals per event key — process-wide by default, or one
+        pipeline's slice with ``pipeline=`` (empty string selects events
+        recorded outside any pipeline scope)."""
+        label_filter = {} if pipeline is None else {"pipeline": pipeline}
+        out = {k: 0 for k in self._KEYS}
+        for key, v in _telemetry.REGISTRY.sum_by(
+                _telemetry.RESILIENCE_METRIC, "event",
+                **label_filter).items():
+            if key:
+                out[key] = int(round(v))
+        return out
 
-    def delta(self, base: Dict[str, int]) -> Dict[str, int]:
-        now = self.snapshot()
+    def delta(self, base: Dict[str, int],
+              pipeline: Optional[str] = None) -> Dict[str, int]:
+        now = self.snapshot(pipeline)
         return {k: now.get(k, 0) - base.get(k, 0) for k in now}
 
     def reset(self) -> None:
-        with self._lock:
-            self._n = {k: 0 for k in self._KEYS}
+        _telemetry.REGISTRY.clear(_telemetry.RESILIENCE_METRIC)
 
 
 COUNTERS = _Counters()
 
 
-def counters_snapshot() -> Dict[str, int]:
-    return COUNTERS.snapshot()
+def counters_snapshot(pipeline: Optional[str] = None) -> Dict[str, int]:
+    return COUNTERS.snapshot(pipeline)
 
 
-def counters_delta(base: Dict[str, int]) -> Dict[str, int]:
-    return COUNTERS.delta(base)
+def counters_delta(base: Dict[str, int],
+                   pipeline: Optional[str] = None) -> Dict[str, int]:
+    return COUNTERS.delta(base, pipeline)
 
 
 def reset_counters() -> None:
@@ -285,10 +316,10 @@ class RetryPolicy:
         in the counters; ``on_retry`` runs before each re-attempt (e.g.
         drop a broken inner stream).
         """
-        t0 = time.monotonic()
+        t0 = get_time()
         retries = 0
         while True:
-            COUNTERS.bump("attempts")
+            record_event("attempts")
             try:
                 faults.maybe_fail("connect", what)
                 faults.maybe_fail(op, what)
@@ -297,7 +328,7 @@ class RetryPolicy:
                 raise  # control-flow exceptions must never be rewrapped
             except BaseException as exc:  # noqa: BLE001 - classified below
                 if classify(exc) != RETRYABLE:
-                    COUNTERS.bump("fatal")
+                    record_event("fatal")
                     if isinstance(exc, DMLCError):
                         raise
                     raise DMLCError(
@@ -306,18 +337,18 @@ class RetryPolicy:
                 out_of_budget = retries + 1 >= self.max_attempts
                 past_deadline = (
                     self.deadline is not None
-                    and time.monotonic() - t0 + delay > self.deadline)
+                    and get_time() - t0 + delay > self.deadline)
                 if out_of_budget or past_deadline:
-                    COUNTERS.bump("giveups")
+                    record_event("giveups")
                     why = ("deadline exceeded" if past_deadline
                            else f"retry budget exhausted "
                                 f"({self.max_attempts} attempts)")
                     raise DMLCError(
                         f"{op} {what} failed, {why}: {exc}") from exc
                 retries += 1
-                COUNTERS.bump("retries")
+                record_event("retries")
                 if resume_offset > 0:
-                    COUNTERS.bump("resumes")
+                    record_event("resumes")
                 self.sleep(delay)
                 if on_retry is not None:
                     on_retry()

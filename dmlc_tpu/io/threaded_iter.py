@@ -31,6 +31,7 @@ from collections import deque
 from typing import Any, Callable, Deque, Generic, Optional, Tuple, TypeVar
 
 from dmlc_tpu.io import resilience as _resilience
+from dmlc_tpu.utils import telemetry as _telemetry
 from dmlc_tpu.utils.check import DMLCError
 from dmlc_tpu.utils.timer import get_time
 
@@ -68,6 +69,19 @@ def _stall_timeout() -> float:
     remote reads) must never be killed by an arbitrary limit.
     """
     return float(os.environ.get("DMLC_PIPELINE_STALL_TIMEOUT", "0") or 0)
+
+
+def _publish_stall_diagnostic(diag: dict) -> None:
+    """Publish a stall diagnostic as a structured info metric on the
+    telemetry registry, keyed by component, pool label, and pipeline
+    scope (a pipeline runs several pools — parse fan-out + convert — and
+    their diagnostics must not overwrite each other) — the
+    machine-readable twin of the DMLCError message (tests and monitors
+    assert on this dict, never on message text)."""
+    _telemetry.REGISTRY.info(
+        _telemetry.STALL_METRIC, component=diag.get("component", ""),
+        label=diag.get("label", ""),
+        pipeline=_telemetry.current_scope() or "").set(diag)
 
 
 class ThreadedIter(Generic[T]):
@@ -108,7 +122,15 @@ class ThreadedIter(Generic[T]):
         self.restarts = 0          # lifetime restart count
         self.restart_giveups = 0   # budget-exhausted poisonings
         self.last_producer_error: Optional[str] = None
-        self._thread = threading.Thread(target=self._producer_loop, daemon=True)
+        # the producer runs under the owning pipeline's telemetry scope so
+        # spans/metrics it records land under the right label: captured at
+        # construction, ADOPTED from the first consumer pull when built
+        # outside any scope (a ThreadedInputSplit is constructed with the
+        # parser, before the DeviceIter that owns it exists) — the loop
+        # re-installs it each iteration, so adoption takes effect mid-run
+        self._scope = _telemetry.current_scope()
+        self._thread = threading.Thread(target=self._producer_loop,
+                                        daemon=True)
         self._thread.start()
 
     def _budget_state(self) -> str:
@@ -118,6 +140,16 @@ class ThreadedIter(Generic[T]):
             return "producer restart disabled"
         return (f"producer restarts {self._epoch_restarts}/"
                 f"{max(0, pol.max_attempts - 1)} used this epoch")
+
+    def _budget_dict(self) -> dict:
+        """The restart budget as structured data (the registry's stall
+        diagnostic carries this next to the human message)."""
+        pol = self._restart_policy
+        return {
+            "enabled": pol is not None,
+            "used": self._epoch_restarts,
+            "limit": max(0, pol.max_attempts - 1) if pol is not None else 0,
+        }
 
     def _try_restart(self, exc: BaseException) -> bool:
         """Classify a producer error; on a retryable class with budget left,
@@ -130,14 +162,14 @@ class ThreadedIter(Generic[T]):
         verdict = _resilience.restart_verdict(self._restart_policy, used, exc)
         if verdict == "giveup":
             self.restart_giveups += 1
-            _resilience.COUNTERS.bump("producer_giveups")
+            _resilience.record_event("producer_giveups")
             return False
         if verdict != "restart":
             return False
         with self._lock:
             self._epoch_restarts += 1
             self.restarts += 1
-        _resilience.COUNTERS.bump("producer_restarts")
+        _resilience.record_event("producer_restarts")
         _resilience.restart_backoff(self._restart_policy, used, exc)
         if self._restart_fn is not None:
             # reposition failures propagate to the caller's except branch
@@ -148,6 +180,7 @@ class ThreadedIter(Generic[T]):
 
     def _producer_loop(self) -> None:
         while True:
+            _telemetry.set_scope(self._scope)  # one TLS store per item
             cell: Optional[T] = None
             with self._lock:
                 # wait for: destroy/reset signal, or space to produce
@@ -215,6 +248,10 @@ class ThreadedIter(Generic[T]):
         """Pop the next item; None at end of stream. Rethrows producer errors."""
         if self._destroyed:
             raise DMLCError("ThreadedIter: already destroyed")
+        if self._scope is None:
+            # scope adoption (see __init__): the first scoped consumer owns
+            # this pipeline — monotonic None -> label, so benign if raced
+            self._scope = _telemetry.current_scope()
         t0 = get_time()
         timeout = _stall_timeout()
         with self._lock:
@@ -223,6 +260,18 @@ class ThreadedIter(Generic[T]):
                     lambda: self._queue or self._produce_end, timeout=timeout
                 ):
                     alive = self._thread.is_alive()
+                    # the diagnostic is DATA first: published on the
+                    # metrics registry so monitors/tests read structure,
+                    # not message text (docs/observability.md)
+                    _publish_stall_diagnostic({
+                        "component": "ThreadedIter",
+                        "timeout_seconds": timeout,
+                        "producer_alive": alive,
+                        "queue_len": len(self._queue),
+                        "free_cells": len(self._free),
+                        "last_producer_error": self.last_producer_error,
+                        "restart_budget": self._budget_dict(),
+                    })
                     raise DMLCError(
                         f"pipeline stalled: no item produced in {timeout:.0f}s "
                         f"(producer thread {'alive but blocked' if alive else 'dead'}, "
@@ -387,6 +436,10 @@ class OrderedWorkerPool(Generic[T]):
         self.restarts = 0
         self.restart_giveups = 0
         self.last_producer_error: Optional[str] = None
+        # workers run under the owning pipeline's scope: captured at
+        # construction, adopted from the first consumer pull otherwise
+        # (see ThreadedIter)
+        self._scope = _telemetry.current_scope()
         self._threads = [
             threading.Thread(target=self._worker_loop, daemon=True)
             for _ in range(max(1, int(num_workers)))
@@ -401,6 +454,14 @@ class OrderedWorkerPool(Generic[T]):
         return (f"source restarts {self.restarts}/"
                 f"{max(0, pol.max_attempts - 1)} used")
 
+    def _budget_dict(self) -> dict:
+        pol = self._restart_policy
+        return {
+            "enabled": pol is not None,
+            "used": self.restarts,
+            "limit": max(0, pol.max_attempts - 1) if pol is not None else 0,
+        }
+
     def _try_source_restart(self, exc: BaseException) -> bool:
         """Called under ``_pull_lock`` after a source pull raised. On a
         retryable class with budget left: back off, rebuild the source, and
@@ -410,13 +471,13 @@ class OrderedWorkerPool(Generic[T]):
                                               self.restarts, exc)
         if verdict == "giveup":
             self.restart_giveups += 1
-            _resilience.COUNTERS.bump(f"{self._counter_label}_giveups")
+            _resilience.record_event(f"{self._counter_label}_giveups")
             return False
         if verdict != "restart":
             return False
         used = self.restarts
         self.restarts += 1
-        _resilience.COUNTERS.bump(f"{self._counter_label}_restarts")
+        _resilience.record_event(f"{self._counter_label}_restarts")
         _resilience.restart_backoff(self._restart_policy, used, exc)
         with self._lock:
             pulled = self._seq
@@ -427,6 +488,7 @@ class OrderedWorkerPool(Generic[T]):
 
     def _worker_loop(self) -> None:
         while True:
+            _telemetry.set_scope(self._scope)  # one TLS store per item
             with self._lock:
                 self._lock.wait_for(
                     lambda: self._destroyed or self._produce_end
@@ -491,6 +553,9 @@ class OrderedWorkerPool(Generic[T]):
             raise DMLCError("OrderedWorkerPool: already destroyed")
         if self._poisoned:
             return None
+        if self._scope is None:
+            # scope adoption: the first scoped consumer owns this pool
+            self._scope = _telemetry.current_scope()
         t0 = get_time()
         timeout = _stall_timeout()
         with self._lock:
@@ -500,6 +565,17 @@ class OrderedWorkerPool(Generic[T]):
             if timeout > 0:
                 if not self._lock.wait_for(ready, timeout=timeout):
                     alive = sum(t.is_alive() for t in self._threads)
+                    _publish_stall_diagnostic({
+                        "component": "OrderedWorkerPool",
+                        "label": self._counter_label,
+                        "timeout_seconds": timeout,
+                        "workers_alive": alive,
+                        "workers": len(self._threads),
+                        "waiting_for": self._want,
+                        "pulled": self._seq,
+                        "last_producer_error": self.last_producer_error,
+                        "restart_budget": self._budget_dict(),
+                    })
                     raise DMLCError(
                         f"pipeline stalled: no item produced in {timeout:.0f}s "
                         f"({alive}/{len(self._threads)} workers alive, "

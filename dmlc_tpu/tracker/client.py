@@ -11,12 +11,14 @@ client speaks the same wire protocol (tracker.py:58-136) so that
 
 from __future__ import annotations
 
+import json
 import socket
 import threading
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
 from dmlc_tpu.io.resilience import RetryPolicy
 from dmlc_tpu.tracker.tracker import MAGIC, Conn
+from dmlc_tpu.utils import telemetry as _telemetry
 
 
 class Assignment(NamedTuple):
@@ -152,10 +154,24 @@ class WorkerClient:
         conn = self._hello("heartbeat", self.rank, -1)
         conn.close()
 
-    def start_heartbeat(self, interval: float = 5.0):
+    def report_metrics(self, snapshot: Optional[dict] = None) -> None:
+        """Ship one telemetry snapshot to the tracker (pod-scale
+        aggregation, docs/observability.md): the ``metrics`` command
+        carries ``telemetry.pod_snapshot()`` — per-stage seconds,
+        resilience totals, span counts — as one JSON string, and doubles
+        as a liveness ping. Requires an assigned rank."""
+        snap = snapshot if snapshot is not None else _telemetry.pod_snapshot()
+        conn = self._hello("metrics", self.rank, -1)
+        conn.send_str(json.dumps(snap))
+        conn.close()
+
+    def start_heartbeat(self, interval: float = 5.0, metrics: bool = False):
         """Ping the tracker every `interval` seconds from a managed thread
-        until :meth:`stop_heartbeat` (or close). Idempotent: a running
-        heartbeat thread is stopped (and, if stuck in a socket op, simply
+        until :meth:`stop_heartbeat` (or close). With ``metrics=True``
+        every ping also carries this process's telemetry snapshot
+        (:meth:`report_metrics`) — the periodic feed behind the tracker's
+        merged per-rank stage table. Idempotent: a running heartbeat
+        thread is stopped (and, if stuck in a socket op, simply
         superseded — names are unique). Returns the thread."""
         from dmlc_tpu.utils.thread_group import ThreadGroup, timer_thread
 
@@ -163,14 +179,21 @@ class WorkerClient:
         if self._hb_group is None:
             self._hb_group = ThreadGroup()
         self._hb_seq += 1
+        fn = self._safe_report_metrics if metrics else self._safe_heartbeat
         self._hb_thread = timer_thread(
             self._hb_group, f"heartbeat-{self._hb_seq}", interval,
-            self._safe_heartbeat, run_first_immediately=True)
+            fn, run_first_immediately=True)
         return self._hb_thread
 
     def _safe_heartbeat(self) -> None:
         try:
             self.heartbeat()
+        except OSError:
+            pass  # tracker gone; shutdown paths report the real error
+
+    def _safe_report_metrics(self) -> None:
+        try:
+            self.report_metrics()
         except OSError:
             pass  # tracker gone; shutdown paths report the real error
 

@@ -24,6 +24,7 @@ env contract onto ``jax.distributed`` (dmlc_tpu/parallel/distributed.py).
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import socket
@@ -31,6 +32,8 @@ import struct
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
+
+from dmlc_tpu.utils import telemetry as _telemetry
 
 MAGIC = 0xFF99
 
@@ -318,6 +321,14 @@ class RabitTracker:
         self.on_worker_lost = on_worker_lost
         self.last_seen: Dict[int, float] = {}
         self.lost_workers: set = set()
+        # pod-scale telemetry aggregation (docs/observability.md): workers
+        # running our WorkerClient ship periodic registry snapshots over a
+        # `metrics` command — a cmd legacy rabit clients never send, so the
+        # wire protocol stays compatible. Latest snapshot per rank; the
+        # merged per-rank × per-stage table is logged as they arrive.
+        self.metrics_by_rank: Dict[int, dict] = {}
+        self._metrics_lock = threading.Lock()
+        self._metrics_logged = 0.0  # last table log (rate-limited)
         self._shutdown_ranks: set = set()
         self._liveness_lock = threading.Lock()
         self._processing_since: Optional[float] = None
@@ -370,6 +381,48 @@ class RabitTracker:
             "DMLC_TRACKER_PORT": str(self.port),
         }
 
+    # -------- pod-scale telemetry aggregation --------
+
+    def _ingest_metrics(self, rank: int, payload: str) -> None:
+        if rank < 0:
+            return
+        try:
+            snap = json.loads(payload)
+        except ValueError as exc:
+            logger.warning("tracker: unparseable metrics from rank %d: %s",
+                           rank, exc)
+            return
+        if not isinstance(snap, dict):
+            return
+        with self._metrics_lock:
+            self.metrics_by_rank[rank] = snap
+            now = time.time()
+            do_log = now - self._metrics_logged >= self._metrics_log_every()
+            if do_log:
+                self._metrics_logged = now
+        if do_log:
+            logger.info("@tracker pod telemetry (%d rank(s)):\n%s",
+                        len(self.metrics_by_rank), self.format_pod_table())
+
+    @staticmethod
+    def _metrics_log_every() -> float:
+        """Seconds between merged-table log lines (DMLC_METRICS_LOG_EVERY;
+        0 logs on every snapshot — handy in tests)."""
+        try:
+            return float(os.environ.get("DMLC_METRICS_LOG_EVERY", "30") or 30)
+        except ValueError:
+            return 30.0
+
+    def pod_metrics(self) -> Dict[int, dict]:
+        """Latest telemetry snapshot per rank (copy)."""
+        with self._metrics_lock:
+            return {r: dict(s) for r, s in self.metrics_by_rank.items()}
+
+    def format_pod_table(self) -> str:
+        """The merged per-rank × per-stage seconds table
+        (telemetry.format_pod_table over the latest snapshots)."""
+        return _telemetry.format_pod_table(self.pod_metrics())
+
     def _accept_loop(self, num_workers: int, master_ip: Optional[str] = None):
         shutdown: Dict[int, WorkerEntry] = {}
         wait_conn: Dict[int, WorkerEntry] = {}
@@ -402,6 +455,20 @@ class RabitTracker:
             if worker.cmd == "heartbeat":
                 self._mark_alive(worker.rank)
                 worker.conn.close()
+                continue
+            if worker.cmd == "metrics":
+                # heartbeat + telemetry snapshot in one round trip: the
+                # payload is one JSON string (telemetry.pod_snapshot())
+                try:
+                    payload = worker.conn.recv_str()
+                except (ConnectionError, OSError) as exc:
+                    logger.warning("tracker: metrics recv from rank %d "
+                                   "failed: %s", worker.rank, exc)
+                    worker.conn.close()
+                    continue
+                self._mark_alive(worker.rank)
+                worker.conn.close()
+                self._ingest_metrics(worker.rank, payload)
                 continue
             if worker.cmd == "shutdown":
                 assert worker.rank >= 0 and worker.rank not in shutdown

@@ -1,0 +1,618 @@
+"""Always-on pipeline telemetry: span tracer + labeled metrics registry.
+
+tf.data's lesson (arXiv:2101.12127) is that AUTOTUNE and fleet-scale
+debugging are both built on exactly one thing — a uniform, low-overhead
+instrumentation layer over every pipeline stage — and the tf.data-service
+paper (arXiv:2210.14826) adds that per-worker metrics must be aggregable
+across hosts before a dispatcher can balance them. This module is that
+layer for the ingest tier, and the sensor substrate the ROADMAP item 4
+feedback controller will read. Two primitives:
+
+**Span tracer** — fixed-size per-thread ring buffers recording
+``(name, tid, start_ns, dur_ns, labels)`` spans. Recording is lock-free on
+the hot path (each ring has exactly one writer: its thread) and bounded
+(old spans overwrite, drops are counted), so it stays on in production.
+Every pipeline stage emits spans at the SAME code sites that feed the
+stage-seconds counters — read / parse in :mod:`dmlc_tpu.data.parsers`,
+cache_read there + cache_write in :mod:`dmlc_tpu.io.block_cache`,
+convert / dispatch / transfer in :mod:`dmlc_tpu.data.device` — so a trace
+timeline and ``DeviceIter.stats()`` can never tell different stories.
+Export as Chrome-trace/Perfetto JSON via ``DMLC_TPU_TRACE=chrome:<path>``
+(dumped when the ``DeviceIter`` closes) or ``DeviceIter.dump_trace(path)``
+/ :func:`export_chrome_trace`.
+
+**Metrics registry** — named counters / gauges / histograms / info blobs
+with label scoping. The single source of truth behind
+``DeviceIter.stats()`` (its :class:`~dmlc_tpu.utils.timer.StageMeter`
+stage counters are registry counters), the resilience counters
+(:mod:`dmlc_tpu.io.resilience` keeps its public
+``counters_snapshot/delta/reset`` API on top of it), the pipeline stall
+diagnostics, and the ``bench.py`` JSON line. ``make lint-metrics`` fails
+ad-hoc bookkeeping added beside it.
+
+**Pipeline scoping** — a thread-local label (:func:`scope`) stamped onto
+every span and metric recorded while it is active. The pipeline thread
+primitives (``ThreadedIter`` / ``OrderedWorkerPool`` / the native feed
+threads / ``ManagedThread``) capture their creator's scope and install it
+in the threads they spawn, so everything a ``DeviceIter`` causes — down
+to filesystem retries on a producer thread — lands under that pipeline's
+label. Two concurrent pipelines therefore keep disjoint books (the
+cross-contamination fix for ``stats()['resilience']``).
+
+**Pod aggregation** — :func:`pod_snapshot` serializes this process's
+registry into a compact JSON-able dict; workers ship it to the rendezvous
+tracker over the heartbeat path (``WorkerClient.report_metrics``) and the
+tracker logs the merged per-rank × per-stage table
+(:func:`format_pod_table`), so an 8-host run is debuggable from one
+place. See docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+# bumped whenever the span schema, the pod-snapshot layout, or a
+# registry metric name consumed across processes changes — the tracker
+# refuses to merge snapshots from a different schema, and bench.py /
+# make bench-smoke gate the value
+SCHEMA_VERSION = 1
+
+# the canonical pipeline stages (benchmarks/_common.STAGE_ORDER mirrors
+# this; DeviceIter.stats()['stages'] carries exactly these keys)
+STAGES = ("read", "cache_read", "parse", "convert", "dispatch", "transfer")
+
+# registry metric names (docs/observability.md has the full table)
+STAGE_BUSY_METRIC = "stage_busy_seconds"
+STAGE_WALL_METRIC = "stage_wall_seconds"
+RESILIENCE_METRIC = "resilience_events"
+STALL_METRIC = "pipeline_stall"
+
+
+# ---------------- pipeline scoping ----------------
+
+_tls = threading.local()
+_scope_seq = itertools.count(1)
+
+
+def new_pipeline_label(prefix: str = "pipeline") -> str:
+    """A process-unique pipeline label (``pipeline-1``, ``pipeline-2``...)."""
+    return f"{prefix}-{next(_scope_seq)}"
+
+
+def current_scope() -> Optional[str]:
+    """The pipeline label active on this thread, or None."""
+    return getattr(_tls, "scope", None)
+
+
+def set_scope(label: Optional[str]) -> None:
+    """Install ``label`` as this thread's pipeline scope (thread primitives
+    call this at thread start with the scope captured at construction)."""
+    _tls.scope = label
+
+
+@contextmanager
+def scope(label: Optional[str]):
+    """Run a block under a pipeline scope; restores the previous one."""
+    prev = current_scope()
+    set_scope(label)
+    try:
+        yield label
+    finally:
+        set_scope(prev)
+
+
+# ---------------- span tracer ----------------
+
+def _ring_capacity() -> int:
+    try:
+        return max(64, int(os.environ.get(
+            "DMLC_TPU_TRACE_RING_SPANS", "8192") or 8192))
+    except ValueError:
+        return 8192
+
+
+def _max_rings() -> int:
+    try:
+        return max(8, int(os.environ.get(
+            "DMLC_TPU_TRACE_MAX_RINGS", "512") or 512))
+    except ValueError:
+        return 512
+
+
+class _SpanRing:
+    """One thread's fixed-size span buffer. Single writer (the owning
+    thread), so ``record`` takes no lock; readers (export) see a racy but
+    structurally safe snapshot — every retained entry is a complete tuple
+    because the list-slot store is atomic under the GIL."""
+
+    __slots__ = ("tid", "thread_name", "thread", "capacity", "entries",
+                 "idx", "total", "counts")
+
+    def __init__(self, tid: int, thread_name: str, capacity: int,
+                 thread: Optional[threading.Thread] = None):
+        self.tid = tid
+        self.thread_name = thread_name
+        self.thread = thread  # liveness probe for ring retirement
+        self.capacity = capacity
+        self.entries: List[Optional[tuple]] = [None] * capacity
+        self.idx = 0
+        self.total = 0
+        self.counts: Dict[str, int] = {}
+
+    def record(self, name: str, start_ns: int, dur_ns: int,
+               pipeline: Optional[str], labels: Optional[dict]) -> None:
+        self.entries[self.idx] = (name, start_ns, dur_ns, pipeline, labels)
+        self.idx = (self.idx + 1) % self.capacity
+        self.total += 1
+        self.counts[name] = self.counts.get(name, 0) + 1
+
+    def snapshot(self) -> List[tuple]:
+        # oldest-first: the wrapped segment precedes the head segment
+        if self.total < self.capacity:
+            ent = self.entries[: self.idx]
+        else:
+            ent = self.entries[self.idx:] + self.entries[: self.idx]
+        return [e for e in ent if e is not None]
+
+    def clear(self) -> None:
+        self.entries = [None] * self.capacity
+        self.idx = 0
+        self.total = 0
+        self.counts = {}
+
+
+_rings_lock = threading.Lock()
+_rings: List[_SpanRing] = []
+# retired dead-thread rings fold their books here so span_counts() /
+# spans_dropped() stay monotonic after retirement
+_retired_counts: Dict[str, int] = {}
+_retired_dropped = 0
+
+
+def _retire_dead_ring_locked() -> None:
+    """Memory bound for thread churn: pipelines create producer/worker
+    threads per epoch, and each thread that ever recorded a span owns a
+    ring. Past ``DMLC_TPU_TRACE_MAX_RINGS`` rings, drop the oldest ring
+    whose thread has exited — its retained spans leave the trace (counted
+    as dropped) but its totals are preserved."""
+    global _retired_dropped
+    if len(_rings) < _max_rings():
+        return
+    for i, ring in enumerate(_rings):
+        if ring.thread is not None and not ring.thread.is_alive():
+            dead = _rings.pop(i)
+            for name, n in dead.counts.items():
+                _retired_counts[name] = _retired_counts.get(name, 0) + n
+            _retired_dropped += dead.total
+            return
+
+
+def _my_ring() -> _SpanRing:
+    ring = getattr(_tls, "ring", None)
+    if ring is None:
+        t = threading.current_thread()
+        ring = _SpanRing(t.ident or 0, t.name, _ring_capacity(), thread=t)
+        with _rings_lock:
+            _retire_dead_ring_locked()
+            _rings.append(ring)
+        _tls.ring = ring
+    return ring
+
+
+def record_span(name: str, start_s: float, dur_s: float, **labels) -> None:
+    """Record one stage span. ``start_s`` is a ``get_time()`` monotonic
+    timestamp, ``dur_s`` its measured duration — the SAME values the
+    caller feeds its stage-seconds counter, so per-stage span sums always
+    reconcile with the attribution. The active pipeline scope rides along
+    automatically."""
+    _my_ring().record(name, int(start_s * 1e9), int(dur_s * 1e9),
+                      current_scope(), labels or None)
+
+
+@contextmanager
+def span(name: str, **labels):
+    """Measure a block as one span (convenience form of
+    :func:`record_span` for call sites that keep no counter of their own)."""
+    import time
+
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        record_span(name, t0, time.monotonic() - t0, **labels)
+
+
+def spans_snapshot(pipeline: Optional[str] = None) -> List[dict]:
+    """Retained spans across all threads, oldest-first per thread, as
+    dicts; optionally filtered to one pipeline label."""
+    with _rings_lock:
+        rings = list(_rings)
+    out = []
+    for ring in rings:
+        for name, start_ns, dur_ns, pipe, labels in ring.snapshot():
+            if pipeline is not None and pipe != pipeline:
+                continue
+            out.append({"name": name, "tid": ring.tid,
+                        "thread": ring.thread_name, "start_ns": start_ns,
+                        "dur_ns": dur_ns, "pipeline": pipe,
+                        "labels": labels or {}})
+    out.sort(key=lambda s: s["start_ns"])
+    return out
+
+
+def span_counts() -> Dict[str, int]:
+    """Spans RECORDED per name since process start (not just retained —
+    neither ring overwrites nor dead-ring retirement lower these)."""
+    with _rings_lock:
+        rings = list(_rings)
+        out = dict(_retired_counts)
+    for ring in rings:
+        for name, n in list(ring.counts.items()):
+            out[name] = out.get(name, 0) + n
+    return out
+
+
+def spans_dropped() -> int:
+    """Spans recorded but no longer exportable (ring overwrites + rings
+    retired with their thread)."""
+    with _rings_lock:
+        return _retired_dropped + sum(
+            max(0, r.total - r.capacity) for r in _rings)
+
+
+def reset_spans() -> None:
+    """Clear every ring (tests; production rings just wrap)."""
+    global _retired_dropped
+    with _rings_lock:
+        for ring in _rings:
+            ring.clear()
+        _retired_counts.clear()
+        _retired_dropped = 0
+
+
+def export_chrome_trace(path: str, pipeline: Optional[str] = None) -> int:
+    """Write the retained spans as Chrome-trace/Perfetto JSON (object
+    form: ``{"traceEvents": [...]}``, complete-event ``ph: "X"``, ts/dur
+    in microseconds). Returns the number of events written. The file is
+    written to ``<path>.tmp`` then atomically published."""
+    pid = os.getpid()
+    events: List[dict] = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": "dmlc_tpu"},
+    }]
+    with _rings_lock:
+        rings = list(_rings)
+    for ring in rings:
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": ring.tid, "args": {"name": ring.thread_name}})
+    rows = spans_snapshot(pipeline)
+    for s in rows:
+        args = dict(s["labels"])
+        if s["pipeline"]:
+            args["pipeline"] = s["pipeline"]
+        events.append({
+            "name": s["name"], "cat": "dmlc_tpu", "ph": "X",
+            "pid": pid, "tid": s["tid"],
+            "ts": s["start_ns"] / 1e3, "dur": s["dur_ns"] / 1e3,
+            "args": args,
+        })
+    doc = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "telemetry_schema_version": SCHEMA_VERSION,
+            "spans_dropped": spans_dropped(),
+        },
+    }
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return len(rows)
+
+
+# ---------------- trace-mode knob ----------------
+
+def trace_mode() -> Tuple[str, Optional[str]]:
+    """Parse ``DMLC_TPU_TRACE`` (docs/data.md):
+
+    - ``1`` -> ``('annotate', None)`` — wrap transfer/convert/dispatch/
+      cache_read in ``jax.profiler.TraceAnnotation`` so they show up in a
+      jax profiler / Perfetto device trace
+    - ``chrome:<path>`` -> ``('chrome', path)`` — dump the span rings as a
+      Chrome trace to ``path`` when the pipeline closes
+    - anything else (including unset / ``0``) -> ``('off', None)`` — the
+      historical contract was exactly ``DMLC_TPU_TRACE=1``, so unknown
+      values stay off rather than silently arming per-batch annotations
+    """
+    value = os.environ.get("DMLC_TPU_TRACE", "").strip()
+    if value == "1":
+        return "annotate", None
+    if value.startswith("chrome:"):
+        return "chrome", value[len("chrome:"):]
+    return "off", None
+
+
+@contextmanager
+def profiler_annotation(name: str, enabled: bool = True):
+    """``jax.profiler.TraceAnnotation`` when enabled (and jax importable);
+    a no-op otherwise. Callers cache ``trace_mode()[0] == 'annotate'`` so
+    the env parse never sits on a per-batch path."""
+    if not enabled:
+        yield
+        return
+    try:
+        from jax import profiler as _profiler
+    except Exception:  # noqa: BLE001 - tracing must never break the pipeline
+        yield
+        return
+    with _profiler.TraceAnnotation(name):
+        yield
+
+
+# ---------------- metrics registry ----------------
+
+class _Metric:
+    __slots__ = ("lock", "labels")
+
+    def __init__(self, labels: Dict[str, str]):
+        self.lock = threading.Lock()
+        self.labels = labels
+
+
+class Counter(_Metric):
+    """Monotonic float counter (stage seconds use float increments)."""
+
+    __slots__ = ("_value",)
+    kind = "counter"
+
+    def __init__(self, labels):
+        super().__init__(labels)
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        with self.lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self.lock:
+            return self._value
+
+
+class Gauge(_Metric):
+    __slots__ = ("_value",)
+    kind = "gauge"
+
+    def __init__(self, labels):
+        super().__init__(labels)
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self.lock:
+            self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        with self.lock:
+            return self._value
+
+
+class Histogram(_Metric):
+    """count/sum/min/max summary (enough for stall and latency shapes
+    without bucket-boundary bikeshedding; percentiles can come later)."""
+
+    __slots__ = ("_count", "_sum", "_min", "_max")
+    kind = "histogram"
+
+    def __init__(self, labels):
+        super().__init__(labels)
+        self._count = 0
+        self._sum = 0.0
+        self._min = None
+        self._max = None
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self.lock:
+            self._count += 1
+            self._sum += v
+            self._min = v if self._min is None else min(self._min, v)
+            self._max = v if self._max is None else max(self._max, v)
+
+    @property
+    def value(self) -> dict:
+        with self.lock:
+            return {"count": self._count, "sum": self._sum,
+                    "min": self._min, "max": self._max}
+
+
+class Info(_Metric):
+    """A structured JSON-able dict (e.g. the pipeline stall diagnostic):
+    last write wins, read back verbatim."""
+
+    __slots__ = ("_value",)
+    kind = "info"
+
+    def __init__(self, labels):
+        super().__init__(labels)
+        self._value: Optional[dict] = None
+
+    def set(self, value: dict) -> None:
+        with self.lock:
+            self._value = dict(value)
+
+    @property
+    def value(self) -> Optional[dict]:
+        with self.lock:
+            return dict(self._value) if self._value is not None else None
+
+
+class MetricsRegistry:
+    """Named, labeled metrics. ``counter/gauge/histogram/info`` get or
+    create the handle for an exact (name, labels) pair — handles are
+    cheap to cache at call sites (StageMeter does) so the hot path is one
+    small per-metric lock, never the registry lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[tuple, _Metric] = {}
+
+    def _get(self, cls, name: str, labels: Dict[str, str]) -> _Metric:
+        key = (cls.kind, name, tuple(sorted(labels.items())))
+        m = self._metrics.get(key)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(key)
+                if m is None:
+                    m = cls(dict(labels))
+                    self._metrics[key] = m
+        return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, **labels) -> Histogram:
+        return self._get(Histogram, name, labels)
+
+    def info(self, name: str, **labels) -> Info:
+        return self._get(Info, name, labels)
+
+    # -------- read side --------
+
+    def _rows(self, name: Optional[str], kind: Optional[str],
+              label_filter: Dict[str, str]) -> Iterable[Tuple[tuple, _Metric]]:
+        with self._lock:
+            items = list(self._metrics.items())
+        for key, m in items:
+            k, n, _ = key
+            if name is not None and n != name:
+                continue
+            if kind is not None and k != kind:
+                continue
+            if any(m.labels.get(fk) != fv for fk, fv in label_filter.items()):
+                continue
+            yield key, m
+
+    def snapshot(self, name: Optional[str] = None, kind: Optional[str] = None,
+                 **label_filter) -> List[dict]:
+        """Matching metrics as ``{"kind", "name", "labels", "value"}`` rows."""
+        return [{"kind": key[0], "name": key[1], "labels": dict(m.labels),
+                 "value": m.value}
+                for key, m in self._rows(name, kind, label_filter)]
+
+    def sum(self, name: str, **label_filter) -> float:
+        """Total over matching counters/gauges."""
+        return sum(m.value for _, m in self._rows(name, None, label_filter)
+                   if isinstance(m, (Counter, Gauge)))
+
+    def sum_by(self, name: str, by: str, **label_filter) -> Dict[str, float]:
+        """Per-``by``-label totals over matching counters/gauges."""
+        out: Dict[str, float] = {}
+        for _, m in self._rows(name, None, label_filter):
+            if isinstance(m, (Counter, Gauge)):
+                k = m.labels.get(by, "")
+                out[k] = out.get(k, 0.0) + m.value
+        return out
+
+    def clear(self, name: Optional[str] = None) -> None:
+        """Drop matching metrics entirely (tests / counter reset)."""
+        with self._lock:
+            if name is None:
+                self._metrics.clear()
+            else:
+                self._metrics = {k: v for k, v in self._metrics.items()
+                                 if k[1] != name}
+
+
+REGISTRY = MetricsRegistry()
+
+
+# ---------------- pod-scale aggregation ----------------
+
+def pod_snapshot() -> dict:
+    """This process's registry as a compact JSON-able snapshot — what a
+    worker ships to the tracker over the heartbeat path. Stage seconds and
+    resilience events are summed ACROSS pipeline labels (the tracker's
+    unit of balance is the host, not the pipeline instance)."""
+    stages = REGISTRY.sum_by(STAGE_BUSY_METRIC, "stage")
+    # 'transfer' lives on the wall meter only (it is a sampled consumer-
+    # side probe, not a pipeline-thread busy counter) — merge it in so a
+    # transfer-bound rank is visible in the pod table
+    transfer = REGISTRY.sum_by(STAGE_WALL_METRIC, "stage").get("transfer")
+    if transfer:
+        stages["transfer"] = stages.get("transfer", 0.0) + transfer
+    return {
+        "telemetry_schema_version": SCHEMA_VERSION,
+        "stages": {k: round(v, 4) for k, v in stages.items() if k},
+        "resilience": {k: int(round(v)) for k, v in
+                       REGISTRY.sum_by(RESILIENCE_METRIC, "event").items()
+                       if k},
+        "spans": span_counts(),
+        "spans_dropped": spans_dropped(),
+    }
+
+
+def format_pod_table(by_rank: Dict[int, dict]) -> str:
+    """Merged per-rank × per-stage seconds table from worker snapshots
+    (what the tracker logs). Ranks whose snapshot carries a different
+    schema version are listed but not merged."""
+    stage_cols = list(STAGES)
+    extras = sorted({s for snap in by_rank.values()
+                     for s in (snap.get("stages") or {})
+                     if s not in STAGES})
+    stage_cols += extras
+    width = max([5] + [len(s) for s in stage_cols])
+    header = "rank  " + "  ".join(f"{s:>{width}}" for s in stage_cols) \
+        + "  resilience"
+    lines = [header]
+    totals = {s: 0.0 for s in stage_cols}
+    for rank in sorted(by_rank):
+        snap = by_rank[rank] or {}
+        if snap.get("telemetry_schema_version") != SCHEMA_VERSION:
+            lines.append(f"{rank:>4}  [schema "
+                         f"{snap.get('telemetry_schema_version')!r} != "
+                         f"{SCHEMA_VERSION}: not merged]")
+            continue
+        stages = snap.get("stages") or {}
+        cells = []
+        for s in stage_cols:
+            v = float(stages.get(s, 0.0))
+            totals[s] += v
+            cells.append(f"{v:>{width}.3f}")
+        res = snap.get("resilience") or {}
+        hot = {k: v for k, v in sorted(res.items()) if v}
+        lines.append(f"{rank:>4}  " + "  ".join(cells)
+                     + f"  {hot if hot else '-'}")
+    lines.append("-" * len(header))
+    lines.append(" sum  " + "  ".join(
+        f"{totals[s]:>{width}.3f}" for s in stage_cols))
+    return "\n".join(lines)
+
+
+# ---------------- thread-scope inheritance helper ----------------
+
+def scoped_target(fn: Callable[..., Any],
+                  label: Optional[str] = None) -> Callable[..., Any]:
+    """Wrap a thread target so it runs under ``label`` (default: the scope
+    active where THIS call happens — i.e. the creator's scope). The
+    pipeline thread primitives use this so spans/metrics recorded on their
+    workers land under the right pipeline."""
+    if label is None:
+        label = current_scope()
+
+    def run(*args, **kwargs):
+        set_scope(label)
+        return fn(*args, **kwargs)
+
+    return run

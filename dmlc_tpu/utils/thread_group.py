@@ -69,7 +69,11 @@ class ManagedThread:
             except BaseException as exc:  # surfaced on join()
                 self._exc = exc
 
-        self._thread = threading.Thread(target=_run, name=name, daemon=daemon)
+        from dmlc_tpu.utils import telemetry as _telemetry
+
+        # inherit the creator's pipeline scope (see telemetry.scoped_target)
+        self._thread = threading.Thread(
+            target=_telemetry.scoped_target(_run), name=name, daemon=daemon)
 
     def start(self) -> None:
         self._thread.start()

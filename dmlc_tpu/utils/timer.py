@@ -11,6 +11,7 @@ import threading
 import time
 from typing import Dict, Iterable, Optional
 
+from dmlc_tpu.utils import telemetry as _telemetry
 from dmlc_tpu.utils.check import get_logger
 
 
@@ -20,7 +21,8 @@ def get_time() -> float:
 
 
 class StageMeter:
-    """Thread-safe named-stage seconds accumulator.
+    """Thread-safe named-stage seconds accumulator, backed by the
+    telemetry metrics registry.
 
     The pipeline-attribution primitive (tf.data's per-stage cost naming,
     arXiv:2101.12127 §4): each pipeline stage adds its measured seconds
@@ -30,24 +32,49 @@ class StageMeter:
     a missing stage in a report is indistinguishable from an unmeasured
     one, which is exactly the "unaccounted 50%" failure mode this exists
     to close.
+
+    Each (stage) cell IS a registry counter under ``metric`` with a
+    ``pipeline=scope`` label — so ``DeviceIter.stats()``, the pod
+    snapshot a worker ships to the tracker, and any future autotuner all
+    read the SAME books (no second bookkeeping path). ``scope`` defaults
+    to a fresh process-unique label so independent meters never alias.
     """
 
-    def __init__(self, *stages: str):
-        self._lock = threading.Lock()
-        self._seconds: Dict[str, float] = {s: 0.0 for s in stages}
+    def __init__(self, *stages: str,
+                 metric: str = _telemetry.STAGE_BUSY_METRIC,
+                 scope: Optional[str] = None):
+        self._metric = metric
+        self.scope = scope if scope is not None else \
+            _telemetry.new_pipeline_label("meter")
+        self._lock = threading.Lock()  # guards handle-map growth only
+        self._handles: Dict[str, _telemetry.Counter] = {
+            s: _telemetry.REGISTRY.counter(metric, stage=s,
+                                           pipeline=self.scope)
+            for s in stages
+        }
+
+    def _handle(self, stage: str) -> "_telemetry.Counter":
+        h = self._handles.get(stage)
+        if h is None:
+            with self._lock:
+                h = self._handles.get(stage)
+                if h is None:
+                    h = _telemetry.REGISTRY.counter(
+                        self._metric, stage=stage, pipeline=self.scope)
+                    self._handles[stage] = h
+        return h
 
     def add(self, stage: str, seconds: float) -> None:
-        with self._lock:
-            self._seconds[stage] = self._seconds.get(stage, 0.0) + seconds
+        self._handle(stage).inc(seconds)
 
     def seconds(self) -> Dict[str, float]:
         """Snapshot of cumulative per-stage seconds."""
         with self._lock:
-            return dict(self._seconds)
+            handles = dict(self._handles)
+        return {s: h.value for s, h in handles.items()}
 
     def total(self) -> float:
-        with self._lock:
-            return sum(self._seconds.values())
+        return sum(self.seconds().values())
 
 
 def format_stage_table(stages: Dict[str, float], wall: float,

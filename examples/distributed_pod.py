@@ -99,8 +99,11 @@ def worker() -> None:
     else:
         client.start()
     # beat well inside the liveness window (1.0s in the demo): an interval
-    # equal to the timeout would flag healthy-but-jittery ranks as lost
-    client.start_heartbeat(0.25)
+    # equal to the timeout would flag healthy-but-jittery ranks as lost.
+    # metrics=True makes each beat carry this worker's telemetry snapshot,
+    # so the tracker logs the merged per-rank × per-stage ingest table
+    # (docs/observability.md pod aggregation)
+    client.start_heartbeat(0.25, metrics=True)
 
     init_from_env()  # DMLC_* -> jax.distributed.initialize
     rank, world = jax.process_index(), jax.process_count()

@@ -460,6 +460,102 @@ class TestStartPathWorkerDeath:
             tracker.close()
 
 
+class TestPodMetrics:
+    def test_multiprocess_workers_merge_per_rank_stage_table(self, tmp_path):
+        """ISSUE 6 pod aggregation: ≥2 REAL worker processes rendezvous,
+        each records telemetry and ships a registry snapshot over the
+        `metrics` command; the tracker merges them into the per-rank ×
+        per-stage table."""
+        import time as _time
+
+        os.environ["DMLC_METRICS_LOG_EVERY"] = "0"
+        tracker = RabitTracker("127.0.0.1", 2)
+        tracker.start(2)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        worker_code = (
+            "import sys, os; sys.path.insert(0, os.environ['REPO'])\n"
+            "from dmlc_tpu.tracker.client import WorkerClient\n"
+            "from dmlc_tpu.utils import telemetry\n"
+            "from dmlc_tpu.io import resilience\n"
+            "c = WorkerClient('127.0.0.1', int(os.environ['PORT']))\n"
+            "a = c.start(world_size=2)\n"
+            "# stage seconds + a scoped resilience event + a span, as a\n"
+            "# real pipeline would record them\n"
+            "telemetry.REGISTRY.counter(telemetry.STAGE_BUSY_METRIC,\n"
+            "    stage='parse', pipeline='p').inc(1.5)\n"
+            "telemetry.REGISTRY.counter(telemetry.STAGE_BUSY_METRIC,\n"
+            "    stage='read', pipeline='p').inc(0.25 * a.rank)\n"
+            "with telemetry.scope('p'):\n"
+            "    resilience.record_event('retries', a.rank)\n"
+            "telemetry.record_span('parse', 0.0, 1.5)\n"
+            "c.report_metrics()\n"
+            "c.shutdown()\n"
+        )
+        env = dict(os.environ, REPO=repo, PORT=str(tracker.port),
+                   JAX_PLATFORMS="cpu")
+        procs = [subprocess.Popen([sys.executable, "-c", worker_code],
+                                  env=env, stdout=subprocess.PIPE,
+                                  stderr=subprocess.PIPE, text=True)
+                 for _ in range(2)]
+        try:
+            for p in procs:
+                out, err = p.communicate(timeout=60)
+                assert p.returncode == 0, err
+            tracker.join(timeout=30)
+            # a metrics send can race the shutdown accept: wait briefly
+            deadline = _time.monotonic() + 5
+            while (len(tracker.pod_metrics()) < 2
+                   and _time.monotonic() < deadline):
+                _time.sleep(0.05)
+            pod = tracker.pod_metrics()
+            assert sorted(pod) == [0, 1]
+            for rank in (0, 1):
+                snap = pod[rank]
+                assert snap["telemetry_schema_version"] >= 1
+                assert snap["stages"]["parse"] == pytest.approx(1.5)
+                assert snap["spans"]["parse"] >= 1
+            assert pod[1]["stages"]["read"] == pytest.approx(0.25)
+            assert pod[1]["resilience"]["retries"] == 1
+            table = tracker.format_pod_table()
+            lines = table.splitlines()
+            assert "rank" in lines[0] and "parse" in lines[0]
+            assert any(ln.strip().startswith("0") for ln in lines[1:])
+            assert any(ln.strip().startswith("1") for ln in lines[1:])
+            assert "3.000" in lines[-1]  # merged parse sum across ranks
+        finally:
+            os.environ.pop("DMLC_METRICS_LOG_EVERY", None)
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            tracker.close()
+
+    def test_heartbeat_thread_with_metrics(self):
+        """start_heartbeat(metrics=True): the periodic ping doubles as a
+        snapshot feed and still counts for liveness."""
+        import time as _time
+
+        tracker = RabitTracker("127.0.0.1", 1, liveness_timeout=5.0)
+        tracker.start(1)
+        w = WorkerClient("127.0.0.1", tracker.port)
+        try:
+            a = w.start(world_size=1)
+            w.start_heartbeat(interval=0.1, metrics=True)
+            deadline = _time.monotonic() + 5
+            while (a.rank not in tracker.pod_metrics()
+                   and _time.monotonic() < deadline):
+                _time.sleep(0.05)
+            snap = tracker.pod_metrics().get(a.rank)
+            assert snap is not None
+            assert snap["telemetry_schema_version"] >= 1
+            assert a.rank in tracker.last_seen  # metrics == liveness ping
+            w.stop_heartbeat()
+            w.shutdown()
+            tracker.join(10)
+        finally:
+            w.close()
+            tracker.close()
+
+
 class TestLiveness:
     def test_silent_worker_flagged_heartbeater_not(self):
         import time as _time
