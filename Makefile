@@ -12,7 +12,9 @@
 #                     attribution (read/cache_read/parse/convert/dispatch/
 #                     transfer), the block-cache epoch-pair fields
 #                     (warm_epoch_mb_per_sec/warm_vs_cold_speedup/
-#                     cache_state), and the telemetry contract
+#                     cache_state), the data-service leg (service_workers/
+#                     service_mb_per_sec/service_vs_local_speedup from a
+#                     localhost 2-worker fleet), and the telemetry contract
 #                     (telemetry_schema_version + per-stage span counts)
 #   make fuzz         mutation fuzz of every native parse C-ABI entry point
 #                     (crash-safety; DMLC_FUZZ_ITERS to scale)
@@ -59,7 +61,7 @@ sanitize:
 bench-smoke:
 	DMLC_BENCH_PLATFORM=cpu DMLC_BENCH_MB=8 DMLC_BENCH_REPS=1 \
 	DMLC_BENCH_ATTEMPTS=1 DMLC_BENCH_TIMEOUT=600 \
-	    $(PYTHON) bench.py > .bench_smoke.json
+	    $(PYTHON) bench.py --service > .bench_smoke.json
 	$(PYTHON) -c "import json; \
 	    line = json.load(open('.bench_smoke.json')); \
 	    a = line.get('attribution') or {}; \
@@ -79,6 +81,12 @@ bench-smoke:
 	        'warm_vs_cold_speedup missing'; \
 	    assert line.get('cache_state') == 'warm', \
 	        f\"cache_state {line.get('cache_state')!r} != 'warm'\"; \
+	    assert line.get('service_workers') == 2, \
+	        'service_workers missing (service leg did not run)'; \
+	    assert line.get('service_mb_per_sec'), \
+	        'service_mb_per_sec missing'; \
+	    assert line.get('service_vs_local_speedup'), \
+	        'service_vs_local_speedup missing'; \
 	    assert line.get('telemetry_schema_version') == 1, \
 	        'telemetry_schema_version missing/mismatched'; \
 	    assert line.get('trace_spans'), 'trace_spans missing/zero'; \
@@ -95,7 +103,11 @@ bench-smoke:
 	          'workers =', line['parse_workers']); \
 	    print('bench-smoke: block cache OK:', \
 	          line['warm_epoch_mb_per_sec'], 'MB/s warm, speedup x', \
-	          line['warm_vs_cold_speedup'])"
+	          line['warm_vs_cold_speedup']); \
+	    print('bench-smoke: data service OK:', \
+	          line['service_mb_per_sec'], 'MB/s with', \
+	          line['service_workers'], 'workers, vs-local x', \
+	          line['service_vs_local_speedup'])"
 
 parse-bench:
 	mkdir -p native/build

@@ -317,6 +317,55 @@ def block_cache_epoch_pair(path: str, size_mb: float):
             warm_stats["stages"].get("cache_read", 0.0))
 
 
+def service_leg(path: str, size_mb: float, workers: int = 2):
+    """Disaggregated data-service leg (``--service`` / ISSUE 7): a
+    localhost 1-dispatcher + N-worker fleet parses the corpus's N
+    partitions in parallel and streams the frames to one client, timed
+    against the same partitions parsed serially on this host with the
+    identical config. ``service_vs_local_speedup > 1`` means the fleet's
+    parallel parse beats the single-host serial pass even after paying
+    the frame encode + loopback TCP + decode tax — the disaggregation
+    claim at smoke scale (arXiv:2210.14826)."""
+    from dmlc_tpu.data import create_parser
+    from dmlc_tpu.service import LocalFleet, ServiceParser
+
+    num_parts = workers
+    cfg = {"format": "libsvm", "chunk_bytes": CHUNK_BYTES}
+    t0 = time.monotonic()
+    rows = 0
+    for p in range(num_parts):
+        parser = create_parser(path, p, num_parts, "libsvm",
+                               chunk_bytes=CHUNK_BYTES)
+        while parser.next_block() is not None:
+            rows += 1
+        parser.close()
+    local_dt = time.monotonic() - t0
+    # fleet construction is inside the timed region: the workers' parallel
+    # parse IS the work being measured, not a warm pre-parse
+    t0 = time.monotonic()
+    fleet = LocalFleet(path, num_parts, num_workers=workers, parser=cfg)
+    client = None
+    try:
+        client = ServiceParser(fleet.address)
+        sblocks = 0
+        while client.next_block() is not None:
+            sblocks += 1
+        service_dt = time.monotonic() - t0
+    finally:
+        if client is not None:
+            client.close()
+        fleet.close()
+    log(f"bench: service {workers}-worker fleet {sblocks} blocks in "
+        f"{service_dt:.2f}s = {size_mb/service_dt:.1f} MB/s vs local "
+        f"serial {size_mb/local_dt:.1f} MB/s -> speedup "
+        f"x{local_dt/service_dt:.2f}")
+    return {
+        "service_workers": workers,
+        "service_mb_per_sec": round(size_mb / service_dt, 2),
+        "service_vs_local_speedup": round(local_dt / service_dt, 3),
+    }
+
+
 def device_floor_mbps(x_dtype: str = "float32"):
     """Raw repeated-shape device_put floor for bench.py's exact batch
     geometry, measured in THIS process right after the pipeline reps (same
@@ -539,6 +588,15 @@ def run_child() -> None:
             bf16_dev[1] / bf_floor_med, 3)
     except Exception as exc:  # noqa: BLE001 - the headline must still print
         log(f"bench: bf16 leg failed: {exc}")
+    # disaggregated data-service leg (docs/service.md): localhost fleet
+    # throughput + speedup over the same partitions parsed serially —
+    # emitted when --service / DMLC_BENCH_SERVICE=1 asked for it (make
+    # bench-smoke gates the fields)
+    if os.environ.get("DMLC_BENCH_SERVICE", "0") not in ("", "0"):
+        try:
+            line.update(service_leg(path, size_mb))
+        except Exception as exc:  # noqa: BLE001 - the headline must still print
+            log(f"bench: service leg failed: {exc}")
     # always-on telemetry contract (docs/observability.md): the schema
     # version + per-stage span counts ride the JSON line, proving the span
     # tracer covered the whole measurement (make bench-smoke gates these)
@@ -607,6 +665,10 @@ def _spawn_child(env: dict, timeout: float):
 
 
 def main() -> int:
+    if "--service" in sys.argv:
+        # the measurement runs in a supervised child; the flag travels as
+        # env so retries and the CPU fallback keep the leg
+        os.environ["DMLC_BENCH_SERVICE"] = "1"
     if os.environ.get("DMLC_BENCH_CHILD") == "1":
         run_child()
         return 0
@@ -697,6 +759,8 @@ def main() -> int:
                           "cold_epoch_mb_per_sec", "warm_epoch_mb_per_sec",
                           "warm_vs_cold_speedup", "cache_state",
                           "warm_vs_parse_ceiling",
+                          "service_workers", "service_mb_per_sec",
+                          "service_vs_local_speedup",
                           "telemetry_schema_version", "trace_spans",
                           "trace_span_counts"):
                     if parsed.get(k) is not None:

@@ -8,8 +8,11 @@ The telemetry PR centralized every stage clock and event counter onto
 point solutions — process-global counters that let concurrent pipelines
 contaminate each other, and ``time.monotonic()`` stopwatches whose
 numbers never reached ``stats()`` or a trace. ``make lint-metrics`` keeps
-that from creeping back. It FAILS on, anywhere under ``dmlc_tpu/`` except
-the two sanctioned modules:
+that from creeping back. It FAILS on, anywhere under ``dmlc_tpu/`` —
+every package, including ``dmlc_tpu/service/`` (whose frame
+encode/send/recv/decode timing must ride the span tracer, and whose
+failover events must go through ``record_event``) — except the two
+sanctioned modules:
 
 - ``COUNTERS.bump(`` — direct resilience-counter mutation; new events
   must go through ``dmlc_tpu.io.resilience.record_event`` (which stamps

@@ -167,6 +167,27 @@ def _pslice(part, a: int, b: int):
     return ("blk", part[1].slice(a, b))
 
 
+def _adopt_pipeline_scope(source, label: str, max_depth: int = 8) -> None:
+    """Stamp a pipeline label onto the thread primitives a parser chain
+    built BEFORE its DeviceIter existed (a threaded input split starts
+    prefetching at parser construction). Walks the chain's wrapper
+    attributes and calls ``adopt_scope`` on every ThreadedIter /
+    OrderedWorkerPool found — monotonic None -> label, so primitives that
+    already have a scope are untouched."""
+    seen = set()
+    stack = [(source, 0)]
+    while stack:
+        obj, depth = stack.pop()
+        if obj is None or id(obj) in seen or depth > max_depth:
+            continue
+        seen.add(id(obj))
+        adopt = getattr(obj, "adopt_scope", None)
+        if callable(adopt):
+            adopt(label)
+        for name in ("source", "base", "_base", "_iter", "_pool"):
+            stack.append((getattr(obj, name, None), depth + 1))
+
+
 def _csr_coords_impl(cols, row_ptr):
     """Rebuild BCOO (row, col) coordinate pairs from the CSR wire format.
 
@@ -375,6 +396,12 @@ class DeviceIter:
         # (docs/observability.md).
         self.pipeline_label = (pipeline_label
                                or _telemetry.new_pipeline_label())
+        # thread primitives the parser chain already constructed (a
+        # threaded input split starts prefetching at parser build, before
+        # this pipeline exists) capture the scope NOW, at iterator
+        # construction — without this their pre-first-pull work landed in
+        # the process-wide books only (the old adoption-window caveat)
+        _adopt_pipeline_scope(source, self.pipeline_label)
         # DMLC_TPU_TRACE modes (docs/data.md): '1' wraps transfer /
         # convert / dispatch / cache_read in jax profiler annotations;
         # 'chrome:<path>' dumps the span rings as a Chrome trace on close

@@ -198,6 +198,7 @@ def create_row_block_iter(
     silent: bool = False,
     parse_workers: Optional[int] = None,
     block_cache: Optional[str] = None,
+    service: Optional[str] = None,
     **parser_kw,
 ) -> RowBlockIter:
     """RowBlockIter factory — analog of RowBlockIter::Create
@@ -216,8 +217,20 @@ def create_row_block_iter(
     block cache on the parser the iterator drains: the first load parses
     text once, later loads serve mmap-backed parsed blocks
     (:class:`~dmlc_tpu.data.parsers.BlockCacheIter`, docs/data.md).
+
+    ``service`` (or a ``#service=<host:port>`` URI suffix) streams the
+    blocks from a disaggregated parse-worker fleet instead of parsing
+    locally — the drained parser is the drop-in
+    :class:`~dmlc_tpu.service.client.ServiceParser` and the dispatcher
+    owns the dataset spec (docs/service.md).
     """
     spec = URISpec(uri, part_index, num_parts)
+    if service is None:
+        service = spec.service
+    if service is not None:
+        parser = create_parser(uri, part_index, num_parts, type_,
+                               index_dtype=index_dtype, service=service)
+        return BasicRowIter(parser, silent=silent)
     # the cache here is the parsed-page cache (DiskRowIter); strip it before
     # the parser so the split layer does not also chunk-cache to the same
     # path — but a #blockcache= fragment belongs to the parser factory,

@@ -806,6 +806,20 @@ class LibFMParser(TextParserBase):
         )
 
 
+def annot_key(state: Optional[dict]) -> str:
+    """Canonical comparison key of a resume annotation — ONE
+    normalization (strip the per-wrapper ``blocks`` delivery counter,
+    JSON round-trip so tuples/dict-order/non-JSON scalars collapse to
+    their wire form, sorted dump) shared by :class:`BlockCacheIter`'s
+    stored-annotation match and the data service's remote ``find``
+    (:mod:`dmlc_tpu.service.frame` re-exports it). Two implementations
+    here would let a checkpoint restore locally but not over the
+    service, or vice versa."""
+    norm = {k: v for k, v in (state or {}).items() if k != "blocks"}
+    return json.dumps(json.loads(json.dumps(norm, default=str)),
+                      sort_keys=True)
+
+
 class _WrappedParserMixin:
     """The delegation + checkpoint contract shared by the parse-ahead
     wrappers (:class:`ThreadedParser`, :class:`ParallelTextParser`): both
@@ -1367,10 +1381,7 @@ class BlockCacheIter(Parser):
             return self.base.state_dict()
         return {"kind": "blocks", "blocks": self._delivered}
 
-    @staticmethod
-    def _annot_key(state: dict) -> str:
-        norm = {k: v for k, v in state.items() if k != "blocks"}
-        return json.dumps(norm, sort_keys=True, default=str)
+    _annot_key = staticmethod(annot_key)
 
     def _find_block(self, state: dict) -> Optional[int]:
         """Block index to resume at for a parser-chain annotation: the
@@ -1570,6 +1581,7 @@ def create_parser(
     threaded: bool = True,
     parse_workers: Optional[int] = None,
     block_cache: Optional[str] = None,
+    service: Optional[str] = None,
     **split_kw,
 ) -> Parser:
     """Parser factory — analog of dmlc::Parser::Create (src/data.cc:62-85).
@@ -1590,8 +1602,28 @@ def create_parser(
     the ``DMLC_TPU_BLOCK_CACHE`` env directory; the cache self-invalidates
     when the source files, partition, or parser config drift
     (docs/data.md block cache section).
+
+    ``service`` (or a ``#service=<host:port>`` URI suffix) names a
+    RowBlock data-service dispatcher: parsing then happens on a remote
+    parse-worker fleet and the returned parser is the drop-in
+    :class:`~dmlc_tpu.service.client.ServiceParser` streaming parsed
+    blocks over TCP — the dataset spec (URI, partitioning, parser
+    config) is the DISPATCHER's; every other argument here is ignored
+    (docs/service.md).
     """
     spec = URISpec(uri, part_index, num_parts)
+    if service is None:
+        service = spec.service
+    if service is not None:
+        # the DISPATCHER owns partitioning: silently handing every rank
+        # the full dataset would duplicate training data — reject loudly
+        check(part_index == 0 and num_parts == 1,
+              "create_parser(service=...): client-side part_index/"
+              "num_parts are not supported — the dispatcher owns the "
+              "dataset's partitioning (docs/service.md)")
+        from dmlc_tpu.service.client import ServiceParser
+
+        return ServiceParser(service)
     if type_ == "auto":
         type_ = spec.args.get("format", "libsvm")
     bc_path = _resolve_block_cache(spec, part_index, num_parts, block_cache)

@@ -80,6 +80,49 @@ def _pad_to(f, align: int) -> int:
     return pos
 
 
+def write_segments(f, segments: Dict[str, Optional[np.ndarray]],
+                   crc: int = 0) -> tuple:
+    """Serialize the present :data:`SEGMENT_NAMES` arrays at ``f``'s
+    current (already-aligned) position — the v1 segment encoding shared
+    by the on-disk cache block and the data-service wire frame
+    (:mod:`dmlc_tpu.service.frame`): canonical order, each array start
+    padded to 64-byte alignment, raw little-endian C-order bytes, one
+    crc32 rolling over padding + payload. Returns ``(end, crc, arrays)``
+    with ``arrays`` mapping name -> ``[dtype_str, abs_offset, nbytes]``
+    (the footer/meta schema both containers store)."""
+    arrays: Dict[str, list] = {}
+    for name in SEGMENT_NAMES:
+        arr = segments.get(name)
+        if arr is None:
+            continue
+        arr = np.ascontiguousarray(arr)
+        start = f.tell()
+        rem = start % _ALIGN
+        if rem:
+            padding = b"\0" * (_ALIGN - rem)
+            f.write(padding)
+            crc = zlib.crc32(padding, crc)
+            start += len(padding)
+        raw = arr.tobytes()  # canonical C-order little-endian payload
+        f.write(raw)
+        crc = zlib.crc32(raw, crc)
+        arrays[name] = [arr.dtype.str, start, len(raw)]
+    return f.tell(), crc & 0xFFFFFFFF, arrays
+
+
+def read_segments(buf, arrays: Dict[str, list]) -> Dict[str, np.ndarray]:
+    """Decode a :func:`write_segments` ``arrays`` mapping over ``buf``
+    (an mmap or bytes) into {name: zero-copy numpy view} — shared by the
+    warm cache reader and the service frame decoder."""
+    out: Dict[str, np.ndarray] = {}
+    for name, (dtype_str, off, nbytes) in arrays.items():
+        dt = np.dtype(dtype_str)
+        out[name] = np.frombuffer(buf, dtype=dt,
+                                  count=nbytes // dt.itemsize,
+                                  offset=int(off))
+    return out
+
+
 class BlockCacheWriter:
     """Streams checksummed columnar block segments to ``<path>.tmp``;
     :meth:`finish` writes the footer, fsyncs, and atomically publishes."""
@@ -109,25 +152,7 @@ class BlockCacheWriter:
         t_span = get_time()
         f = self._f
         pos = _pad_to(f, _ALIGN)
-        crc = 0
-        arrays: Dict[str, list] = {}
-        for name in SEGMENT_NAMES:
-            arr = segments.get(name)
-            if arr is None:
-                continue
-            arr = np.ascontiguousarray(arr)
-            start = f.tell()
-            rem = start % _ALIGN
-            if rem:
-                padding = b"\0" * (_ALIGN - rem)
-                f.write(padding)
-                crc = zlib.crc32(padding, crc)
-                start += len(padding)
-            raw = arr.tobytes()  # canonical C-order little-endian payload
-            f.write(raw)
-            crc = zlib.crc32(raw, crc)
-            arrays[name] = [arr.dtype.str, start, len(raw)]
-        end = f.tell()
+        end, crc, arrays = write_segments(f, segments)
         # resume annotations round-trip through JSON (tuples -> lists,
         # dict order normalized) so cold- and warm-served states compare
         # equal byte for byte
@@ -135,7 +160,7 @@ class BlockCacheWriter:
                        if resume is not None else None)
         self._entries.append({
             "pos": pos, "end": end, "rows": int(rows),
-            "crc": crc & 0xFFFFFFFF, "resume": resume_json,
+            "crc": crc, "resume": resume_json,
             "arrays": arrays,
         })
         self._rows += int(rows)
@@ -288,13 +313,7 @@ class BlockCacheReader:
             if not ok:
                 raise CacheCorruptionError(
                     f"block cache {self.path}: crc mismatch on block {i}")
-        out: Dict[str, np.ndarray] = {}
-        for name, (dtype_str, off, nbytes) in entry["arrays"].items():
-            dt = np.dtype(dtype_str)
-            out[name] = np.frombuffer(self._mm, dtype=dt,
-                                      count=nbytes // dt.itemsize,
-                                      offset=int(off))
-        return out
+        return read_segments(self._mm, entry["arrays"])
 
     def close(self) -> None:
         # best-effort: the mmap cannot close while exported views are

@@ -171,12 +171,23 @@ class _Counters:
                   healing rebuilds triggered by a mid-stream corruption:
                   the bad cache was dropped, the source re-read/re-parsed,
                   and a fresh cache rewritten
+    ``service_retries``
+                  data-service client streams interrupted (connection
+                  loss, torn frame, worker error) and re-requested at the
+                  exact block index
+    ``service_failovers``
+                  of those, resumes that landed on a DIFFERENT worker
+                  after the dispatcher re-issued the dead worker's split
+    ``service_giveups``
+                  service streams abandoned with the failure budget
+                  exhausted (no live worker took the part)
     """
 
     _KEYS = ("attempts", "retries", "resumes", "giveups", "fatal",
              "producer_restarts", "producer_giveups",
              "parse_restarts", "parse_giveups",
-             "cache_corruptions", "cache_invalidations", "cache_rebuilds")
+             "cache_corruptions", "cache_invalidations", "cache_rebuilds",
+             "service_retries", "service_failovers", "service_giveups")
 
     def bump(self, key: str, n: int = 1) -> None:
         record_event(key, n)

@@ -71,6 +71,17 @@ def _stall_timeout() -> float:
     return float(os.environ.get("DMLC_PIPELINE_STALL_TIMEOUT", "0") or 0)
 
 
+def _restart_budget_dict(policy, used: int) -> dict:
+    """The restart budget as structured data — one schema for both
+    pipeline primitives, published inside the stall diagnostic."""
+    return {
+        "enabled": policy is not None,
+        "used": used,
+        "limit": max(0, policy.max_attempts - 1) if policy is not None
+        else 0,
+    }
+
+
 def _publish_stall_diagnostic(diag: dict) -> None:
     """Publish a stall diagnostic as a structured info metric on the
     telemetry registry, keyed by component, pool label, and pipeline
@@ -142,14 +153,8 @@ class ThreadedIter(Generic[T]):
                 f"{max(0, pol.max_attempts - 1)} used this epoch")
 
     def _budget_dict(self) -> dict:
-        """The restart budget as structured data (the registry's stall
-        diagnostic carries this next to the human message)."""
-        pol = self._restart_policy
-        return {
-            "enabled": pol is not None,
-            "used": self._epoch_restarts,
-            "limit": max(0, pol.max_attempts - 1) if pol is not None else 0,
-        }
+        return _restart_budget_dict(self._restart_policy,
+                                    self._epoch_restarts)
 
     def _try_restart(self, exc: BaseException) -> bool:
         """Classify a producer error; on a retryable class with budget left,
@@ -243,6 +248,15 @@ class ThreadedIter(Generic[T]):
                 self._lock.notify_all()
 
     # ---------------- consumer side ----------------
+
+    def adopt_scope(self, label: Optional[str]) -> None:
+        """Install ``label`` as this pipeline's scope if it was built
+        outside any (monotonic None -> label, so benign if raced). The
+        owning ``DeviceIter`` walks its source chain and calls this at
+        construction, so prefetch work done BEFORE the first pull is
+        already scoped (docs/observability.md)."""
+        if self._scope is None and label is not None:
+            self._scope = label
 
     def next(self) -> Optional[T]:
         """Pop the next item; None at end of stream. Rethrows producer errors."""
@@ -455,12 +469,7 @@ class OrderedWorkerPool(Generic[T]):
                 f"{max(0, pol.max_attempts - 1)} used")
 
     def _budget_dict(self) -> dict:
-        pol = self._restart_policy
-        return {
-            "enabled": pol is not None,
-            "used": self.restarts,
-            "limit": max(0, pol.max_attempts - 1) if pol is not None else 0,
-        }
+        return _restart_budget_dict(self._restart_policy, self.restarts)
 
     def _try_source_restart(self, exc: BaseException) -> bool:
         """Called under ``_pull_lock`` after a source pull raised. On a
@@ -537,6 +546,11 @@ class OrderedWorkerPool(Generic[T]):
                 self._lock.notify_all()
 
     # ---------------- consumer side ----------------
+
+    def adopt_scope(self, label: Optional[str]) -> None:
+        """See :meth:`ThreadedIter.adopt_scope` — same contract."""
+        if self._scope is None and label is not None:
+            self._scope = label
 
     def next(self) -> Optional[T]:
         """Pop the next result in source order; None at end of stream.

@@ -48,17 +48,21 @@ class URI:
 class URISpec:
     """URI sugar: ``real_uri?k=v&k2=v2#cache_file`` (uri_spec.h:42-75).
 
-    Extension over the reference: a fragment of the form
+    Extensions over the reference: a fragment of the form
     ``#blockcache=<path>`` selects the parse-once columnar **block cache**
     (docs/data.md) instead of the raw chunk cache — ``block_cache`` then
     carries the raw path (partition qualification happens at the resolver,
     :func:`dmlc_tpu.data.parsers.create_parser`) and ``cache_file`` stays
-    None.
+    None. A ``#service=<host:port>`` fragment selects the disaggregated
+    **RowBlock data service** (docs/service.md): ``service`` carries the
+    dispatcher address and the rest of the URI is informational (the
+    dispatcher owns the dataset spec).
     """
 
     def __init__(self, uri: str, part_index: int = 0, num_parts: int = 1):
         name_cache = uri.split("#")
         self.block_cache: str | None = None
+        self.service: str | None = None
         if len(name_cache) == 2:
             cache = name_cache[1]
             if cache.startswith("blockcache="):
@@ -68,6 +72,14 @@ class URISpec:
                         "empty path in `#blockcache=` URI suffix")
                 self.block_cache = path
                 self.cache_file: str | None = None
+            elif cache.startswith("service="):
+                addr = cache[len("service="):]
+                if not addr or ":" not in addr:
+                    raise DMLCError(
+                        "`#service=` URI suffix needs a host:port "
+                        "dispatcher address")
+                self.service = addr
+                self.cache_file = None
             else:
                 if num_parts != 1:
                     cache = f"{cache}.split{num_parts}.part{part_index}"

@@ -1,0 +1,21 @@
+"""Disaggregated RowBlock data service (tf.data service, arXiv:2210.14826).
+
+One shared preprocessing tier feeds many trainer clients: a
+:class:`~dmlc_tpu.service.dispatcher.Dispatcher` owns split assignment
+(first-come-first-served, exactly-once per epoch, re-issue on worker
+death), tracker-launchable
+:class:`~dmlc_tpu.service.worker.ParseWorker` s run the existing
+parser/block-cache stack and stream parsed RowBlocks as length-prefixed
+CRC'd frames in the block-cache v1 segment encoding
+(:mod:`~dmlc_tpu.service.frame`), and the
+:class:`~dmlc_tpu.service.client.ServiceParser` is a drop-in parser with
+classified retry + worker failover that feeds ``DeviceIter`` unchanged.
+See docs/service.md.
+"""
+
+from dmlc_tpu.service.client import ServiceParser
+from dmlc_tpu.service.dispatcher import Dispatcher
+from dmlc_tpu.service.fleet import LocalFleet
+from dmlc_tpu.service.worker import ParseWorker
+
+__all__ = ["Dispatcher", "LocalFleet", "ParseWorker", "ServiceParser"]

@@ -1,0 +1,389 @@
+"""Data-service client: a drop-in RowBlock parser over the wire.
+
+:class:`ServiceParser` implements the :class:`~dmlc_tpu.data.parsers.Parser`
+contract against a dispatcher address, so it feeds ``DeviceIter`` (and
+``BasicRowIter``) unchanged — selected via
+``create_parser(service=...)`` / ``create_row_block_iter(service=...)``
+or a ``#service=<host:port>`` URI suffix.
+
+Delivery order is **part-major**: part 0's blocks, then part 1's, ...
+— exactly the stream a single host produces looping
+``create_parser(uri, p, num_parts)`` for ``p`` in order with the same
+config, so the delivered blocks (arrays AND resume annotations) are
+byte-identical to local parsing regardless of which workers parsed what.
+
+Fault tolerance composes the shared :mod:`dmlc_tpu.io.resilience`
+machinery: a broken stream (connection loss, torn frame, worker ERROR)
+is a classified retryable fault — the client reports the worker lost,
+waits for the dispatcher to re-issue the part, reconnects to the new
+owner, and resumes **at the exact block index** (``start=`` in the
+stream request), counting ``service_retries`` per interruption and
+``service_failovers`` when the resume landed on a different worker;
+exhausted budgets count ``service_giveups`` and surface as ``DMLCError``.
+
+Checkpoints: ``state_dict()`` is ``(part, block)`` — O(1) to restore
+into a **fresh** client/connection. ``load_state`` additionally accepts
+the parser chain's annotation states (the ``kind='split'``/``'chunks'``
+states a ``DeviceIter`` checkpoint embeds) by asking the serving workers
+to ``find`` the annotation in their frame stores — the service analog of
+``BlockCacheIter``'s stored-annotation match.
+"""
+
+from __future__ import annotations
+
+import socket
+import json
+import threading
+from typing import Dict, Optional
+
+from dmlc_tpu.data.parsers import Parser
+from dmlc_tpu.data.row_block import RowBlock
+from dmlc_tpu.io import resilience as _resilience
+from dmlc_tpu.service import dispatcher as _dispatch
+from dmlc_tpu.service.frame import (
+    KIND_BLOCK,
+    KIND_END,
+    KIND_ERROR,
+    ServiceFrameError,
+    annot_key,
+    block_from_frame,
+    recv_frame,
+)
+from dmlc_tpu.utils.check import DMLCError
+from dmlc_tpu.utils.timer import get_time
+
+_LOCATE_POLL_S = 0.05
+
+
+class ServiceUnavailableError(DMLCError):
+    """No live worker owns the requested part (yet). Retryable — it
+    consumes the client's stream-failure budget like any broken stream,
+    so a fleet that never recovers surfaces as a ``service_giveups``."""
+
+    def __init__(self, msg: str):
+        super().__init__(msg)
+        self.__cause__ = ConnectionError(msg)
+
+
+class ServiceParser(Parser):
+    """RowBlock stream served by a parse-worker fleet (one epoch pass =
+    one part-major visitation; ``before_first`` rewinds to part 0 —
+    workers re-serve from their frame stores, nothing re-parses)."""
+
+    def __init__(self, service: str,
+                 retry_policy: Optional["_resilience.RetryPolicy"] = None,
+                 connect_timeout: float = 10.0,
+                 stream_timeout: float = 300.0):
+        self.service = service
+        self._policy = retry_policy or _resilience.default_policy()
+        self._connect_timeout = float(connect_timeout)
+        # idle timeout on an ESTABLISHED stream, deliberately much larger
+        # than the policy's attempt timeout: a worker mid-parse (slow
+        # remote reads, its own retry backoffs) is slow, not dead —
+        # misclassifying it as lost would re-queue all its parts
+        self._stream_timeout = float(stream_timeout)
+        cfg = self._policy.call(
+            lambda: _dispatch.request(service, {"cmd": "config"}),
+            op="service_config", what=service)
+        self.uri = cfg["uri"]
+        self.num_parts = int(cfg["num_parts"])
+        self.parser_config = dict(cfg.get("parser") or {})
+        self._part = 0
+        self._pos = 0          # next block index within the current part
+        self._delivered = 0    # blocks delivered this epoch (all parts)
+        self._sock: Optional[socket.socket] = None
+        self._owner: Optional[str] = None
+        # the owner the dispatcher last pointed us at, kept across the
+        # connect itself: a located worker that refuses the connection is
+        # just as dead as one that drops mid-frame and must be reported,
+        # or the dispatcher keeps handing it out for the liveness window
+        self._pending_owner: Optional[str] = None
+        self._failover_from: Optional[str] = None
+        # owner already granted one same-owner retry for a torn frame
+        # (ServiceFrameError): the first CRC blip re-requests the exact
+        # block from the SAME worker; only a repeat escalates to
+        # report_lost (which re-queues the worker's whole share)
+        self._soft_retry_owner: Optional[str] = None
+        self._stream_failures = 0
+        self._bytes = 0
+        self._recv_seconds = 0.0
+        self._decode_seconds = 0.0
+        self._closed = threading.Event()
+        self._last_annot: Optional[dict] = None
+
+    # ---------------- connection plumbing ----------------
+
+    def _drop_stream(self) -> None:
+        sock, self._sock = self._sock, None
+        self._owner = None
+        # a pending owner is only blameable while ITS connect/stream is in
+        # flight: once the stream is dropped (END, epoch reset) a later
+        # fault must not report this — by then healthy — worker lost
+        self._pending_owner = None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _locate_owner(self) -> dict:
+        """Poll the dispatcher until the current part has a live owner.
+        Bounded by the policy's attempt timeout — a fleet with no live
+        worker must surface, not spin forever."""
+        deadline = get_time() + self._policy.attempt_timeout
+        while not self._closed.is_set():
+            resp = _dispatch.request(self.service,
+                                     {"cmd": "locate", "part": self._part})
+            if not resp.get("wait"):
+                return resp
+            if get_time() >= deadline:
+                break
+            self._closed.wait(_LOCATE_POLL_S)
+        raise ServiceUnavailableError(
+            f"service {self.service}: no live worker took part "
+            f"{self._part} within {self._policy.attempt_timeout:.0f}s")
+
+    def _ensure_stream(self) -> socket.socket:
+        if self._sock is not None:
+            return self._sock
+        owner = self._locate_owner()
+        self._pending_owner = str(owner["worker"])
+        sock = socket.create_connection(
+            (owner["host"], int(owner["port"])),
+            timeout=self._connect_timeout)
+        sock.settimeout(self._stream_timeout)
+        sock.sendall(json.dumps({
+            "cmd": "stream", "part": self._part, "start": self._pos,
+        }).encode() + b"\n")
+        self._sock = sock
+        self._owner = str(owner["worker"])
+        if self._failover_from is not None:
+            if self._owner != self._failover_from:
+                # resumed mid-part on a DIFFERENT worker: the failover
+                # the dispatcher's re-issue path exists for
+                _resilience.record_event("service_failovers")
+            self._failover_from = None
+        return sock
+
+    def _on_stream_fault(self, exc: BaseException) -> None:
+        """One broken stream: count it, tell the dispatcher, back off.
+        Budget: the shared policy's max_attempts of consecutive faults
+        with no delivered block in between."""
+        _resilience.record_event("service_retries")
+        lost = self._owner or self._pending_owner
+        self._pending_owner = None
+        self._drop_stream()
+        soft = (isinstance(exc, ServiceFrameError) and lost is not None
+                and lost != self._soft_retry_owner)
+        if soft:
+            # a torn frame from a live, talking worker (wire blip): the
+            # resume protocol re-requests the exact block — try the same
+            # owner once before report_lost re-queues its whole share
+            self._soft_retry_owner = lost
+            self._failover_from = lost
+        elif lost is not None:
+            self._failover_from = lost
+            try:
+                _dispatch.request(self.service,
+                                  {"cmd": "report_lost", "worker": lost})
+            except (OSError, DMLCError, ValueError):
+                pass  # dispatcher unreachable too: the locate poll decides
+        used = self._stream_failures
+        self._stream_failures += 1
+        if self._stream_failures >= self._policy.max_attempts:
+            _resilience.record_event("service_giveups")
+            raise DMLCError(
+                f"service {self.service}: part {self._part} stream failed "
+                f"{self._stream_failures} times (budget "
+                f"{self._policy.max_attempts}): {exc}") from exc
+        self._policy.sleep(self._policy.backoff(used))
+
+    # ---------------- Parser contract ----------------
+
+    def next_block(self) -> Optional[RowBlock]:
+        while self._part < self.num_parts:
+            t0 = get_time()
+            try:
+                sock = self._ensure_stream()
+                kind, meta, payload = recv_frame(sock)
+            except (ConnectionError, OSError, ValueError,
+                    ServiceFrameError, ServiceUnavailableError) as exc:
+                # ValueError: a torn dispatcher reply mid-crash is JSON
+                # garbage — the same transient fault as the connection
+                # dropping, so it must fail over, not kill the epoch
+                self._recv_seconds += get_time() - t0
+                self._on_stream_fault(exc)
+                continue
+            self._recv_seconds += get_time() - t0
+            if kind == KIND_BLOCK:
+                t1 = get_time()
+                block = block_from_frame(meta, payload)
+                self._decode_seconds += get_time() - t1
+                self._bytes += len(payload)
+                self._pos += 1
+                self._delivered += 1
+                self._stream_failures = 0  # progress resets the budget
+                self._soft_retry_owner = None
+                self._last_annot = meta.get("resume")
+                return block
+            if kind == KIND_END:
+                total = meta.get("blocks")
+                if total is not None and int(total) != self._pos:
+                    # the shipped count is the delivery cross-check: a
+                    # worker ending a part early (truncated parse marked
+                    # complete) must read as a fault to fail over, never
+                    # as a silently short epoch
+                    self._on_stream_fault(DMLCError(
+                        f"part {self._part} truncated: END after block "
+                        f"{self._pos} of {total}"))
+                    continue
+                self._drop_stream()
+                self._part += 1
+                self._pos = 0
+                continue
+            # KIND_ERROR (worker reassigned / parse failure): retryable —
+            # the dispatcher may have moved the part; ERROR text rides the
+            # chained cause for the give-up message
+            self._on_stream_fault(DMLCError(
+                f"worker error frame: {meta.get('error')}"
+                if kind == KIND_ERROR else f"unknown frame kind {kind}"))
+        return None
+
+    def before_first(self) -> None:
+        self._drop_stream()
+        self._part = 0
+        self._pos = 0
+        self._delivered = 0
+        self._stream_failures = 0
+        self._failover_from = None
+        self._soft_retry_owner = None
+        self._last_annot = None
+
+    # ---------------- checkpoint / resume ----------------
+
+    def state_dict(self) -> dict:
+        """O(1) resume point: the next (part, block) to deliver —
+        restorable into a fresh client against the same service."""
+        return {"kind": "service", "part": self._part, "block": self._pos,
+                "blocks": self._delivered}
+
+    def _part_query(self, part: int, req: dict) -> dict:
+        """One JSON request to the worker serving ``part`` (find/count),
+        under the shared retry policy with dispatcher-driven relocation.
+        The reply socket gets the stream (not attempt) timeout — the
+        worker legitimately blocks until the part is fully parsed, and
+        slow-mid-parse is not dead."""
+        def attempt():
+            owner = self._locate_with_part(part)
+            sock = socket.create_connection(
+                (owner["host"], int(owner["port"])),
+                timeout=self._connect_timeout)
+            try:
+                sock.settimeout(self._stream_timeout)
+                sock.sendall(json.dumps(dict(req, part=part)).encode()
+                             + b"\n")
+                with sock.makefile("rb") as f:
+                    line = f.readline()
+            finally:
+                sock.close()
+            if not line:
+                raise ConnectionError(f"part {part}: empty reply")
+            resp = json.loads(line)
+            if "error" in resp:
+                # the located worker cannot answer authoritatively (stale
+                # assignment, interrupted parse): heal exactly like the
+                # stream path — report it, let the dispatcher re-issue,
+                # and retry against the new owner. A wrong count/find
+                # would silently restore the wrong position.
+                try:
+                    _dispatch.request(self.service, {
+                        "cmd": "report_lost",
+                        "worker": str(owner["worker"])})
+                except (OSError, DMLCError, ValueError):
+                    pass
+                raise ServiceUnavailableError(
+                    f"part {part}: {resp['error']}")
+            return resp
+
+        return self._policy.call(attempt, op="service_query",
+                                 what=f"part {part}")
+
+    def _locate_with_part(self, part: int) -> dict:
+        prev = self._part
+        self._part = part
+        try:
+            return self._locate_owner()
+        finally:
+            self._part = prev
+
+    def _part_counts_until(self, stop_part: int) -> int:
+        """Total blocks in parts [0, stop_part) — the global-delivery
+        offset a (part, block) position corresponds to."""
+        return sum(int(self._part_query(p, {"cmd": "count"})["blocks"])
+                   for p in range(stop_part))
+
+    def load_state(self, state: dict) -> None:
+        self._drop_stream()
+        self._stream_failures = 0
+        self._failover_from = None
+        self._soft_retry_owner = None
+        self._last_annot = None
+        kind = state.get("kind")
+        if kind == "service":
+            self._part = int(state["part"])
+            self._pos = int(state["block"])
+            self._delivered = int(state.get(
+                "blocks", state.get("block", 0)))
+            return
+        if kind == "blocks" or kind == "block_cache":
+            # a delivered-block count maps onto the part-major order via
+            # the workers' per-part block counts
+            n = int(state.get("blocks", state.get("block", 0)))
+            part = 0
+            while part < self.num_parts:
+                c = int(self._part_query(part, {"cmd": "count"})["blocks"])
+                if n < c:
+                    break
+                n -= c
+                part += 1
+            self._part, self._pos = part, n
+            self._delivered = int(state.get("blocks",
+                                            state.get("block", 0)))
+            return
+        if kind in ("split", "chunks"):
+            if not state.get("chunks") and not state.get("blocks"):
+                self.before_first()  # epoch-start state
+                return
+            key = annot_key(state)
+            for part in range(self.num_parts):
+                idx = int(self._part_query(
+                    part, {"cmd": "find", "key": key})["block"])
+                if idx >= 0:
+                    # annotations mark the position AFTER their block
+                    self._part = part
+                    self._pos = idx + 1
+                    self._delivered = (self._part_counts_until(part)
+                                       + idx + 1)
+                    return
+            raise DMLCError(
+                f"service {self.service}: no serving worker holds a block "
+                f"matching the checkpoint annotation (stale state?)")
+        raise DMLCError(f"ServiceParser: unknown state kind {kind!r}")
+
+    # ---------------- metrics ----------------
+
+    def stage_seconds(self) -> Dict[str, float]:
+        """Frame recv waits report as the pipeline's ``read`` stage,
+        decode as ``parse`` — so ``DeviceIter.stats()`` attributes a
+        service-fed pipeline with the same keys as a local one (the
+        service-specific twins are the ``service_recv``/``service_decode``
+        spans)."""
+        return {"read": self._recv_seconds, "parse": self._decode_seconds}
+
+    @property
+    def bytes_read(self) -> int:
+        return self._bytes
+
+    def close(self) -> None:
+        self._closed.set()
+        self._drop_stream()

@@ -1,0 +1,256 @@
+"""Data-service dispatcher: split assignment + worker registry.
+
+The control plane of the disaggregated RowBlock service (tf.data
+service's dispatcher role, arXiv:2210.14826 §3): it owns ONE dataset —
+a URI, its partition count, and the parser config every worker must use
+— and hands the ``num_parts`` :class:`~dmlc_tpu.io.input_split.InputSplit`
+partitions to parse workers **first-come-first-served, exactly once per
+epoch**. A split is re-issued only when its owner is declared dead (a
+client reported a broken stream, or heartbeats went stale), and re-issued
+splits jump the queue so a mid-stream failover heals before new work
+starts.
+
+Protocol: one JSON object per connection (newline-terminated request,
+newline-terminated response — the same short-lived-connection shape the
+rabit tracker uses for ``heartbeat``/``metrics``). Commands:
+
+``config``                      -> the dataset spec workers/clients parse
+``register worker host port``   -> join the fleet (idempotent; a re-
+                                   registration after death re-queues
+                                   nothing — the worker starts fresh)
+``next_split worker``           -> ``{"part": k}`` | ``{"part": null}``
+                                   (nothing to do) — doubles as liveness
+``heartbeat worker``            -> liveness only
+``locate part``                 -> ``{"worker", "host", "port"}`` of the
+                                   live owner, or ``{"wait": true}`` while
+                                   the part awaits (re)assignment
+``report_lost worker``          -> a client observed the worker dead: all
+                                   its parts re-queue at the FRONT
+``status``                      -> registry snapshot (tests, operators)
+
+The dispatcher is deliberately dataset-state-free about *blocks*: block
+ordering, resume, and exactly-once delivery live with the client (global
+order is part-major), so the dispatcher never becomes a data-plane
+bottleneck — it serves O(workers + failovers) tiny requests per epoch.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+from dmlc_tpu.utils.timer import get_time
+
+logger = logging.getLogger("dmlc_tpu.service")
+
+
+class _WorkerInfo:
+    __slots__ = ("worker", "host", "port", "last_seen", "alive")
+
+    def __init__(self, worker: str, host: str, port: int, now: float):
+        self.worker = worker
+        self.host = host
+        self.port = port
+        self.last_seen = now
+        self.alive = True
+
+
+class Dispatcher:
+    """Split-assignment server for one dataset.
+
+    ``parser`` is the config dict every worker builds its parser from
+    (``format``/``type_``, ``chunk_bytes``, ``threaded``, ... — the
+    kwargs of :func:`dmlc_tpu.data.parsers.create_parser`); shipping it
+    from one place is what makes N workers' output byte-identical to a
+    local parse with the same config. ``liveness_timeout`` (seconds)
+    declares a worker dead when its polls/heartbeats go stale; client
+    ``report_lost`` reports short-circuit that wait.
+    """
+
+    def __init__(self, uri: str, num_parts: int,
+                 parser: Optional[dict] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 liveness_timeout: float = 10.0):
+        self.uri = uri
+        self.num_parts = int(num_parts)
+        self.parser = dict(parser or {})
+        self.liveness_timeout = float(liveness_timeout)
+        self._lock = threading.Lock()
+        self._workers: Dict[str, _WorkerInfo] = {}
+        # FCFS visitation queue: parts not yet assigned this epoch.
+        # Re-issued parts (dead owner) go to the FRONT so failover work
+        # heals before fresh parts are handed out.
+        self._todo: deque = deque(range(self.num_parts))
+        self._assigned: Dict[int, str] = {}   # part -> worker id
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._closed = False
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="service-dispatcher")
+        self._thread.start()
+        logger.info("dispatcher for %s (%d parts) on %s:%d",
+                    uri, num_parts, self.host, self.port)
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # ---------------- assignment core (lock held) ----------------
+
+    def _mark_dead_locked(self, worker: str) -> None:
+        info = self._workers.get(worker)
+        if info is None or not info.alive:
+            return
+        info.alive = False
+        lost = sorted(p for p, w in self._assigned.items() if w == worker)
+        for part in lost:
+            del self._assigned[part]
+        # re-issue at the front, lowest part first (clients consume
+        # part-major, so the earliest lost part is the one blocking them)
+        for part in reversed(lost):
+            self._todo.appendleft(part)
+        if lost:
+            logger.warning("dispatcher: worker %s lost; re-issuing parts %s",
+                           worker, lost)
+
+    def _reap_stale_locked(self, now: float) -> None:
+        if self.liveness_timeout <= 0:
+            return
+        for info in list(self._workers.values()):
+            if info.alive and now - info.last_seen > self.liveness_timeout:
+                logger.warning("dispatcher: worker %s missed heartbeats "
+                               "(last seen %.1fs ago)", info.worker,
+                               now - info.last_seen)
+                self._mark_dead_locked(info.worker)
+
+    # ---------------- request handlers ----------------
+
+    def _handle(self, req: dict) -> dict:
+        cmd = req.get("cmd")
+        now = get_time()
+        with self._lock:
+            if cmd == "config":
+                return {"uri": self.uri, "num_parts": self.num_parts,
+                        "parser": self.parser}
+            if cmd == "register":
+                worker = str(req["worker"])
+                self._workers[worker] = _WorkerInfo(
+                    worker, str(req["host"]), int(req["port"]), now)
+                return {"ok": True}
+            if cmd == "heartbeat":
+                info = self._workers.get(str(req.get("worker")))
+                if info is not None and info.alive:
+                    info.last_seen = now
+                return {"ok": True}
+            if cmd == "next_split":
+                worker = str(req["worker"])
+                info = self._workers.get(worker)
+                if info is None or not info.alive:
+                    # unregistered/declared-dead workers get no splits —
+                    # a zombie must re-register before it can own parts
+                    return {"part": None, "register": True}
+                info.last_seen = now
+                self._reap_stale_locked(now)
+                if not self._todo:
+                    return {"part": None}
+                part = self._todo.popleft()
+                self._assigned[part] = worker
+                logger.info("dispatcher: part %d -> worker %s", part, worker)
+                return {"part": part}
+            if cmd == "locate":
+                part = int(req["part"])
+                if not 0 <= part < self.num_parts:
+                    return {"error": f"part {part} out of range"}
+                self._reap_stale_locked(now)
+                owner = self._assigned.get(part)
+                info = self._workers.get(owner) if owner is not None else None
+                if info is None or not info.alive:
+                    return {"wait": True}
+                return {"worker": info.worker, "host": info.host,
+                        "port": info.port}
+            if cmd == "report_lost":
+                self._mark_dead_locked(str(req["worker"]))
+                return {"ok": True}
+            if cmd == "status":
+                return {
+                    "workers": {w: {"host": i.host, "port": i.port,
+                                    "alive": i.alive}
+                                for w, i in self._workers.items()},
+                    "assigned": {str(p): w
+                                 for p, w in self._assigned.items()},
+                    "todo": list(self._todo),
+                }
+        return {"error": f"unknown command {cmd!r}"}
+
+    # ---------------- server loop ----------------
+
+    def _serve(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # closed
+            # one thread per connection: requests are tiny, but a
+            # half-open client blocking the ONLY serve thread for its
+            # read timeout would queue every worker heartbeat behind it —
+            # long enough to trip the liveness reaper on a healthy fleet
+            threading.Thread(target=self._serve_one, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_one(self, conn) -> None:
+        try:
+            conn.settimeout(10.0)
+            with conn.makefile("rwb") as f:
+                line = f.readline()
+                if not line:
+                    return
+                try:
+                    req = json.loads(line)
+                    resp = self._handle(req)
+                except (ValueError, KeyError, TypeError) as exc:
+                    resp = {"error": f"bad request: {exc}"}
+                f.write(json.dumps(resp).encode() + b"\n")
+                f.flush()
+        except OSError as exc:
+            logger.debug("dispatcher: connection error: %s", exc)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def request(address: str, req: dict, timeout: float = 10.0) -> dict:
+    """One dispatcher round trip (shared by workers and clients).
+    ``address`` is ``host:port``. Transport failures surface as their
+    natural ConnectionError/OSError classes — callers run this under a
+    :class:`~dmlc_tpu.io.resilience.RetryPolicy` where retry is wanted."""
+    host, _, port = address.rpartition(":")
+    with socket.create_connection((host, int(port)), timeout=timeout) as s:
+        s.settimeout(timeout)
+        with s.makefile("rwb") as f:
+            f.write(json.dumps(req).encode() + b"\n")
+            f.flush()
+            line = f.readline()
+    if not line:
+        raise ConnectionError(f"dispatcher {address}: empty response")
+    resp = json.loads(line)
+    if "error" in resp:
+        from dmlc_tpu.utils.check import DMLCError
+
+        raise DMLCError(f"dispatcher {address}: {resp['error']}")
+    return resp

@@ -1,0 +1,103 @@
+"""Localhost fleet bootstrap: one dispatcher + N parse workers.
+
+The in-process form of the service deployment (tests, ``bench.py
+--service``, the docs example): multi-host launches reuse the tracker
+backends instead — export ``DMLC_SERVICE_DISPATCHER`` through the
+launcher env contract and run one :class:`~dmlc_tpu.service.worker.
+ParseWorker` per host (docs/service.md "Deploying").
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from dmlc_tpu.service.dispatcher import Dispatcher
+from dmlc_tpu.service.worker import ParseWorker
+
+
+class LocalFleet:
+    """1 dispatcher + ``num_workers`` workers over localhost TCP.
+
+    ``parser`` is the dispatcher-shipped parse config (see
+    :class:`~dmlc_tpu.service.dispatcher.Dispatcher`). With
+    ``tracker=True`` a rabit-protocol tracker is started too and every
+    worker fetches its rank from it and feeds the pod-telemetry table
+    over the ``metrics`` heartbeat (workers then bootstrap in parallel —
+    rank assignment is a barrier across the fleet).
+    """
+
+    def __init__(self, uri: str, num_parts: int, num_workers: int = 2,
+                 parser: Optional[dict] = None, tracker: bool = False,
+                 liveness_timeout: float = 10.0,
+                 poll_interval: float = 0.05,
+                 heartbeat_interval: float = 1.0):
+        self.dispatcher = Dispatcher(uri, num_parts, parser=parser,
+                                     liveness_timeout=liveness_timeout)
+        self.tracker = None
+        tracker_addr = None
+        if tracker:
+            from dmlc_tpu.tracker.tracker import RabitTracker
+
+            self.tracker = RabitTracker("127.0.0.1", num_workers)
+            self.tracker.start(num_workers)
+            tracker_addr = ("127.0.0.1", self.tracker.port)
+        self.workers: List[ParseWorker] = [None] * num_workers  # type: ignore[list-item]
+        errors: List[BaseException] = []
+
+        def boot(slot: int) -> None:
+            try:
+                self.workers[slot] = ParseWorker(
+                    self.dispatcher.address, tracker=tracker_addr,
+                    tracker_world=num_workers, poll_interval=poll_interval,
+                    heartbeat_interval=heartbeat_interval)
+            except BaseException as exc:  # noqa: BLE001 - re-raised below
+                errors.append(exc)
+
+        stuck = False
+        if tracker:
+            # rank assignment blocks until every worker joins: boot the
+            # fleet concurrently or the first constructor deadlocks
+            threads = [threading.Thread(target=boot, args=(i,),
+                                        daemon=True)
+                       for i in range(num_workers)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60.0)
+            if errors and any(t.is_alive() for t in threads):
+                # a failed sibling leaves the others blocked inside the
+                # rank-assignment barrier forever: break the barrier by
+                # closing the tracker, then reap the boot threads
+                self.tracker.close()
+                for t in threads:
+                    t.join(timeout=10.0)
+            stuck = any(t.is_alive() for t in threads)
+        else:
+            for i in range(num_workers):
+                boot(i)
+        if errors or stuck or any(w is None for w in self.workers):
+            # a half-booted fleet must not leak listeners/threads into the
+            # caller's process, and the real boot failure rides the raise
+            self.close()
+            raise RuntimeError("service fleet bootstrap failed") from (
+                errors[0] if errors else None)
+
+    @property
+    def address(self) -> str:
+        """The dispatcher address clients connect to."""
+        return self.dispatcher.address
+
+    def kill_worker(self, index: int) -> ParseWorker:
+        """Crash-simulate one worker (see :meth:`ParseWorker.kill`)."""
+        w = self.workers[index]
+        w.kill()
+        return w
+
+    def close(self) -> None:
+        for w in self.workers:
+            if w is not None:
+                w.close()
+        self.dispatcher.close()
+        if self.tracker is not None:
+            self.tracker.close()

@@ -1,0 +1,208 @@
+"""Service wire format v1: length-prefixed, CRC'd RowBlock frames.
+
+The payload of a BLOCK frame is the block-cache v1 **segment encoding**
+(:func:`dmlc_tpu.io.block_cache.write_segments` — canonical
+:data:`~dmlc_tpu.io.block_cache.SEGMENT_NAMES` order, 64-byte-aligned
+array starts, raw little-endian C-order bytes), so a parse worker's wire
+frame and its on-disk cache block are the same bytes modulo framing, and
+the client decodes with the exact zero-copy view machinery the warm
+cache reader uses (:func:`~dmlc_tpu.io.block_cache.read_segments`,
+:meth:`~dmlc_tpu.data.row_block.RowBlock.from_segments`).
+
+Frame layout (pinned by ``tests/data/service_frame_v1.golden``)::
+
+    [header]  magic "DSRV" (4B) + version u8 + kind u8 + 2 zero pad bytes
+              + meta_len u32 LE + payload_len u64 LE
+    [meta]    utf-8 JSON (sort_keys, compact): BLOCK frames carry
+              {"arrays": {name: [dtype_str, payload_offset, nbytes]},
+               "num_col", "resume", "rows"}; END frames {"blocks", "part"};
+              ERROR frames {"error"}
+    [payload] BLOCK only: the segment encoding (offset 0 is aligned)
+    [crc]     u32 LE crc32 over meta + payload
+
+Kinds: ``BLOCK`` (one RowBlock), ``END`` (part finished — carries the
+part's total block count so clients can cross-check delivery), ``ERROR``
+(the worker cannot serve; the client treats it as a retryable fault and
+fails over via the dispatcher). ``resume`` is the block's byte-exact
+resume annotation, shipped verbatim — a client-side checkpoint is
+therefore indistinguishable from one taken against local parsing.
+
+Integrity: the trailing crc covers meta + payload; a mismatch (torn
+write, flaky link) raises :class:`ServiceFrameError`, which classifies
+retryable — the client re-requests the block index from the dispatcher's
+current owner instead of delivering corrupt data.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+import zlib
+from typing import Optional, Tuple
+
+from dmlc_tpu.data.parsers import annot_key  # noqa: F401  (re-export: the
+# ONE annotation normalization the local cache match and the remote find
+# share — the service layer imports it from here, next to the codec)
+from dmlc_tpu.data.row_block import RowBlock
+from dmlc_tpu.io.block_cache import read_segments, write_segments
+from dmlc_tpu.utils import telemetry as _telemetry
+from dmlc_tpu.utils.check import DMLCError
+from dmlc_tpu.utils.timer import get_time
+
+FRAME_MAGIC = b"DSRV"
+FRAME_VERSION = 1
+
+KIND_BLOCK = 1
+KIND_END = 2
+KIND_ERROR = 3
+
+_HEADER_FMT = "<4sBB2xIQ"  # magic, version, kind, meta_len, payload_len
+HEADER_LEN = struct.calcsize(_HEADER_FMT)
+_CRC_FMT = "<I"
+_CRC_LEN = struct.calcsize(_CRC_FMT)
+
+# frames above this are refused at decode: a corrupt length prefix must
+# not make the client try to allocate terabytes (1 GiB >> any real block)
+MAX_FRAME_BYTES = 1 << 30
+
+
+class ServiceFrameError(DMLCError):
+    """Malformed/corrupt wire frame. Classified RETRYABLE by
+    :func:`dmlc_tpu.io.resilience.classify` (chained from ConnectionError)
+    — the client heals by re-requesting the block from the service."""
+
+    def __init__(self, msg: str):
+        # chain a ConnectionError cause so the shared classifier walks to
+        # a retryable class without a service-specific branch
+        super().__init__(msg)
+        self.__cause__ = ConnectionError(msg)
+
+
+def _pack(kind: int, meta: dict, payload: bytes = b"") -> bytes:
+    meta_raw = json.dumps(meta, sort_keys=True,
+                          separators=(",", ":")).encode()
+    crc = zlib.crc32(payload, zlib.crc32(meta_raw)) & 0xFFFFFFFF
+    header = struct.pack(_HEADER_FMT, FRAME_MAGIC, FRAME_VERSION, kind,
+                         len(meta_raw), len(payload))
+    return b"".join((header, meta_raw, payload, struct.pack(_CRC_FMT, crc)))
+
+
+def encode_block_frame(block: RowBlock,
+                       resume: Optional[dict] = None) -> bytes:
+    """One RowBlock (+ its resume annotation) as a BLOCK frame.
+
+    The annotation is JSON-normalized exactly as the block cache stores
+    it (tuples -> lists, key order fixed), so a block decoded from the
+    wire carries a byte-for-byte identical ``resume_state`` to one
+    delivered by local parsing through a cache.
+    """
+    t0 = get_time()
+    buf = io.BytesIO()
+    _, _, arrays = write_segments(buf, block.to_segments())
+    resume_json = (json.loads(json.dumps(resume))
+                   if resume is not None else None)
+    meta = {
+        "rows": len(block),
+        "num_col": block.num_col,
+        "resume": resume_json,
+        "arrays": arrays,
+    }
+    out = _pack(KIND_BLOCK, meta, buf.getvalue())
+    _telemetry.record_span("service_encode", t0, get_time() - t0,
+                           rows=len(block))
+    return out
+
+
+def encode_end_frame(part: int, blocks: int) -> bytes:
+    """End-of-part marker carrying the part's total block count."""
+    return _pack(KIND_END, {"part": int(part), "blocks": int(blocks)})
+
+
+def encode_error_frame(message: str) -> bytes:
+    return _pack(KIND_ERROR, {"error": str(message)})
+
+
+def decode_frame(data: bytes) -> Tuple[int, dict, bytes]:
+    """Split one raw frame into ``(kind, meta, payload)``; verifies magic,
+    version, and the trailing crc."""
+    if len(data) < HEADER_LEN + _CRC_LEN:
+        raise ServiceFrameError(f"service frame truncated ({len(data)}B)")
+    magic, version, kind, meta_len, payload_len = struct.unpack(
+        _HEADER_FMT, data[:HEADER_LEN])
+    if magic != FRAME_MAGIC:
+        raise ServiceFrameError(f"service frame: bad magic {magic!r}")
+    if version != FRAME_VERSION:
+        raise ServiceFrameError(
+            f"service frame: version {version} != {FRAME_VERSION}")
+    end = HEADER_LEN + meta_len + payload_len
+    if end + _CRC_LEN != len(data):
+        raise ServiceFrameError("service frame: length mismatch")
+    meta_raw = data[HEADER_LEN:HEADER_LEN + meta_len]
+    payload = data[HEADER_LEN + meta_len:end]
+    (crc,) = struct.unpack(_CRC_FMT, data[end:end + _CRC_LEN])
+    if zlib.crc32(payload, zlib.crc32(meta_raw)) & 0xFFFFFFFF != crc:
+        raise ServiceFrameError("service frame: crc mismatch")
+    try:
+        meta = json.loads(meta_raw)
+    except ValueError as exc:
+        raise ServiceFrameError(f"service frame: bad meta: {exc}") from exc
+    return kind, meta, payload
+
+
+def block_from_frame(meta: dict, payload: bytes) -> RowBlock:
+    """Rebuild the RowBlock a BLOCK frame carries; the arrays are
+    zero-copy views over ``payload`` (pinned via ``hold``), and the
+    stored resume annotation is re-attached verbatim."""
+    t0 = get_time()
+    segments = read_segments(payload, meta["arrays"])
+    block = RowBlock.from_segments(segments, hold=payload)
+    resume = meta.get("resume")
+    if resume is not None:
+        block.resume_state = resume
+    _telemetry.record_span("service_decode", t0, get_time() - t0,
+                           rows=len(block))
+    return block
+
+
+# ---------------- socket plumbing ----------------
+
+def recvall(sock, nbytes: int) -> bytes:
+    """Read exactly ``nbytes``; a peer hangup mid-message raises
+    ConnectionError (retryable — the client fails over)."""
+    chunks = []
+    nread = 0
+    while nread < nbytes:
+        chunk = sock.recv(min(nbytes - nread, 1 << 20))
+        if not chunk:
+            raise ConnectionError("service: peer closed mid-frame")
+        nread += len(chunk)
+        chunks.append(chunk)
+    return b"".join(chunks)
+
+
+def send_frame(sock, frame: bytes) -> None:
+    """Ship one encoded frame (``service_send`` span)."""
+    t0 = get_time()
+    sock.sendall(frame)
+    _telemetry.record_span("service_send", t0, get_time() - t0,
+                           nbytes=len(frame))
+
+
+def recv_frame(sock) -> Tuple[int, dict, bytes]:
+    """Read one frame off the socket (``service_recv`` span covers the
+    wire wait; decode is spanned separately by :func:`block_from_frame`)."""
+    t0 = get_time()
+    header = recvall(sock, HEADER_LEN)
+    magic, version, kind, meta_len, payload_len = struct.unpack(
+        _HEADER_FMT, header)
+    if magic != FRAME_MAGIC or version != FRAME_VERSION:
+        raise ServiceFrameError(
+            f"service frame: bad header (magic {magic!r} version {version})")
+    if meta_len + payload_len > MAX_FRAME_BYTES:
+        raise ServiceFrameError(
+            f"service frame: implausible length {meta_len + payload_len}")
+    rest = recvall(sock, meta_len + payload_len + _CRC_LEN)
+    _telemetry.record_span("service_recv", t0, get_time() - t0,
+                           nbytes=HEADER_LEN + len(rest))
+    return decode_frame(header + rest)

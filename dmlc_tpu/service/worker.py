@@ -1,0 +1,392 @@
+"""Data-service parse worker: claim splits, parse, stream frames.
+
+One worker of the disaggregated ingest fleet (arXiv:2210.14826 §3.2):
+it polls the :class:`~dmlc_tpu.service.dispatcher.Dispatcher` for
+partitions (first-come-first-served — a fast worker simply visits more
+splits), runs the **existing** parser stack on each
+(:func:`dmlc_tpu.data.parsers.create_parser` with the dispatcher-shipped
+config, optionally fronted by the parse-once
+:class:`~dmlc_tpu.data.parsers.BlockCacheIter` when the dispatcher
+config carries ``block_cache`` — a relaunched worker then re-serves its
+parts from the warm cache instead of re-parsing text), encodes every
+RowBlock into a wire frame at parse time
+(:func:`~dmlc_tpu.service.frame.encode_block_frame`, ``service_encode``
+spans), and serves ``stream``/``find``/``count`` requests from trainer
+clients over its own TCP listener (``service_send`` spans).
+
+Fleet bootstrap reuses the tracker layer: pass ``tracker=(uri, port)``
+and the worker fetches a stable rank from the rabit-protocol tracker
+(:class:`~dmlc_tpu.tracker.client.WorkerClient`) — its worker id becomes
+``rank<N>`` — and ships its telemetry registry to the tracker over the
+PR-6 ``metrics`` command (``start_heartbeat(metrics=True)``), so
+per-worker parse/encode/send seconds and ``service_*`` span counts land
+in the tracker's merged pod table next to every other rank.
+
+Failure model: :meth:`kill` simulates a crash — listener and client
+connections drop mid-frame, the dispatcher is NOT told (clients
+``report_lost`` it / heartbeats go stale), and the in-memory frame store
+is gone, exactly like a dead process. The dispatcher re-issues the dead
+worker's parts and a live worker re-parses them; parsing is
+deterministic, so the re-served frames are byte-identical.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from dmlc_tpu.service import dispatcher as _dispatch
+from dmlc_tpu.service.frame import (
+    annot_key,
+    encode_block_frame,
+    encode_end_frame,
+    encode_error_frame,
+    send_frame,
+)
+from dmlc_tpu.utils.check import DMLCError
+
+logger = logging.getLogger("dmlc_tpu.service")
+
+
+class _PartStore:
+    """Frames of one claimed part, appended as the parse progresses so a
+    client can stream a part that is still being parsed. Held in RAM for
+    the worker's life (warm epoch re-serves + O(1) failover resume) —
+    the fleet must be sized so each worker's share of the encoded corpus
+    fits its host (docs/service.md "Memory model")."""
+
+    __slots__ = ("frames", "keys", "complete", "error")
+
+    def __init__(self):
+        self.frames: List[bytes] = []
+        self.keys: List[Optional[str]] = []  # annot_key per block (or None)
+        self.complete = False
+        self.error: Optional[str] = None
+
+
+class ParseWorker:
+    """One tracker-launchable parse worker process/object."""
+
+    def __init__(self, dispatcher: str, worker_id: Optional[str] = None,
+                 host: str = "127.0.0.1",
+                 tracker: Optional[Tuple[str, int]] = None,
+                 tracker_world: int = -1,
+                 poll_interval: float = 0.2,
+                 heartbeat_interval: float = 2.0):
+        self.dispatcher = dispatcher
+        self.poll_interval = float(poll_interval)
+        self.heartbeat_interval = float(heartbeat_interval)
+        cfg = _dispatch.request(dispatcher, {"cmd": "config"})
+        self.uri = cfg["uri"]
+        self.num_parts = int(cfg["num_parts"])
+        self._parser_cfg = dict(cfg.get("parser") or {})
+        # data listener first: the tracker/dispatcher registrations carry
+        # its port
+        self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listen.bind((host, 0))
+        self._listen.listen(64)
+        self.host, self.port = self._listen.getsockname()[:2]
+        # optional rank bootstrap + pod-telemetry feed via the tracker
+        self.rank = -1
+        self._tracker_client = None
+        try:
+            if tracker is not None:
+                from dmlc_tpu.tracker.client import WorkerClient
+
+                self._tracker_client = WorkerClient(tracker[0], tracker[1])
+                self.rank = self._tracker_client.start(
+                    world_size=tracker_world).rank
+                self._tracker_client.start_heartbeat(
+                    interval=self.heartbeat_interval, metrics=True)
+            self.worker_id = worker_id or (
+                f"rank{self.rank}" if self.rank >= 0
+                else f"{self.host}:{self.port}")
+            self._cond = threading.Condition()
+            self._store: Dict[int, _PartStore] = {}
+            self._stop = threading.Event()
+            self._dead = False
+            self._conns: set = set()
+            self._conns_lock = threading.Lock()
+            _dispatch.request(dispatcher, {
+                "cmd": "register", "worker": self.worker_id,
+                "host": self.host, "port": self.port})
+        except BaseException:
+            # a failed bootstrap must not leak the bound listener or a
+            # live heartbeat thread for a worker that never existed
+            try:
+                self._listen.close()
+            except OSError:
+                pass
+            if self._tracker_client is not None:
+                self._tracker_client.close()
+                self._tracker_client = None
+            raise
+        self._threads = [
+            threading.Thread(target=self._serve_loop, daemon=True,
+                             name=f"service-worker-{self.worker_id}-serve"),
+            threading.Thread(target=self._split_loop, daemon=True,
+                             name=f"service-worker-{self.worker_id}-parse"),
+            threading.Thread(target=self._hb_loop, daemon=True,
+                             name=f"service-worker-{self.worker_id}-hb"),
+        ]
+        for t in self._threads:
+            t.start()
+        logger.info("parse worker %s serving on %s:%d", self.worker_id,
+                    self.host, self.port)
+
+    # ---------------- parse side ----------------
+
+    def _build_parser(self, part: int):
+        from dmlc_tpu.data.parsers import create_parser
+
+        kwargs = dict(self._parser_cfg)
+        type_ = kwargs.pop("format", kwargs.pop("type_", "auto"))
+        return create_parser(self.uri, part, self.num_parts, type_, **kwargs)
+
+    def _split_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                resp = _dispatch.request(
+                    self.dispatcher,
+                    {"cmd": "next_split", "worker": self.worker_id})
+            except (OSError, DMLCError, ValueError):
+                self._stop.wait(self.poll_interval)
+                continue
+            if resp.get("register"):
+                try:  # dispatcher restarted / declared us dead: rejoin
+                    _dispatch.request(self.dispatcher, {
+                        "cmd": "register", "worker": self.worker_id,
+                        "host": self.host, "port": self.port})
+                except (OSError, DMLCError, ValueError):
+                    pass
+                self._stop.wait(self.poll_interval)
+                continue
+            part = resp.get("part")
+            if part is None:
+                self._stop.wait(self.poll_interval)
+                continue
+            self._parse_part(int(part))
+
+    def _parse_part(self, part: int) -> None:
+        store = _PartStore()
+        with self._cond:
+            self._store[part] = store
+            self._cond.notify_all()
+        parser = None
+        try:
+            parser = self._build_parser(part)
+            while True:
+                if self._stop.is_set():
+                    return  # killed mid-parse: the part stays incomplete
+                block = parser.next_block()
+                if block is None:
+                    break
+                annot = getattr(block, "resume_state", None)
+                frame = encode_block_frame(block, annot)
+                with self._cond:
+                    store.frames.append(frame)
+                    store.keys.append(
+                        annot_key(annot) if annot is not None else None)
+                    self._cond.notify_all()
+        except Exception as exc:  # noqa: BLE001 - served to clients as ERROR
+            store.error = f"{type(exc).__name__}: {exc}"
+            logger.warning("worker %s: parse of part %d failed: %s",
+                           self.worker_id, part, store.error)
+        finally:
+            if parser is not None:
+                parser.close()
+            with self._cond:
+                store.complete = True
+                self._cond.notify_all()
+        logger.info("worker %s: part %d parsed (%d blocks)",
+                    self.worker_id, part, len(store.frames))
+
+    def _hb_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval):
+            try:
+                _dispatch.request(self.dispatcher, {
+                    "cmd": "heartbeat", "worker": self.worker_id})
+            except (OSError, DMLCError, ValueError):
+                pass  # dispatcher gone; the split loop surfaces that
+
+    # ---------------- serve side ----------------
+
+    def _serve_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listen.accept()
+            except OSError:
+                return  # listener closed (kill/close)
+            with self._conns_lock:
+                self._conns.add(conn)
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _wait_store(self, part: int, timeout: float = 5.0):
+        """The store of a part whose grant may still be in flight (the
+        dispatcher answered ``locate`` the instant it assigned the part);
+        None when this worker does not serve it."""
+        if not 0 <= part < self.num_parts:
+            return None
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: part in self._store or self._dead, timeout=timeout)
+            return self._store.get(part) if ok else None
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(60.0)
+            with conn.makefile("rb") as f:
+                line = f.readline()
+            req = json.loads(line) if line else {}
+            cmd = req.get("cmd")
+            try:
+                part = int(req.get("part", -1))
+            except (TypeError, ValueError):
+                part = -1  # "part": null etc — handlers answer with ERROR
+            if cmd == "stream":
+                self._serve_stream(conn, part, int(req.get("start", 0)))
+            elif cmd == "find":
+                self._serve_find(conn, part, str(req.get("key", "")))
+            elif cmd == "count":
+                self._serve_count(conn, part)
+            else:
+                send_frame(conn, encode_error_frame(
+                    f"unknown request {cmd!r}"))
+        except (OSError, ValueError):
+            pass  # client went away / garbage request: nothing to serve
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_stream(self, conn, part: int, start: int) -> None:
+        store = self._wait_store(part)
+        if store is None:
+            send_frame(conn, encode_error_frame(
+                f"worker {self.worker_id} does not serve part {part}"))
+            return
+        i = max(0, int(start))
+        while True:
+            with self._cond:
+                self._cond.wait_for(
+                    lambda: i < len(store.frames) or store.complete
+                    or self._dead)
+                if self._dead:
+                    return  # crash simulation: drop mid-stream, no goodbye
+                if i < len(store.frames):
+                    frame = store.frames[i]
+                elif store.error is not None:
+                    frame = encode_error_frame(store.error)
+                    send_frame(conn, frame)
+                    return
+                else:
+                    send_frame(conn,
+                               encode_end_frame(part, len(store.frames)))
+                    return
+            send_frame(conn, frame)  # the sendall runs outside the lock
+            i += 1
+
+    def _serve_find(self, conn, part: int, key: str) -> None:
+        """Block index whose resume annotation matches ``key`` — the
+        remote half of restoring a parser-chain checkpoint into a fresh
+        service client. Scans incrementally so a match early in a part
+        still being parsed answers without waiting for completion."""
+        store = self._wait_store(part)
+        found = -1
+        interrupted = error = None
+        if store is not None:
+            i = 0
+            with self._cond:
+                while True:
+                    while i < len(store.keys):
+                        if store.keys[i] == key:
+                            found = i
+                            break
+                        i += 1
+                    if found >= 0 or store.complete or self._dead:
+                        interrupted = self._dead and not store.complete
+                        error = store.error
+                        break
+                    self._cond.wait()
+        if found < 0 and (error or interrupted or store is None):
+            # a partial scan must not read as an authoritative miss
+            resp = {"block": -1,
+                    "error": error or f"part {part} not fully served"}
+        else:
+            resp = {"block": found}
+        conn.sendall(json.dumps(resp).encode() + b"\n")
+
+    def _serve_count(self, conn, part: int) -> None:
+        store = self._wait_store(part)
+        if store is None:
+            conn.sendall(json.dumps(
+                {"error": f"part {part} not served"}).encode() + b"\n")
+            return
+        with self._cond:
+            self._cond.wait_for(lambda: store.complete or self._dead)
+            n = len(store.frames)
+            partial = store.error or not store.complete
+            error = store.error
+        if partial:
+            # a truncated count is worse than no count: the client maps
+            # delivered-block offsets onto part boundaries with it
+            resp = {"error": error or f"part {part} count interrupted"}
+        else:
+            resp = {"blocks": n}
+        conn.sendall(json.dumps(resp).encode() + b"\n")
+
+    # ---------------- lifecycle ----------------
+
+    def _teardown(self) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        try:
+            self._listen.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def kill(self) -> None:
+        """Simulate a crash: every socket drops mid-whatever, the frame
+        store is abandoned, and NOBODY is notified — the dispatcher
+        learns from client ``report_lost`` / stale heartbeats."""
+        self._dead = True
+        self._teardown()
+        if self._tracker_client is not None:
+            # a dead process sends no shutdown; just stop local threads
+            self._tracker_client.stop_heartbeat()
+            self._tracker_client.close()
+            self._tracker_client = None
+
+    def close(self) -> None:
+        """Graceful shutdown (end of job)."""
+        self._dead = True
+        self._teardown()
+        if self._tracker_client is not None:
+            try:
+                if self.rank >= 0:
+                    self._tracker_client.shutdown()
+                else:
+                    self._tracker_client.close()
+            except (OSError, AssertionError):
+                self._tracker_client.close()
+            self._tracker_client = None

@@ -15,8 +15,11 @@ the hot path (each ring has exactly one writer: its thread) and bounded
 Every pipeline stage emits spans at the SAME code sites that feed the
 stage-seconds counters — read / parse in :mod:`dmlc_tpu.data.parsers`,
 cache_read there + cache_write in :mod:`dmlc_tpu.io.block_cache`,
-convert / dispatch / transfer in :mod:`dmlc_tpu.data.device` — so a trace
-timeline and ``DeviceIter.stats()`` can never tell different stories.
+convert / dispatch / transfer in :mod:`dmlc_tpu.data.device`, and the
+data-service wire quartet (service_encode / service_send on parse
+workers, service_recv / service_decode on clients,
+:mod:`dmlc_tpu.service.frame`) — so a trace timeline and
+``DeviceIter.stats()`` can never tell different stories.
 Export as Chrome-trace/Perfetto JSON via ``DMLC_TPU_TRACE=chrome:<path>``
 (dumped when the ``DeviceIter`` closes) or ``DeviceIter.dump_trace(path)``
 / :func:`export_chrome_trace`.

@@ -13,8 +13,14 @@ import os
 
 import numpy as np
 import pytest
-from hypothesis import HealthCheck, given, settings
-from hypothesis import strategies as st
+
+# hypothesis is an optional dev dependency: without it these properties
+# must SKIP at collection (pytest.importorskip), not error the whole
+# tier-1 collection run
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property tests need the hypothesis package")
+from hypothesis import HealthCheck, given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 from dmlc_tpu.data import create_parser
 from dmlc_tpu.io import create_input_split

@@ -192,6 +192,41 @@ class TestScoping:
         assert events[-1] == "late-owner"
         assert set(events) <= {None, "late-owner"}
 
+    def test_threaded_split_scope_captured_at_construction(self, tmp_path):
+        """Thread primitives the parser chain built BEFORE the DeviceIter
+        existed (the threaded input split starts prefetching at parser
+        construction) are stamped with the pipeline label AT ITERATOR
+        CONSTRUCTION — not on the first pull — so even the initial
+        prefetch window is scoped (the old adoption-window caveat is
+        gone from docs/observability.md)."""
+        from dmlc_tpu.data.device import DeviceIter
+        from dmlc_tpu.data.parsers import create_parser
+
+        p = tmp_path / "c.libsvm"
+        p.write_text("".join(f"{i % 2} 0:1.0 1:2.0\n" for i in range(200)))
+        parser = create_parser(str(p) + "?engine=python", 0, 1, "libsvm",
+                               threaded=True, parse_workers=1)
+        # the parse-ahead chain was built outside any scope: find its
+        # primitives and prove they are unscoped now, scoped after init
+        prims = []
+        stack = [parser]
+        while stack:
+            obj = stack.pop()
+            if obj is None:
+                continue
+            if hasattr(obj, "adopt_scope"):
+                prims.append(obj)
+            stack.extend(getattr(obj, n, None)
+                         for n in ("source", "base", "_base", "_iter"))
+        assert prims, "no thread primitive found in the parser chain"
+        assert all(prim._scope is None for prim in prims)
+        it = DeviceIter(parser, num_col=2, batch_size=32, layout="dense")
+        assert all(prim._scope == it.pipeline_label for prim in prims)
+        # and an already-scoped primitive is never re-labeled
+        prims[0].adopt_scope("someone-else")
+        assert prims[0]._scope == it.pipeline_label
+        it.close()
+
     def test_worker_pool_workers_inherit_scope(self):
         seen = set()
 
