@@ -12,7 +12,10 @@
 #                     attribution (read/cache_read/parse/convert/dispatch/
 #                     transfer), the block-cache epoch-pair fields
 #                     (warm_epoch_mb_per_sec/warm_vs_cold_speedup/
-#                     cache_state), the data-service leg (service_workers/
+#                     cache_state), the shuffle-native plan leg
+#                     (shuffled_warm_epoch_mb_per_sec/shuffle_overhead_pct
+#                     — a plan-ordered warm epoch on the same cache), the
+#                     data-service leg (service_workers/
 #                     service_mb_per_sec/service_vs_local_speedup from a
 #                     localhost 2-worker fleet), and the telemetry contract
 #                     (telemetry_schema_version + per-stage span counts)
@@ -81,6 +84,10 @@ bench-smoke:
 	        'warm_vs_cold_speedup missing'; \
 	    assert line.get('cache_state') == 'warm', \
 	        f\"cache_state {line.get('cache_state')!r} != 'warm'\"; \
+	    assert line.get('shuffled_warm_epoch_mb_per_sec'), \
+	        'shuffled_warm_epoch_mb_per_sec missing (plan leg did not run)'; \
+	    assert line.get('shuffle_overhead_pct') is not None, \
+	        'shuffle_overhead_pct missing'; \
 	    assert line.get('service_workers') == 2, \
 	        'service_workers missing (service leg did not run)'; \
 	    assert line.get('service_mb_per_sec'), \
@@ -104,6 +111,10 @@ bench-smoke:
 	    print('bench-smoke: block cache OK:', \
 	          line['warm_epoch_mb_per_sec'], 'MB/s warm, speedup x', \
 	          line['warm_vs_cold_speedup']); \
+	    print('bench-smoke: shuffled warm OK:', \
+	          line['shuffled_warm_epoch_mb_per_sec'], 'MB/s, overhead', \
+	          line['shuffle_overhead_pct'], 'pct, seed', \
+	          line.get('shuffle_seed')); \
 	    print('bench-smoke: data service OK:', \
 	          line['service_mb_per_sec'], 'MB/s with', \
 	          line['service_workers'], 'workers, vs-local x', \

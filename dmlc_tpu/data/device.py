@@ -1297,6 +1297,7 @@ class DeviceIter:
                 pstats = fn()
             except Exception:  # noqa: BLE001 - stats must never break stats
                 pstats = None
+        plan_state = getattr(self.source, "plan_state", None) or {}
         return {
             "batches": self.batches_fed,
             "bytes_to_device": self.bytes_to_device,
@@ -1307,6 +1308,12 @@ class DeviceIter:
             # shadow-writing), 'warm' (serving mmap'd parsed blocks), or
             # None when no block cache is armed (docs/data.md)
             "cache_state": getattr(self.source, "cache_state", None),
+            # the epoch planner's identity when the source serves a
+            # shuffle-native / pod-sharded cache: the seed and epoch every
+            # delivered byte is a function of, None with no plan armed
+            # (docs/data.md shuffle-native cache; docs/observability.md)
+            "shuffle_seed": plan_state.get("shuffle_seed"),
+            "epoch": plan_state.get("epoch"),
             "stall_seconds": self.stall_seconds,
             "host_stall_seconds": self.host_stall_seconds,
             "stages": self._attr.seconds(),

@@ -199,6 +199,9 @@ def create_row_block_iter(
     parse_workers: Optional[int] = None,
     block_cache: Optional[str] = None,
     service: Optional[str] = None,
+    shuffle_seed: Optional[int] = None,
+    shuffle_window: int = 0,
+    pod_sharding=False,
     **parser_kw,
 ) -> RowBlockIter:
     """RowBlockIter factory — analog of RowBlockIter::Create
@@ -223,13 +226,28 @@ def create_row_block_iter(
     locally — the drained parser is the drop-in
     :class:`~dmlc_tpu.service.client.ServiceParser` and the dispatcher
     owns the dataset spec (docs/service.md).
+
+    ``shuffle_seed`` / ``shuffle_window`` / ``pod_sharding`` arm the
+    deterministic epoch planner on the block cache exactly as in
+    :func:`~dmlc_tpu.data.parsers.create_parser` — the pod entry point:
+    ``create_row_block_iter(uri, block_cache=..., shuffle_seed=...,
+    pod_sharding=True)`` gives every host of an N-host pod its disjoint
+    shard of one globally consistent shuffled epoch, with
+    ``(host_id, num_hosts)`` resolved from the tracker env contract /
+    ``jax.distributed`` (docs/data.md shuffle-native cache section).
     """
     spec = URISpec(uri, part_index, num_parts)
     if service is None:
         service = spec.service
     if service is not None:
+        # forward the plan knobs so the service branch REJECTS them
+        # loudly (the dispatcher owns the plan) instead of silently
+        # serving unshuffled epochs the user asked to shuffle
         parser = create_parser(uri, part_index, num_parts, type_,
-                               index_dtype=index_dtype, service=service)
+                               index_dtype=index_dtype, service=service,
+                               shuffle_seed=shuffle_seed,
+                               shuffle_window=shuffle_window,
+                               pod_sharding=pod_sharding)
         return BasicRowIter(parser, silent=silent)
     # the cache here is the parsed-page cache (DiskRowIter); strip it before
     # the parser so the split layer does not also chunk-cache to the same
@@ -240,12 +258,26 @@ def create_row_block_iter(
         parser = create_parser(parser_uri, part_index, num_parts, type_,
                                index_dtype=index_dtype,
                                parse_workers=parse_workers,
-                               block_cache=block_cache, **parser_kw)
+                               block_cache=block_cache,
+                               shuffle_seed=shuffle_seed,
+                               shuffle_window=shuffle_window,
+                               pod_sharding=pod_sharding, **parser_kw)
         return BasicRowIter(parser, silent=silent)
+    # the #cachefile page cache replays its frozen build-pass row order
+    # every epoch — it cannot serve an epoch plan, and silently dropping
+    # the knobs would hand a user unshuffled epochs they asked to shuffle
+    check(shuffle_seed is None and shuffle_window == 0 and not pod_sharding,
+          "shuffle_seed/shuffle_window/pod_sharding cannot combine with "
+          "the #cachefile page cache (DiskRowIter replays its frozen "
+          "build order); use block_cache= for shuffle-native warm epochs "
+          "(docs/data.md)")
     if os.path.exists(spec.cache_file):
         return DiskRowIter(None, spec.cache_file, silent=silent)
     parser = create_parser(parser_uri, part_index, num_parts, type_,
                            index_dtype=index_dtype,
                            parse_workers=parse_workers,
-                           block_cache=block_cache, **parser_kw)
+                           block_cache=block_cache,
+                           shuffle_seed=shuffle_seed,
+                           shuffle_window=shuffle_window,
+                           pod_sharding=pod_sharding, **parser_kw)
     return DiskRowIter(parser, spec.cache_file, silent=silent)

@@ -26,6 +26,7 @@ import hashlib
 import json
 import os
 import threading
+import warnings
 from typing import Dict, Iterator, Optional
 
 import numpy as np
@@ -41,7 +42,8 @@ from dmlc_tpu.io.input_split import (
 from dmlc_tpu.io.threaded_iter import OrderedWorkerPool, ThreadedIter
 from dmlc_tpu.io.uri import URISpec
 from dmlc_tpu.utils import telemetry as _telemetry
-from dmlc_tpu.utils.check import CacheCorruptionError, DMLCError, check
+from dmlc_tpu.utils.check import (CacheCorruptionError, DMLCError, check,
+                                  get_logger)
 from dmlc_tpu.utils.params import Parameter, field
 from dmlc_tpu.utils.registry import Registry
 from dmlc_tpu.utils.timer import get_time
@@ -1203,13 +1205,32 @@ class BlockCacheIter(Parser):
       blocks), a fresh cache rewritten, and ``cache_corruptions`` /
       ``cache_rebuilds`` counted in the resilience counters — consumers
       see an unbroken, byte-identical block stream.
+
+    **Shuffle-native warm epochs** (the deterministic epoch planner,
+    :mod:`dmlc_tpu.data.epoch`): with ``shuffle_seed`` set, every warm
+    epoch serves the cached blocks through an
+    :class:`~dmlc_tpu.data.epoch.EpochPlan` — a seeded block permutation
+    plus a windowed intra-block row shuffle, both pure functions of
+    ``(seed, epoch)``, with ``num_hosts > 1`` restricting this host to its
+    disjoint round-robin shard of the one global order. A cold pass stays
+    sequential while shadow-writing (the blocks do not exist to permute
+    yet — the documented cold-epoch-0 caveat); the plan applies from the
+    first warm epoch, and the epoch counter advances on every
+    ``before_first``. Plan-mode blocks carry ``kind='epoch_plan'``
+    resume annotations — ``(seed, epoch, plan position)`` — so a
+    mid-epoch ``state_dict``/``load_state`` restore replays the stream
+    byte-identically, including into a fresh pipeline (docs/data.md).
     """
 
     def __init__(self, base, cache_file: str, signature: Optional[dict] = None,
-                 verify: bool = True):
+                 verify: bool = True, shuffle_seed: Optional[int] = None,
+                 shuffle_window: int = 0, host_id: int = 0,
+                 num_hosts: int = 1):
+        from dmlc_tpu.data import epoch as _epoch
         from dmlc_tpu.io import block_cache as _block_cache
 
         self._bc = _block_cache
+        self._ep = _epoch
         self._base_factory = base if callable(base) else (lambda: base)
         self._base: Optional[Parser] = base if not callable(base) else None
         self.cache_file = cache_file
@@ -1218,17 +1239,47 @@ class BlockCacheIter(Parser):
         self._reader = None
         self._writer = None
         self._mode = "cold"
-        self._pos = 0        # warm: next block index to serve
+        self._pos = 0        # warm: next plan position / block index
         self._skip = 0       # cold: blocks to shadow-write but not deliver
         self._shadow = True  # shadow-writing allowed for the current pass
         self._delivered = 0
         self._last_annot: Optional[dict] = None
         self._bytes = 0      # warm bytes served from the cache
         self._cache_read_seconds = 0.0
+        # ---- epoch-plan state (docstring: shuffle-native warm epochs) ----
+        check(num_hosts >= 1 and 0 <= host_id < num_hosts,
+              f"BlockCacheIter: host_id {host_id} not in [0, {num_hosts})")
+        self._seed = None if shuffle_seed is None else int(shuffle_seed)
+        self._window = int(shuffle_window)
+        self._host_id = int(host_id)
+        self._num_hosts = int(num_hosts)
+        self._epoch = 0           # advances on every before_first
+        self._plan = None         # per-epoch EpochPlan, built lazily warm
+        self._seq_restore = False  # serve this epoch's rest sequentially
+        #                           (a legacy/cold-order state was restored)
+        self._cold_seen = 0       # cold: blocks seen this pass (pre-filter)
+        # plan-ordered reads fan out over a small OrderedWorkerPool: a
+        # permuted serve materializes every block (crc + gather/copy —
+        # ~2x the sequential path's supply work), so loading block N+1
+        # must overlap delivering block N or the shuffle tax lands
+        # straight on the pipeline wall. Sequential warm serving stays
+        # single-threaded zero-copy.
+        self._plan_pool: Optional[OrderedWorkerPool] = None
+        self._plan_read_workers = max(1, int(os.environ.get(
+            "DMLC_TPU_PLAN_READ_WORKERS", "2") or 2))
+        self._cr_lock = threading.Lock()  # _cache_read_seconds writers
+        # per-block uniform-column-pattern verdicts (epoch-invariant —
+        # GIL-atomic dict ops, shared across plan-read workers)
+        self._uniform_cols: Dict[int, bool] = {}
         # DMLC_TPU_TRACE=1 extends profiler annotations to the warm cache
         # path (docs/data.md trace modes); cached once, not per block
         self._annotate = _telemetry.trace_mode()[0] == "annotate"
         self._open_reader()
+
+    @property
+    def _plan_armed(self) -> bool:
+        """A plan governs warm serving (seeded shuffle and/or sharding)."""
+        return self._seed is not None or self._num_hosts > 1
 
     # ---------------- mode plumbing ----------------
 
@@ -1237,6 +1288,23 @@ class BlockCacheIter(Parser):
         """``warm`` when blocks come from the cache, else ``cold`` —
         surfaced by ``DeviceIter.stats()['cache_state']``."""
         return "warm" if self._mode == "warm" else "cold"
+
+    @property
+    def plan_state(self) -> Optional[dict]:
+        """The epoch planner's live identity — ``None`` when no plan is
+        armed, else seed/epoch/position/sharding plus ``order``:
+        ``'plan'`` when the current pass serves in plan order,
+        ``'sequential'`` for cold passes and sequential restores.
+        Surfaced by ``DeviceIter.stats()['shuffle_seed'/'epoch']``
+        (docs/observability.md)."""
+        if not self._plan_armed:
+            return None
+        sequential = (self._mode != "warm" or self._seq_restore
+                      or self._seed is None)
+        return {"shuffle_seed": self._seed, "epoch": self._epoch,
+                "pos": self._pos, "window": self._window,
+                "host_id": self._host_id, "num_hosts": self._num_hosts,
+                "order": "sequential" if sequential else "plan"}
 
     @property
     def base(self) -> Parser:
@@ -1253,6 +1321,7 @@ class BlockCacheIter(Parser):
         self._reader = reader
         self._mode = "warm"
         self._pos = 0
+        self._uniform_cols.clear()  # verdicts are per published cache
         return True
 
     def _drop_reader(self) -> None:
@@ -1275,36 +1344,177 @@ class BlockCacheIter(Parser):
 
     def next_block(self) -> Optional[RowBlock]:
         if self._mode == "warm":
+            if self._plan_armed and not self._seq_restore:
+                return self._next_warm_plan()
             return self._next_warm()
         return self._next_cold()
 
     def _next_warm(self) -> Optional[RowBlock]:
         reader = self._reader
-        if self._pos >= reader.num_blocks:
-            return None
+        while self._pos < reader.num_blocks:
+            i = self._pos
+            if self._seq_restore and self._num_hosts > 1 \
+                    and i % self._num_hosts != self._host_id:
+                # sequential serving of a restored sharded cold stream:
+                # the round-robin delivery filter of the cold pass applies
+                # by sequential block index (== cold _cold_seen)
+                self._pos += 1
+                continue
+            t0 = get_time()
+            try:
+                with _telemetry.profiler_annotation("dmlc_tpu.cache_read",
+                                                    self._annotate):
+                    segments = reader.load_segments(i)
+            except CacheCorruptionError:
+                dt = get_time() - t0
+                self._cache_read_seconds += dt
+                _telemetry.record_span("cache_read", t0, dt)
+                self._heal_corruption()
+                return self._next_cold()
+            block = RowBlock.from_segments(segments, hold=reader.hold)
+            annot = reader.resume(i)
+            if annot is not None:
+                block.resume_state = annot
+            self._bytes += reader.block_nbytes(i)
+            dt = get_time() - t0
+            self._cache_read_seconds += dt
+            _telemetry.record_span("cache_read", t0, dt)
+            self._pos += 1
+            self._delivered += 1
+            self._last_annot = annot
+            return block
+        return None
+
+    def _ensure_plan(self):
+        if self._plan is None:
+            self._plan = self._ep.EpochPlan(
+                self._seed, self._epoch, self._reader.num_blocks,
+                num_hosts=self._num_hosts, host_id=self._host_id,
+                window=self._window)
+        return self._plan
+
+    def _plan_read_work(self, pos: int):
+        """One plan-ordered block load — the pool's PARALLEL stage. All
+        materialization happens HERE, inside the timed ``cache_read``
+        span: either the row gather copies or ``copy=`` does, so the
+        permuted pattern's page faults land under cache_read and never
+        leak into convert (docs/data.md)."""
+        plan = self._plan
+        reader = self._reader
+        bidx = plan.block_at(pos)
         t0 = get_time()
         try:
             with _telemetry.profiler_annotation("dmlc_tpu.cache_read",
                                                 self._annotate):
-                segments = reader.load_segments(self._pos)
-        except CacheCorruptionError:
+                rows = reader.block_rows(bidx)
+                rowperm = plan.row_order(bidx, rows)
+                segments = reader.load_segments(
+                    bidx, copy=rowperm is None and plan.permuted)
+                # a row-gathered block may pass permutation-invariant id
+                # arrays through as views — keep the mmap pinned then
+                hold = (None if rowperm is None and plan.permuted
+                        else reader.hold)
+                block = RowBlock.from_segments(segments, hold=hold)
+                if rowperm is not None:
+                    uniform = self._uniform_cols.get(bidx)
+                    if uniform is None:
+                        # one read-only pass, memoized: blocks recur every
+                        # epoch, so only the first epoch pays the scan
+                        uniform = self._ep.uniform_column_pattern(block)
+                        self._uniform_cols[bidx] = uniform
+                    block = self._ep.permute_block_rows(
+                        block, rowperm, uniform_columns=uniform)
+        finally:
             dt = get_time() - t0
-            self._cache_read_seconds += dt
+            with self._cr_lock:
+                self._cache_read_seconds += dt
             _telemetry.record_span("cache_read", t0, dt)
-            self._heal_corruption()
-            return self._next_cold()
-        block = RowBlock.from_segments(segments, hold=reader.hold)
-        annot = reader.resume(self._pos)
-        if annot is not None:
+        return block, reader.block_nbytes(bidx)
+
+    def _quiesce_plan_pool(self) -> None:
+        pool, self._plan_pool = self._plan_pool, None
+        if pool is not None:
+            pool.destroy()
+
+    def _ensure_plan_pool(self) -> OrderedWorkerPool:
+        if self._plan_pool is None:
+            plan = self._ensure_plan()
+            start = self._pos
+            self._plan_pool = OrderedWorkerPool(
+                lambda: iter(range(start, len(plan))),
+                self._plan_read_work,
+                num_workers=self._plan_read_workers,
+                max_ahead=2 * self._plan_read_workers,
+                counter_label="cache_read")
+        return self._plan_pool
+
+    def _next_warm_plan(self) -> Optional[RowBlock]:
+        plan = self._ensure_plan()
+        healed = 0
+        while self._pos < len(plan):
+            pool = self._ensure_plan_pool()
+            try:
+                item = pool.next()
+            except CacheCorruptionError:
+                check(healed == 0,
+                      f"block cache {self.cache_file}: still corrupt "
+                      "after a full rebuild")
+                healed += 1
+                self._quiesce_plan_pool()
+                self._rebuild_cache(corruption=True)
+                # the rebuild is deterministic: same blocks, same plan —
+                # re-arm the pool at the failed position and retry
+                continue
+            if item is None:
+                return None
+            block, nbytes = item
+            annot = plan.state(self._pos + 1)
             block.resume_state = annot
-        self._bytes += reader.block_nbytes(self._pos)
-        dt = get_time() - t0
-        self._cache_read_seconds += dt
-        _telemetry.record_span("cache_read", t0, dt)
-        self._pos += 1
-        self._delivered += 1
-        self._last_annot = annot
-        return block
+            self._bytes += nbytes
+            self._pos += 1
+            self._delivered += 1
+            self._last_annot = annot
+            return block
+        return None
+
+    def _rebuild_cache(self, corruption: bool = False) -> None:
+        """Plan-mode cache (re)build: drain the source into a fresh cache
+        in one silent pass, publish, reopen. Parsing is deterministic, so
+        the rebuilt blocks are byte-identical to the lost ones and the
+        plan stream continues unbroken at the same position."""
+        if corruption:
+            _resilience.record_event("cache_corruptions")
+            _resilience.record_event("cache_rebuilds")
+        self._drop_reader()
+        try:
+            os.remove(self.cache_file)
+        except OSError:
+            pass
+        self._abort_writer()
+        base = self.base
+        base.before_first()
+        writer = self._bc.BlockCacheWriter(self.cache_file,
+                                           signature=self._signature)
+        try:
+            while True:
+                block = base.next_block()
+                if block is None:
+                    break
+                check(hasattr(block, "to_segments"),
+                      "epoch plan requires columnar RowBlocks: the base "
+                      "parser emits an uncacheable block kind")
+                writer.add_block(block.to_segments(), rows=len(block),
+                                 num_col=block.num_col,
+                                 resume=getattr(block, "resume_state", None))
+            writer.finish()
+        except BaseException:
+            writer.abort()
+            raise
+        pos = self._pos  # _open_reader rewinds; the plan position survives
+        check(self._open_reader(),
+              f"block cache {self.cache_file}: rebuild did not publish a "
+              "readable cache")
+        self._pos = pos
 
     def _heal_corruption(self) -> None:
         """Warm block ``self._pos`` failed its integrity check: drop the
@@ -1324,6 +1534,7 @@ class BlockCacheIter(Parser):
         self._shadow = True
         self._skip = self._pos
         self._pos = 0
+        self._cold_seen = 0  # re-counts through the skipped prefix
         self.base.before_first()
 
     def _next_cold(self) -> Optional[RowBlock]:
@@ -1337,7 +1548,12 @@ class BlockCacheIter(Parser):
             if not hasattr(block, "to_segments"):
                 # non-RowBlock emits (a base with dense/COO mode already
                 # armed): pass through uncached — the cache stores the
-                # columnar CSR layout only
+                # columnar CSR layout only. An epoch plan cannot order
+                # blocks that never reach the cache, so the combination
+                # is rejected rather than silently serving unshuffled.
+                check(not self._plan_armed,
+                      "epoch plan requires columnar RowBlocks: the base "
+                      "parser emits an uncacheable block kind")
                 self._abort_writer()
                 self._shadow = False
             annot = getattr(block, "resume_state", None)
@@ -1345,9 +1561,25 @@ class BlockCacheIter(Parser):
             if writer is not None:
                 writer.add_block(block.to_segments(), rows=len(block),
                                  num_col=block.num_col, resume=annot)
+            seen = self._cold_seen
+            self._cold_seen += 1
             if self._skip > 0:
                 self._skip -= 1
                 continue
+            if self._num_hosts > 1 and seen % self._num_hosts != self._host_id:
+                # pod-sharded cold pass: every block is shadow-written,
+                # but delivery is round-robin by sequential block index —
+                # the hosts' cold streams stay disjoint and union to the
+                # corpus even before the first planned warm epoch
+                continue
+            if self._num_hosts > 1 and annot is not None:
+                # the checkpoint must carry the shard cursor: a plain
+                # split state restored later could not reconstruct how
+                # many blocks the filter had consumed (same shape as
+                # state_dict's cold wrapping — one builder, no drift)
+                annot = dict(self._plan_annot(0), cold=annot,
+                             seen=seen + 1)
+                block.resume_state = annot
             self._delivered += 1
             self._last_annot = annot
             return block
@@ -1355,6 +1587,16 @@ class BlockCacheIter(Parser):
     def before_first(self) -> None:
         # an interrupted cold pass cannot publish: drop the partial tmp
         self._abort_writer()
+        self._quiesce_plan_pool()
+        if self._delivered or self._pos or self._cold_seen:
+            # a pass actually ran: the rewind starts the NEXT epoch (the
+            # plan's permutation is keyed by this counter, so each warm
+            # epoch draws a fresh order; idempotent for back-to-back
+            # rewinds with nothing delivered in between)
+            self._epoch += 1
+        self._plan = None
+        self._seq_restore = False
+        self._cold_seen = 0
         self._skip = 0
         self._delivered = 0
         self._last_annot = None
@@ -1374,12 +1616,30 @@ class BlockCacheIter(Parser):
 
     # -------- checkpoint / resume --------
 
+    def _plan_annot(self, pos: int) -> dict:
+        """``(seed, epoch, plan position)`` — the epoch-plan resume
+        annotation (docs/data.md): everything a fresh pipeline needs to
+        replay the stream byte-identically from ``pos``. Delegates to the
+        ONE shape builder (:func:`dmlc_tpu.data.epoch.plan_state_dict`)."""
+        return self._ep.plan_state_dict(self._seed, self._window,
+                                        self._epoch, pos, self._host_id,
+                                        self._num_hosts)
+
     def state_dict(self) -> dict:
         if self._mode == "warm":
+            if self._plan_armed and not self._seq_restore:
+                return self._plan_annot(self._pos)
             return {"kind": "block_cache", "block": self._pos}
         if hasattr(self.base, "state_dict"):
-            return self.base.state_dict()
-        return {"kind": "blocks", "blocks": self._delivered}
+            base_state = self.base.state_dict()
+        else:
+            base_state = {"kind": "blocks", "blocks": self._delivered}
+        if self._num_hosts > 1:
+            # the sharded cold pass filters delivery by sequential block
+            # index: the checkpoint must carry that cursor too
+            return dict(self._plan_annot(0), cold=base_state,
+                        seen=self._cold_seen)
+        return base_state
 
     _annot_key = staticmethod(annot_key)
 
@@ -1399,6 +1659,12 @@ class BlockCacheIter(Parser):
 
     def load_state(self, state: dict) -> None:
         kind = state.get("kind")
+        if kind == "epoch_plan":
+            self._load_plan_state(state)
+            return
+        if self._plan_armed:
+            self._load_legacy_into_plan(state)
+            return
         if kind == "block_cache":
             n = int(state["block"])
             self._abort_writer()
@@ -1446,6 +1712,118 @@ class BlockCacheIter(Parser):
                               or 0)
         self._last_annot = None
 
+    def _load_plan_state(self, state: dict) -> None:
+        """Restore a ``kind='epoch_plan'`` state. The state's plan
+        identity (seed/window/epoch/sharding) is adopted WHOLESALE — the
+        state IS the stream position, and replay must be byte-identical
+        even into a pipeline constructed with different knobs."""
+        self._abort_writer()
+        self._quiesce_plan_pool()
+        seed = state.get("seed")
+        self._seed = None if seed is None else int(seed)
+        self._window = int(state.get("window", 0))
+        self._host_id = int(state.get("host_id", 0))
+        self._num_hosts = int(state.get("num_hosts", 1))
+        self._epoch = int(state.get("epoch", 0))
+        self._plan = None
+        self._skip = 0
+        if "cold" in state:
+            # a checkpoint from a sharded cold pass: the base annotation
+            # rides under 'cold', the shard cursor under 'seen'
+            cold = state["cold"]
+            seen = int(state.get("seen", 0))
+            if self._mode == "warm" or self._open_reader():
+                idx = self._find_block(cold) if cold is not None else None
+                if idx is not None:
+                    # the cache (now published) holds the cold stream:
+                    # serve its remainder sequentially with the shard
+                    # filter — exactly what the cold pass would deliver
+                    self._seq_restore = True
+                    self._pos = idx
+                    self._cold_seen = idx
+                    self._delivered = max(
+                        0, -(-(idx - self._host_id) // self._num_hosts))
+                    self._last_annot = dict(state)
+                    return
+                self._drop_reader()
+                self._mode = "cold"
+            # resume the sharded cold pass itself (mid-stream seek: this
+            # pass can no longer publish a complete cache)
+            self._seq_restore = False
+            self._shadow = False
+            self._mode = "cold"
+            if cold is not None and hasattr(self.base, "load_state"):
+                self.base.load_state(cold)
+            self._cold_seen = seen
+            self._delivered = max(
+                0, -(-(seen - self._host_id) // self._num_hosts))
+            self._last_annot = dict(state)
+            return
+        # plan-position state: (seed, epoch, pos) into the warm cache
+        target = int(state["pos"])
+        self._seq_restore = False
+        if self._mode != "warm" and not self._open_reader():
+            # cache gone: one silent full rebuild pass, then serve from
+            # the plan position (parsing is deterministic — the rebuilt
+            # blocks are the ones the state was taken over)
+            self._rebuild_cache()
+        self._pos = target
+        self._delivered = target
+        self._cold_seen = 0
+        self._last_annot = dict(state) if target else None
+
+    def _load_legacy_into_plan(self, state: dict) -> None:
+        """A sequential-order state (legacy warm ``block_cache`` position,
+        delivered-``blocks`` count, or a parser-chain ``split``/``chunks``
+        annotation from a cold pass) restored into a plan-armed pipeline:
+        the recorded position only exists in the SEQUENTIAL stream, so the
+        remainder of this epoch serves sequentially — byte-identical to
+        the stream the state came from — and the plan resumes at the next
+        ``before_first`` (docs/data.md)."""
+        kind = state.get("kind")
+        self._abort_writer()
+        self._quiesce_plan_pool()
+        self._skip = 0
+        if self._mode != "warm" and not self._open_reader():
+            if kind in ("block_cache", "blocks"):
+                # cache-relative positions only exist in the cache
+                self._rebuild_cache()
+            else:
+                self._legacy_cold_seek(state)
+                return
+        if kind == "block_cache":
+            idx: Optional[int] = int(state["block"])
+        elif kind == "blocks":
+            # delivered == sequential index in the unsharded legacy runs
+            # these states come from
+            idx = int(state["blocks"])
+        else:
+            idx = self._find_block(state)
+        if idx is None:
+            # annotation unknown to this cache (foreign/stale state):
+            # fall back to the parser chain, mid-stream
+            self._drop_reader()
+            self._mode = "cold"
+            self._legacy_cold_seek(state)
+            return
+        self._seq_restore = True
+        self._pos = idx
+        self._cold_seen = idx
+        self._delivered = idx
+        self._last_annot = (self._reader.resume(idx - 1) if idx else None)
+
+    def _legacy_cold_seek(self, state: dict) -> None:
+        """Mid-stream seek of the parser chain itself (the chunk count
+        approximates the shard cursor — exact for the non-empty-chunk
+        corpora the parsers emit 1:1)."""
+        self._seq_restore = False
+        self._shadow = False
+        self.base.load_state(state)
+        n = int(state.get("blocks", state.get("chunks", 0)) or 0)
+        self._cold_seen = n
+        self._delivered = n
+        self._last_annot = None
+
     # ---------------- metrics ----------------
 
     def stage_seconds(self) -> Dict[str, float]:
@@ -1471,6 +1849,7 @@ class BlockCacheIter(Parser):
 
     def close(self) -> None:
         self._abort_writer()
+        self._quiesce_plan_pool()
         self._drop_reader()
         if self._base is not None:
             self._base.close()
@@ -1572,6 +1951,12 @@ def _resolve_block_cache(spec: URISpec, part_index: int, num_parts: int,
     return path
 
 
+# intra-block row-shuffle window the legacy ``shuffle=True`` decorator arg
+# maps onto (it asked for record-level shuffling; the plan's windowed row
+# shuffle is its successor — docs/data.md deprecation note)
+LEGACY_SHUFFLE_WINDOW = 4096
+
+
 def create_parser(
     uri: str,
     part_index: int = 0,
@@ -1582,6 +1967,9 @@ def create_parser(
     parse_workers: Optional[int] = None,
     block_cache: Optional[str] = None,
     service: Optional[str] = None,
+    shuffle_seed: Optional[int] = None,
+    shuffle_window: int = 0,
+    pod_sharding=False,
     **split_kw,
 ) -> Parser:
     """Parser factory — analog of dmlc::Parser::Create (src/data.cc:62-85).
@@ -1610,6 +1998,22 @@ def create_parser(
     blocks over TCP — the dataset spec (URI, partitioning, parser
     config) is the DISPATCHER's; every other argument here is ignored
     (docs/service.md).
+
+    ``shuffle_seed`` arms the deterministic epoch planner
+    (:mod:`dmlc_tpu.data.epoch`) on the block cache: warm epochs serve
+    the cached blocks through a seeded per-epoch block permutation plus
+    a windowed intra-block row shuffle (``shuffle_window`` rows per
+    window; 0 = block-level shuffle only), with ``(seed, epoch, plan
+    position)`` recorded in the resume annotations for byte-identical
+    mid-epoch restores. ``pod_sharding`` additionally restricts this
+    host to its disjoint shard of the one global order — ``True``
+    resolves ``(host_id, num_hosts)`` from the tracker env contract /
+    ``jax.distributed`` (:func:`dmlc_tpu.parallel.distributed.
+    pod_identity`), or pass an explicit ``(host_id, num_hosts)`` tuple.
+    Both require ``block_cache``; the legacy split-layer ``shuffle`` /
+    ``num_shuffle_parts`` decorator args combined with ``block_cache``
+    are DEPRECATED and map onto these knobs for one release
+    (docs/data.md shuffle-native cache section).
     """
     spec = URISpec(uri, part_index, num_parts)
     if service is None:
@@ -1621,6 +2025,14 @@ def create_parser(
               "create_parser(service=...): client-side part_index/"
               "num_parts are not supported — the dispatcher owns the "
               "dataset's partitioning (docs/service.md)")
+        # same for the epoch plan: silently dropping the knobs would hand
+        # the user unshuffled epochs they asked to shuffle
+        check(shuffle_seed is None and shuffle_window == 0
+              and not pod_sharding,
+              "create_parser(service=...): client-side shuffle_seed/"
+              "shuffle_window/pod_sharding are not supported — the "
+              "dispatcher owns the dataset's plan (Dispatcher(plan=...), "
+              "docs/service.md plan distribution)")
         from dmlc_tpu.service.client import ServiceParser
 
         return ServiceParser(service)
@@ -1632,12 +2044,55 @@ def create_parser(
         # strip it so downstream engines see a plain URI
         uri = uri.split("#", 1)[0]
     if bc_path is None:
+        check(shuffle_seed is None and shuffle_window == 0
+              and not pod_sharding,
+              "shuffle_seed/shuffle_window/pod_sharding require a "
+              "block_cache: the epoch plan orders cached blocks "
+              "(docs/data.md)")
         return _create_parser_uncached(
             uri, spec, part_index, num_parts, type_, index_dtype, threaded,
             parse_workers, **split_kw)
-    check(not split_kw.get("shuffle") and not split_kw.get("num_shuffle_parts"),
-          "block_cache and shuffle decorators cannot be combined: the cache "
-          "would freeze the first epoch's order into every warm epoch")
+    if split_kw.get("shuffle") or split_kw.get("num_shuffle_parts"):
+        # the old hard rejection ("the cache would freeze the first
+        # epoch's order into every warm epoch") is gone: the epoch plan
+        # IS shuffled warm serving. Legacy decorator args map onto the
+        # plan knobs for one release, then the combination errors
+        # (docs/data.md deprecation note).
+        warnings.warn(
+            "block_cache + shuffle decorator args (shuffle/"
+            "num_shuffle_parts) now map onto the shuffle-native epoch "
+            "plan; pass shuffle_seed/shuffle_window directly — this "
+            "mapping will be removed in the next release (docs/data.md)",
+            DeprecationWarning, stacklevel=2)
+        if shuffle_seed is None:
+            shuffle_seed = int(split_kw.get("seed", 0) or 0)
+        if split_kw.pop("shuffle", None) and shuffle_window == 0:
+            shuffle_window = LEGACY_SHUFFLE_WINDOW
+        split_kw.pop("num_shuffle_parts", None)
+        # the seed now lives in the plan: leaving it in split_kw would
+        # bake it into the cache signature and force a full cold
+        # re-parse on every seed change (plan knobs are signature-free)
+        split_kw.pop("seed", None)
+        get_logger().warning(
+            "create_parser: mapping legacy shuffle decorator args onto "
+            "the epoch plan (effective shuffle_seed=%s, shuffle_window=%s)",
+            shuffle_seed, shuffle_window)
+    check(shuffle_window == 0 or shuffle_seed is not None,
+          "shuffle_window requires shuffle_seed: the row-shuffle rng is "
+          "keyed by the seed, so a window alone would silently serve "
+          "sequential epochs (docs/data.md)")
+    host_id, num_hosts = 0, 1
+    if pod_sharding:
+        if isinstance(pod_sharding, (tuple, list)):
+            host_id, num_hosts = int(pod_sharding[0]), int(pod_sharding[1])
+        else:
+            from dmlc_tpu.parallel.distributed import pod_identity
+
+            host_id, num_hosts = pod_identity()
+        check(num_parts == 1,
+              "pod_sharding shards the one logical epoch at the cache "
+              "block level; combining it with num_parts partitioning "
+              "would double-shard — use one or the other (docs/data.md)")
     from dmlc_tpu.io import block_cache as _block_cache
 
     # engine/worker knobs (threaded, parse_workers, engine=) are
@@ -1661,7 +2116,12 @@ def create_parser(
             uri, spec, part_index, num_parts, type_, index_dtype, threaded,
             parse_workers, **split_kw)
 
-    return BlockCacheIter(build, bc_path, signature=signature)
+    # plan knobs stay OUTSIDE the signature: the plan orders blocks at
+    # read time, so one cache serves every (seed, window, sharding)
+    return BlockCacheIter(build, bc_path, signature=signature,
+                          shuffle_seed=shuffle_seed,
+                          shuffle_window=shuffle_window,
+                          host_id=host_id, num_hosts=num_hosts)
 
 
 def _create_parser_uncached(

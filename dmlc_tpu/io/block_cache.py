@@ -295,8 +295,17 @@ class BlockCacheReader:
         e = self._blocks[i]
         return int(e["end"]) - int(e["pos"])
 
-    def load_segments(self, i: int) -> Dict[str, np.ndarray]:
+    def load_segments(self, i: int,
+                      copy: bool = False) -> Dict[str, np.ndarray]:
         """Decode block ``i`` to {name: zero-copy read-only numpy view}.
+
+        ``copy=True`` materializes the arrays into process memory instead
+        (no ``hold`` needed): plan-ordered warm epochs serve blocks in a
+        permuted pattern the OS readahead cannot predict, and the copy
+        forces the page faults to land HERE — inside the caller's timed
+        ``cache_read`` region — instead of leaking into whichever
+        downstream stage first touches the lazy views (the same
+        attribution class of bug PR 6 fixed for the serial path).
 
         Raises :class:`CacheCorruptionError` on a crc mismatch (or when a
         ``cache_read`` fault is injected) — callers heal by dropping the
@@ -313,7 +322,10 @@ class BlockCacheReader:
             if not ok:
                 raise CacheCorruptionError(
                     f"block cache {self.path}: crc mismatch on block {i}")
-        return read_segments(self._mm, entry["arrays"])
+        segments = read_segments(self._mm, entry["arrays"])
+        if copy:
+            segments = {k: np.array(v) for k, v in segments.items()}
+        return segments
 
     def close(self) -> None:
         # best-effort: the mmap cannot close while exported views are

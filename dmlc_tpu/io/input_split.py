@@ -1140,6 +1140,15 @@ class ShuffledInputSplit(InputSplit):
 
     Splits this rank's partition into ``num_shuffle_parts`` sub-partitions and
     visits them in a shuffled order each epoch (input_split_shuffle.h:19-60).
+
+    Relationship to the epoch planner: this decorator shuffles what gets
+    *read*, per epoch, on the parse path — combined with a block cache it
+    is superseded by the deterministic epoch plan
+    (:mod:`dmlc_tpu.data.epoch`), which shuffles what gets *served* from
+    the cache instead; ``create_parser`` maps the legacy
+    ``shuffle``/``num_shuffle_parts`` + ``block_cache`` combination onto
+    the plan knobs with a one-release deprecation (docs/data.md).
+    Uncached parsing keeps this decorator unchanged.
     """
 
     def __init__(

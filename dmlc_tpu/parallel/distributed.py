@@ -88,6 +88,43 @@ def init_from_env(
     return contract
 
 
+def pod_identity(env=None) -> "tuple[int, int]":
+    """``(host_id, num_hosts)`` of this process in the pod — the shard
+    identity the deterministic epoch planner's ``pod_sharding`` resolves
+    (:mod:`dmlc_tpu.data.epoch`, docs/data.md).
+
+    Resolution order mirrors how a pod process learns who it is:
+
+    1. the tracker env contract (``DMLC_TASK_ID`` / ``DMLC_NUM_WORKER``,
+       exported by every launcher backend incl. ``tpu-pod``) — available
+       before, and without, jax.distributed initialization;
+    2. an initialized ``jax.distributed`` runtime
+       (``process_index``/``process_count``) — covers processes
+       bootstrapped outside the dmlc tracker;
+    3. ``(0, 1)`` — single host.
+    """
+    e = os.environ if env is None else env
+    contract = EnvContract.from_env(env)
+    if contract.num_worker > 1:
+        if e.get("DMLC_TASK_ID") is None:
+            # EnvContract defaults task_id to 0 — trusting that here
+            # would hand EVERY host shard 0 (fully overlapping "disjoint"
+            # shards, most of the corpus never read, silently)
+            raise DMLCError(
+                "pod_identity: DMLC_NUM_WORKER is set but DMLC_TASK_ID "
+                "is not — every host would claim shard 0; launch through "
+                "a dmlc-submit backend or export both")
+        return contract.task_id, contract.num_worker
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            return jax.process_index(), jax.process_count()
+    except Exception:  # noqa: BLE001 - no jax runtime: single host
+        pass
+    return 0, 1
+
+
 def sync_min(value: int) -> int:
     """All-process minimum of a host integer (1 tiny collective).
 

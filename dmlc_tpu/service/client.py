@@ -88,6 +88,12 @@ class ServiceParser(Parser):
         self.uri = cfg["uri"]
         self.num_parts = int(cfg["num_parts"])
         self.parser_config = dict(cfg.get("parser") or {})
+        # the dispatcher-shipped epoch-plan identity (shuffle_seed /
+        # shuffle_window) — the seed the fleet's warm-cache serving is
+        # keyed by, surfaced so trainer-side planners agree with the
+        # workers on one global shuffle (docs/service.md)
+        self.plan = dict(cfg.get("plan") or {})
+        self.shuffle_seed = self.plan.get("shuffle_seed")
         self._part = 0
         self._pos = 0          # next block index within the current part
         self._delivered = 0    # blocks delivered this epoch (all parts)

@@ -74,10 +74,17 @@ class Dispatcher:
     def __init__(self, uri: str, num_parts: int,
                  parser: Optional[dict] = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 liveness_timeout: float = 10.0):
+                 liveness_timeout: float = 10.0,
+                 plan: Optional[dict] = None):
         self.uri = uri
         self.num_parts = int(num_parts)
         self.parser = dict(parser or {})
+        # the epoch-plan identity of the dataset (shuffle_seed /
+        # shuffle_window, dmlc_tpu/data/epoch.py): shipped in `config` so
+        # every worker arms its block cache with the SAME plan and every
+        # client learns the seed its epochs are a function of — the one
+        # place the fleet's shuffle is decided (docs/service.md)
+        self.plan = dict(plan or {})
         self.liveness_timeout = float(liveness_timeout)
         self._lock = threading.Lock()
         self._workers: Dict[str, _WorkerInfo] = {}
@@ -138,7 +145,7 @@ class Dispatcher:
         with self._lock:
             if cmd == "config":
                 return {"uri": self.uri, "num_parts": self.num_parts,
-                        "parser": self.parser}
+                        "parser": self.parser, "plan": self.plan}
             if cmd == "register":
                 worker = str(req["worker"])
                 self._workers[worker] = _WorkerInfo(

@@ -31,9 +31,11 @@ class LocalFleet:
                  parser: Optional[dict] = None, tracker: bool = False,
                  liveness_timeout: float = 10.0,
                  poll_interval: float = 0.05,
-                 heartbeat_interval: float = 1.0):
+                 heartbeat_interval: float = 1.0,
+                 plan: Optional[dict] = None):
         self.dispatcher = Dispatcher(uri, num_parts, parser=parser,
-                                     liveness_timeout=liveness_timeout)
+                                     liveness_timeout=liveness_timeout,
+                                     plan=plan)
         self.tracker = None
         tracker_addr = None
         if tracker:

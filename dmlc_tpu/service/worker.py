@@ -83,6 +83,15 @@ class ParseWorker:
         self.uri = cfg["uri"]
         self.num_parts = int(cfg["num_parts"])
         self._parser_cfg = dict(cfg.get("parser") or {})
+        # dispatcher-shipped epoch-plan identity, surfaced for clients /
+        # operators. Deliberately NOT folded into the worker's own parser
+        # builds: frames must stay parse-order — a relaunched worker
+        # re-serving a part from an already-published warm cache with a
+        # plan armed would serve PLAN order, and the client's
+        # failover-resume-at-block-index contract (byte-identity) would
+        # break. The seed is the fleet's shared metadata, not a worker
+        # serving mode (docs/service.md plan distribution).
+        self.plan = dict(cfg.get("plan") or {})
         # data listener first: the tracker/dispatcher registrations carry
         # its port
         self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -145,6 +154,11 @@ class ParseWorker:
 
         kwargs = dict(self._parser_cfg)
         type_ = kwargs.pop("format", kwargs.pop("type_", "auto"))
+        # plan knobs never reach the worker's parser (see __init__): the
+        # frame store must be parse-order for exact-block failover resume
+        kwargs.pop("shuffle_seed", None)
+        kwargs.pop("shuffle_window", None)
+        kwargs.pop("pod_sharding", None)
         return create_parser(self.uri, part, self.num_parts, type_, **kwargs)
 
     def _split_loop(self) -> None:

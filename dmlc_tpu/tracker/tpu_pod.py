@@ -14,6 +14,11 @@ ranks over sockets. On a TPU pod slice the placement is per-host
    :func:`dmlc_tpu.parallel.init_from_env`, which maps that contract onto
    the JAX coordinator (coordinator = tracker host, port + 1), and their
    InputSplit shard index is their process index (SURVEY.md §2.3 row 1).
+   The same ``DMLC_TASK_ID``/``DMLC_NUM_WORKER`` pair doubles as the pod
+   identity the deterministic epoch planner's ``pod_sharding`` resolves
+   (:func:`dmlc_tpu.parallel.distributed.pod_identity`): each launched
+   worker reads its disjoint shard of one globally consistent shuffled
+   epoch straight from the launcher env (docs/data.md).
 
 The job's data plane is XLA collectives over ICI — no peer sockets to
 broker, which is why this backend needs nothing beyond placement + env.

@@ -22,3 +22,23 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    """Environment-gate the ``jax_multiprocess`` marker (pyproject.toml):
+    this environment's CPU jaxlib cannot run multiprocess collectives
+    ('Multiprocess computations aren't implemented on the CPU backend'),
+    so the marked tests skip — with this reason, distinguishable from a
+    regression — unless DMLC_TPU_TEST_JAX_MULTIPROCESS=1 opts in on a
+    capable environment (real pod, or a multiprocess-capable jaxlib)."""
+    if os.environ.get("DMLC_TPU_TEST_JAX_MULTIPROCESS", "0") not in ("", "0"):
+        return
+    skip = pytest.mark.skip(
+        reason="known environment gap: jax.distributed multiprocess "
+               "collectives unsupported by this CPU jaxlib; set "
+               "DMLC_TPU_TEST_JAX_MULTIPROCESS=1 to run")
+    for item in items:
+        if "jax_multiprocess" in item.keywords:
+            item.add_marker(skip)

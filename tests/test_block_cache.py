@@ -336,11 +336,19 @@ class TestColdWarmParity:
         parser.close()
         assert resilience.counters_delta(base)["cache_invalidations"] == 1
 
-    def test_shuffle_refused(self, tmp_path):
+    def test_shuffle_maps_to_plan_with_deprecation(self, tmp_path):
+        # the old hard rejection is gone: legacy shuffle decorator args +
+        # block_cache now map onto the shuffle-native epoch plan with a
+        # one-release DeprecationWarning (docs/data.md)
         path = _write(tmp_path, "corpus.libsvm", _libsvm_text(n=50))
-        with pytest.raises(DMLCError):
-            create_parser(path, 0, 1, "libsvm", num_shuffle_parts=2,
-                          block_cache=str(tmp_path / "c.bc"))
+        with pytest.warns(DeprecationWarning, match="epoch plan"):
+            parser = create_parser(path, 0, 1, "libsvm", num_shuffle_parts=2,
+                                   seed=9, block_cache=str(tmp_path / "c.bc"))
+        try:
+            assert parser.plan_state is not None
+            assert parser.plan_state["shuffle_seed"] == 9
+        finally:
+            parser.close()
 
     def test_uri_suffix_and_env_dir(self, tmp_path, monkeypatch):
         path = _write(tmp_path, "corpus.libsvm", _libsvm_text(n=100))

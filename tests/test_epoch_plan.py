@@ -1,0 +1,522 @@
+"""Determinism property suite for the epoch planner (ISSUE 8).
+
+The contract the shuffle-native warm cache must hold (docs/data.md):
+
+- same ``(seed, epoch)`` => byte-identical stream, across runs and across
+  ``parse_workers`` settings (the cache content is engine-invariant, the
+  plan is a pure function);
+- different seed (or epoch) => different order with the identical
+  multiset of rows;
+- per-host shards of one epoch are disjoint and their union equals the
+  unsharded epoch;
+- a mid-epoch checkpoint restores byte-identically into a FRESH
+  pipeline, at the parser level and through ``DeviceIter``;
+- cold epoch 0 stays sequential while shadow-writing (the documented
+  caveat), the plan applies from the first warm epoch;
+- a corrupt plan-served block heals by rebuild, stream unbroken.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.data import create_parser
+from dmlc_tpu.data.epoch import (
+    EpochPlan,
+    block_permutation,
+    permute_block_rows,
+    row_permutation,
+    uniform_column_pattern,
+)
+from dmlc_tpu.data.row_block import RowBlock
+from dmlc_tpu.io import faults
+from dmlc_tpu.io.resilience import counters_delta, counters_snapshot
+
+N_ROWS = 1200
+CHUNK = 4096  # the split layer's minimum chunk hint -> ~8 blocks
+
+
+def _write_corpus(tmp_path, n=N_ROWS):
+    path = tmp_path / "plan.libsvm"
+    with open(path, "w") as f:
+        for i in range(n):
+            # label identifies the row; values identify it redundantly so
+            # row-level mixups cannot cancel out in comparisons
+            f.write(f"{i} 0:{i}.0 1:{i}.5 2:0.25\n")
+    return str(path)
+
+
+def _rows(parser):
+    """Drain to a list of per-row tuples — the byte-comparison unit."""
+    out = []
+    while (b := parser.next_block()) is not None:
+        for i in range(len(b)):
+            s, e = int(b.offset[i]), int(b.offset[i + 1])
+            out.append((float(b.label[i]), tuple(b.index[s:e].tolist()),
+                        tuple(np.asarray(b.value[s:e]).tolist())))
+    return out
+
+
+def _mk(path, cache, **kw):
+    kw.setdefault("threaded", False)
+    kw.setdefault("chunk_bytes", CHUNK)
+    return create_parser(path, 0, 1, "libsvm", block_cache=cache, **kw)
+
+
+# ---------------- plan unit properties ----------------
+
+class TestPlanUnit:
+    def test_block_permutation_pure_function(self):
+        a = block_permutation(7, 3, 50)
+        assert np.array_equal(a, block_permutation(7, 3, 50))
+        assert not np.array_equal(a, block_permutation(7, 4, 50))
+        assert not np.array_equal(a, block_permutation(8, 3, 50))
+        assert sorted(a.tolist()) == list(range(50))
+
+    def test_row_permutation_windowed_and_independent(self):
+        rp = row_permutation(7, 3, 5, rows=10, window=4)
+        # each window permutes only its own range
+        assert sorted(rp[:4].tolist()) == [0, 1, 2, 3]
+        assert sorted(rp[4:8].tolist()) == [4, 5, 6, 7]
+        assert sorted(rp[8:].tolist()) == [8, 9]
+        # keyed by (seed, epoch, block): computable without predecessors,
+        # different blocks draw different orders
+        assert np.array_equal(rp, row_permutation(7, 3, 5, 10, 4))
+        full_a = row_permutation(7, 3, 5, rows=64, window=64)
+        full_b = row_permutation(7, 3, 6, rows=64, window=64)
+        assert not np.array_equal(full_a, full_b)
+        # window<=1 / degenerate rows = identity
+        assert row_permutation(7, 3, 5, rows=10, window=0) is None
+        assert row_permutation(7, 3, 5, rows=1, window=8) is None
+
+    def test_shards_partition_the_global_order(self):
+        shards = [EpochPlan(7, 2, 23, num_hosts=3, host_id=h).order
+                  for h in range(3)]
+        union = np.concatenate(shards)
+        assert sorted(union.tolist()) == list(range(23))
+        assert abs(len(shards[0]) - len(shards[2])) <= 1
+        # sequential (seed=None) plan: identity order, still sharded
+        seq = EpochPlan(None, 2, 10, num_hosts=2, host_id=1)
+        assert seq.order.tolist() == [1, 3, 5, 7, 9]
+        assert not seq.permuted
+
+    def test_permute_block_rows_gathers_csr(self):
+        blk = RowBlock(offset=np.array([0, 2, 3, 6]),
+                       label=np.array([0.0, 1.0, 2.0], np.float32),
+                       index=np.array([10, 11, 20, 30, 31, 32], np.uint64),
+                       value=np.array([1, 2, 3, 4, 5, 6], np.float32),
+                       weight=np.array([.1, .2, .3], np.float32),
+                       qid=np.array([5, 6, 7]))
+        out = permute_block_rows(blk, np.array([2, 0, 1]))
+        assert out.label.tolist() == [2.0, 0.0, 1.0]
+        assert out.offset.tolist() == [0, 3, 5, 6]
+        assert out.index.tolist() == [30, 31, 32, 10, 11, 20]
+        assert out.value.tolist() == [4, 5, 6, 1, 2, 3]
+        assert out.weight.tolist() == pytest.approx([.3, .1, .2])
+        assert out.qid.tolist() == [7, 5, 6]
+        assert not uniform_column_pattern(blk)  # ragged rows
+
+    def test_uniform_column_pattern_skips_id_gathers(self):
+        # HIGGS/Criteo-like: every row carries the same column ids, so
+        # index is permutation-invariant and passes through un-gathered
+        n, k = 4, 3
+        blk = RowBlock(
+            offset=np.arange(0, (n + 1) * k, k),
+            label=np.arange(n, dtype=np.float32),
+            index=np.tile(np.array([5, 7, 9], np.uint64), n),
+            value=np.arange(n * k, dtype=np.float32))
+        assert uniform_column_pattern(blk)
+        perm = np.array([3, 1, 0, 2])
+        fast = permute_block_rows(blk, perm, uniform_columns=True)
+        slow = permute_block_rows(blk, perm, uniform_columns=False)
+        assert fast.index is blk.index  # invariant array passed through
+        assert np.array_equal(fast.index, slow.index)
+        assert np.array_equal(fast.value, slow.value)
+        assert np.array_equal(fast.label, slow.label)
+        # mixed column ids must fail the detection
+        ragged_ids = RowBlock(
+            offset=np.arange(0, (n + 1) * k, k),
+            label=np.arange(n, dtype=np.float32),
+            index=np.arange(n * k, dtype=np.uint64))
+        assert not uniform_column_pattern(ragged_ids)
+
+
+# ---------------- end-to-end determinism ----------------
+
+class TestDeterminism:
+    def test_cold_sequential_then_planned_warm_epochs(self, tmp_path):
+        path = _write_corpus(tmp_path)
+        parser = _mk(path, str(tmp_path / "c.bc"),
+                     shuffle_seed=42, shuffle_window=8)
+        cold = _rows(parser)
+        assert [r[0] for r in cold] == [float(i) for i in range(N_ROWS)], \
+            "cold epoch 0 must stay sequential while shadow-writing"
+        parser.before_first()
+        assert parser._reader.num_blocks > 4  # the plan has blocks to order
+        warm1 = _rows(parser)
+        parser.before_first()
+        warm2 = _rows(parser)
+        parser.close()
+        assert sorted(warm1) == sorted(cold) and warm1 != cold
+        assert sorted(warm2) == sorted(cold) and warm2 != warm1, \
+            "each epoch draws a fresh permutation"
+
+    def test_same_seed_epoch_byte_identical_across_runs_and_engines(
+            self, tmp_path):
+        path = _write_corpus(tmp_path)
+        # two caches built by different engines/fan-outs...
+        streams = {}
+        for tag, kw in (("w1", dict(parse_workers=1)),
+                        ("w4", dict(threaded=True, parse_workers=4))):
+            cache = str(tmp_path / f"{tag}.bc")
+            build = _mk(path, cache, shuffle_seed=9, shuffle_window=16, **kw)
+            _rows(build)
+            build.close()
+            # ...serve a fresh warm pipeline each: epoch 0 plan order
+            warm = _mk(path, cache, shuffle_seed=9, shuffle_window=16)
+            streams[tag] = _rows(warm)
+            warm.close()
+        assert streams["w1"] == streams["w4"], \
+            "same (seed, epoch) => byte-identical across parse_workers"
+        again = _mk(path, str(tmp_path / "w1.bc"),
+                    shuffle_seed=9, shuffle_window=16)
+        assert _rows(again) == streams["w1"], "and across runs"
+        again.close()
+
+    def test_different_seed_different_order_same_multiset(self, tmp_path):
+        path = _write_corpus(tmp_path)
+        cache = str(tmp_path / "c.bc")
+        build = _mk(path, cache)
+        base = _rows(build)
+        build.close()
+        a = _mk(path, cache, shuffle_seed=1, shuffle_window=32)
+        b = _mk(path, cache, shuffle_seed=2, shuffle_window=32)
+        ra, rb = _rows(a), _rows(b)
+        a.close(), b.close()
+        assert ra != rb
+        assert sorted(ra) == sorted(rb) == sorted(base)
+
+    def test_pod_shards_disjoint_union_equals_epoch(self, tmp_path):
+        path = _write_corpus(tmp_path)
+        cache = str(tmp_path / "c.bc")
+        build = _mk(path, cache)
+        _rows(build)
+        build.close()
+        kw = dict(shuffle_seed=7, shuffle_window=8)
+        shards = []
+        for h in range(3):
+            p = _mk(path, cache, pod_sharding=(h, 3), **kw)
+            assert p.plan_state["num_hosts"] == 3
+            shards.append(_rows(p))
+            p.close()
+        full = _mk(path, cache, **kw)
+        whole = _rows(full)
+        full.close()
+        sets = [set(s) for s in shards]
+        assert sets[0].isdisjoint(sets[1]) and sets[0].isdisjoint(sets[2]) \
+            and sets[1].isdisjoint(sets[2])
+        assert sorted(sum(shards, [])) == sorted(whole)
+        # row orders inside a block are host-independent: each shard's
+        # stream is a subsequence-by-blocks of the global plan's serve
+        assert all(s != whole for s in shards)
+
+    def test_sharded_cold_pass_disjoint_too(self, tmp_path):
+        path = _write_corpus(tmp_path)
+        streams = []
+        for h in range(2):
+            cache = str(tmp_path / f"h{h}.bc")  # per-host cache files
+            p = _mk(path, cache, shuffle_seed=3, pod_sharding=(h, 2))
+            streams.append(_rows(p))
+            p.close()
+        assert set(streams[0]).isdisjoint(streams[1])
+        union = sorted(streams[0] + streams[1])
+        assert [r[0] for r in union] == [float(i) for i in range(N_ROWS)]
+
+    def test_mid_epoch_resume_byte_identical_fresh_pipeline(self, tmp_path):
+        path = _write_corpus(tmp_path)
+        cache = str(tmp_path / "c.bc")
+        kw = dict(shuffle_seed=5, shuffle_window=8)
+        build = _mk(path, cache, **kw)
+        _rows(build)
+        build.close()
+        parser = _mk(path, cache, **kw)
+        head = []
+        for _ in range(3):
+            b = parser.next_block()
+            for i in range(len(b)):
+                head.append(float(b.label[i]))
+        state = parser.state_dict()
+        assert state["kind"] == "epoch_plan"
+        assert state["seed"] == 5 and state["pos"] == 3  # (seed,epoch,pos)
+        tail = _rows(parser)
+        parser.close()
+        fresh = _mk(path, cache, **kw)
+        fresh.load_state(state)
+        assert _rows(fresh) == tail
+        fresh.close()
+        # the state even restores into a pipeline built with DIFFERENT
+        # knobs: the annotation's plan identity wins (byte-identity first)
+        other = _mk(path, cache, shuffle_seed=99, shuffle_window=2)
+        other.load_state(state)
+        assert _rows(other) == tail
+        other.close()
+
+    def test_deviceiter_checkpoint_restores_plan_stream(self, tmp_path):
+        from dmlc_tpu.data.device import DeviceIter
+
+        path = _write_corpus(tmp_path)
+        cache = str(tmp_path / "c.bc")
+        kw = dict(shuffle_seed=11, shuffle_window=8)
+        build = _mk(path, cache, **kw)
+        _rows(build)
+        build.close()
+
+        def harvest(it, limit=None):
+            out = []
+            for x, y, w in it:
+                out.append(np.asarray(y).tolist())
+                if limit and len(out) >= limit:
+                    break
+            return out
+
+        it = DeviceIter(_mk(path, cache, **kw), num_col=3, batch_size=128,
+                        layout="dense")
+        head = harvest(it, limit=3)
+        state = it.state_dict()
+        stats = it.stats()
+        assert stats["shuffle_seed"] == 11 and stats["epoch"] == 0
+        assert stats["cache_state"] == "warm"
+        assert stats["stages"].get("cache_read", 0.0) > 0.0
+        tail = harvest(it)
+        it.close()
+        it2 = DeviceIter(_mk(path, cache, **kw), num_col=3, batch_size=128,
+                         layout="dense")
+        it2.load_state(state)
+        tail2 = harvest(it2)
+        it2.close()
+        assert tail2 == tail, \
+            "mid-epoch DeviceIter restore replays byte-identically"
+
+    def test_cold_state_restores_into_plan_pipeline_sequentially(
+            self, tmp_path):
+        path = _write_corpus(tmp_path)
+        cache = str(tmp_path / "c.bc")
+        cold = _mk(path, cache, shuffle_seed=4)
+        for _ in range(3):
+            cold.next_block()
+        state = cold.state_dict()  # a parser-chain split state
+        rest_cold = _rows(cold)  # completing the pass publishes the cache
+        cold.close()
+        assert os.path.exists(cache)
+        # restore the cold checkpoint into a warm plan-armed pipeline:
+        # the remainder must match the cold stream (sequential), the plan
+        # only resuming at the next epoch
+        warm = _mk(path, cache, shuffle_seed=4)
+        warm.load_state(state)
+        assert warm.plan_state["order"] == "sequential"
+        assert _rows(warm) == rest_cold
+        # ...and the NEXT epoch returns to plan order
+        warm.before_first()
+        nxt = _rows(warm)
+        assert sorted(nxt) == sorted(rest_cold + _head_rows(path, 3))
+        assert warm.plan_state["order"] == "plan"
+        warm.close()
+
+
+def _head_rows(path, nblocks):
+    """The first ``nblocks`` blocks' rows of a sequential parse."""
+    p = create_parser(path, 0, 1, "libsvm", threaded=False,
+                      chunk_bytes=CHUNK)
+    out = []
+    for _ in range(nblocks):
+        b = p.next_block()
+        for i in range(len(b)):
+            s, e = int(b.offset[i]), int(b.offset[i + 1])
+            out.append((float(b.label[i]), tuple(b.index[s:e].tolist()),
+                        tuple(np.asarray(b.value[s:e]).tolist())))
+    p.close()
+    return out
+
+
+# ---------------- resilience + plumbing ----------------
+
+class TestPlanResilience:
+    def test_corrupt_plan_block_heals_by_rebuild(self, tmp_path):
+        path = _write_corpus(tmp_path)
+        cache = str(tmp_path / "c.bc")
+        kw = dict(shuffle_seed=6, shuffle_window=8)
+        build = _mk(path, cache, **kw)
+        _rows(build)
+        build.close()
+        clean = _mk(path, cache, **kw)
+        expect = _rows(clean)
+        clean.close()
+        before = counters_snapshot()
+        with faults.inject("cache_read@3=corrupt"):
+            parser = _mk(path, cache, **kw)
+            healed = _rows(parser)
+            parser.close()
+        delta = counters_delta(before)
+        assert healed == expect, "stream unbroken through the rebuild"
+        assert delta.get("cache_corruptions") == 1
+        assert delta.get("cache_rebuilds") == 1
+
+    def test_plan_state_restore_rebuilds_missing_cache(self, tmp_path):
+        path = _write_corpus(tmp_path)
+        cache = str(tmp_path / "c.bc")
+        kw = dict(shuffle_seed=8, shuffle_window=4)
+        build = _mk(path, cache, **kw)
+        _rows(build)
+        build.close()
+        parser = _mk(path, cache, **kw)
+        for _ in range(2):
+            parser.next_block()
+        state = parser.state_dict()
+        tail = _rows(parser)
+        parser.close()
+        os.remove(cache)  # the cache vanishes between save and restore
+        fresh = _mk(path, cache, **kw)
+        fresh.load_state(state)
+        assert _rows(fresh) == tail
+        fresh.close()
+        assert os.path.exists(cache), "restore republished the cache"
+
+    def test_one_cache_serves_every_plan(self, tmp_path):
+        # plan knobs are outside the cache signature: arming/armless and
+        # different seeds must NOT invalidate (no rebuild between them)
+        path = _write_corpus(tmp_path)
+        cache = str(tmp_path / "c.bc")
+        build = _mk(path, cache)
+        _rows(build)
+        build.close()
+        mtime = os.path.getmtime(cache)
+        for kw in (dict(shuffle_seed=1), dict(shuffle_seed=2),
+                   dict(shuffle_seed=1, pod_sharding=(0, 2)), {}):
+            p = _mk(path, cache, **kw)
+            assert p.cache_state == "warm"
+            p.next_block()
+            p.close()
+        assert os.path.getmtime(cache) == mtime
+
+
+class TestPlumbing:
+    def test_plan_requires_block_cache(self, tmp_path):
+        from dmlc_tpu.utils.check import DMLCError
+
+        path = _write_corpus(tmp_path)
+        with pytest.raises(DMLCError, match="require a block_cache"):
+            create_parser(path, 0, 1, "libsvm", shuffle_seed=1)
+        with pytest.raises(DMLCError, match="requires shuffle_seed"):
+            # a window alone would silently serve sequential epochs
+            create_parser(path, 0, 1, "libsvm",
+                          block_cache=str(tmp_path / "c.bc"),
+                          shuffle_window=4096)
+        with pytest.raises(DMLCError, match="double-shard"):
+            create_parser(path, 0, 2, "libsvm",
+                          block_cache=str(tmp_path / "c.bc"),
+                          shuffle_seed=1, pod_sharding=(0, 2))
+        with pytest.raises(DMLCError, match="dispatcher owns the dataset's "
+                                            "plan"):
+            # the service branch must reject, not silently drop, the knobs
+            create_parser(path, 0, 1, "libsvm",
+                          service="127.0.0.1:1", shuffle_seed=1)
+
+    def test_legacy_seed_stays_out_of_cache_signature(self, tmp_path):
+        # the mapped legacy seed must NOT invalidate the cache: one cache
+        # serves every seed, and the migration path (shuffle_seed=) must
+        # hit the cache a legacy run (shuffle=True, seed=) built
+        path = _write_corpus(tmp_path)
+        cache = str(tmp_path / "c.bc")
+        with pytest.warns(DeprecationWarning):
+            legacy = create_parser(path, 0, 1, "libsvm", threaded=False,
+                                   chunk_bytes=CHUNK, block_cache=cache,
+                                   shuffle=True, seed=1)
+        _rows(legacy)
+        legacy.close()
+        mtime = os.path.getmtime(cache)
+        for kw in (dict(shuffle_seed=1, shuffle_window=4096), {}):
+            p = _mk(path, cache, **kw)
+            assert p.cache_state == "warm", kw
+            p.close()
+        with pytest.warns(DeprecationWarning):
+            legacy2 = create_parser(path, 0, 1, "libsvm", threaded=False,
+                                    chunk_bytes=CHUNK, block_cache=cache,
+                                    shuffle=True, seed=2)
+        assert legacy2.cache_state == "warm"
+        legacy2.close()
+        assert os.path.getmtime(cache) == mtime
+
+    def test_pod_identity_resolution(self, monkeypatch):
+        from dmlc_tpu.parallel.distributed import pod_identity
+
+        monkeypatch.setenv("DMLC_TASK_ID", "2")
+        monkeypatch.setenv("DMLC_NUM_WORKER", "4")
+        assert pod_identity() == (2, 4)
+        monkeypatch.delenv("DMLC_TASK_ID")
+        monkeypatch.delenv("DMLC_NUM_WORKER")
+        assert pod_identity() == (0, 1)  # single host, no jax pod
+
+    def test_create_row_block_iter_pod_entry_point(self, tmp_path,
+                                                   monkeypatch):
+        from dmlc_tpu.data import create_row_block_iter
+
+        path = _write_corpus(tmp_path, n=300)
+        cache = str(tmp_path / "c.bc")
+        build = _mk(path, cache)
+        _rows(build)
+        build.close()
+        monkeypatch.setenv("DMLC_TASK_ID", "1")
+        monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+        it = create_row_block_iter(path, block_cache=cache, shuffle_seed=3,
+                                   pod_sharding=True, threaded=False,
+                                   chunk_bytes=CHUNK, silent=True)
+        blk = it.next_block()
+        full = sum(1 for _ in open(path))
+        assert 0 < len(blk) < full, "the iterator drained one disjoint shard"
+        it.close()
+
+    def test_dispatcher_ships_plan_to_fleet(self, tmp_path):
+        from dmlc_tpu.service import Dispatcher, ServiceParser
+        from dmlc_tpu.service import dispatcher as _dispatch
+
+        disp = Dispatcher("dummy.libsvm", 2, parser={"format": "libsvm"},
+                          plan={"shuffle_seed": 13, "shuffle_window": 8})
+        try:
+            cfg = _dispatch.request(disp.address, {"cmd": "config"})
+            assert cfg["plan"] == {"shuffle_seed": 13, "shuffle_window": 8}
+        finally:
+            disp.close()
+
+    def test_fleet_ships_plan_but_serves_parse_order(self, tmp_path):
+        from dmlc_tpu.service import LocalFleet, ServiceParser
+
+        path = _write_corpus(tmp_path, n=400)
+        fleet = LocalFleet(
+            path, 2, num_workers=2,
+            parser={"format": "libsvm", "chunk_bytes": CHUNK,
+                    "threaded": False,
+                    "block_cache": str(tmp_path / "svc.bc")},
+            plan={"shuffle_seed": 21, "shuffle_window": 4})
+        client = None
+        try:
+            client = ServiceParser(fleet.address)
+            # the plan identity reaches every party...
+            assert client.shuffle_seed == 21
+            assert all(w.plan.get("shuffle_seed") == 21
+                       for w in fleet.workers)
+            # ...but the wire stays PARSE-order (the failover-resume
+            # byte-identity contract): the stream equals local sequential
+            # parsing, never a plan permutation
+            got = _rows(client)
+            expect = []
+            for part in range(2):
+                p = create_parser(path, part, 2, "libsvm", threaded=False,
+                                  chunk_bytes=CHUNK)
+                expect.extend(_rows(p))
+                p.close()
+            assert got == expect
+        finally:
+            if client is not None:
+                client.close()
+            fleet.close()
