@@ -15,6 +15,10 @@
 #                     cache_state), the shuffle-native plan leg
 #                     (shuffled_warm_epoch_mb_per_sec/shuffle_overhead_pct
 #                     — a plan-ordered warm epoch on the same cache), the
+#                     device-native snapshot leg (snapshot_warm_mb_per_sec/
+#                     snapshot_vs_cache_speedup/snapshot_wire_bytes_ratio
+#                     — warm epochs stream stored post-convert batches
+#                     with convert busy ~0; bf16 halves stored bytes), the
 #                     data-service leg (service_workers/
 #                     service_mb_per_sec/service_vs_local_speedup from a
 #                     localhost 2-worker fleet), and the telemetry contract
@@ -88,6 +92,18 @@ bench-smoke:
 	        'shuffled_warm_epoch_mb_per_sec missing (plan leg did not run)'; \
 	    assert line.get('shuffle_overhead_pct') is not None, \
 	        'shuffle_overhead_pct missing'; \
+	    assert line.get('snapshot_warm_mb_per_sec'), \
+	        'snapshot_warm_mb_per_sec missing (snapshot leg did not run)'; \
+	    assert line.get('snapshot_vs_cache_speedup'), \
+	        'snapshot_vs_cache_speedup missing'; \
+	    assert line.get('snapshot_state') == 'warm', \
+	        f\"snapshot_state {line.get('snapshot_state')!r} != 'warm'\"; \
+	    ratio = line.get('snapshot_wire_bytes_ratio'); \
+	    assert ratio is not None and ratio <= 0.55, \
+	        f'snapshot_wire_bytes_ratio {ratio} missing or > 0.55'; \
+	    conv = line.get('snapshot_warm_convert_seconds'); \
+	    assert conv is not None and conv <= 0.05, \
+	        f'snapshot warm convert busy {conv}s != ~0 (convert not bypassed)'; \
 	    assert line.get('service_workers') == 2, \
 	        'service_workers missing (service leg did not run)'; \
 	    assert line.get('service_mb_per_sec'), \
@@ -115,6 +131,11 @@ bench-smoke:
 	          line['shuffled_warm_epoch_mb_per_sec'], 'MB/s, overhead', \
 	          line['shuffle_overhead_pct'], 'pct, seed', \
 	          line.get('shuffle_seed')); \
+	    print('bench-smoke: snapshot OK:', \
+	          line['snapshot_warm_mb_per_sec'], 'MB/s warm, x', \
+	          line['snapshot_vs_cache_speedup'], 'over cache warm,', \
+	          'bf16 bytes ratio', line['snapshot_wire_bytes_ratio'], \
+	          ', warm convert', conv, 's'); \
 	    print('bench-smoke: data service OK:', \
 	          line['service_mb_per_sec'], 'MB/s with', \
 	          line['service_workers'], 'workers, vs-local x', \

@@ -377,6 +377,112 @@ def block_cache_epoch_pair(path: str, size_mb: float):
             warm_cache_read, shuffled, shuffled_stats)
 
 
+def snapshot_epoch_leg(path: str, size_mb: float):
+    """Device-native snapshot store leg (ISSUE 9 tentpole): epoch 1
+    parses + converts while shadow-writing the post-convert packed
+    batches (``DMLCSN01``); warm epochs then mmap those batches straight
+    into ``device_put`` with ZERO host convert work. The structural
+    claims the JSON line carries:
+
+    - ``snapshot_warm_mb_per_sec`` above the parse ceiling
+      (``snapshot_vs_parse_ceiling > 1``) proves the parser AND the
+      convert stage are bypassed, not merely overlapped;
+    - ``snapshot_warm_convert_seconds`` ~ 0 with a nonzero
+      ``snapshot_read_seconds`` is the stats()-level proof;
+    - ``snapshot_wire_bytes_ratio`` (bf16 snapshot file bytes / f32)
+      <= 0.55 shows reduced precision halves stored AND wire bytes.
+
+    Returns the field dict to merge into the JSON line.
+    """
+    import jax
+
+    from dmlc_tpu.data import create_parser
+    from dmlc_tpu.data.device import DeviceIter
+
+    snap = CORPUS + ".snapshot"
+    snap16 = CORPUS + ".bf16.snapshot"
+    for stale in (snap, snap + ".tmp", snap16, snap16 + ".tmp"):
+        try:
+            os.remove(stale)
+        except OSError:
+            pass
+
+    def one_epoch(it):
+        t0 = time.monotonic()
+        last = None
+        nb = 0
+        for batch in it:
+            last = batch
+            nb += 1
+        if last is not None:
+            jax.block_until_ready(last)
+        return nb, time.monotonic() - t0
+
+    out = {}
+    it = it16 = None
+    try:
+        parser = create_parser(path, 0, 1, "libsvm", threaded=True,
+                               chunk_bytes=CHUNK_BYTES, snapshot=snap)
+        it = DeviceIter(parser, num_col=NUM_COL, batch_size=BATCH,
+                        layout="dense", prefetch=4, convert_ahead=6,
+                        pack_aux=True)
+        nb, dt = one_epoch(it)
+        stats = it.stats()
+        log(f"bench: snapshot cold epoch {nb} batches in {dt:.2f}s = "
+            f"{size_mb/dt:.1f} MB/s "
+            f"(snapshot_state={stats['snapshot_state']})")
+        warm = 0.0
+        conv_prev = stats["stage_busy"].get("convert", 0.0)
+        sr_prev = stats["stage_busy"].get("snapshot_read", 0.0)
+        for _round in range(2):
+            it.reset()
+            nb, dt = one_epoch(it)
+            warm = max(warm, size_mb / dt)
+            stats = it.stats()
+            # registry counters are cumulative across reset(): report the
+            # epoch's own deltas, not the running sum
+            conv_now = stats["stage_busy"].get("convert", 0.0)
+            sr_now = stats["stage_busy"].get("snapshot_read", 0.0)
+            conv_epoch, conv_prev = conv_now - conv_prev, conv_now
+            sr_epoch, sr_prev = sr_now - sr_prev, sr_now
+            log(f"bench: snapshot WARM epoch {nb} batches in {dt:.2f}s = "
+                f"{size_mb/dt:.1f} MB/s "
+                f"(snapshot_state={stats['snapshot_state']}, "
+                f"convert={conv_epoch:.4f}s, "
+                f"snapshot_read={sr_epoch:.4f}s)")
+        out["snapshot_warm_mb_per_sec"] = round(warm, 2)
+        out["snapshot_state"] = stats["snapshot_state"]
+        out["snapshot_warm_convert_seconds"] = round(max(0.0, conv_epoch), 4)
+        out["snapshot_read_seconds"] = round(max(0.0, sr_epoch), 4)
+        # bf16 snapshot: one cold epoch through the bf16 pipeline writes
+        # the half-width store — the file-size ratio IS the stored/wire
+        # byte claim (the service ships the same segment encoding)
+        parser16 = create_parser(path, 0, 1, "libsvm", threaded=True,
+                                 chunk_bytes=CHUNK_BYTES, snapshot=snap16)
+        it16 = DeviceIter(parser16, num_col=NUM_COL, batch_size=BATCH,
+                          layout="dense", prefetch=4, convert_ahead=6,
+                          x_dtype="bfloat16", pack_aux=True)
+        one_epoch(it16)
+        if os.path.exists(snap) and os.path.exists(snap16):
+            ratio = os.path.getsize(snap16) / os.path.getsize(snap)
+            out["snapshot_wire_bytes_ratio"] = round(ratio, 3)
+            log(f"bench: snapshot bytes f32 "
+                f"{os.path.getsize(snap)/2**20:.1f} MB, bf16 "
+                f"{os.path.getsize(snap16)/2**20:.1f} MB -> ratio "
+                f"{ratio:.3f}")
+    finally:
+        if it is not None:
+            it.close()
+        if it16 is not None:
+            it16.close()
+        for leftover in (snap, snap + ".tmp", snap16, snap16 + ".tmp"):
+            try:
+                os.remove(leftover)  # the leg must start cold every run
+            except OSError:
+                pass
+    return out
+
+
 def service_leg(path: str, size_mb: float, workers: int = 2):
     """Disaggregated data-service leg (``--service`` / ISSUE 7): a
     localhost 1-dispatcher + N-worker fleet parses the corpus's N
@@ -430,13 +536,22 @@ def device_floor_mbps(x_dtype: str = "float32"):
     """Raw repeated-shape device_put floor for bench.py's exact batch
     geometry, measured in THIS process right after the pipeline reps (same
     backend, same tunnel weather) so the line-rate join compares rates
-    captured minutes — not rounds — apart. Returns (best, median) MB/s.
+    captured minutes — not rounds — apart. Returns
+    (best, median, trimmed_best) MB/s.
 
     This is the denominator of ``pct_of_line_rate``: the BASELINE claim is
     ">=90% of host->HBM line rate with zero input-bound stalls", and the
     line rate IS what device_put of the same bytes sustains with no
     parsing attached (benchmarks/bench_transfer_floor.py standalone form).
-    """
+
+    Stability (BENCH_r05: the bf16 floor swung best 5159.7 vs median
+    1858.2 MB/s): the first timed rounds used to eat lazy backend work —
+    the bf16 view wrapper, dtype-specific transfer-plan setup — so the
+    path is now WARMED with full untimed put rounds until the rate
+    stabilizes (bounded), and ``trimmed_best`` (the best sample after
+    dropping the single highest — one fluke window cannot own it) rides
+    alongside best/median as the stable denominator snapshot gating
+    divides by."""
     import jax
     import numpy as np
 
@@ -456,18 +571,30 @@ def device_floor_mbps(x_dtype: str = "float32"):
     batch = [
         rng.standard_normal((BATCH, NUM_COL + 2)).astype(np_dtype),
     ]
-    jax.block_until_ready(jax.device_put(batch))  # transfer-plan warmup
     n = 64
     mb = n * sum(a.nbytes for a in batch) / 2**20
+    # warm up until two consecutive untimed rounds agree within 25% (or
+    # the bounded budget runs out): first-touch costs — transfer-plan
+    # build, dtype wrapper setup, allocator growth — must not land inside
+    # a timed sample
+    prev = None
+    for _ in range(4):
+        t0 = time.monotonic()
+        jax.block_until_ready([jax.device_put(batch) for _ in range(n)])
+        rate = mb / (time.monotonic() - t0)
+        if prev is not None and abs(rate - prev) <= 0.25 * max(rate, prev):
+            break
+        prev = rate
     samples = []
-    for _ in range(3):
+    for _ in range(5):
         t0 = time.monotonic()
         handles = [jax.device_put(batch) for _ in range(n)]
         jax.block_until_ready(handles)
         samples.append(mb / (time.monotonic() - t0))
+    trimmed = max(sorted(samples)[:-1])  # best-of after dropping the top
     log(f"bench: device_put floor ({x_dtype}) best {max(samples):.1f} "
-        f"median {_median(samples):.1f} MB/s")
-    return max(samples), _median(samples)
+        f"trimmed {trimmed:.1f} median {_median(samples):.1f} MB/s")
+    return max(samples), _median(samples), trimmed
 
 
 # child exit code for backend/transport failures — the supervisor retries
@@ -558,7 +685,8 @@ def run_child() -> None:
     # captured in this same process, and report the pipeline's device-side
     # rate as a fraction of it.
     try:
-        floor_best, floor_med = device_floor_mbps("float32")
+        floor_best, floor_med, floor_trim = device_floor_mbps("float32")
+        line["line_rate_trimmed_mb_per_sec"] = round(floor_trim, 2)
         line["pct_of_line_rate"] = round(dev[0] / floor_best, 3)
         line["pct_of_line_rate_median"] = round(dev[1] / floor_med, 3)
         line["device_mb_per_sec"] = round(dev[0], 2)
@@ -649,6 +777,31 @@ def run_child() -> None:
                 f"{line['shuffle_overhead_pct']:.1f}%")
     except Exception as exc:  # noqa: BLE001 - the headline must still print
         log(f"bench: block-cache epoch-pair leg failed: {exc}")
+    # device-native snapshot store (ISSUE 9): warm epochs skip parse AND
+    # convert — mmap'd post-convert batches stream straight into
+    # device_put. snapshot_vs_cache_speedup positions the two warm tiers
+    # (cache = parser output, snapshot = device layout); above the parse
+    # ceiling proves the bypass is structural. make bench-smoke gates the
+    # fields.
+    try:
+        snap_fields = snapshot_epoch_leg(path, size_mb)
+        line.update(snap_fields)
+        warm_snap = snap_fields.get("snapshot_warm_mb_per_sec")
+        cache_warm = line.get("warm_epoch_mb_per_sec")
+        if warm_snap and cache_warm:
+            line["snapshot_vs_cache_speedup"] = round(
+                warm_snap / cache_warm, 3)
+        ceiling = line.get("parse_ceiling_mb_per_sec")
+        if warm_snap and ceiling:
+            line["snapshot_vs_parse_ceiling"] = round(warm_snap / ceiling, 3)
+        if warm_snap:
+            log(f"bench: snapshot warm {warm_snap:.1f} MB/s"
+                + (f" = x{line['snapshot_vs_cache_speedup']:.2f} over the "
+                   f"cache's warm epochs" if cache_warm else "")
+                + (f", x{line['snapshot_vs_parse_ceiling']:.2f} of parse "
+                   f"ceiling" if ceiling else ""))
+    except Exception as exc:  # noqa: BLE001 - the headline must still print
+        log(f"bench: snapshot epoch leg failed: {exc}")
     # bf16 ingest: the C++ repack emits bfloat16 (the MXU's operand width),
     # halving host->HBM bytes — reported alongside, headline stays f32
     try:
@@ -657,10 +810,17 @@ def run_child() -> None:
         line["bf16_mb_per_sec"] = round(bf16_value, 2)
         line["bf16_vs_baseline"] = round(bf16_value / base_best, 3)
         line["bf16_median_vs_baseline"] = round(bf16_med / base_med, 3)
-        bf_floor_best, bf_floor_med = device_floor_mbps("bfloat16")
+        bf_floor_best, bf_floor_med, bf_floor_trim = \
+            device_floor_mbps("bfloat16")
         line["bf16_pct_of_line_rate"] = round(bf16_dev[0] / bf_floor_best, 3)
         line["bf16_pct_of_line_rate_median"] = round(
             bf16_dev[1] / bf_floor_med, 3)
+        # the STABLE bf16 denominator (warmed + trimmed best-of): the
+        # number snapshot gating divides by, immune to the one-fluke-
+        # window swings BENCH_r05 recorded (best 5159.7 vs median 1858.2)
+        line["bf16_line_rate_trimmed_mb_per_sec"] = round(bf_floor_trim, 2)
+        line["bf16_pct_of_line_rate_trimmed"] = round(
+            bf16_dev[0] / bf_floor_trim, 3)
     except Exception as exc:  # noqa: BLE001 - the headline must still print
         log(f"bench: bf16 leg failed: {exc}")
     # disaggregated data-service leg (docs/service.md): localhost fleet
@@ -836,6 +996,13 @@ def main() -> int:
                           "warm_vs_parse_ceiling",
                           "shuffled_warm_epoch_mb_per_sec",
                           "shuffle_overhead_pct", "shuffle_seed",
+                          "snapshot_warm_mb_per_sec", "snapshot_state",
+                          "snapshot_vs_cache_speedup",
+                          "snapshot_vs_parse_ceiling",
+                          "snapshot_wire_bytes_ratio",
+                          "snapshot_warm_convert_seconds",
+                          "snapshot_read_seconds",
+                          "bf16_line_rate_trimmed_mb_per_sec",
                           "service_workers", "service_mb_per_sec",
                           "service_vs_local_speedup",
                           "telemetry_schema_version", "trace_spans",

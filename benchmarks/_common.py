@@ -57,9 +57,11 @@ def log(msg: str) -> None:
 
 
 # canonical stage order for the ingest attribution table (VERDICT r5 weak
-# #4: name the unaccounted share of pipeline bound, per-stage)
-STAGE_ORDER = ("read", "cache_read", "parse", "convert", "dispatch",
-               "transfer")
+# #4: name the unaccounted share of pipeline bound, per-stage).
+# snapshot_read = warm device-native snapshot supply (mmap + crc of
+# post-convert batches, docs/data.md snapshot section)
+STAGE_ORDER = ("read", "cache_read", "snapshot_read", "parse", "convert",
+               "dispatch", "transfer")
 
 
 def attribution_line(stats: dict, extra_transfer: float = 0.0) -> dict:

@@ -11,10 +11,13 @@ numbers never reached ``stats()`` or a trace. ``make lint-metrics`` keeps
 that from creeping back. It FAILS on, anywhere under ``dmlc_tpu/`` —
 every package, including ``dmlc_tpu/service/`` (whose frame
 encode/send/recv/decode timing must ride the span tracer, and whose
-failover events must go through ``record_event``) and
+failover events must go through ``record_event``),
 ``dmlc_tpu/data/epoch.py`` (the epoch planner is pure plan math: any
 timing it ever grows must pair with the ``cache_read`` spans its
-consumer records) — except the two sanctioned modules:
+consumer records), and ``dmlc_tpu/io/snapshot.py`` (the device-native
+snapshot store: its ``snapshot_read``/``snapshot_write`` timing rides
+the span tracer and its invalidation/corruption events go through
+``record_event``) — except the two sanctioned modules:
 
 - ``COUNTERS.bump(`` — direct resilience-counter mutation; new events
   must go through ``dmlc_tpu.io.resilience.record_event`` (which stamps

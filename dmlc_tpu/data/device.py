@@ -40,12 +40,13 @@ from dmlc_tpu.data.row_block import (
     CooBlock, DenseBlock, RowBlock, RowBlockContainer,
 )
 from dmlc_tpu.io import resilience as _resilience
+from dmlc_tpu.io import snapshot as _snapshot
 from dmlc_tpu.io.threaded_iter import OrderedWorkerPool, ThreadedIter
 from dmlc_tpu.ops.sparse import (
     EllBatch, block_to_bcoo_host, block_to_dense, block_to_ell,
 )
 from dmlc_tpu.utils import telemetry as _telemetry
-from dmlc_tpu.utils.check import DMLCError, check
+from dmlc_tpu.utils.check import CacheCorruptionError, DMLCError, check
 from dmlc_tpu.utils.timer import StageMeter, get_time
 
 
@@ -79,6 +80,63 @@ def rebatch_blocks(
                 pending.push_block(merged.slice(pos, len(merged)))
     if pending_rows and not drop_remainder:
         yield pending.to_block()
+
+
+def _require_bf16_exact(packed_col, src, what: str) -> None:
+    """``packed_col`` is a just-assigned bfloat16 aux column, ``src`` the
+    float32 source values: raise when the cast lost precision. Shared by
+    the local convert-pool pack and the service worker's snapshot-frame
+    pack, so no bf16 path can silently corrupt labels/weights."""
+    if not np.array_equal(packed_col.astype(np.float32),
+                          np.asarray(src, dtype=np.float32)):
+        raise DMLCError(
+            f"bfloat16 aux packing: this batch's {what}s are not "
+            "bf16-exact — packing would silently corrupt them. Keep the "
+            f"{what}s float32-packable (pack_aux=False locally, or an "
+            "f32 snapshot geometry on the service) or use "
+            "x_dtype='float32' (docs/data.md pack_aux)")
+
+
+def pack_dense_batches(blocks, batch_size: int, num_col: int,
+                       dtype=None, drop_remainder: bool = False):
+    """Pack a RowBlock stream into fixed-geometry ``[B, num_col + 2]``
+    slabs (features | label | weight) — the exact layout
+    :class:`PackedDenseBatch` ships and the snapshot store persists.
+    Yields ``(packed, resume_annotation)`` per batch; the epoch tail is
+    row-padded to ``B`` (pad rows carry weight 0 -> masked downstream)
+    unless ``drop_remainder``. Used by the data service's snapshot frames
+    (worker-side packing, docs/service.md) so a fleet can ship
+    device-layout bf16 batches at half the CSR wire bytes. A bfloat16
+    target validates label/weight losslessness per batch, like the local
+    pack path."""
+    B, nc = int(batch_size), int(num_col)
+    dt = np.dtype(np.float32) if dtype is None else np.dtype(dtype)
+    aux_check = dt.kind == "V" or dt.itemsize < 4  # narrower than f32
+    for block in rebatch_blocks(iter(blocks), B,
+                                drop_remainder=drop_remainder):
+        x, y, w = block_to_dense(block, nc,
+                                 pad_rows_to=(B if len(block) != B
+                                              else None))
+        packed = np.empty((B, nc + 2), dt)
+        packed[:, :nc] = x
+        packed[:, nc] = y
+        packed[:, nc + 1] = w
+        if aux_check:
+            _require_bf16_exact(packed[:, nc], y, "label")
+            _require_bf16_exact(packed[:, nc + 1], w, "weight")
+        yield packed, getattr(block, "resume_state", None)
+
+
+def _dequant_q8_impl(q, scale):
+    """Device-side int8 -> float32 dequantization of a quantized snapshot
+    batch: one fused multiply per element (VPU noise next to the 4x
+    host->HBM byte saving the int8 wire buys)."""
+    import jax.numpy as jnp
+
+    return q.astype(jnp.float32) * scale
+
+
+_dequant_q8 = jax.jit(_dequant_q8_impl)
 
 
 _RING_FREE = object()  # sentinel: slot never attached / explicitly released
@@ -276,6 +334,47 @@ class PackedDenseBatch:
         return cls(children[0], num_col)
 
 
+class _SnapshotFeed:
+    """The warm-snapshot producer in the ``_host_iter`` slot: wraps a
+    :class:`~dmlc_tpu.io.snapshot.SnapshotIter` and emits the pool item
+    shape ``(host_batch, None, annot)`` the consumer fill loop expects —
+    no staging bufs (the batch views alias the snapshot mmap; numpy pins
+    it via the view base chain until the transfer's arrays die) and the
+    resume annotation resolved per serving order: the stored pipeline
+    annotation for sequential epochs, a ``(seed, epoch, position)``
+    plan annotation for plan-ordered ones."""
+
+    def __init__(self, feed, start: int = 0, plan_annot=None):
+        self._feed = feed
+        self._pos = int(start)  # plan/sequential position of the next batch
+        self._plan_annot = plan_annot  # pos-after -> annot dict (plan order)
+        self.served_bytes = 0
+
+    @property
+    def stall_seconds(self) -> float:
+        return self._feed.stall_seconds
+
+    @stall_seconds.setter
+    def stall_seconds(self, value: float) -> None:
+        self._feed.stall_seconds = value
+
+    def next(self):
+        item = self._feed.next()
+        if item is None:
+            return None
+        host_batch, resume, nbytes = item
+        self.served_bytes += nbytes
+        self._pos += 1
+        if self._plan_annot is not None:
+            annot = self._plan_annot(self._pos)
+        else:
+            annot = resume
+        return host_batch, None, annot
+
+    def destroy(self) -> None:
+        self._feed.destroy()
+
+
 class DeviceIter:
     """Double-buffered host->device batch iterator with stage attribution.
 
@@ -317,6 +416,11 @@ class DeviceIter:
         csr_wire: bool = True,
         pack_aux: Optional[bool] = None,
         pipeline_label: Optional[str] = None,
+        snapshot: Optional[str] = None,
+        snapshot_signature: Optional[dict] = None,
+        snapshot_quant: Optional[str] = None,
+        snapshot_shuffle_seed: Optional[int] = None,
+        snapshot_read_workers: Optional[int] = None,
     ):
         check(layout in ("dense", "ell", "bcoo"), f"unknown layout {layout!r}")
         check(batch_size is not None or layout == "bcoo",
@@ -441,6 +545,63 @@ class DeviceIter:
             pack_aux = (layout == "dense" and mesh is None
                         and x_dtype == "float32")
         self.pack_aux = bool(pack_aux) and layout == "dense" and mesh is None
+        # bf16 aux packing casts labels/weights to bfloat16 too — sound
+        # ONLY when they are bf16-exact. That used to be an undocumented
+        # caller promise; it is now VALIDATED at pack time (a round-trip
+        # compare per batch) so a lossy corpus raises instead of silently
+        # training on corrupted labels (docs/data.md pack_aux).
+        self._aux_exact_check = (self.pack_aux
+                                 and self.x_dtype == "bfloat16")
+        # ---- device-native snapshot store (docs/data.md snapshot) ----
+        # cold epochs shadow-write the post-convert batches; warm epochs
+        # mmap them straight into the transfer path with zero convert
+        # work (a new 'snapshot_read' stage), bounded by transfer instead
+        # of host packing (ROADMAP item 3, arXiv:2501.10546).
+        if snapshot is None:
+            snapshot = getattr(source, "snapshot_path", None)
+            if snapshot is not None and snapshot_signature is None:
+                snapshot_signature = getattr(source, "snapshot_signature",
+                                             None)
+        self.snapshot_path = snapshot
+        self._snap_sig = snapshot_signature
+        self._snap_quant = snapshot_quant
+        self._snap_seed = (None if snapshot_shuffle_seed is None
+                           else int(snapshot_shuffle_seed))
+        self._snap_read_workers = snapshot_read_workers
+        self._snap_epoch = 0    # advances per reset() while snapshot armed
+        self._snap_pos0 = 0     # warm start position (mid-epoch restore)
+        self._snap_reader = None
+        self._snap_writer = None
+        self._snap_serving = False   # current producer is the warm feed
+        self._snap_seq_restore = False  # serve this epoch sequentially
+        self._snap_shadow = True  # a fresh pass may publish the snapshot
+        # a restore the snapshot cannot reproduce (e.g. a BLOCK-plan
+        # state replayed by the source) suspends warm serving for the
+        # rest of the epoch — the seeked source owns the stream
+        self._snap_suspend = False
+        if snapshot is not None:
+            check(batch_size is not None,
+                  "snapshot= requires a fixed batch_size: the store "
+                  "persists one batch geometry (docs/data.md)")
+            check(layout == "dense" or (layout == "ell" and max_nnz),
+                  "snapshot v1 stores fixed-geometry batches: layout "
+                  "'dense', or 'ell' with max_nnz pinned (docs/io.md)")
+            check(mesh is None and shardings is None,
+                  "snapshot= serves single-put batches; mesh/shardings "
+                  "pipelines are not snapshot-servable")
+            check(snapshot_quant in (None, "int8"),
+                  f"unknown snapshot_quant {snapshot_quant!r}")
+            check(snapshot_quant is None or (layout == "dense"
+                                             and self.pack_aux),
+                  "snapshot_quant='int8' applies to packed dense "
+                  "batches (layout='dense' with pack_aux)")
+            src_plan = getattr(source, "plan_state", None) or {}
+            check(src_plan.get("shuffle_seed") is None,
+                  "snapshot= cannot combine with a source-side epoch "
+                  "plan (shuffle_seed on the block cache): the snapshot "
+                  "freezes one epoch's batch order — shuffle snapshot "
+                  "epochs with snapshot_shuffle_seed= instead "
+                  "(docs/data.md)")
         if layout == "dense" and hasattr(source, "set_emit_dense"):
             # ask the parser for HBM-ready dense batches (skips CSR), repacked
             # to this batch size (and target dtype) off-GIL when the native
@@ -481,13 +642,13 @@ class DeviceIter:
         # Both meters are registry-backed under this pipeline's label, so
         # stats(), the pod snapshot, and the trace all read one set of
         # books (docs/observability.md).
-        self._busy = StageMeter("read", "cache_read", "parse", "convert",
-                                "dispatch",
+        self._busy = StageMeter("read", "cache_read", "snapshot_read",
+                                "parse", "convert", "dispatch",
                                 metric=_telemetry.STAGE_BUSY_METRIC,
                                 scope=self.pipeline_label)
         # consumer-wall attribution (the partition stats() reports)
-        self._attr = StageMeter("read", "cache_read", "parse", "convert",
-                                "dispatch", "transfer",
+        self._attr = StageMeter("read", "cache_read", "snapshot_read",
+                                "parse", "convert", "dispatch", "transfer",
                                 metric=_telemetry.STAGE_WALL_METRIC,
                                 scope=self.pipeline_label)
         self._transfer_samples = 0
@@ -521,7 +682,14 @@ class DeviceIter:
     @property
     def _host_iter(self):
         if self._host_iter_obj is None:
-            if self.batch_size is None:
+            if (self.snapshot_path is not None and not self._snap_suspend
+                    and self._open_snapshot()):
+                # warm snapshot epoch: the source chain (parse AND
+                # convert) is bypassed entirely — batches stream off the
+                # snapshot mmap into device_put
+                self._host_iter_obj = self._snapshot_feed()
+                self._snap_serving = True
+            elif self.batch_size is None:
                 # natural-block mode: convert + (async) device_put on ONE
                 # producer thread — puts must not interleave across workers
                 # because the skip-credit resume counts whole blocks
@@ -529,12 +697,164 @@ class DeviceIter:
                     self._host_batches, max_capacity=self._convert_ahead
                 )
             else:
+                if self.snapshot_path is not None and self._snap_shadow:
+                    # cold snapshot epoch: the convert stage's output
+                    # tees into the shadow writer (published at epoch
+                    # end, served warm from the next epoch on)
+                    self._arm_snapshot_writer()
                 self._host_iter_obj = OrderedWorkerPool(
                     self._serial_batches, self._convert_work,
                     num_workers=self.convert_workers,
                     max_ahead=self._convert_ahead,
                 )
         return self._host_iter_obj
+
+    # ---------------- snapshot store (docs/data.md snapshot) ----------------
+
+    def _snapshot_geometry(self) -> dict:
+        """The batch-shape identity a snapshot is bound to: any drift
+        (batch size, width, dtype, layout, padding policy, quantization)
+        self-invalidates the stored file at open instead of serving
+        wrong-shaped batches."""
+        return {
+            "v": _snapshot.SNAPSHOT_VERSION,
+            "batch_size": int(self.batch_size),
+            "num_col": int(self.num_col),
+            "layout": self.layout,
+            "x_dtype": self.x_dtype,
+            "pack_aux": bool(self.pack_aux),
+            "quant": self._snap_quant,
+            "drop_remainder": bool(self.drop_remainder),
+            "max_nnz": (int(self.max_nnz)
+                        if self.layout == "ell" and self.max_nnz else None),
+        }
+
+    def _open_snapshot(self) -> bool:
+        if self._snap_reader is None:
+            self._snap_reader = _snapshot.open_snapshot(
+                self.snapshot_path, signature=self._snap_sig,
+                geometry=self._snapshot_geometry())
+        return self._snap_reader is not None
+
+    def _drop_snap_reader(self) -> None:
+        reader, self._snap_reader = self._snap_reader, None
+        if reader is not None:
+            reader.close()
+
+    def _arm_snapshot_writer(self) -> None:
+        if self._snap_writer is None:
+            self._snap_writer = _snapshot.SnapshotWriter(
+                self.snapshot_path, signature=self._snap_sig,
+                geometry=self._snapshot_geometry())
+
+    def _abort_snapshot_writer(self) -> None:
+        writer, self._snap_writer = self._snap_writer, None
+        if writer is not None:
+            writer.abort()
+
+    def _finish_snapshot_writer(self) -> None:
+        """End of a complete cold pass: fsync + atomically publish the
+        shadow-written snapshot (idempotent; a partial pass never gets
+        here — mid-epoch restores abort the writer instead)."""
+        writer, self._snap_writer = self._snap_writer, None
+        if writer is not None:
+            writer.finish()
+
+    def _write_snapshot_batch(self, host_batch, annot) -> None:
+        """Tee one converted batch into the shadow writer (consumer
+        thread — production order IS delivery order here). ``dense_packed``
+        batches optionally quantize to int8 + per-column scale."""
+        kind = host_batch[0]
+        arrays = host_batch[1:]
+        if self._snap_quant == "int8" and kind == "dense_packed":
+            q, scale = _snapshot.quantize_int8(
+                np.asarray(arrays[0], dtype=np.float32))
+            kind, arrays = "dense_packed_q8", (q, scale)
+        self._snap_writer.add_batch(kind, arrays, rows=self.batch_size,
+                                    resume=annot)
+
+    def _snapshot_feed(self) -> _SnapshotFeed:
+        """Build the warm feed for this epoch: sequential, or — with a
+        ``snapshot_shuffle_seed`` armed — the epoch plan's permutation
+        over snapshot BATCH indices (PR 8's planner, one tier up:
+        :func:`dmlc_tpu.data.epoch.block_permutation` keyed by
+        ``(seed, epoch)``), with ``(seed, epoch, position)`` resume
+        annotations so mid-epoch restores replay byte-identically."""
+        from dmlc_tpu.data import epoch as _epoch
+
+        reader = self._snap_reader
+        order = None
+        plan_annot = None
+        if self._snap_seed is not None and not self._snap_seq_restore:
+            order = _epoch.block_permutation(
+                self._snap_seed, self._snap_epoch, reader.num_batches)
+            seed, ep = self._snap_seed, self._snap_epoch
+
+            def plan_annot(pos):
+                return {"source": _epoch.plan_state_dict(
+                    seed, 0, ep, pos, 0, 1, unit="batch"),
+                    "skip_rows": 0}
+        start = self._snap_pos0
+        self._snap_pos0 = 0
+        feed = _snapshot.SnapshotIter(
+            reader, order=order, start=start,
+            read_workers=self._snap_read_workers,
+            on_read=lambda dt: self._add_busy("snapshot_read", dt),
+            annotate=self._trace)
+        return _SnapshotFeed(feed, start=start, plan_annot=plan_annot)
+
+    def _invalidate_snapshot(self) -> None:
+        """A warm batch failed its integrity check: classified snapshot
+        corruption — drop the file so the restart path re-arms COLD from
+        the source (sequential states) or rebuilds deterministically
+        (plan states); the stream stays byte-identical either way."""
+        _resilience.record_event("snapshot_corruptions")
+        self._drop_snap_reader()
+        try:
+            os.remove(self.snapshot_path)
+        except OSError:
+            pass
+
+    def _rebuild_snapshot(self) -> None:
+        """Deterministic full rebuild (vanished/corrupt snapshot under a
+        plan-position restore): drive the cold convert pipeline end to
+        end, writing every batch and delivering none — parsing and
+        packing are deterministic, so the rebuilt batches are
+        byte-identical to the lost ones and the plan stream continues
+        unbroken at the same position."""
+        _resilience.record_event("snapshot_rebuilds")
+        self._drop_snap_reader()
+        try:
+            os.remove(self.snapshot_path)
+        except OSError:
+            pass
+        self._teardown_producer()
+        self._snap_serving = False
+        self._abort_snapshot_writer()
+        self._arm_snapshot_writer()
+        pool = OrderedWorkerPool(
+            self._serial_batches, self._convert_work,
+            num_workers=self.convert_workers,
+            max_ahead=self._convert_ahead)
+        try:
+            while True:
+                item = pool.next()
+                if item is None:
+                    break
+                host_batch, bufs, annot = item
+                self._write_snapshot_batch(host_batch, annot)
+                if bufs is not None and self._ring is not None:
+                    self._ring.attach(bufs, None)  # nothing transferred
+            self._finish_snapshot_writer()
+        except BaseException:
+            self._abort_snapshot_writer()
+            raise
+        finally:
+            pool.destroy()
+        self._teardown_producer()  # clear the silent pass's bookkeeping
+        check(self._open_snapshot(),
+              f"snapshot {self.snapshot_path}: rebuild did not publish a "
+              "readable snapshot")
 
     # ---------------- host side ----------------
 
@@ -608,17 +928,20 @@ class DeviceIter:
                 self._boundaries.append((rows, annot))
             yield block
 
-    def _push_annot(self, rows_emitted: int) -> None:
+    def _push_annot(self, rows_emitted: int) -> Optional[dict]:
         """Record the resume annotation for the batch ending at
-        ``rows_emitted`` (rows of real data since stream/resume start)."""
+        ``rows_emitted`` (rows of real data since stream/resume start).
+        Returns the annotation so the serial stage can also ride it on
+        the work item (the snapshot shadow writer stores it per batch)."""
         while self._boundaries and self._boundaries[0][0] <= rows_emitted:
             self._cur_boundary = self._boundaries.popleft()
         if self._cur_boundary is None:
             self._annot_fifo.append(None)
-            return
+            return None
         r, state = self._cur_boundary
-        self._annot_fifo.append(
-            {"source": state, "skip_rows": rows_emitted - r})
+        annot = {"source": state, "skip_rows": rows_emitted - r}
+        self._annot_fifo.append(annot)
+        return annot
 
     def _host_batches(self):
         # natural-block mode only (BCOO interop: nnz varies per batch
@@ -675,14 +998,14 @@ class DeviceIter:
             self._tracked_blocks(), self.batch_size, self.drop_remainder
         ):
             emitted += len(block)
-            self._push_annot(emitted)
+            annot = self._push_annot(emitted)
             # bcoo nnz-bucket planning stays HERE, in stream order: the
             # tail batch pads its nse into the set of already-emitted
             # shapes, which must be complete by then — pool workers
             # convert out of order, so they cannot own this bookkeeping
             pad = (self._plan_bcoo_pad_nnz(block)
                    if self.layout == "bcoo" else None)
-            yield ("convert_block", block, pad)
+            yield ("convert_block", block, pad, annot)
 
     def _serial_batches_dense(self):
         """Dense serial stage: group incoming blocks into exact-B part
@@ -699,8 +1022,8 @@ class DeviceIter:
                 # native packed batch at exactly B rows: zero further host
                 # work — the whole (x|label|weight) batch is ONE array
                 emitted += B
-                self._push_annot(emitted)
-                yield ("dense_ready", block.x)
+                annot = self._push_annot(emitted)
+                yield ("dense_ready", block.x, annot)
                 continue
             if (isinstance(block, DenseBlock) and block.packed
                     and not parts and len(block) < B):
@@ -714,8 +1037,8 @@ class DeviceIter:
                     continue
                 n = len(block)
                 emitted += n
-                self._push_annot(emitted)
-                yield ("dense_parts", [("packed", block.x)])
+                annot = self._push_annot(emitted)
+                yield ("dense_parts", [("packed", block.x)], annot)
                 continue
             if isinstance(block, DenseBlock) and block.packed:
                 # parts pending from non-packed blocks (mixed engines) or
@@ -741,28 +1064,32 @@ class DeviceIter:
                         need = 0
                 pending -= B
                 emitted += B
-                self._push_annot(emitted)
-                yield ("dense_parts", take)
+                annot = self._push_annot(emitted)
+                yield ("dense_parts", take, annot)
         if pending and not self.drop_remainder:
             emitted += pending
-            self._push_annot(emitted)
-            yield ("dense_parts", parts)
+            annot = self._push_annot(emitted)
+            yield ("dense_parts", parts, annot)
 
     def _convert_work(self, item):
         """The pool's PARALLEL stage: per-batch layout conversion/packing.
-        Returns ``(host_batch, staging_bufs_or_None)`` — the bufs ride to
-        :meth:`_put` so the ring slot can be tied to the device array."""
+        Returns ``(host_batch, staging_bufs_or_None, resume_annot)`` —
+        the bufs ride to :meth:`_put` so the ring slot can be tied to the
+        device array; the annotation rides to the snapshot shadow
+        writer."""
         t0 = get_time()
         try:
             with _telemetry.profiler_annotation("dmlc_tpu.convert",
                                                 self._trace):
                 kind = item[0]
                 if kind == "dense_ready":
-                    return ("dense_packed", item[1]), None
+                    return ("dense_packed", item[1]), None, item[2]
                 if kind == "dense_parts":
-                    return self._pack_dense_parts(item[1])
-                # ("convert_block", block, precomputed bcoo pad plan)
-                return self._convert(item[1], pad_plan=(item[2],)), None
+                    hb, bufs = self._pack_dense_parts(item[1])
+                    return hb, bufs, item[2]
+                # ("convert_block", block, bcoo pad plan, annot)
+                return (self._convert(item[1], pad_plan=(item[2],)), None,
+                        item[3])
         finally:
             dt = get_time() - t0
             self._add_busy("convert", dt)
@@ -822,6 +1149,17 @@ class DeviceIter:
                         xp[pos:pos + n, nc + 1] = 1.0
                     else:
                         xp[pos:pos + n, nc + 1] = w
+                    if self._aux_exact_check:
+                        # the slice assignment above just cast label/
+                        # weight to bfloat16 — verify the round trip is
+                        # lossless NOW, instead of silently training on
+                        # corrupted aux values (the old undocumented
+                        # caller promise, made checkable)
+                        self._require_bf16_exact(
+                            xp[pos:pos + n, nc], y, "label")
+                        if w is not None:
+                            self._require_bf16_exact(
+                                xp[pos:pos + n, nc + 1], w, "weight")
                 pos += n
             if pos < B:
                 xp[pos:] = 0  # pad rows: weight 0 -> masked downstream
@@ -847,6 +1185,9 @@ class DeviceIter:
             yb[pos:] = 0
             wb[pos:] = 0
         return ("dense", xb, yb, wb), bufs
+
+    # one guard for every bf16 aux-packing site (module docstring)
+    _require_bf16_exact = staticmethod(_require_bf16_exact)
 
     def _x_np_dtype(self):
         if self.x_dtype == "bfloat16":
@@ -959,6 +1300,16 @@ class DeviceIter:
             d = (jax.device_put(xp, self.device)
                  if self.device is not None else jax.device_put(xp))
             return PackedDenseBatch(d, self.num_col)
+        if kind == "dense_packed_q8":
+            # int8-quantized snapshot batch: ship q + per-column scale
+            # (1/4 the f32 bytes over the link) and dequantize with one
+            # fused device multiply — still zero HOST convert work
+            q, scale = host_batch[1], host_batch[2]
+            self.bytes_to_device += q.nbytes + scale.nbytes
+            out = (jax.device_put([q, scale], self.device)
+                   if self.device is not None
+                   else jax.device_put([q, scale]))
+            return PackedDenseBatch(_dequant_q8(*out), self.num_col)
         if kind == "bcoo_csr":
             from jax.experimental import sparse as jsparse
 
@@ -1048,10 +1399,20 @@ class DeviceIter:
             try:
                 item = self._host_iter.next()
             except BaseException as exc:  # noqa: BLE001 - classified below
+                if self._snap_serving and isinstance(exc,
+                                                     CacheCorruptionError):
+                    # corrupt warm snapshot batch: drop the file FIRST so
+                    # the restart below re-arms from the source (or a
+                    # deterministic rebuild) instead of re-reading the
+                    # same bad bytes forever
+                    self._invalidate_snapshot()
                 if self._maybe_restart_pipeline(exc):
                     continue
                 raise
             if item is None:
+                # a COMPLETE cold pass publishes its shadow snapshot here
+                # (mid-epoch restores abort the writer before this point)
+                self._finish_snapshot_writer()
                 return
             if item is _SKIPPED:
                 # resume marker that load_state's drain missed (stream
@@ -1060,7 +1421,14 @@ class DeviceIter:
             if producer_put:
                 self._inflight.append(item)
             else:
-                host_batch, bufs = item
+                host_batch, bufs, annot = item
+                if self._snap_writer is not None:
+                    self._write_snapshot_batch(host_batch, annot)
+                if self._snap_serving:
+                    # warm feed: the source-side fifo is idle (nothing is
+                    # parsed) — pair the stored annotation with delivery
+                    # through the same fifo the cold path uses
+                    self._annot_fifo.append(annot)
                 self._inflight.append(self._put(host_batch, bufs))
 
     def __iter__(self):
@@ -1083,7 +1451,8 @@ class DeviceIter:
         consumer_put = self.batch_size is not None
         window = (t1 - t0) - (d_disp if consumer_put else 0.0)
         weights = {k: busy1[k] - busy0[k]
-                   for k in ("read", "cache_read", "parse", "convert")}
+                   for k in ("read", "cache_read", "snapshot_read",
+                             "parse", "convert")}
         if not consumer_put:
             # natural-block mode dispatches on the producer thread: its put
             # time is part of what the consumer waited on
@@ -1154,7 +1523,11 @@ class DeviceIter:
         JOINED (not just signalled) before annotation state is cleared —
         an in-flight produce step could otherwise append a stale old-epoch
         annotation after the clear and desync the fifo for the whole next
-        epoch."""
+        epoch. With a snapshot armed this is also the epoch boundary the
+        store keys on: the next pass serves warm once a snapshot is
+        published, and the plan epoch advances so each warm epoch draws a
+        fresh batch permutation."""
+        advanced = self.batches_fed > 0
         self._teardown_producer()
         self._skip_blocks = 0
         self._drop_rows = 0
@@ -1163,6 +1536,14 @@ class DeviceIter:
         self.batches_fed = 0
         self.pipeline_restarts = 0  # fresh fault budget per epoch
         self.pipeline_giveups = 0
+        if self.snapshot_path is not None:
+            self._abort_snapshot_writer()  # mid-epoch reset: partial pass
+            self._snap_shadow = True
+            self._snap_seq_restore = False
+            self._snap_suspend = False
+            self._snap_pos0 = 0
+            if advanced:
+                self._snap_epoch += 1
 
     # -------- checkpoint / resume (SURVEY.md §5.4 addition) --------
 
@@ -1182,6 +1563,7 @@ class DeviceIter:
         if self._host_iter_obj is not None:
             self._host_iter_obj.destroy()
             self._host_iter_obj = None
+        self._snap_serving = False
         self._annot_fifo.clear()
         # drop the staging ring with the producer: slots acquired by
         # now-dead workers would otherwise stay busy forever
@@ -1191,7 +1573,88 @@ class DeviceIter:
         with _telemetry.scope(self.pipeline_label):
             self._load_state_scoped(state)
 
+    def _load_snapshot_state(self, state: dict) -> bool:
+        """Restore into warm snapshot serving when possible. Returns True
+        when the state was fully handled; False hands it to the normal
+        source-seek/replay machinery (cold restore).
+
+        Snapshot batches are 1:1 with pipeline batches at one geometry,
+        so the delivered-batch count IS the warm resume position — a
+        checkpoint taken against a block-cache (or plain) pipeline
+        restores into a warm snapshot pipeline byte-identically, and vice
+        versa (the stored per-batch annotations are the cold pipeline's
+        own states). Plan-position states (``kind='epoch_plan'`` with
+        ``unit='batch'`` under ``source``) adopt the state's plan
+        identity wholesale; a vanished snapshot under a plan state
+        triggers a deterministic full rebuild."""
+        kind = state.get("kind")
+        n = int(state.get("batches", 0))
+        src = state.get("source") if kind == "source" else None
+        plan = (src if isinstance(src, dict)
+                and src.get("kind") == "epoch_plan"
+                and src.get("unit") == "batch" else None)
+        if plan is not None:
+            self._teardown_producer()
+            self._abort_snapshot_writer()
+            self._snap_shadow = False
+            self._snap_suspend = False
+            self._snap_seq_restore = False
+            seed = plan.get("seed")
+            self._snap_seed = None if seed is None else int(seed)
+            self._snap_epoch = int(plan.get("epoch", 0))
+            pos = int(plan.get("pos", n))
+            if not self._open_snapshot():
+                self._rebuild_snapshot()
+            self._snap_pos0 = pos
+            self.batches_fed = n
+            self._last_resume = ({"source": dict(plan), "skip_rows": 0}
+                                 if pos else None)
+            return True
+        if isinstance(src, dict) and src.get("kind") == "epoch_plan":
+            # a BLOCK-plan state (shuffled/sharded block cache): its
+            # position lives in the cache's permuted block stream, which
+            # this snapshot (always sequential-order — snapshot + source
+            # plan is rejected at construction) cannot reproduce. Hand it
+            # to the source, which replays the plan byte-identically.
+            return False
+        if kind not in ("source", "batches") or not self._open_snapshot():
+            return False
+        if n > self._snap_reader.num_batches:
+            # stale count (shrunk source rebuilt elsewhere): the cold
+            # machinery owns foreign states
+            return False
+        self._teardown_producer()
+        self._abort_snapshot_writer()
+        self._snap_shadow = False
+        self._snap_suspend = False
+        # a sequential position restored into a plan-armed pipeline: the
+        # position only exists in the SEQUENTIAL stream, so the rest of
+        # this epoch serves sequentially — byte-identical to the stream
+        # the state came from — and the plan resumes next epoch (the
+        # same contract as the block cache's legacy restores)
+        self._snap_seq_restore = self._snap_seed is not None
+        self._snap_pos0 = n
+        self.batches_fed = n
+        if kind == "source":
+            self._last_resume = {k: state[k]
+                                 for k in ("source", "skip_rows")}
+        else:
+            self._last_resume = (self._snap_reader.resume(n - 1)
+                                 if n else None)
+        return True
+
     def _load_state_scoped(self, state: dict) -> None:
+        if self.snapshot_path is not None:
+            if self._load_snapshot_state(state):
+                return
+            # cold restore below: a mid-epoch seek can no longer shadow-
+            # write a complete snapshot, and the seeked SOURCE owns the
+            # stream for the rest of this epoch (a warm snapshot cannot
+            # reproduce e.g. a block-plan order) — both resume at the
+            # next reset()
+            self._abort_snapshot_writer()
+            self._snap_shadow = False
+            self._snap_suspend = True
         if state.get("kind") == "source":
             # byte-exact restore: seek the source (parser -> split) to the
             # block boundary, drop the few rows into it, rebatch from there
@@ -1241,6 +1704,8 @@ class DeviceIter:
     def close(self) -> None:
         if self._host_iter_obj is not None:
             self._host_iter_obj.destroy()
+        self._abort_snapshot_writer()
+        self._drop_snap_reader()
         if hasattr(self.source, "close"):
             self.source.close()
         if self._trace_export:
@@ -1308,6 +1773,20 @@ class DeviceIter:
             # shadow-writing), 'warm' (serving mmap'd parsed blocks), or
             # None when no block cache is armed (docs/data.md)
             "cache_state": getattr(self.source, "cache_state", None),
+            # device-native snapshot store: None when not armed, 'warm'
+            # while this epoch streams stored device-layout batches
+            # (convert busy stays ~0), 'cold' while converting +
+            # shadow-writing (docs/data.md snapshot section)
+            "snapshot_state": (None if self.snapshot_path is None
+                               else ("warm" if self._snap_serving
+                                     else "cold")),
+            # the snapshot plan identity (permutation over BATCH indices,
+            # pure function of (seed, epoch)) — None seed = sequential
+            "snapshot_seed": (self._snap_seed
+                              if self.snapshot_path is not None else None),
+            "snapshot_epoch": (self._snap_epoch
+                               if self.snapshot_path is not None
+                               else None),
             # the epoch planner's identity when the source serves a
             # shuffle-native / pod-sharded cache: the seed and epoch every
             # delivered byte is a function of, None with no plan armed

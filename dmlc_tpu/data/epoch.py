@@ -187,17 +187,30 @@ def permute_block_rows(block: RowBlock, perm: np.ndarray,
 
 
 def plan_state_dict(seed: Optional[int], window: int, epoch: int, pos: int,
-                    host_id: int, num_hosts: int) -> dict:
+                    host_id: int, num_hosts: int,
+                    unit: str = "block") -> dict:
     """THE ``kind='epoch_plan'`` resume-annotation shape — ``(seed,
     epoch, plan position)`` plus the sharding identity. One builder:
     delivered-block annotations (:meth:`EpochPlan.state`), checkpoint
     states, and the sharded-cold wrapping all come through here, so the
     shape cannot drift between producers
-    (``BlockCacheIter._load_plan_state`` adopts every field)."""
-    return {"kind": "epoch_plan",
-            "seed": None if seed is None else int(seed),
-            "window": int(window), "epoch": int(epoch), "pos": int(pos),
-            "host_id": int(host_id), "num_hosts": int(num_hosts)}
+    (``BlockCacheIter._load_plan_state`` adopts every field).
+
+    ``unit`` names what the plan permutes: ``'block'`` (the block cache's
+    cached parser blocks — the default, omitted from the state so
+    pre-existing checkpoints stay byte-identical) or ``'batch'`` (the
+    device-native snapshot store's fixed-geometry batches,
+    :mod:`dmlc_tpu.io.snapshot` — the SAME permutation machinery one tier
+    up, consumed by ``DeviceIter``'s ``snapshot_shuffle_seed``). The two
+    streams' positions are not interchangeable, so each consumer rejects
+    the other's unit loudly instead of restoring a wrong position."""
+    state = {"kind": "epoch_plan",
+             "seed": None if seed is None else int(seed),
+             "window": int(window), "epoch": int(epoch), "pos": int(pos),
+             "host_id": int(host_id), "num_hosts": int(num_hosts)}
+    if unit != "block":
+        state["unit"] = str(unit)
+    return state
 
 
 class EpochPlan:

@@ -198,6 +198,7 @@ def create_row_block_iter(
     silent: bool = False,
     parse_workers: Optional[int] = None,
     block_cache: Optional[str] = None,
+    snapshot: Optional[str] = None,
     service: Optional[str] = None,
     shuffle_seed: Optional[int] = None,
     shuffle_window: int = 0,
@@ -226,6 +227,12 @@ def create_row_block_iter(
     locally — the drained parser is the drop-in
     :class:`~dmlc_tpu.service.client.ServiceParser` and the dispatcher
     owns the dataset spec (docs/service.md).
+
+    ``snapshot`` (or a ``#snapshot=<path>`` URI suffix) stamps the
+    device-native snapshot store onto the parser exactly as in
+    :func:`~dmlc_tpu.data.parsers.create_parser` — it takes effect when
+    the parser feeds a ``DeviceIter`` (docs/data.md snapshot section);
+    the row-block iterators themselves drain host blocks and ignore it.
 
     ``shuffle_seed`` / ``shuffle_window`` / ``pod_sharding`` arm the
     deterministic epoch planner on the block cache exactly as in
@@ -259,6 +266,7 @@ def create_row_block_iter(
                                index_dtype=index_dtype,
                                parse_workers=parse_workers,
                                block_cache=block_cache,
+                               snapshot=snapshot,
                                shuffle_seed=shuffle_seed,
                                shuffle_window=shuffle_window,
                                pod_sharding=pod_sharding, **parser_kw)
@@ -277,6 +285,7 @@ def create_row_block_iter(
                            index_dtype=index_dtype,
                            parse_workers=parse_workers,
                            block_cache=block_cache,
+                           snapshot=snapshot,
                            shuffle_seed=shuffle_seed,
                            shuffle_window=shuffle_window,
                            pod_sharding=pod_sharding, **parser_kw)

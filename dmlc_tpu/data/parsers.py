@@ -1717,6 +1717,11 @@ class BlockCacheIter(Parser):
         identity (seed/window/epoch/sharding) is adopted WHOLESALE — the
         state IS the stream position, and replay must be byte-identical
         even into a pipeline constructed with different knobs."""
+        check(state.get("unit") in (None, "block"),
+              "epoch_plan state over snapshot BATCHES (unit='batch') "
+              "cannot restore into the block cache's block stream — "
+              "restore it into a snapshot-armed DeviceIter "
+              "(docs/data.md snapshot section)")
         self._abort_writer()
         self._quiesce_plan_pool()
         seed = state.get("seed")
@@ -1966,6 +1971,7 @@ def create_parser(
     threaded: bool = True,
     parse_workers: Optional[int] = None,
     block_cache: Optional[str] = None,
+    snapshot: Optional[str] = None,
     service: Optional[str] = None,
     shuffle_seed: Optional[int] = None,
     shuffle_window: int = 0,
@@ -1990,6 +1996,19 @@ def create_parser(
     the ``DMLC_TPU_BLOCK_CACHE`` env directory; the cache self-invalidates
     when the source files, partition, or parser config drift
     (docs/data.md block cache section).
+
+    ``snapshot`` (or a ``#snapshot=<path>`` URI suffix) names a
+    device-native snapshot store (:mod:`dmlc_tpu.io.snapshot`): the path
+    and its staleness signature are stamped onto the returned parser as
+    ``snapshot_path`` / ``snapshot_signature``, and a ``DeviceIter``
+    built over it arms the store automatically — cold epochs shadow-write
+    the post-convert device-layout batches, warm epochs stream them into
+    HBM with zero parse AND zero convert work (docs/data.md snapshot
+    section: block cache = parser output, snapshot = device layout).
+    Composable with ``block_cache`` (the cold snapshot pass then reads
+    the warm cache); NOT with ``shuffle_seed`` — the snapshot freezes one
+    epoch's order, so shuffled snapshot epochs come from ``DeviceIter``'s
+    own ``snapshot_shuffle_seed`` (a permutation over stored batches).
 
     ``service`` (or a ``#service=<host:port>`` URI suffix) names a
     RowBlock data-service dispatcher: parsing then happens on a remote
@@ -2033,25 +2052,62 @@ def create_parser(
               "shuffle_window/pod_sharding are not supported — the "
               "dispatcher owns the dataset's plan (Dispatcher(plan=...), "
               "docs/service.md plan distribution)")
+        check(snapshot is None,
+              "create_parser(service=...): client-side snapshot= is not "
+              "supported — the dispatcher decides whether the fleet "
+              "ships device-layout snapshot frames "
+              "(Dispatcher(snapshot=...), docs/service.md)")
         from dmlc_tpu.service.client import ServiceParser
 
         return ServiceParser(service)
     if type_ == "auto":
         type_ = spec.args.get("format", "libsvm")
     bc_path = _resolve_block_cache(spec, part_index, num_parts, block_cache)
-    if spec.block_cache is not None:
-        # the fragment is block-cache routing sugar, not a chunk cachefile:
-        # strip it so downstream engines see a plain URI
+    snap_path = snapshot if snapshot is not None else spec.snapshot
+    if snap_path is not None and num_parts != 1:
+        snap_path = f"{snap_path}.split{num_parts}.part{part_index}"
+    # the snapshot stores one epoch's batch order: a source-side shuffle
+    # would change the order under it every epoch. Reject here — shuffled
+    # snapshot epochs come from DeviceIter's snapshot_shuffle_seed, a
+    # permutation over the STORED batches (docs/data.md).
+    check(snap_path is None or shuffle_seed is None,
+          "snapshot= cannot combine with shuffle_seed= (the snapshot "
+          "freezes one epoch's batch order) — use DeviceIter's "
+          "snapshot_shuffle_seed for shuffled snapshot epochs "
+          "(docs/data.md)")
+    if spec.block_cache is not None or spec.snapshot is not None:
+        # the fragment is cache/snapshot routing sugar, not a chunk
+        # cachefile: strip it so downstream engines see a plain URI
         uri = uri.split("#", 1)[0]
+    def _stamp_snapshot(parser: Parser) -> Parser:
+        """Arm the device-native snapshot store on the built parser:
+        DeviceIter reads these attributes at construction (docs/data.md
+        snapshot section). The signature is the block cache's source/
+        config key — any source or parser-config drift invalidates the
+        stored snapshot the same way it invalidates the cache."""
+        if snap_path is not None:
+            from dmlc_tpu.io import block_cache as _bc
+
+            parser.snapshot_path = snap_path
+            parser.snapshot_signature = _bc.source_signature(
+                spec.uri, part_index, num_parts,
+                format=type_, args=dict(spec.args),
+                index_dtype=np.dtype(index_dtype).str,
+                chunk_bytes=int(split_kw.get("chunk_bytes",
+                                             DEFAULT_CHUNK_BYTES)),
+                split={k: v for k, v in sorted(split_kw.items())
+                       if k != "chunk_bytes"})
+        return parser
+
     if bc_path is None:
         check(shuffle_seed is None and shuffle_window == 0
               and not pod_sharding,
               "shuffle_seed/shuffle_window/pod_sharding require a "
               "block_cache: the epoch plan orders cached blocks "
               "(docs/data.md)")
-        return _create_parser_uncached(
+        return _stamp_snapshot(_create_parser_uncached(
             uri, spec, part_index, num_parts, type_, index_dtype, threaded,
-            parse_workers, **split_kw)
+            parse_workers, **split_kw))
     if split_kw.get("shuffle") or split_kw.get("num_shuffle_parts"):
         # the old hard rejection ("the cache would freeze the first
         # epoch's order into every warm epoch") is gone: the epoch plan
@@ -2118,10 +2174,11 @@ def create_parser(
 
     # plan knobs stay OUTSIDE the signature: the plan orders blocks at
     # read time, so one cache serves every (seed, window, sharding)
-    return BlockCacheIter(build, bc_path, signature=signature,
-                          shuffle_seed=shuffle_seed,
-                          shuffle_window=shuffle_window,
-                          host_id=host_id, num_hosts=num_hosts)
+    return _stamp_snapshot(BlockCacheIter(
+        build, bc_path, signature=signature,
+        shuffle_seed=shuffle_seed,
+        shuffle_window=shuffle_window,
+        host_id=host_id, num_hosts=num_hosts))
 
 
 def _create_parser_uncached(

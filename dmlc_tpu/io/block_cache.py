@@ -61,7 +61,6 @@ from dmlc_tpu.utils.timer import get_time
 
 BLOCK_CACHE_MAGIC = b"DMLCBC01"
 BLOCK_CACHE_VERSION = 1
-_HEADER = BLOCK_CACHE_MAGIC + struct.pack("<I", BLOCK_CACHE_VERSION) + b"\0" * 4
 _TAIL_FMT = "<QQI"  # footer offset, footer length, footer crc32
 _TAIL_LEN = struct.calcsize(_TAIL_FMT) + len(BLOCK_CACHE_MAGIC)
 _ALIGN = 64
@@ -69,6 +68,17 @@ _ALIGN = 64
 # canonical segment order (fixed so the golden layout is deterministic);
 # optional arrays are simply absent from a block's footer entry
 SEGMENT_NAMES = ("offset", "label", "weight", "qid", "field", "index", "value")
+
+
+def container_header(magic: bytes, version: int) -> bytes:
+    """The shared v1 container header: 8-byte magic + u32 LE version +
+    4 zero pad bytes — one builder for every DMLC segment container
+    (block cache, device-native snapshot)."""
+    check(len(magic) == 8, "container magic must be 8 bytes")
+    return magic + struct.pack("<I", version) + b"\0" * 4
+
+
+_HEADER = container_header(BLOCK_CACHE_MAGIC, BLOCK_CACHE_VERSION)
 
 
 def _pad_to(f, align: int) -> int:
@@ -81,17 +91,20 @@ def _pad_to(f, align: int) -> int:
 
 
 def write_segments(f, segments: Dict[str, Optional[np.ndarray]],
-                   crc: int = 0) -> tuple:
-    """Serialize the present :data:`SEGMENT_NAMES` arrays at ``f``'s
-    current (already-aligned) position — the v1 segment encoding shared
-    by the on-disk cache block and the data-service wire frame
-    (:mod:`dmlc_tpu.service.frame`): canonical order, each array start
-    padded to 64-byte alignment, raw little-endian C-order bytes, one
-    crc32 rolling over padding + payload. Returns ``(end, crc, arrays)``
-    with ``arrays`` mapping name -> ``[dtype_str, abs_offset, nbytes]``
-    (the footer/meta schema both containers store)."""
+                   crc: int = 0, names=SEGMENT_NAMES) -> tuple:
+    """Serialize the present ``names`` arrays (default
+    :data:`SEGMENT_NAMES`) at ``f``'s current (already-aligned) position —
+    the v1 segment encoding shared by the on-disk cache block, the
+    data-service wire frame (:mod:`dmlc_tpu.service.frame`), and the
+    device-native snapshot store (:mod:`dmlc_tpu.io.snapshot`, which
+    passes its own positional name order): canonical order, each array
+    start padded to 64-byte alignment, raw little-endian C-order bytes,
+    one crc32 rolling over padding + payload. Returns ``(end, crc,
+    arrays)`` with ``arrays`` mapping name ->
+    ``[dtype_str, abs_offset, nbytes]`` (the footer/meta schema every
+    container stores)."""
     arrays: Dict[str, list] = {}
-    for name in SEGMENT_NAMES:
+    for name in names:
         arr = segments.get(name)
         if arr is None:
             continue
@@ -106,21 +119,106 @@ def write_segments(f, segments: Dict[str, Optional[np.ndarray]],
         raw = arr.tobytes()  # canonical C-order little-endian payload
         f.write(raw)
         crc = zlib.crc32(raw, crc)
-        arrays[name] = [arr.dtype.str, start, len(raw)]
+        # extension dtypes (ml_dtypes bfloat16 in snapshot segments) read
+        # as void through .str ('<V2') — their registered NAME round-trips
+        # through np.dtype(); standard dtypes keep .str (golden-pinned)
+        dtype_str = (arr.dtype.str if arr.dtype.kind != "V"
+                     else arr.dtype.name)
+        arrays[name] = [dtype_str, start, len(raw)]
     return f.tell(), crc & 0xFFFFFFFF, arrays
+
+
+def _segment_dtype(dtype_str: str) -> np.dtype:
+    """Resolve a stored segment dtype. Extension names ('bfloat16') only
+    resolve once ml_dtypes has registered them — a client process that
+    never imported jax (e.g. a host-block service consumer decoding bf16
+    snapshot frames) must not crash on the lookup."""
+    try:
+        return np.dtype(dtype_str)
+    except TypeError:
+        import ml_dtypes  # noqa: F401 - import registers the dtypes
+
+        return np.dtype(dtype_str)
 
 
 def read_segments(buf, arrays: Dict[str, list]) -> Dict[str, np.ndarray]:
     """Decode a :func:`write_segments` ``arrays`` mapping over ``buf``
     (an mmap or bytes) into {name: zero-copy numpy view} — shared by the
-    warm cache reader and the service frame decoder."""
+    warm cache reader, the service frame decoder, and the snapshot
+    reader."""
     out: Dict[str, np.ndarray] = {}
     for name, (dtype_str, off, nbytes) in arrays.items():
-        dt = np.dtype(dtype_str)
+        dt = _segment_dtype(dtype_str)
         out[name] = np.frombuffer(buf, dtype=dt,
                                   count=nbytes // dt.itemsize,
                                   offset=int(off))
     return out
+
+
+def finish_container(f, tmp_path: str, path: str, footer: dict,
+                     magic: bytes) -> None:
+    """The shared publish tail: write the crc'd JSON ``footer`` + tail
+    record + closing ``magic``, fsync, close, and atomically rename
+    ``tmp_path`` -> ``path``. One implementation so a crash can never
+    leave a torn-but-valid-looking container of either format."""
+    payload = json.dumps(footer, sort_keys=True,
+                         separators=(",", ":")).encode()
+    off = _pad_to(f, _ALIGN)
+    f.write(payload)
+    f.write(struct.pack(_TAIL_FMT, off, len(payload),
+                        zlib.crc32(payload) & 0xFFFFFFFF))
+    f.write(magic)
+    # fsync BEFORE the atomic rename: without it a crash between write
+    # and rename can publish a complete-looking file whose data blocks
+    # never hit the platter (same protocol as CachedInputSplit)
+    f.flush()
+    os.fsync(f.fileno())
+    f.close()
+    os.replace(tmp_path, path)
+
+
+def open_container(path: str, magic: bytes, version: int, what: str):
+    """mmap a published container and verify its structure (header magic +
+    version, tail magic, footer crc): the shared open half of
+    :func:`finish_container`. Returns ``(file, mmap, footer_dict)``;
+    raises :class:`DMLCError` — with the file/mmap already closed — on
+    any structural problem."""
+    header = container_header(magic, version)
+    f = mm = None
+    try:
+        size = os.path.getsize(path)
+        check(size >= len(header) + _TAIL_LEN, f"{what}: too short")
+        f = open(path, "rb")
+        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+    except (OSError, DMLCError) as exc:
+        if mm is not None:
+            mm.close()
+        if f is not None:
+            f.close()  # the fd must not leak when the mmap fails
+        raise DMLCError(f"{what}: unreadable: {exc}") from exc
+    try:
+        head = mm[: len(header)]
+        check(head[:8] == magic, f"{what}: bad magic")
+        (ver,) = struct.unpack("<I", head[8:12])
+        check(ver == version, f"{what}: version {ver} != {version}")
+        tail = mm[size - _TAIL_LEN:]
+        check(tail[-8:] == magic, f"{what}: truncated (no tail magic)")
+        off, length, crc = struct.unpack(
+            _TAIL_FMT, tail[: struct.calcsize(_TAIL_FMT)])
+        check(off + length <= size - _TAIL_LEN,
+              f"{what}: footer out of range")
+        with memoryview(mm)[off: off + length] as mv:
+            payload_crc = zlib.crc32(mv) & 0xFFFFFFFF
+            payload = bytes(mv)  # json needs bytes; footer is small
+        check(payload_crc == crc, f"{what}: footer crc mismatch")
+        return f, mm, json.loads(payload)
+    except Exception:
+        try:
+            mm.close()
+        except BufferError:  # pragma: no cover - no views exported yet
+            pass
+        f.close()
+        raise
 
 
 class BlockCacheWriter:
@@ -183,21 +281,9 @@ class BlockCacheWriter:
             "rows": self._rows,
             "blocks": self._entries,
         }
-        payload = json.dumps(footer, sort_keys=True,
-                             separators=(",", ":")).encode()
-        off = _pad_to(f, _ALIGN)
-        f.write(payload)
-        f.write(struct.pack(_TAIL_FMT, off, len(payload),
-                            zlib.crc32(payload) & 0xFFFFFFFF))
-        f.write(BLOCK_CACHE_MAGIC)
-        # fsync BEFORE the atomic rename: without it a crash between write
-        # and rename can publish a complete-looking file whose data blocks
-        # never hit the platter (same protocol as CachedInputSplit)
-        f.flush()
-        os.fsync(f.fileno())
-        f.close()
+        finish_container(f, self.tmp_path, self.path, footer,
+                         BLOCK_CACHE_MAGIC)
         self._f = None
-        os.replace(self.tmp_path, self.path)
         self._finished = True
 
     def abort(self) -> None:
@@ -227,38 +313,10 @@ class BlockCacheReader:
                  verify: bool = True):
         self.path = path
         self.verify = verify
-        self._file = None
-        self._mm = None
+        self._file, self._mm, footer = open_container(
+            path, BLOCK_CACHE_MAGIC, BLOCK_CACHE_VERSION,
+            f"block cache {path}")
         try:
-            size = os.path.getsize(path)
-            check(size >= len(_HEADER) + _TAIL_LEN, "block cache too short")
-            self._file = open(path, "rb")
-            self._mm = mmap.mmap(self._file.fileno(), 0,
-                                 access=mmap.ACCESS_READ)
-        except (OSError, DMLCError) as exc:
-            self.close()  # the fd must not leak when the mmap fails
-            raise DMLCError(f"block cache {path}: unreadable: {exc}") from exc
-        try:
-            head = self._mm[: len(_HEADER)]
-            check(head[:8] == BLOCK_CACHE_MAGIC,
-                  f"block cache {path}: bad magic")
-            (version,) = struct.unpack("<I", head[8:12])
-            check(version == BLOCK_CACHE_VERSION,
-                  f"block cache {path}: version {version} != "
-                  f"{BLOCK_CACHE_VERSION}")
-            tail = self._mm[size - _TAIL_LEN:]
-            check(tail[-8:] == BLOCK_CACHE_MAGIC,
-                  f"block cache {path}: truncated (no tail magic)")
-            off, length, crc = struct.unpack(
-                _TAIL_FMT, tail[: struct.calcsize(_TAIL_FMT)])
-            check(off + length <= size - _TAIL_LEN,
-                  f"block cache {path}: footer out of range")
-            with memoryview(self._mm)[off: off + length] as mv:
-                payload_crc = zlib.crc32(mv) & 0xFFFFFFFF
-                payload = bytes(mv)  # json needs bytes; footer is small
-            check(payload_crc == crc,
-                  f"block cache {path}: footer crc mismatch")
-            footer = json.loads(payload)
             self.signature = footer.get("signature") or {}
             self.num_col = int(footer.get("num_col", 0))
             self.rows = int(footer.get("rows", 0))

@@ -53,15 +53,21 @@ class URISpec:
     (docs/data.md) instead of the raw chunk cache — ``block_cache`` then
     carries the raw path (partition qualification happens at the resolver,
     :func:`dmlc_tpu.data.parsers.create_parser`) and ``cache_file`` stays
-    None. A ``#service=<host:port>`` fragment selects the disaggregated
-    **RowBlock data service** (docs/service.md): ``service`` carries the
-    dispatcher address and the rest of the URI is informational (the
-    dispatcher owns the dataset spec).
+    None. A ``#snapshot=<path>`` fragment selects the device-native
+    **snapshot store** (docs/data.md snapshot section): ``snapshot``
+    carries the raw path, resolved/qualified the same way, and arms
+    ``DeviceIter``'s warm snapshot serving through the parser's
+    ``snapshot_path`` attribute. A ``#service=<host:port>`` fragment
+    selects the disaggregated **RowBlock data service**
+    (docs/service.md): ``service`` carries the dispatcher address and the
+    rest of the URI is informational (the dispatcher owns the dataset
+    spec).
     """
 
     def __init__(self, uri: str, part_index: int = 0, num_parts: int = 1):
         name_cache = uri.split("#")
         self.block_cache: str | None = None
+        self.snapshot: str | None = None
         self.service: str | None = None
         if len(name_cache) == 2:
             cache = name_cache[1]
@@ -72,6 +78,13 @@ class URISpec:
                         "empty path in `#blockcache=` URI suffix")
                 self.block_cache = path
                 self.cache_file: str | None = None
+            elif cache.startswith("snapshot="):
+                path = cache[len("snapshot="):]
+                if not path:
+                    raise DMLCError(
+                        "empty path in `#snapshot=` URI suffix")
+                self.snapshot = path
+                self.cache_file = None
             elif cache.startswith("service="):
                 addr = cache[len("service="):]
                 if not addr or ":" not in addr:

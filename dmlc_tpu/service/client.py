@@ -37,17 +37,19 @@ import threading
 from typing import Dict, Optional
 
 from dmlc_tpu.data.parsers import Parser
-from dmlc_tpu.data.row_block import RowBlock
+from dmlc_tpu.data.row_block import DenseBlock, RowBlock
 from dmlc_tpu.io import resilience as _resilience
 from dmlc_tpu.service import dispatcher as _dispatch
 from dmlc_tpu.service.frame import (
     KIND_BLOCK,
     KIND_END,
     KIND_ERROR,
+    KIND_SNAPSHOT,
     ServiceFrameError,
     annot_key,
     block_from_frame,
     recv_frame,
+    snapshot_from_frame,
 )
 from dmlc_tpu.utils.check import DMLCError
 from dmlc_tpu.utils.timer import get_time
@@ -94,6 +96,14 @@ class ServiceParser(Parser):
         # workers on one global shuffle (docs/service.md)
         self.plan = dict(cfg.get("plan") or {})
         self.shuffle_seed = self.plan.get("shuffle_seed")
+        # dispatcher-decided snapshot mode: with a geometry shipped, the
+        # fleet streams device-layout PACKED batches instead of CSR
+        # blocks (bf16 halves the wire bytes) and delivered blocks are
+        # exact-batch-size packed DenseBlocks — DeviceIter's zero-work
+        # dense_ready fast path (docs/service.md snapshot frames).
+        # Checkpoints stay (part, batch) 'service' states: the packed
+        # batches carry no parser-chain annotations to match against.
+        self.snapshot = dict(cfg.get("snapshot") or {})
         self._part = 0
         self._pos = 0          # next block index within the current part
         self._delivered = 0    # blocks delivered this epoch (all parts)
@@ -158,9 +168,10 @@ class ServiceParser(Parser):
             (owner["host"], int(owner["port"])),
             timeout=self._connect_timeout)
         sock.settimeout(self._stream_timeout)
-        sock.sendall(json.dumps({
-            "cmd": "stream", "part": self._part, "start": self._pos,
-        }).encode() + b"\n")
+        req = {"cmd": "stream", "part": self._part, "start": self._pos}
+        if self.snapshot:
+            req["snapshot"] = True
+        sock.sendall(json.dumps(req).encode() + b"\n")
         self._sock = sock
         self._owner = str(owner["worker"])
         if self._failover_from is not None:
@@ -231,6 +242,31 @@ class ServiceParser(Parser):
                 self._stream_failures = 0  # progress resets the budget
                 self._soft_retry_owner = None
                 self._last_annot = meta.get("resume")
+                return block
+            if kind == KIND_SNAPSHOT:
+                # device-layout packed batch: decode to a packed
+                # DenseBlock (zero-copy views over the payload) —
+                # DeviceIter serves it through the dense_ready fast path
+                t1 = get_time()
+                bkind, *arrays = snapshot_from_frame(meta, payload)
+                if bkind != "dense_packed":
+                    self._on_stream_fault(DMLCError(
+                        f"unsupported snapshot frame kind {bkind!r}"))
+                    continue
+                xp = arrays[0]
+                nc = int(self.snapshot["num_col"])
+                block = DenseBlock(xp, xp[:, nc], xp[:, nc + 1],
+                                   hold=payload, packed=True)
+                resume = meta.get("resume")
+                if resume is not None:
+                    block.resume_state = resume
+                self._decode_seconds += get_time() - t1
+                self._bytes += len(payload)
+                self._pos += 1
+                self._delivered += 1
+                self._stream_failures = 0
+                self._soft_retry_owner = None
+                self._last_annot = resume
                 return block
             if kind == KIND_END:
                 total = meta.get("blocks")
@@ -335,6 +371,14 @@ class ServiceParser(Parser):
         self._soft_retry_owner = None
         self._last_annot = None
         kind = state.get("kind")
+        if self.snapshot and kind != "service":
+            # per-part batch counts differ from block counts and packed
+            # batches carry no parser-chain annotations — a foreign state
+            # must fail loudly, not restore a wrong position
+            raise DMLCError(
+                "snapshot-mode service clients restore (part, batch) "
+                f"'service' states only, got kind {kind!r} "
+                "(docs/service.md snapshot frames)")
         if kind == "service":
             self._part = int(state["part"])
             self._pos = int(state["block"])

@@ -75,7 +75,8 @@ class Dispatcher:
                  parser: Optional[dict] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  liveness_timeout: float = 10.0,
-                 plan: Optional[dict] = None):
+                 plan: Optional[dict] = None,
+                 snapshot: Optional[dict] = None):
         self.uri = uri
         self.num_parts = int(num_parts)
         self.parser = dict(parser or {})
@@ -85,6 +86,13 @@ class Dispatcher:
         # client learns the seed its epochs are a function of — the one
         # place the fleet's shuffle is decided (docs/service.md)
         self.plan = dict(plan or {})
+        # snapshot-frame geometry ({batch_size, num_col, x_dtype}): when
+        # set, workers ALSO pack each part into fixed-geometry device-
+        # layout batches (dmlc_tpu/io/snapshot.py encoding) and clients
+        # stream those instead of CSR blocks — x_dtype='bfloat16' halves
+        # the wire bytes. One dispatcher-owned knob, like the plan: the
+        # whole fleet serves one batch geometry or none (docs/service.md)
+        self.snapshot = dict(snapshot or {})
         self.liveness_timeout = float(liveness_timeout)
         self._lock = threading.Lock()
         self._workers: Dict[str, _WorkerInfo] = {}
@@ -145,7 +153,8 @@ class Dispatcher:
         with self._lock:
             if cmd == "config":
                 return {"uri": self.uri, "num_parts": self.num_parts,
-                        "parser": self.parser, "plan": self.plan}
+                        "parser": self.parser, "plan": self.plan,
+                        "snapshot": self.snapshot}
             if cmd == "register":
                 worker = str(req["worker"])
                 self._workers[worker] = _WorkerInfo(

@@ -32,10 +32,11 @@ class LocalFleet:
                  liveness_timeout: float = 10.0,
                  poll_interval: float = 0.05,
                  heartbeat_interval: float = 1.0,
-                 plan: Optional[dict] = None):
+                 plan: Optional[dict] = None,
+                 snapshot: Optional[dict] = None):
         self.dispatcher = Dispatcher(uri, num_parts, parser=parser,
                                      liveness_timeout=liveness_timeout,
-                                     plan=plan)
+                                     plan=plan, snapshot=snapshot)
         self.tracker = None
         tracker_addr = None
         if tracker:

@@ -56,6 +56,10 @@ FRAME_VERSION = 1
 KIND_BLOCK = 1
 KIND_END = 2
 KIND_ERROR = 3
+# a device-layout snapshot batch (dmlc_tpu/io/snapshot.py positional
+# segment encoding): the worker ships post-convert packed batches — bf16
+# halves the wire bytes vs the f32 CSR block frames (docs/service.md)
+KIND_SNAPSHOT = 4
 
 _HEADER_FMT = "<4sBB2xIQ"  # magic, version, kind, meta_len, payload_len
 HEADER_LEN = struct.calcsize(_HEADER_FMT)
@@ -112,6 +116,65 @@ def encode_block_frame(block: RowBlock,
     _telemetry.record_span("service_encode", t0, get_time() - t0,
                            rows=len(block))
     return out
+
+
+def encode_snapshot_frame(kind: str, arrays, rows: int,
+                          resume: Optional[dict] = None) -> bytes:
+    """One device-layout batch as a SNAPSHOT frame: the positional
+    snapshot segment encoding (:mod:`dmlc_tpu.io.snapshot`
+    ``a0..aN`` names, shapes in the meta) over the same
+    :func:`~dmlc_tpu.io.block_cache.write_segments` machinery as BLOCK
+    frames — so a worker's snapshot frame and an on-disk snapshot batch
+    are the same bytes modulo framing. ``kind`` is the host-batch kind
+    (``dense_packed`` / ``dense_packed_q8`` / ...)."""
+    import numpy as np
+
+    from dmlc_tpu.io.snapshot import SNAPSHOT_SEGMENT_NAMES
+
+    t0 = get_time()
+    arrs = [np.ascontiguousarray(a) for a in arrays]
+    buf = io.BytesIO()
+    _, _, arr_meta = write_segments(
+        buf, {SNAPSHOT_SEGMENT_NAMES[i]: a.reshape(-1)
+              for i, a in enumerate(arrs)},
+        names=SNAPSHOT_SEGMENT_NAMES)
+    resume_json = (json.loads(json.dumps(resume))
+                   if resume is not None else None)
+    meta = {
+        "kind": str(kind),
+        "rows": int(rows),
+        "resume": resume_json,
+        "arrays": arr_meta,
+        "shapes": {SNAPSHOT_SEGMENT_NAMES[i]: list(a.shape)
+                   for i, a in enumerate(arrs)},
+    }
+    out = _pack(KIND_SNAPSHOT, meta, buf.getvalue())
+    _telemetry.record_span("service_encode", t0, get_time() - t0,
+                           rows=int(rows))
+    return out
+
+
+def snapshot_from_frame(meta: dict, payload: bytes) -> tuple:
+    """Rebuild ``(kind, arr0, arr1, ...)`` from a SNAPSHOT frame — the
+    arrays are zero-copy views over ``payload`` reshaped to the stored
+    shapes (callers pin ``payload`` as the hold)."""
+    from dmlc_tpu.io.snapshot import SNAPSHOT_SEGMENT_NAMES
+
+    t0 = get_time()
+    segments = read_segments(payload, meta["arrays"])
+    shapes = meta.get("shapes") or {}
+    out = []
+    for name in SNAPSHOT_SEGMENT_NAMES:
+        if name not in segments:
+            break
+        arr = segments[name]
+        shape = shapes.get(name)
+        if shape is not None and len(shape) != 1:
+            arr = arr.reshape(shape)
+        out.append(arr)
+    _telemetry.record_span("service_decode", t0, get_time() - t0,
+                           rows=int(meta.get("rows", 0)))
+    return (meta["kind"], *out)
 
 
 def encode_end_frame(part: int, blocks: int) -> bytes:

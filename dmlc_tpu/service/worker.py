@@ -56,15 +56,21 @@ class _PartStore:
     client can stream a part that is still being parsed. Held in RAM for
     the worker's life (warm epoch re-serves + O(1) failover resume) —
     the fleet must be sized so each worker's share of the encoded corpus
-    fits its host (docs/service.md "Memory model")."""
+    fits its host (docs/service.md "Memory model"). ``snap_frames`` is
+    the part re-encoded as device-layout snapshot frames (packed on
+    first snapshot stream request, once the part is complete — the
+    dispatcher's ``snapshot`` geometry decides shape and dtype)."""
 
-    __slots__ = ("frames", "keys", "complete", "error")
+    __slots__ = ("frames", "keys", "complete", "error", "snap_frames",
+                 "snap_packing")
 
     def __init__(self):
         self.frames: List[bytes] = []
         self.keys: List[Optional[str]] = []  # annot_key per block (or None)
         self.complete = False
         self.error: Optional[str] = None
+        self.snap_frames: Optional[List[bytes]] = None
+        self.snap_packing = False  # one serve thread holds the pack claim
 
 
 class ParseWorker:
@@ -92,6 +98,12 @@ class ParseWorker:
         # break. The seed is the fleet's shared metadata, not a worker
         # serving mode (docs/service.md plan distribution).
         self.plan = dict(cfg.get("plan") or {})
+        # dispatcher-shipped snapshot geometry: when set, parts ALSO
+        # serve as device-layout snapshot frames — fixed [B, num_col + 2]
+        # packed batches in the geometry's x_dtype (bf16 halves the
+        # wire), packed lazily per part on first snapshot stream request
+        # (docs/service.md snapshot frames)
+        self.snapshot = dict(cfg.get("snapshot") or {})
         # data listener first: the tracker/dispatcher registrations carry
         # its port
         self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -263,7 +275,11 @@ class ParseWorker:
             except (TypeError, ValueError):
                 part = -1  # "part": null etc — handlers answer with ERROR
             if cmd == "stream":
-                self._serve_stream(conn, part, int(req.get("start", 0)))
+                if req.get("snapshot"):
+                    self._serve_stream_snapshot(conn, part,
+                                                int(req.get("start", 0)))
+                else:
+                    self._serve_stream(conn, part, int(req.get("start", 0)))
             elif cmd == "find":
                 self._serve_find(conn, part, str(req.get("key", "")))
             elif cmd == "count":
@@ -307,6 +323,86 @@ class ParseWorker:
                     return
             send_frame(conn, frame)  # the sendall runs outside the lock
             i += 1
+
+    def _pack_snapshot_frames(self, store: _PartStore) -> List[bytes]:
+        """The part re-encoded as device-layout snapshot frames: decode
+        the stored CSR block frames, pack to the dispatcher's fixed
+        batch geometry, encode once, cache on the store (warm re-serves
+        pay nothing). Runs under no lock — only the cached-list publish
+        does."""
+        from dmlc_tpu.data.device import pack_dense_batches
+        from dmlc_tpu.service.frame import (
+            block_from_frame, decode_frame, encode_snapshot_frame,
+        )
+
+        geometry = self.snapshot
+        B = int(geometry["batch_size"])
+        nc = int(geometry["num_col"])
+        if geometry.get("x_dtype") == "bfloat16":
+            from dmlc_tpu.native import bf16_dtype
+
+            dt = bf16_dtype()
+        else:
+            dt = None
+        blocks = []
+        for raw in store.frames:
+            _, meta, payload = decode_frame(raw)
+            blocks.append(block_from_frame(meta, payload))
+        frames = []
+        for packed, resume in pack_dense_batches(blocks, B, nc, dtype=dt):
+            frames.append(encode_snapshot_frame(
+                "dense_packed", (packed,), rows=B, resume=resume))
+        return frames
+
+    def _serve_stream_snapshot(self, conn, part: int, start: int) -> None:
+        """Stream a part as snapshot frames. Packing needs the whole
+        part (fixed batches span block boundaries), so this waits for
+        parse completion — the CSR stream stays the low-latency path;
+        snapshot frames trade first-byte latency for half the wire."""
+        store = self._wait_store(part)
+        if store is None or not self.snapshot:
+            send_frame(conn, encode_error_frame(
+                f"worker {self.worker_id} does not serve part {part} "
+                "as snapshot frames"))
+            return
+        with self._cond:
+            self._cond.wait_for(lambda: store.complete or self._dead)
+            if self._dead:
+                return
+            if store.error is not None:
+                send_frame(conn, encode_error_frame(store.error))
+                return
+            # single-packer claim: concurrent first requests must not
+            # each decode + repack the whole part — one thread packs,
+            # the rest wait on the publish
+            self._cond.wait_for(
+                lambda: store.snap_frames is not None
+                or not store.snap_packing or self._dead)
+            if self._dead:
+                return
+            frames = store.snap_frames
+            if frames is None:
+                store.snap_packing = True
+        if frames is None:
+            try:
+                packed = self._pack_snapshot_frames(store)
+            except Exception as exc:  # noqa: BLE001 - served as ERROR
+                with self._cond:
+                    store.snap_packing = False
+                    self._cond.notify_all()
+                send_frame(conn, encode_error_frame(
+                    f"snapshot packing failed: {exc}"))
+                return
+            with self._cond:
+                store.snap_frames = packed
+                store.snap_packing = False
+                self._cond.notify_all()
+                frames = store.snap_frames
+        for i in range(max(0, int(start)), len(frames)):
+            if self._dead:
+                return  # crash simulation: drop mid-stream, no goodbye
+            send_frame(conn, frames[i])
+        send_frame(conn, encode_end_frame(part, len(frames)))
 
     def _serve_find(self, conn, part: int, key: str) -> None:
         """Block index whose resume annotation matches ``key`` — the

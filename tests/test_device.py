@@ -1202,8 +1202,8 @@ def test_device_iter_stage_attribution_partitions_wall(tmp_path, layout):
     s = it.stats()
     it.close()
     assert n == 8
-    assert set(s["stages"]) == {"read", "cache_read", "parse", "convert",
-                                "dispatch", "transfer"}
+    assert set(s["stages"]) == {"read", "cache_read", "snapshot_read",
+                                "parse", "convert", "dispatch", "transfer"}
     assert s["cache_state"] is None  # no block cache armed on this source
     assert all(v >= 0.0 for v in s["stages"].values())
     assert s["wall_seconds"] > 0.0

@@ -185,7 +185,8 @@ def test_dispatcher_fcfs_exactly_once_and_reissue(tmp_path):
         addr = disp.address
         cfg = svc_dispatcher.request(addr, {"cmd": "config"})
         assert cfg == {"uri": "dummy.libsvm", "num_parts": 4,
-                       "parser": {"format": "libsvm"}, "plan": {}}
+                       "parser": {"format": "libsvm"}, "plan": {},
+                       "snapshot": {}}
         # unregistered workers get no splits
         resp = svc_dispatcher.request(addr, {"cmd": "next_split",
                                              "worker": "ghost"})

@@ -1,0 +1,742 @@
+"""Device-native snapshot store (ISSUE 9): format (golden-pinned),
+geometry/signature self-invalidation, the DeviceIter integration — cold
+shadow write, warm zero-convert serving, byte-identical checkpoints
+across cache<->snapshot pipeline swaps, plan-ordered epochs, int8
+quantization, corruption healing — the bf16 pack_aux losslessness guard,
+and the service snapshot frames (wire halving under bf16)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from dmlc_tpu.data import create_parser  # noqa: E402
+from dmlc_tpu.data.device import DeviceIter, pack_dense_batches  # noqa: E402
+from dmlc_tpu.io import resilience  # noqa: E402
+from dmlc_tpu.io.snapshot import (  # noqa: E402
+    SNAPSHOT_MAGIC,
+    SnapshotIter,
+    SnapshotReader,
+    SnapshotWriter,
+    open_snapshot,
+    quantize_int8,
+)
+from dmlc_tpu.utils.check import DMLCError  # noqa: E402
+
+NUM_COL = 6
+BATCH = 64
+
+
+def _corpus(tmp_path, n=512, name="c.libsvm", bf16_exact=True):
+    rng = np.random.default_rng(7)
+    path = tmp_path / name
+    with open(path, "w") as f:
+        for i in range(n):
+            label = i % 2 if bf16_exact else 0.1 + 0.01 * i
+            feats = " ".join(
+                f"{j}:{rng.standard_normal():.6f}" for j in range(NUM_COL))
+            f.write(f"{label} {feats}\n")
+    return str(path)
+
+
+def _make_iter(corpus, snap=None, **kw):
+    parser = create_parser(corpus, 0, 1, "libsvm", threaded=True,
+                           snapshot=snap)
+    kw.setdefault("num_col", NUM_COL)
+    kw.setdefault("batch_size", BATCH)
+    kw.setdefault("layout", "dense")
+    kw.setdefault("pack_aux", True)
+    return DeviceIter(parser, **kw)
+
+
+def _drain(it):
+    return [np.asarray(b.packed) for b in it]
+
+
+# ---------------- format ----------------
+
+GEOM = {"v": 1, "batch_size": 4, "num_col": 3, "x_dtype": "float32"}
+
+
+def _golden_batches():
+    """The exact fixture tests/data/snapshot_v1.golden was written from —
+    rewriting it must reproduce the committed bytes."""
+    xp = np.arange(20, dtype=np.float32).reshape(4, 5)
+    q, scale = quantize_int8(xp)
+    ell_idx = np.array([[0, 1], [2, 3]], np.int32)
+    ell_val = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    return [
+        ("dense_packed", (xp,), 4,
+         {"source": {"kind": "split", "chunks": 1,
+                     "split": {"kind": "byte", "offset_curr": 64}},
+          "skip_rows": 2}),
+        ("ell", (ell_idx, ell_val, np.array([1.0, 0.0], np.float32),
+                 np.array([1.0, 1.0], np.float32)), 2, None),
+        ("dense_packed_q8", (q, scale), 4, None),
+    ]
+
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "data", "snapshot_v1.golden")
+
+
+class TestFormat:
+    def test_roundtrip_shapes_and_views(self, tmp_path):
+        path = str(tmp_path / "s.snapshot")
+        w = SnapshotWriter(path, signature={"s": 1}, geometry=GEOM)
+        for kind, arrays, rows, resume in _golden_batches():
+            w.add_batch(kind, arrays, rows=rows, resume=resume)
+        w.finish()
+        assert not os.path.exists(path + ".tmp")  # atomic publish
+        r = SnapshotReader(path, signature={"s": 1}, geometry=GEOM)
+        assert r.num_batches == 3 and r.rows == 10
+        for i, (kind, arrays, rows, resume) in enumerate(_golden_batches()):
+            got = r.load_batch(i)
+            assert got[0] == kind
+            assert len(got) == 1 + len(arrays)
+            for a, b in zip(got[1:], arrays):
+                np.testing.assert_array_equal(a, b)
+                assert a.dtype == b.dtype and a.shape == b.shape
+                assert not a.flags.writeable  # zero-copy mmap contract
+            assert r.batch_rows(i) == rows
+            assert r.resume(i) == (json.loads(json.dumps(resume))
+                                   if resume is not None else None)
+        r.close()
+
+    def test_golden_layout_pinned(self, tmp_path):
+        """The v1 layout is frozen: rewriting the golden fixture must be
+        byte-identical to the committed file, and the committed file must
+        decode exactly — an accidental format change fails both ways."""
+        rebuilt = str(tmp_path / "rebuilt.golden")
+        w = SnapshotWriter(rebuilt, signature={"pinned": "snapshot-v1"},
+                           geometry=GEOM)
+        for kind, arrays, rows, resume in _golden_batches():
+            w.add_batch(kind, arrays, rows=rows, resume=resume)
+        w.finish()
+        with open(GOLDEN, "rb") as f:
+            want = f.read()
+        with open(rebuilt, "rb") as f:
+            got = f.read()
+        assert got == want, "on-disk snapshot layout drifted from v1"
+        r = SnapshotReader(GOLDEN)
+        assert r.signature == {"pinned": "snapshot-v1"}
+        k, xp = r.load_batch(0)
+        assert k == "dense_packed" and xp.shape == (4, 5)
+        np.testing.assert_array_equal(
+            xp, np.arange(20, dtype=np.float32).reshape(4, 5))
+        k8, q, scale = r.load_batch(2)
+        assert k8 == "dense_packed_q8" and q.dtype == np.int8
+        assert want[:8] == SNAPSHOT_MAGIC and want[-8:] == SNAPSHOT_MAGIC
+        r.close()
+
+    @pytest.mark.parametrize("drift", [
+        {"batch_size": 8},            # different batch size
+        {"x_dtype": "bfloat16"},      # different dtype
+        {"num_col": 4},               # different width
+    ])
+    def test_geometry_mismatch_self_invalidates(self, tmp_path, drift):
+        """A snapshot written at a different batch_size/x_dtype/padding
+        config must self-invalidate (same signature discipline as the
+        block cache), never serve wrong-shaped batches."""
+        path = str(tmp_path / "s.snapshot")
+        w = SnapshotWriter(path, signature={"s": 1}, geometry=GEOM)
+        w.add_batch(*_golden_batches()[0][:2], rows=4)
+        w.finish()
+        base = resilience.counters_snapshot()
+        assert open_snapshot(path, signature={"s": 1},
+                             geometry=dict(GEOM, **drift)) is None
+        assert not os.path.exists(path)  # stale snapshot dropped
+        assert resilience.counters_delta(base)[
+            "snapshot_invalidations"] == 1
+
+    def test_signature_mismatch_self_invalidates(self, tmp_path):
+        path = str(tmp_path / "s.snapshot")
+        w = SnapshotWriter(path, signature={"files": [["a", 1, 2]]},
+                           geometry=GEOM)
+        w.add_batch(*_golden_batches()[0][:2], rows=4)
+        w.finish()
+        assert open_snapshot(path, signature={"files": [["a", 1, 3]]},
+                             geometry=GEOM) is None
+        assert not os.path.exists(path)
+
+    def test_snapshot_iter_orders(self, tmp_path):
+        path = str(tmp_path / "s.snapshot")
+        w = SnapshotWriter(path, geometry=GEOM)
+        for kind, arrays, rows, resume in _golden_batches():
+            w.add_batch(kind, arrays, rows=rows, resume=resume)
+        w.finish()
+        r = SnapshotReader(path, geometry=GEOM)
+        it = SnapshotIter(r, order=np.array([2, 0, 1]), start=1)
+        first = it.next()
+        assert first is not None and first[0][0] == "dense_packed"
+        assert first[1] == r.resume(0)  # the stored annotation rides along
+        assert it.next()[0][0] == "ell"
+        assert it.next() is None
+        it.destroy()
+        r.close()
+
+    def test_quantize_int8_roundtrip_bound(self):
+        rng = np.random.default_rng(3)
+        a = rng.standard_normal((32, 7)).astype(np.float32) * 10
+        q, scale = quantize_int8(a)
+        assert q.dtype == np.int8 and scale.shape == (7,)
+        np.testing.assert_allclose(q.astype(np.float32) * scale, a,
+                                   atol=float(scale.max()) * 0.51)
+
+
+# ---------------- DeviceIter integration ----------------
+
+class TestPipeline:
+    def test_cold_writes_warm_serves_zero_convert(self, tmp_path):
+        corpus = _corpus(tmp_path)
+        snap = str(tmp_path / "c.snapshot")
+        it = _make_iter(corpus, snap=snap)
+        cold = _drain(it)
+        assert it.stats()["snapshot_state"] == "cold"
+        assert os.path.exists(snap)
+        it.close()
+        # a FRESH pipeline over the published snapshot serves warm with
+        # convert busy EXACTLY zero and a nonzero snapshot_read stage —
+        # the acceptance contract: the convert stage is bypassed, not
+        # merely overlapped
+        it2 = _make_iter(corpus, snap=snap)
+        warm = _drain(it2)
+        s = it2.stats()
+        assert s["snapshot_state"] == "warm"
+        assert s["stage_busy"]["convert"] == 0.0
+        assert s["stage_busy"]["parse"] == 0.0 and s["stage_busy"][
+            "read"] == 0.0
+        assert s["stage_busy"]["snapshot_read"] > 0.0
+        assert s["stages"]["snapshot_read"] >= 0.0
+        it2.close()
+        assert len(cold) == len(warm) == -(-512 // BATCH)
+        for a, b in zip(cold, warm):
+            np.testing.assert_array_equal(a, b)
+
+    def test_same_iterator_flips_warm_on_reset(self, tmp_path):
+        corpus = _corpus(tmp_path)
+        snap = str(tmp_path / "c.snapshot")
+        it = _make_iter(corpus, snap=snap)
+        cold = _drain(it)
+        it.reset()
+        warm = _drain(it)
+        assert it.stats()["snapshot_state"] == "warm"
+        it.close()
+        for a, b in zip(cold, warm):
+            np.testing.assert_array_equal(a, b)
+
+    def test_checkpoint_swaps_cache_and_snapshot(self, tmp_path):
+        """ACCEPTANCE: mid-epoch checkpoints restore byte-identically
+        across cache->snapshot pipeline swaps — a state taken against a
+        warm SNAPSHOT pipeline restores into a block-CACHE pipeline (and
+        a plain one), and vice versa."""
+        corpus = _corpus(tmp_path)
+        snap = str(tmp_path / "c.snapshot")
+        cache = str(tmp_path / "c.blockcache")
+        it = _make_iter(corpus, snap=snap)
+        full = _drain(it)
+        it.close()
+        # warm snapshot pipeline -> 3 batches -> checkpoint
+        it_snap = _make_iter(corpus, snap=snap)
+        for _ in range(3):
+            next(it_snap)
+        state = it_snap.state_dict()
+        it_snap.close()
+        # restore into a block-cache pipeline (no snapshot armed)
+        parser = create_parser(corpus, 0, 1, "libsvm", threaded=True,
+                               block_cache=cache)
+        it_cache = DeviceIter(parser, num_col=NUM_COL, batch_size=BATCH,
+                              layout="dense", pack_aux=True)
+        it_cache.load_state(state)
+        rest = _drain(it_cache)
+        it_cache.close()
+        assert len(rest) == len(full) - 3
+        for a, b in zip(rest, full[3:]):
+            np.testing.assert_array_equal(a, b)
+        # now the reverse: warm CACHE pipeline state -> snapshot pipeline
+        parser = create_parser(corpus, 0, 1, "libsvm", threaded=True,
+                               block_cache=cache)
+        it_cache2 = DeviceIter(parser, num_col=NUM_COL, batch_size=BATCH,
+                               layout="dense", pack_aux=True)
+        for _ in range(2):
+            next(it_cache2)
+        state2 = it_cache2.state_dict()
+        it_cache2.close()
+        it_snap2 = _make_iter(corpus, snap=snap)
+        it_snap2.load_state(state2)
+        rest2 = _drain(it_snap2)
+        assert it_snap2.stats()["snapshot_state"] == "warm"
+        it_snap2.close()
+        assert len(rest2) == len(full) - 2
+        for a, b in zip(rest2, full[2:]):
+            np.testing.assert_array_equal(a, b)
+
+    def test_vanished_snapshot_restores_cold(self, tmp_path):
+        corpus = _corpus(tmp_path)
+        snap = str(tmp_path / "c.snapshot")
+        it = _make_iter(corpus, snap=snap)
+        full = _drain(it)
+        it.close()
+        it2 = _make_iter(corpus, snap=snap)
+        for _ in range(2):
+            next(it2)
+        state = it2.state_dict()
+        it2.close()
+        os.remove(snap)
+        it3 = _make_iter(corpus, snap=snap)
+        it3.load_state(state)
+        rest = _drain(it3)
+        assert it3.stats()["snapshot_state"] == "cold"
+        it3.close()
+        for a, b in zip(rest, full[2:]):
+            np.testing.assert_array_equal(a, b)
+
+    def test_plan_ordered_epochs_deterministic(self, tmp_path):
+        """snapshot_shuffle_seed serves the stored batches through the
+        epoch planner's permutation over BATCH indices: a pure function
+        of (seed, epoch) — same seed reproduces, different seed is a
+        different order of the same multiset, epochs draw fresh orders."""
+        corpus = _corpus(tmp_path)
+        snap = str(tmp_path / "c.snapshot")
+        it = _make_iter(corpus, snap=snap)
+        seq = _drain(it)
+        it.close()
+        it_a = _make_iter(corpus, snap=snap, snapshot_shuffle_seed=11)
+        shuf_a = _drain(it_a)
+        s = it_a.stats()
+        assert s["snapshot_seed"] == 11 and s["snapshot_state"] == "warm"
+        it_a.reset()
+        shuf_a2 = _drain(it_a)  # epoch 1: a fresh permutation
+        it_a.close()
+        it_b = _make_iter(corpus, snap=snap, snapshot_shuffle_seed=11)
+        shuf_b = _drain(it_b)
+        it_b.close()
+        assert len(shuf_a) == len(seq)
+        # same (seed, epoch) -> byte-identical across runs
+        for a, b in zip(shuf_a, shuf_b):
+            np.testing.assert_array_equal(a, b)
+        # permuted vs sequential, and epoch 1 differs from epoch 0
+        assert not all(np.array_equal(a, b) for a, b in zip(shuf_a, seq))
+        assert not all(np.array_equal(a, b)
+                       for a, b in zip(shuf_a, shuf_a2))
+        # same multiset of batches
+        key = lambda arr: arr.tobytes()  # noqa: E731
+        assert sorted(key(a) for a in shuf_a) == sorted(
+            key(a) for a in seq)
+
+    def test_plan_mid_epoch_resume_byte_identical(self, tmp_path):
+        corpus = _corpus(tmp_path)
+        snap = str(tmp_path / "c.snapshot")
+        it = _make_iter(corpus, snap=snap)
+        _drain(it)
+        it.close()
+        it1 = _make_iter(corpus, snap=snap, snapshot_shuffle_seed=5)
+        shuf = _drain(it1)
+        it1.close()
+        it2 = _make_iter(corpus, snap=snap, snapshot_shuffle_seed=5)
+        for _ in range(3):
+            next(it2)
+        state = it2.state_dict()
+        it2.close()
+        assert state["source"]["kind"] == "epoch_plan"
+        assert state["source"]["unit"] == "batch"
+        # restore into a FRESH pipeline — even one built with a different
+        # seed: the state's plan identity is adopted wholesale
+        it3 = _make_iter(corpus, snap=snap, snapshot_shuffle_seed=99)
+        it3.load_state(state)
+        rest = _drain(it3)
+        it3.close()
+        assert len(rest) == len(shuf) - 3
+        for a, b in zip(rest, shuf[3:]):
+            np.testing.assert_array_equal(a, b)
+
+    def test_block_plan_state_falls_through_to_source(self, tmp_path):
+        """A shuffled BLOCK-cache checkpoint (kind='epoch_plan' over
+        blocks, no unit) restored into a snapshot-armed pipeline must
+        replay the PERMUTED stream via the source — never be hijacked
+        into a count-based sequential warm-snapshot resume."""
+        corpus = _corpus(tmp_path)
+        cache = str(tmp_path / "c.blockcache")
+        snap = str(tmp_path / "c.snapshot")
+        # small chunks -> many cache blocks, so mid-epoch checkpoints
+        # carry real plan annotations (a 1-block cache degrades them to
+        # order-less count states no restore path could disambiguate)
+        kw = dict(threaded=True, chunk_bytes=2048)
+        # publish the (sequential-order) snapshot and the block cache
+        parser = create_parser(corpus, 0, 1, "libsvm", block_cache=cache,
+                               snapshot=snap, **kw)
+        it = DeviceIter(parser, num_col=NUM_COL, batch_size=BATCH,
+                        layout="dense", pack_aux=True)
+        _drain(it)
+        it.close()
+
+        def shuffled_iter():
+            p = create_parser(corpus, 0, 1, "libsvm", block_cache=cache,
+                              shuffle_seed=3, shuffle_window=8, **kw)
+            return DeviceIter(p, num_col=NUM_COL, batch_size=BATCH,
+                              layout="dense", pack_aux=True)
+
+        it_ref = shuffled_iter()
+        ref = _drain(it_ref)  # warm epoch 0 in plan order
+        it_ref.close()
+        it_ck = shuffled_iter()
+        for _ in range(2):
+            next(it_ck)
+        state = it_ck.state_dict()
+        it_ck.close()
+        assert state["source"]["kind"] == "epoch_plan"
+        assert "unit" not in state["source"]  # a BLOCK-plan state
+        # restore into a snapshot-armed (sequential) pipeline: the plan
+        # state's order only exists at the source — the snapshot must
+        # step aside
+        parser = create_parser(corpus, 0, 1, "libsvm", block_cache=cache,
+                               snapshot=snap, **kw)
+        it2 = DeviceIter(parser, num_col=NUM_COL, batch_size=BATCH,
+                         layout="dense", pack_aux=True)
+        it2.load_state(state)
+        rest = _drain(it2)
+        it2.close()
+        assert len(rest) == len(ref) - 2
+        for a, b in zip(rest, ref[2:]):
+            np.testing.assert_array_equal(a, b)
+
+    def test_plan_state_rejected_by_block_cache(self, tmp_path):
+        """A unit='batch' plan state must not restore into the block
+        cache's block stream (wrong positions) — it rejects loudly."""
+        corpus = _corpus(tmp_path)
+        snap = str(tmp_path / "c.snapshot")
+        cache = str(tmp_path / "c.blockcache")
+        it = _make_iter(corpus, snap=snap)
+        _drain(it)
+        it.close()
+        it2 = _make_iter(corpus, snap=snap, snapshot_shuffle_seed=5)
+        next(it2)
+        state = it2.state_dict()
+        it2.close()
+        parser = create_parser(corpus, 0, 1, "libsvm", threaded=True,
+                               block_cache=cache, shuffle_seed=1)
+        with pytest.raises(DMLCError, match="unit='batch'"):
+            parser.load_state(state["source"])
+        parser.close()
+
+    def test_quant_int8_epoch(self, tmp_path):
+        corpus = _corpus(tmp_path)
+        snap = str(tmp_path / "q.snapshot")
+        it = _make_iter(corpus, snap=snap, snapshot_quant="int8")
+        cold = _drain(it)
+        it.reset()
+        warm = _drain(it)
+        assert it.stats()["snapshot_state"] == "warm"
+        assert it.stats()["stage_busy"]["convert"] > 0.0  # cold converted
+        it.close()
+        assert len(warm) == len(cold)
+        # dequantized batches approximate the originals within the
+        # per-column quantization step
+        for a, b in zip(cold, warm):
+            step = np.abs(a).max(axis=0) / 127.0 + 1e-12
+            assert np.all(np.abs(a - b) <= step * 0.51 + 1e-6)
+        # quantized store approaches 1/4 the f32 one (at this tiny test
+        # batch geometry the 64B segment alignment + per-batch footer
+        # entries dominate, so the bound is looser than the asymptote)
+        snap32 = str(tmp_path / "f.snapshot")
+        it32 = _make_iter(corpus, snap=snap32)
+        _drain(it32)
+        it32.close()
+        ratio = os.path.getsize(snap) / os.path.getsize(snap32)
+        assert ratio <= 0.45, ratio
+
+    def test_bf16_snapshot_halves_bytes(self, tmp_path):
+        """ACCEPTANCE: bf16 snapshots halve stored/wire bytes vs float32
+        (snapshot_wire_bytes_ratio <= 0.55)."""
+        corpus = _corpus(tmp_path)
+        snap32 = str(tmp_path / "f32.snapshot")
+        snap16 = str(tmp_path / "bf16.snapshot")
+        it = _make_iter(corpus, snap=snap32)
+        _drain(it)
+        it.close()
+        it16 = _make_iter(corpus, snap=snap16, x_dtype="bfloat16")
+        cold16 = _drain(it16)
+        it16.reset()
+        warm16 = _drain(it16)
+        assert it16.stats()["snapshot_state"] == "warm"
+        it16.close()
+        for a, b in zip(cold16, warm16):
+            np.testing.assert_array_equal(a, b)
+        ratio = os.path.getsize(snap16) / os.path.getsize(snap32)
+        assert ratio <= 0.55, ratio
+
+    def test_corruption_heals_to_cold_byte_identical(self, tmp_path):
+        """A corrupt warm batch (bit flip on disk) is a classified fault:
+        the snapshot is dropped, the pipeline re-arms COLD at the exact
+        delivered batch, and the stream stays byte-identical."""
+        corpus = _corpus(tmp_path)
+        snap = str(tmp_path / "c.snapshot")
+        it = _make_iter(corpus, snap=snap)
+        full = _drain(it)
+        it.close()
+        # flip one byte inside batch 2's span
+        r = SnapshotReader(snap)
+        entry_pos = r._batches[2]["pos"]
+        r.close()
+        with open(snap, "r+b") as f:
+            f.seek(entry_pos + 8)
+            b = f.read(1)
+            f.seek(entry_pos + 8)
+            f.write(bytes([b[0] ^ 0xFF]))
+        base = resilience.counters_snapshot()
+        it2 = _make_iter(corpus, snap=snap)
+        healed = _drain(it2)
+        s = it2.stats()
+        it2.close()
+        assert len(healed) == len(full)
+        for a, b2 in zip(healed, full):
+            np.testing.assert_array_equal(a, b2)
+        delta = resilience.counters_delta(base)
+        assert delta["snapshot_corruptions"] == 1
+        assert s["resilience"]["pipeline_restarts"] == 1
+
+    def test_snapshot_rejects_source_plan(self, tmp_path):
+        corpus = _corpus(tmp_path)
+        snap = str(tmp_path / "c.snapshot")
+        cache = str(tmp_path / "c.blockcache")
+        with pytest.raises(DMLCError, match="snapshot.*shuffle_seed"):
+            create_parser(corpus, 0, 1, "libsvm", threaded=True,
+                          snapshot=snap, block_cache=cache, shuffle_seed=3)
+        # and at the DeviceIter level for a directly-armed planned source
+        parser = create_parser(corpus, 0, 1, "libsvm", threaded=True,
+                               block_cache=cache, shuffle_seed=3)
+        with pytest.raises(DMLCError, match="source-side epoch plan"):
+            DeviceIter(parser, num_col=NUM_COL, batch_size=BATCH,
+                       layout="dense", pack_aux=True, snapshot=snap)
+        parser.close()
+
+    def test_snapshot_composes_with_block_cache(self, tmp_path):
+        """The two-tier story: block cache (parser output) under the
+        snapshot (device layout) — the cold snapshot pass reads the warm
+        cache, and the warmest tier wins thereafter."""
+        corpus = _corpus(tmp_path)
+        snap = str(tmp_path / "c.snapshot")
+        cache = str(tmp_path / "c.blockcache")
+        # epoch 0: parse + publish the block cache (no snapshot)
+        parser = create_parser(corpus, 0, 1, "libsvm", threaded=True,
+                               block_cache=cache)
+        it = DeviceIter(parser, num_col=NUM_COL, batch_size=BATCH,
+                        layout="dense", pack_aux=True)
+        plain = _drain(it)
+        it.close()
+        # epoch 1: cache-warm cold-snapshot pass (parses nothing)
+        parser = create_parser(corpus, 0, 1, "libsvm", threaded=True,
+                               block_cache=cache, snapshot=snap)
+        it = DeviceIter(parser, num_col=NUM_COL, batch_size=BATCH,
+                        layout="dense", pack_aux=True)
+        from_cache = _drain(it)
+        s = it.stats()
+        assert s["cache_state"] == "warm" and s["snapshot_state"] == "cold"
+        it.close()
+        # epoch 2: snapshot-warm (neither parser nor cache touched)
+        parser = create_parser(corpus, 0, 1, "libsvm", threaded=True,
+                               block_cache=cache, snapshot=snap)
+        it = DeviceIter(parser, num_col=NUM_COL, batch_size=BATCH,
+                        layout="dense", pack_aux=True)
+        from_snap = _drain(it)
+        s = it.stats()
+        assert s["snapshot_state"] == "warm"
+        assert s["stage_busy"]["cache_read"] == 0.0
+        assert s["stage_busy"]["convert"] == 0.0
+        it.close()
+        for a, b in zip(plain, from_cache):
+            np.testing.assert_array_equal(a, b)
+        for a, b in zip(plain, from_snap):
+            np.testing.assert_array_equal(a, b)
+
+    def test_ell_snapshot_roundtrip(self, tmp_path):
+        corpus = _corpus(tmp_path, n=200)
+        snap = str(tmp_path / "e.snapshot")
+
+        def ell_iter():
+            parser = create_parser(corpus, 0, 1, "libsvm", threaded=True,
+                                   snapshot=snap)
+            return DeviceIter(parser, num_col=NUM_COL, batch_size=32,
+                              layout="ell", max_nnz=NUM_COL)
+
+        def drain_ell(it):
+            return [tuple(np.asarray(x) for x in b) for b in it]
+
+        it = ell_iter()
+        cold = drain_ell(it)
+        it.close()
+        it2 = ell_iter()
+        warm = drain_ell(it2)
+        assert it2.stats()["snapshot_state"] == "warm"
+        assert it2.stats()["stage_busy"]["convert"] == 0.0
+        it2.close()
+        assert len(cold) == len(warm)
+        for ba, bb in zip(cold, warm):
+            for a, b in zip(ba, bb):
+                np.testing.assert_array_equal(a, b)
+
+
+# ---------------- bf16 pack_aux losslessness (satellite) ----------------
+
+class TestBf16AuxGuard:
+    def test_exact_labels_pass(self, tmp_path):
+        corpus = _corpus(tmp_path, n=128, bf16_exact=True)
+        it = _make_iter(corpus, x_dtype="bfloat16")
+        out = _drain(it)
+        it.close()
+        assert len(out) == 2
+
+    def test_lossy_labels_raise(self, tmp_path, monkeypatch):
+        """Labels that are not bf16-exact must raise a clear error at
+        pack time instead of silently corrupting (the old undocumented
+        device.py caller promise, now enforced). The guard lives at the
+        Python pack site (_pack_dense_parts); the fully-native dense-emit
+        path converts inside C++ where the f32 originals never surface —
+        pin the Python engine so the guarded path runs."""
+        monkeypatch.setenv("DMLC_TPU_NO_NATIVE_READER", "1")
+        corpus = _corpus(tmp_path, n=128, bf16_exact=False)
+        it = _make_iter(corpus, x_dtype="bfloat16")
+        with pytest.raises(DMLCError, match="bf16-exact"):
+            _drain(it)
+        it.close()
+
+    def test_lossy_labels_fine_without_pack_aux(self, tmp_path):
+        corpus = _corpus(tmp_path, n=128, bf16_exact=False)
+        it = _make_iter(corpus, x_dtype="bfloat16", pack_aux=False)
+        n = sum(1 for _ in it)
+        it.close()
+        assert n == 2
+
+
+# ---------------- service snapshot frames ----------------
+
+class TestServiceSnapshot:
+    def test_wire_halved_under_bf16(self):
+        from dmlc_tpu.native import bf16_dtype
+        from dmlc_tpu.service.frame import (
+            decode_frame, encode_snapshot_frame, snapshot_from_frame,
+        )
+
+        rng = np.random.default_rng(0)
+        xp = rng.standard_normal((BATCH, NUM_COL + 2)).astype(np.float32)
+        f32 = encode_snapshot_frame("dense_packed", (xp,), rows=BATCH)
+        f16 = encode_snapshot_frame(
+            "dense_packed", (xp.astype(bf16_dtype()),), rows=BATCH)
+        assert len(f16) / len(f32) <= 0.55
+        kind, meta, payload = decode_frame(f16)
+        got = snapshot_from_frame(meta, payload)
+        assert got[0] == "dense_packed"
+        assert got[1].dtype == bf16_dtype()
+        np.testing.assert_array_equal(
+            got[1], xp.astype(bf16_dtype()))
+
+    def test_bf16_frame_decodes_without_jax(self, tmp_path):
+        """A host-block service consumer never imports jax/ml_dtypes —
+        decoding a bf16 snapshot frame must register the extension dtype
+        lazily instead of crashing on np.dtype('bfloat16')."""
+        import subprocess
+        import sys
+
+        from dmlc_tpu.native import bf16_dtype
+        from dmlc_tpu.service.frame import encode_snapshot_frame
+
+        xp = np.arange(24, dtype=np.float32).reshape(4, 6).astype(
+            bf16_dtype())
+        frame = encode_snapshot_frame("dense_packed", (xp,), rows=4)
+        fpath = tmp_path / "frame.bin"
+        fpath.write_bytes(frame)
+        code = (
+            "import sys; sys.path.insert(0, %r)\n"
+            "from dmlc_tpu.service.frame import decode_frame, "
+            "snapshot_from_frame\n"
+            "assert 'jax' not in sys.modules and "
+            "'ml_dtypes' not in sys.modules\n"
+            "data = open(%r, 'rb').read()\n"
+            "kind, meta, payload = decode_frame(data)\n"
+            "got = snapshot_from_frame(meta, payload)\n"
+            "assert got[0] == 'dense_packed' and got[1].shape == (4, 6)\n"
+            "print('ok')\n"
+        ) % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+             str(fpath))
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip() == "ok"
+
+    def test_worker_pack_validates_bf16_labels(self, tmp_path):
+        """The worker-side snapshot-frame pack applies the same bf16
+        losslessness guard as the local pack path — lossy labels surface
+        as an error, never silent corruption on the wire."""
+        from dmlc_tpu.data.parsers import create_parser as _cp
+
+        corpus = _corpus(tmp_path, n=64, bf16_exact=False)
+        parser = _cp(corpus, 0, 1, "libsvm", threaded=False)
+        blocks = list(parser)
+        parser.close()
+        from dmlc_tpu.native import bf16_dtype
+
+        with pytest.raises(DMLCError, match="bf16-exact"):
+            list(pack_dense_batches(blocks, 16, NUM_COL,
+                                    dtype=bf16_dtype()))
+        # exact labels pack clean
+        corpus2 = _corpus(tmp_path, n=64, name="e.libsvm",
+                          bf16_exact=True)
+        parser = _cp(corpus2, 0, 1, "libsvm", threaded=False)
+        blocks = list(parser)
+        parser.close()
+        out = list(pack_dense_batches(blocks, 16, NUM_COL,
+                                      dtype=bf16_dtype()))
+        assert len(out) == 4
+
+    def test_fleet_serves_packed_batches(self, tmp_path):
+        from dmlc_tpu.service import LocalFleet, ServiceParser
+
+        corpus = _corpus(tmp_path, n=300)
+        geom = {"batch_size": 32, "num_col": NUM_COL,
+                "x_dtype": "bfloat16"}
+        fleet = LocalFleet(corpus, 2, num_workers=2,
+                           parser={"format": "libsvm"}, snapshot=geom)
+        try:
+            client = ServiceParser(fleet.address)
+            assert client.snapshot == geom
+            blocks = []
+            while (b := client.next_block()) is not None:
+                blocks.append(b)
+            client.close()
+            assert blocks and all(b.packed and len(b) == 32
+                                  for b in blocks)
+            assert sum(len(b) for b in blocks) >= 300
+            # a DeviceIter over snapshot frames rides the dense_ready
+            # fast path: packing work on the trainer is ~zero
+            client2 = ServiceParser(fleet.address)
+            it = DeviceIter(client2, num_col=NUM_COL, batch_size=32,
+                            layout="dense", x_dtype="bfloat16",
+                            pack_aux=True)
+            n = sum(1 for _ in it)
+            assert n == len(blocks)
+            it.close()
+        finally:
+            fleet.close()
+
+    def test_foreign_state_rejected_in_snapshot_mode(self, tmp_path):
+        from dmlc_tpu.service import LocalFleet, ServiceParser
+
+        corpus = _corpus(tmp_path, n=64)
+        fleet = LocalFleet(corpus, 1, num_workers=1,
+                           parser={"format": "libsvm"},
+                           snapshot={"batch_size": 16,
+                                     "num_col": NUM_COL,
+                                     "x_dtype": "float32"})
+        try:
+            client = ServiceParser(fleet.address)
+            with pytest.raises(DMLCError, match="service"):
+                client.load_state({"kind": "blocks", "blocks": 3})
+            # but (part, batch) service states round-trip
+            while client.next_block() is not None:
+                pass
+            client.close()
+        finally:
+            fleet.close()
