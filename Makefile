@@ -21,7 +21,11 @@
 #                     with convert busy ~0; bf16 halves stored bytes), the
 #                     data-service leg (service_workers/
 #                     service_mb_per_sec/service_vs_local_speedup from a
-#                     localhost 2-worker fleet), and the telemetry contract
+#                     localhost 2-worker fleet), the online-autotuner leg
+#                     (autotune_enabled/autotune_steps/
+#                     autotune_final_config — the feedback controller
+#                     climbs a starved config and emits the chosen knobs
+#                     as reusable env), and the telemetry contract
 #                     (telemetry_schema_version + per-stage span counts)
 #   make fuzz         mutation fuzz of every native parse C-ABI entry point
 #                     (crash-safety; DMLC_FUZZ_ITERS to scale)
@@ -68,7 +72,7 @@ sanitize:
 bench-smoke:
 	DMLC_BENCH_PLATFORM=cpu DMLC_BENCH_MB=8 DMLC_BENCH_REPS=1 \
 	DMLC_BENCH_ATTEMPTS=1 DMLC_BENCH_TIMEOUT=600 \
-	    $(PYTHON) bench.py --service > .bench_smoke.json
+	    $(PYTHON) bench.py --service --autotune > .bench_smoke.json
 	$(PYTHON) -c "import json; \
 	    line = json.load(open('.bench_smoke.json')); \
 	    a = line.get('attribution') or {}; \
@@ -110,6 +114,16 @@ bench-smoke:
 	        'service_mb_per_sec missing'; \
 	    assert line.get('service_vs_local_speedup'), \
 	        'service_vs_local_speedup missing'; \
+	    assert line.get('autotune_enabled') is True, \
+	        'autotune_enabled missing (autotune leg did not run)'; \
+	    assert line.get('autotune_steps') is not None, \
+	        'autotune_steps missing'; \
+	    acfg = line.get('autotune_final_config') or {}; \
+	    assert acfg.get('DMLC_TPU_PREFETCH') and \
+	        acfg.get('DMLC_TPU_CONVERT_AHEAD'), \
+	        f'autotune_final_config incomplete: {acfg}'; \
+	    assert line.get('input_wait_seconds') is not None, \
+	        'input_wait_seconds missing'; \
 	    assert line.get('telemetry_schema_version') == 1, \
 	        'telemetry_schema_version missing/mismatched'; \
 	    assert line.get('trace_spans'), 'trace_spans missing/zero'; \
@@ -139,7 +153,11 @@ bench-smoke:
 	    print('bench-smoke: data service OK:', \
 	          line['service_mb_per_sec'], 'MB/s with', \
 	          line['service_workers'], 'workers, vs-local x', \
-	          line['service_vs_local_speedup'])"
+	          line['service_vs_local_speedup']); \
+	    print('bench-smoke: autotune OK:', line['autotune_steps'], \
+	          'steps,', line.get('autotune_adjustments'), \
+	          'adjustments, converged', line.get('autotune_converged'), \
+	          ', config', acfg)"
 
 parse-bench:
 	mkdir -p native/build

@@ -241,6 +241,11 @@ def into_hbm_mb_per_sec(path: str, size_mb: float, x_dtype: str = "float32"):
                 "parse_workers": stats.get("parse_workers"),
                 "parse_parallelism_efficiency":
                     stats.get("parse_parallelism_efficiency"),
+                # the trustworthy input-bound counter (ISSUE 10 satellite:
+                # handle waits + sampled transfer landings — nonzero on a
+                # transfer-bound epoch even when stall_seconds reads 0)
+                "input_wait_seconds": round(
+                    stats.get("input_wait_seconds") or 0.0, 4),
             }
         it.close()
         log(
@@ -532,6 +537,68 @@ def service_leg(path: str, size_mb: float, workers: int = 2):
     }
 
 
+def autotune_leg(path: str, size_mb: float, max_epochs: int = 5):
+    """Offline controller convergence (``--autotune`` / ISSUE 10): run
+    the ingest pipeline with the feedback controller armed at a
+    deliberately starved config (prefetch 1, convert_ahead 1) and
+    mid-epoch stepping, for repeated epochs until the controller reports
+    convergence (two consecutive steady windows — gap_stage == transfer /
+    the consumer never waits) or the epoch budget runs out. The JSON
+    line then carries the decision count and the CHOSEN CONFIG keyed by
+    env variable names, so a converged run is reusable verbatim::
+
+        export DMLC_TPU_PREFETCH=4 DMLC_TPU_CONVERT_AHEAD=8 ...
+
+    (docs/data.md autotune section; make bench-smoke gates the fields).
+    """
+    import jax
+
+    from dmlc_tpu.data import autotune as _autotune
+    from dmlc_tpu.data import create_parser
+    from dmlc_tpu.data.device import DeviceIter
+
+    parser = create_parser(path, 0, 1, "libsvm", threaded=True,
+                           chunk_bytes=CHUNK_BYTES)
+    it = DeviceIter(parser, num_col=NUM_COL, batch_size=BATCH,
+                    layout="dense", prefetch=1, convert_ahead=1,
+                    pack_aux=True, autotune=True, autotune_interval=16)
+    rate = 0.0
+    try:
+        for ep in range(max_epochs):
+            t0 = time.monotonic()
+            last = None
+            nb = 0
+            for batch in it:
+                last = batch
+                nb += 1
+            if last is not None:
+                jax.block_until_ready(last)
+            dt = time.monotonic() - t0
+            rate = max(rate, size_mb / dt)
+            snap = it.autotuner.snapshot(history=1)
+            log(f"bench: autotune epoch {ep} {nb} batches in {dt:.2f}s = "
+                f"{size_mb/dt:.1f} MB/s (steps {snap['steps']}, "
+                f"adjustments {snap['adjustments']}, knobs "
+                f"{snap['knobs']}, converged {snap['converged']})")
+            if it.autotuner.converged and ep >= 1:
+                break
+            it.reset()
+        snap = it.autotuner.snapshot(history=4)
+        for d in snap["history"]:
+            log(f"bench: autotune decision: {d}")
+        return {
+            "autotune_enabled": True,
+            "autotune_steps": snap["steps"],
+            "autotune_adjustments": snap["adjustments"],
+            "autotune_converged": snap["converged"],
+            "autotune_gap_stage": snap["gap_stage"],
+            "autotune_final_config": _autotune.env_config(snap["knobs"]),
+            "autotune_mb_per_sec": round(rate, 2),
+        }
+    finally:
+        it.close()
+
+
 def device_floor_mbps(x_dtype: str = "float32"):
     """Raw repeated-shape device_put floor for bench.py's exact batch
     geometry, measured in THIS process right after the pipeline reps (same
@@ -658,6 +725,7 @@ def run_child() -> None:
         line["parse_workers"] = parallel.get("parse_workers")
         line["parse_parallelism_efficiency"] = parallel.get(
             "parse_parallelism_efficiency")
+        line["input_wait_seconds"] = parallel.get("input_wait_seconds")
     # parse fan-out scaling curve (ISSUE 3): the host parse ceiling of the
     # PYTHON engine at 1/2/4 workers, interleaved so ambient drift cancels
     # in the ratio. parse_ceiling_workers_1 is the pre-fan-out engine;
@@ -832,6 +900,16 @@ def run_child() -> None:
             line.update(service_leg(path, size_mb))
         except Exception as exc:  # noqa: BLE001 - the headline must still print
             log(f"bench: service leg failed: {exc}")
+    # online-autotuner convergence leg (docs/data.md autotune): the
+    # controller climbs a starved config until gap_stage == transfer and
+    # the chosen knobs ride the JSON line as reusable env — emitted when
+    # --autotune / DMLC_BENCH_AUTOTUNE=1 asked for it (make bench-smoke
+    # gates the fields)
+    if os.environ.get("DMLC_BENCH_AUTOTUNE", "0") not in ("", "0"):
+        try:
+            line.update(autotune_leg(path, size_mb))
+        except Exception as exc:  # noqa: BLE001 - the headline must still print
+            log(f"bench: autotune leg failed: {exc}")
     # always-on telemetry contract (docs/observability.md): the schema
     # version + per-stage span counts ride the JSON line, proving the span
     # tracer covered the whole measurement (make bench-smoke gates these)
@@ -904,6 +982,8 @@ def main() -> int:
         # the measurement runs in a supervised child; the flag travels as
         # env so retries and the CPU fallback keep the leg
         os.environ["DMLC_BENCH_SERVICE"] = "1"
+    if "--autotune" in sys.argv:
+        os.environ["DMLC_BENCH_AUTOTUNE"] = "1"
     if os.environ.get("DMLC_BENCH_CHILD") == "1":
         run_child()
         return 0
@@ -1005,6 +1085,10 @@ def main() -> int:
                           "bf16_line_rate_trimmed_mb_per_sec",
                           "service_workers", "service_mb_per_sec",
                           "service_vs_local_speedup",
+                          "autotune_enabled", "autotune_steps",
+                          "autotune_adjustments", "autotune_converged",
+                          "autotune_gap_stage", "autotune_final_config",
+                          "autotune_mb_per_sec", "input_wait_seconds",
                           "telemetry_schema_version", "trace_spans",
                           "trace_span_counts"):
                     if parsed.get(k) is not None:

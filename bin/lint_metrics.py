@@ -17,7 +17,7 @@ timing it ever grows must pair with the ``cache_read`` spans its
 consumer records), and ``dmlc_tpu/io/snapshot.py`` (the device-native
 snapshot store: its ``snapshot_read``/``snapshot_write`` timing rides
 the span tracer and its invalidation/corruption events go through
-``record_event``) — except the two sanctioned modules:
+``record_event``) — except the sanctioned modules:
 
 - ``COUNTERS.bump(`` — direct resilience-counter mutation; new events
   must go through ``dmlc_tpu.io.resilience.record_event`` (which stamps
@@ -25,6 +25,15 @@ the span tracer and its invalidation/corruption events go through
 - ``time.monotonic(`` — ad-hoc stage timing; use
   ``dmlc_tpu.utils.timer.get_time`` (so the reading can be paired with a
   ``telemetry.record_span``) or ``telemetry.span``.
+- ad-hoc TUNABLE env reads (``DMLC_TPU_*_WORKERS``,
+  ``DMLC_TPU_PREFETCH``, ``DMLC_TPU_CONVERT_AHEAD``,
+  ``DMLC_TPU_AUTOTUNE*``) — every pipeline tunable must be a row in the
+  autotune knob table (``dmlc_tpu/utils/knobs.py``, read via
+  ``knobs.resolve``) so the feedback controller knows its bounds and the
+  value is validated loudly; a point-of-use ``os.environ.get`` parse is
+  exactly the pre-autotuner drift this gate closes (the three historical
+  per-site parses in parsers.py/snapshot.py/device.py were consolidated
+  by the autotuner PR).
 
 Exit status: 0 clean, 1 with offenders listed as ``path:line``.
 """
@@ -41,6 +50,9 @@ ALLOWED = {
     Path("dmlc_tpu") / "utils" / "timer.py",
 }
 
+# the knob table is the ONE sanctioned reader of tunable env variables
+KNOB_TABLE_MODULE = Path("dmlc_tpu") / "utils" / "knobs.py"
+
 _PATTERNS = (
     (re.compile(r"\bCOUNTERS\.bump\s*\("),
      "direct COUNTERS.bump — use resilience.record_event / a registry "
@@ -50,15 +62,26 @@ _PATTERNS = (
      "telemetry.span"),
 )
 
+_KNOB_PATTERN = (
+    re.compile(r"(?:environ(?:\.get)?\s*[\(\[]|\bgetenv\s*\()\s*['\"]"
+               r"DMLC_TPU_(?:[A-Z0-9_]*_WORKERS|PREFETCH|CONVERT_AHEAD|"
+               r"AUTOTUNE[A-Z0-9_]*)['\"]"),
+    "ad-hoc tunable env read — register the knob in "
+    "dmlc_tpu/utils/knobs.py (KNOB_TABLE) and read it via knobs.resolve")
 
-def scan_source(text: str) -> List[Tuple[int, str]]:
-    """Return (1-based line, reason) for each ad-hoc bookkeeping site."""
+
+def scan_source(text: str,
+                knob_gate: bool = True) -> List[Tuple[int, str]]:
+    """Return (1-based line, reason) for each ad-hoc bookkeeping site.
+    ``knob_gate=False`` skips the tunable-env pattern (the knob table
+    module is its one sanctioned home)."""
     offenders: List[Tuple[int, str]] = []
+    patterns = _PATTERNS + ((_KNOB_PATTERN,) if knob_gate else ())
     for i, line in enumerate(text.splitlines()):
         stripped = line.lstrip()
         if stripped.startswith("#"):
             continue
-        for pattern, reason in _PATTERNS:
+        for pattern, reason in patterns:
             if pattern.search(line):
                 offenders.append((i + 1, reason))
     return offenders
@@ -72,7 +95,9 @@ def main(argv: List[str]) -> int:
         rel = path.relative_to(root)
         if rel in ALLOWED:
             continue
-        for lineno, reason in scan_source(path.read_text(encoding="utf-8")):
+        for lineno, reason in scan_source(
+                path.read_text(encoding="utf-8"),
+                knob_gate=rel != KNOB_TABLE_MODULE):
             print(f"{rel}:{lineno}: {reason}", file=sys.stderr)
             bad += 1
     if bad:

@@ -6,6 +6,7 @@ turns them into HBM-resident jax.Array / BCOO batches.
 """
 
 from dmlc_tpu.data.row_block import Row, RowBlock, RowBlockContainer
+from dmlc_tpu.data.autotune import AutoTuner, Knob, ParseTierTuner
 from dmlc_tpu.data.epoch import (
     EpochPlan, block_permutation, permute_block_rows, row_permutation,
 )
@@ -19,6 +20,7 @@ from dmlc_tpu.data.iterators import (
 
 __all__ = [
     "Row", "RowBlock", "RowBlockContainer",
+    "AutoTuner", "Knob", "ParseTierTuner",
     "EpochPlan", "block_permutation", "permute_block_rows",
     "row_permutation",
     "Parser", "LibSVMParser", "CSVParser", "LibFMParser", "ThreadedParser",

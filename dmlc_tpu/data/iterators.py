@@ -26,6 +26,11 @@ from dmlc_tpu.utils.timer import ThroughputMeter
 CACHE_PAGE_BYTES = 64 << 20
 _CACHE_MAGIC = b"DMLCTPU-RBCACHE1"
 
+# autotuned load passes re-tune the parse tier every this many blocks
+# (one chunk == one block for the text engines, so this is a few tens of
+# MB between decisions — frequent enough to converge inside one load)
+AUTOTUNE_LOAD_BLOCKS = 32
+
 
 class RowBlockIter:
     """Multi-pass iterator interface — analog of dmlc::RowBlockIter
@@ -54,17 +59,48 @@ class RowBlockIter:
 
 class BasicRowIter(RowBlockIter):
     """Drain the parser into RAM at init; each epoch yields one big block
-    (src/data/basic_row_iter.h:35-42, 61-82)."""
+    (src/data/basic_row_iter.h:35-42, 61-82).
 
-    def __init__(self, parser: Parser, silent: bool = False):
+    With ``autotune`` armed (arg or ``DMLC_TPU_AUTOTUNE=1``) and a
+    live-resizable parse tier underneath, the load pass re-tunes its
+    fan-out width every :data:`AUTOTUNE_LOAD_BLOCKS` blocks from the
+    measured parallelism efficiency (docs/data.md autotune section);
+    the decision record lands on :attr:`autotune`."""
+
+    def __init__(self, parser: Parser, silent: bool = False,
+                 autotune: Optional[bool] = None):
+        from dmlc_tpu.data.autotune import (
+            ParseTierTuner, efficiency_window,
+        )
+        from dmlc_tpu.utils import knobs as _knobs
+
+        tuner = None
+        if (_knobs.autotune_enabled(autotune)
+                and callable(getattr(parser, "resize_parse_workers",
+                                     None))):
+            tuner = ParseTierTuner()
         meter = ThroughputMeter("load", silent=silent)
         container = RowBlockContainer()
+        seen = 0
+        eff_prev = None
         for block in parser:
             container.push_block(block)
             meter.add(parser.bytes_read - meter.bytes, len(block))
+            seen += 1
+            if tuner is not None and seen % AUTOTUNE_LOAD_BLOCKS == 0:
+                stats_fn = getattr(parser, "parallel_stats", None)
+                stats = stats_fn() if callable(stats_fn) else None
+                # each decision reads THIS window's efficiency (the raw
+                # sideband is cumulative and mixes widths after a live
+                # resize — see autotune.efficiency_window)
+                eff, eff_prev = efficiency_window(eff_prev, stats)
+                new = tuner.decide(
+                    eff, workers=(stats or {}).get("parse_workers"))
+                parser.resize_parse_workers(new)
         self.block = container.to_block()
         meter.log_final()
         self.load_mb_per_sec = meter.mb_per_sec
+        self.autotune = tuner.snapshot() if tuner is not None else None
         self._done = False
         parser.close()
 
@@ -203,6 +239,7 @@ def create_row_block_iter(
     shuffle_seed: Optional[int] = None,
     shuffle_window: int = 0,
     pod_sharding=False,
+    autotune: Optional[bool] = None,
     **parser_kw,
 ) -> RowBlockIter:
     """RowBlockIter factory — analog of RowBlockIter::Create
@@ -242,6 +279,12 @@ def create_row_block_iter(
     shard of one globally consistent shuffled epoch, with
     ``(host_id, num_hosts)`` resolved from the tracker env contract /
     ``jax.distributed`` (docs/data.md shuffle-native cache section).
+
+    ``autotune`` (arg or ``DMLC_TPU_AUTOTUNE=1``) lets the load pass
+    re-tune its parse fan-out online from the measured parallelism
+    efficiency — the load-time face of the pipeline autotuner
+    (docs/data.md autotune section); the decision record lands on the
+    returned iterator's ``autotune`` attribute.
     """
     spec = URISpec(uri, part_index, num_parts)
     if service is None:
@@ -255,7 +298,7 @@ def create_row_block_iter(
                                shuffle_seed=shuffle_seed,
                                shuffle_window=shuffle_window,
                                pod_sharding=pod_sharding)
-        return BasicRowIter(parser, silent=silent)
+        return BasicRowIter(parser, silent=silent, autotune=autotune)
     # the cache here is the parsed-page cache (DiskRowIter); strip it before
     # the parser so the split layer does not also chunk-cache to the same
     # path — but a #blockcache= fragment belongs to the parser factory,
@@ -270,7 +313,7 @@ def create_row_block_iter(
                                shuffle_seed=shuffle_seed,
                                shuffle_window=shuffle_window,
                                pod_sharding=pod_sharding, **parser_kw)
-        return BasicRowIter(parser, silent=silent)
+        return BasicRowIter(parser, silent=silent, autotune=autotune)
     # the #cachefile page cache replays its frozen build-pass row order
     # every epoch — it cannot serve an epoch plan, and silently dropping
     # the knobs would hand a user unshuffled epochs they asked to shuffle

@@ -41,6 +41,7 @@ from dmlc_tpu.io.input_split import (
 )
 from dmlc_tpu.io.threaded_iter import OrderedWorkerPool, ThreadedIter
 from dmlc_tpu.io.uri import URISpec
+from dmlc_tpu.utils import knobs as _knobs
 from dmlc_tpu.utils import telemetry as _telemetry
 from dmlc_tpu.utils.check import (CacheCorruptionError, DMLCError, check,
                                   get_logger)
@@ -1129,6 +1130,23 @@ class ParallelTextParser(_WrappedParserMixin, Parser):
             self._last_annot = getattr(block, "resume_state", None)
             return block
 
+    def resize_parse_workers(self, num_workers: int) -> bool:
+        """Live parse-tier resize (the autotuner's ``parse_workers``
+        knob): the pool grows/shrinks in place — chunks keep pulling
+        serially and delivering in pull order, so the block stream (and
+        every checkpoint annotation riding it) is byte-identical to a
+        static-width run. Always returns True."""
+        n = max(1, int(num_workers))
+        self.num_workers = n
+        # chunk-level fan-out replaces intra-chunk scanner threads; at
+        # width 1 the base may use its own scanner threading again
+        self.base._parse_nthread = 1 if n > 1 else 0
+        self._ahead = max(4, 2 * n)
+        if self._pool is not None:
+            self._pool.resize(n)
+            self._pool.set_max_ahead(self._ahead)
+        return True
+
     def before_first(self) -> None:
         self._quiesce()
         self.base.before_first()
@@ -1265,8 +1283,9 @@ class BlockCacheIter(Parser):
         # straight on the pipeline wall. Sequential warm serving stays
         # single-threaded zero-copy.
         self._plan_pool: Optional[OrderedWorkerPool] = None
-        self._plan_read_workers = max(1, int(os.environ.get(
-            "DMLC_TPU_PLAN_READ_WORKERS", "2") or 2))
+        # validated by the knob table; live-resizable via
+        # resize_plan_read_workers (the autotuner's plan_read knob)
+        self.plan_read_workers = _knobs.resolve("plan_read_workers")
         self._cr_lock = threading.Lock()  # _cache_read_seconds writers
         # per-block uniform-column-pattern verdicts (epoch-invariant —
         # GIL-atomic dict ops, shared across plan-read workers)
@@ -1443,8 +1462,8 @@ class BlockCacheIter(Parser):
             self._plan_pool = OrderedWorkerPool(
                 lambda: iter(range(start, len(plan))),
                 self._plan_read_work,
-                num_workers=self._plan_read_workers,
-                max_ahead=2 * self._plan_read_workers,
+                num_workers=self.plan_read_workers,
+                max_ahead=2 * self.plan_read_workers,
                 counter_label="cache_read")
         return self._plan_pool
 
@@ -1847,6 +1866,27 @@ class BlockCacheIter(Parser):
                 return fn()
         return None
 
+    def resize_parse_workers(self, num_workers: int) -> bool:
+        """Autotune passthrough: the parse tier only exists on cold
+        passes — warm epochs bypass the parser entirely, so the knob
+        reports unavailable (False) until a cold pass arms the base."""
+        if self._base is None:
+            return False
+        fn = getattr(self._base, "resize_parse_workers", None)
+        return bool(fn(num_workers)) if callable(fn) else False
+
+    def resize_plan_read_workers(self, num_workers: int) -> bool:
+        """Live plan-read-pool resize (the autotuner's
+        ``plan_read_workers`` knob): applies to the running pool when a
+        plan-ordered warm epoch is being served, and to every pool built
+        after. Delivery stays in plan order either way."""
+        n = max(1, int(num_workers))
+        self.plan_read_workers = n
+        if self._plan_pool is not None:
+            self._plan_pool.resize(n)
+            self._plan_pool.set_max_ahead(2 * n)
+        return True
+
     @property
     def bytes_read(self) -> int:
         cold = self._base.bytes_read if self._base is not None else 0
@@ -1863,14 +1903,10 @@ class BlockCacheIter(Parser):
 # ---------------- factory & registry (src/data.cc) ----------------
 
 def _resolve_parse_workers(parse_workers: Optional[int]) -> int:
-    """None -> DMLC_TPU_PARSE_WORKERS env, else min(4, cpu count); 1 keeps
+    """None -> DMLC_TPU_PARSE_WORKERS env (validated loudly by the knob
+    table, :mod:`dmlc_tpu.utils.knobs`), else min(4, cpu count); 1 keeps
     today's single-producer ThreadedParser path."""
-    if parse_workers is not None:
-        return max(1, int(parse_workers))
-    env = os.environ.get("DMLC_TPU_PARSE_WORKERS", "").strip()
-    if env:
-        return max(1, int(env))
-    return max(1, min(4, os.cpu_count() or 1))
+    return _knobs.resolve("parse_workers", parse_workers)
 
 
 def _parallel_chunk_source(uri: str, part_index: int, num_parts: int,
@@ -2174,11 +2210,17 @@ def create_parser(
 
     # plan knobs stay OUTSIDE the signature: the plan orders blocks at
     # read time, so one cache serves every (seed, window, sharding)
-    return _stamp_snapshot(BlockCacheIter(
+    cached = BlockCacheIter(
         build, bc_path, signature=signature,
         shuffle_seed=shuffle_seed,
         shuffle_window=shuffle_window,
-        host_id=host_id, num_hosts=num_hosts))
+        host_id=host_id, num_hosts=num_hosts)
+    # the parse width the lazily-built base WILL use: the autotuner seeds
+    # its parse_workers knob from this before any cold pass builds the
+    # parser (seeding from the table default would let a later "grow"
+    # silently shrink an explicitly wider pool)
+    cached.parse_workers_hint = _resolve_parse_workers(parse_workers)
+    return _stamp_snapshot(cached)
 
 
 def _create_parser_uncached(

@@ -67,6 +67,7 @@ import numpy as np
 
 from dmlc_tpu.io import faults
 from dmlc_tpu.io import resilience as _resilience
+from dmlc_tpu.utils import knobs as _knobs
 from dmlc_tpu.utils import telemetry as _telemetry
 from dmlc_tpu.utils.check import CacheCorruptionError, DMLCError, check
 from dmlc_tpu.utils.timer import get_time
@@ -355,16 +356,22 @@ class SnapshotIter:
         self._on_read = on_read
         self._annotate = annotate
         n = reader.num_batches if order is None else len(order)
-        if read_workers is None:
-            read_workers = int(os.environ.get(
-                "DMLC_TPU_SNAPSHOT_READ_WORKERS", "2") or 2)
-        workers = max(1, int(read_workers))
+        workers = _knobs.resolve("snapshot_read_workers", read_workers)
         self._pool = OrderedWorkerPool(
             lambda: iter(range(int(start), int(n))),
             self._read,
             num_workers=workers,
             max_ahead=2 * workers,
             counter_label="snapshot_read")
+
+    def resize(self, read_workers: int) -> bool:
+        """Live read-pool resize (the autotuner's
+        ``snapshot_read_workers`` knob): batches keep delivering in
+        serving order across the width change. Always returns True."""
+        n = max(1, int(read_workers))
+        self._pool.resize(n)
+        self._pool.set_max_ahead(2 * n)
+        return True
 
     def _read(self, pos: int):
         reader = self.reader

@@ -306,6 +306,16 @@ class ThreadedIter(Generic[T]):
             self._check_exc_locked()
             return None
 
+    def set_capacity(self, max_capacity: int) -> None:
+        """Live-resize the prefetch window (the autotuner's
+        ``convert_ahead`` knob in natural-block mode): growing lets the
+        producer run further ahead immediately; shrinking only gates NEW
+        production — already-queued items still drain to the consumer,
+        so delivery order and content are untouched."""
+        with self._lock:
+            self._capacity = max(1, int(max_capacity))
+            self._lock.notify_all()
+
     def recycle(self, item: T) -> None:
         """Return a consumed cell for reuse (threadediter.h:476-488)."""
         with self._lock:
@@ -454,9 +464,14 @@ class OrderedWorkerPool(Generic[T]):
         # construction, adopted from the first consumer pull otherwise
         # (see ThreadedIter)
         self._scope = _telemetry.current_scope()
+        # live resize (docs/data.md autotune): _shrink holds exit credits
+        # surplus workers consume at their next loop top; num_workers is
+        # the current TARGET width (threads alive minus pending exits)
+        self._shrink = 0
+        self.num_workers = max(1, int(num_workers))
         self._threads = [
             threading.Thread(target=self._worker_loop, daemon=True)
-            for _ in range(max(1, int(num_workers)))
+            for _ in range(self.num_workers)
         ]
         for t in self._threads:
             t.start()
@@ -501,9 +516,16 @@ class OrderedWorkerPool(Generic[T]):
             with self._lock:
                 self._lock.wait_for(
                     lambda: self._destroyed or self._produce_end
+                    or self._shrink > 0
                     or (self._seq - self._want) < self._ahead
                 )
                 if self._destroyed or self._produce_end:
+                    return
+                if self._shrink > 0:
+                    # live shrink: consume one exit credit and retire —
+                    # between the wait and the pull lock, so a retiring
+                    # worker never holds an undelivered item
+                    self._shrink -= 1
                     return
             with self._pull_lock:
                 # re-check under the pull lock: another worker may have hit
@@ -584,7 +606,7 @@ class OrderedWorkerPool(Generic[T]):
                         "label": self._counter_label,
                         "timeout_seconds": timeout,
                         "workers_alive": alive,
-                        "workers": len(self._threads),
+                        "workers": self.num_workers,
                         "waiting_for": self._want,
                         "pulled": self._seq,
                         "last_producer_error": self.last_producer_error,
@@ -616,6 +638,47 @@ class OrderedWorkerPool(Generic[T]):
                 exc, self._src_exc = self._src_exc, None
                 raise exc
             return None
+
+    def resize(self, num_workers: int) -> int:
+        """Live-resize the worker pool (the autotuner's pool-width
+        knobs): growth spawns threads that join the same serial pull +
+        in-order delivery machinery, shrink posts exit credits surplus
+        workers consume at their next loop top. Sequence numbers — and
+        therefore delivery order and content — are unaffected in both
+        directions. Returns the new target width."""
+        n = max(1, int(num_workers))
+        spawn = []
+        with self._lock:
+            if self._destroyed:
+                return self.num_workers
+            # drop retired/dead threads so diagnostics count live ones
+            self._threads = [t for t in self._threads if t.is_alive()]
+            delta = n - self.num_workers
+            self.num_workers = n
+            if delta > 0:
+                # cancel pending exits first, then top up with threads
+                cancel = min(self._shrink, delta)
+                self._shrink -= cancel
+                for _ in range(delta - cancel):
+                    t = threading.Thread(target=self._worker_loop,
+                                         daemon=True)
+                    self._threads.append(t)
+                    spawn.append(t)
+            elif delta < 0:
+                self._shrink += -delta
+            self._lock.notify_all()
+        for t in spawn:
+            t.start()
+        return n
+
+    def set_max_ahead(self, max_ahead: int) -> None:
+        """Live-resize the pulled-but-undelivered window (the
+        ``convert_ahead`` knob): growing opens the window immediately;
+        shrinking only gates NEW pulls — items already in flight still
+        deliver in order."""
+        with self._lock:
+            self._ahead = max(1, int(max_ahead))
+            self._lock.notify_all()
 
     def destroy(self) -> None:
         """Stop and join the worker threads."""

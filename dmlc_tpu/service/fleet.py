@@ -33,7 +33,8 @@ class LocalFleet:
                  poll_interval: float = 0.05,
                  heartbeat_interval: float = 1.0,
                  plan: Optional[dict] = None,
-                 snapshot: Optional[dict] = None):
+                 snapshot: Optional[dict] = None,
+                 autotune: Optional[bool] = None):
         self.dispatcher = Dispatcher(uri, num_parts, parser=parser,
                                      liveness_timeout=liveness_timeout,
                                      plan=plan, snapshot=snapshot)
@@ -53,7 +54,8 @@ class LocalFleet:
                 self.workers[slot] = ParseWorker(
                     self.dispatcher.address, tracker=tracker_addr,
                     tracker_world=num_workers, poll_interval=poll_interval,
-                    heartbeat_interval=heartbeat_interval)
+                    heartbeat_interval=heartbeat_interval,
+                    autotune=autotune)
             except BaseException as exc:  # noqa: BLE001 - re-raised below
                 errors.append(exc)
 

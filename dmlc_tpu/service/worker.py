@@ -81,7 +81,8 @@ class ParseWorker:
                  tracker: Optional[Tuple[str, int]] = None,
                  tracker_world: int = -1,
                  poll_interval: float = 0.2,
-                 heartbeat_interval: float = 2.0):
+                 heartbeat_interval: float = 2.0,
+                 autotune: Optional[bool] = None):
         self.dispatcher = dispatcher
         self.poll_interval = float(poll_interval)
         self.heartbeat_interval = float(heartbeat_interval)
@@ -89,6 +90,22 @@ class ParseWorker:
         self.uri = cfg["uri"]
         self.num_parts = int(cfg["num_parts"])
         self._parser_cfg = dict(cfg.get("parser") or {})
+        # per-host parse-tier self-tuning (docs/data.md autotune; the
+        # tf.data-service motivation — a heterogeneous fleet cannot share
+        # one static parse_workers): each completed part is a clean
+        # measurement window, and the measured parallelism efficiency
+        # decides the NEXT part's fan-out width within the knob-table
+        # caps. Armed by autotune=True or DMLC_TPU_AUTOTUNE=1; block
+        # content is engine-width-invariant (the A/B parity suites), so
+        # re-served frames stay byte-identical across tier changes.
+        self.tier_tuner = None
+        from dmlc_tpu.utils import knobs as _knobs
+
+        if _knobs.autotune_enabled(autotune):
+            from dmlc_tpu.data.autotune import ParseTierTuner
+
+            self.tier_tuner = ParseTierTuner(
+                start=self._parser_cfg.get("parse_workers"))
         # dispatcher-shipped epoch-plan identity, surfaced for clients /
         # operators. Deliberately NOT folded into the worker's own parser
         # builds: frames must stay parse-order — a relaunched worker
@@ -171,7 +188,33 @@ class ParseWorker:
         kwargs.pop("shuffle_seed", None)
         kwargs.pop("shuffle_window", None)
         kwargs.pop("pod_sharding", None)
+        if self.tier_tuner is not None:
+            # the self-tuned tier overrides the shipped static width
+            kwargs["parse_workers"] = self.tier_tuner.workers
         return create_parser(self.uri, part, self.num_parts, type_, **kwargs)
+
+    def _retune_parse_tier(self, parser) -> None:
+        """Feed the completed part's measured parallelism efficiency back
+        into the tier tuner (grow saturated lanes, shed idle ones) so the
+        next part parses at the adjusted width."""
+        if self.tier_tuner is None or parser is None:
+            return
+        stats = None
+        fn = getattr(parser, "parallel_stats", None)
+        if callable(fn):
+            try:
+                stats = fn()
+            except Exception:  # noqa: BLE001 - a sensor must never kill parse
+                stats = None
+        self.tier_tuner.decide(
+            (stats or {}).get("parse_parallelism_efficiency"),
+            workers=(stats or {}).get("parse_workers"))
+
+    def autotune_state(self) -> Optional[dict]:
+        """The tier tuner's decision record (None when self-tuning is
+        off) — the worker-side analog of stats()['autotune']."""
+        return (self.tier_tuner.snapshot()
+                if self.tier_tuner is not None else None)
 
     def _split_loop(self) -> None:
         while not self._stop.is_set():
@@ -223,6 +266,12 @@ class ParseWorker:
             logger.warning("worker %s: parse of part %d failed: %s",
                            self.worker_id, part, store.error)
         finally:
+            if store.error is None:
+                # only CLEAN parts are measurement windows: a failed part
+                # measures the failure (workers idle behind a dying
+                # stream), not the tier — tuning on it would shrink the
+                # width the next healthy part needs
+                self._retune_parse_tier(parser)
             if parser is not None:
                 parser.close()
             with self._cond:

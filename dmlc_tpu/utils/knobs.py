@@ -1,0 +1,195 @@
+"""The pipeline knob table: one validated home for every tunable.
+
+Before this module, each worker-count env knob was parsed at its point of
+use with ``int(os.environ.get(NAME, "2") or 2)`` — garbage, zero, and
+negative values silently fell back or crashed far from the typo, and the
+set of tunables was only discoverable by grepping. Now every tunable the
+ingest pipeline exposes — pool widths, queue depths, the autotuner's own
+pacing — is one :class:`KnobSpec` row in :data:`KNOB_TABLE`, and every
+read goes through :func:`resolve` (explicit arg > env > default) which
+rejects non-integer / non-positive env values **loudly** at the read
+site.
+
+``make lint-metrics`` enforces the discipline: an ``os.environ`` read of
+a tunable-shaped name (``DMLC_TPU_*_WORKERS``, ``DMLC_TPU_PREFETCH``,
+``DMLC_TPU_CONVERT_AHEAD``, ``DMLC_TPU_AUTOTUNE*``) anywhere under
+``dmlc_tpu/`` outside this module fails the gate — a new knob must be a
+table row, never an ad-hoc parse.
+
+The table also carries each knob's **autotune bounds**: the feedback
+controller (:mod:`dmlc_tpu.data.autotune`) may only move a knob inside
+``[lo, hi]``, where ``hi`` defaults to the host's CPU count for
+worker-pool widths and both ends are overridable per knob via
+``DMLC_TPU_AUTOTUNE_MIN_<KNOB>`` / ``DMLC_TPU_AUTOTUNE_MAX_<KNOB>``
+(knob name upper-cased) — the operator's hard caps (docs/data.md
+autotune section).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional, Tuple, Union
+
+from dmlc_tpu.utils.check import DMLCError, check
+
+IntOrFn = Union[int, Callable[[], int]]
+
+
+def _cpus() -> int:
+    return os.cpu_count() or 1
+
+
+class KnobSpec:
+    """One tunable: its env name, default, and autotune bounds.
+
+    ``default`` / ``hi`` may be callables (host-derived values like the
+    CPU count are resolved at read time, not import time).
+    """
+
+    __slots__ = ("name", "env", "default", "lo", "hi", "doc")
+
+    def __init__(self, name: str, env: Optional[str], default: IntOrFn,
+                 lo: int, hi: IntOrFn, doc: str):
+        self.name = name
+        self.env = env
+        self.default = default
+        self.lo = int(lo)
+        self.hi = hi
+        self.doc = doc
+
+    def default_value(self) -> int:
+        d = self.default
+        return int(d() if callable(d) else d)
+
+    def hi_value(self) -> int:
+        h = self.hi
+        return int(h() if callable(h) else h)
+
+
+# The registered tunables. Every knob the autotuner may touch — and every
+# worker-count env the pipeline reads — is a row here; ``resolve`` /
+# ``bounds`` look knobs up by name.
+KNOB_TABLE: Dict[str, KnobSpec] = {
+    spec.name: spec for spec in (
+        KnobSpec(
+            "parse_workers", "DMLC_TPU_PARSE_WORKERS",
+            default=lambda: max(1, min(4, _cpus())), lo=1, hi=_cpus,
+            doc="data-parallel chunk-parse fan-out width "
+                "(ParallelTextParser pool)"),
+        KnobSpec(
+            "convert_workers", "DMLC_TPU_CONVERT_WORKERS",
+            default=2, lo=1, hi=_cpus,
+            doc="host layout-conversion pool width (DeviceIter)"),
+        KnobSpec(
+            "plan_read_workers", "DMLC_TPU_PLAN_READ_WORKERS",
+            default=2, lo=1, hi=_cpus,
+            doc="plan-ordered warm block-cache read pool width"),
+        KnobSpec(
+            "snapshot_read_workers", "DMLC_TPU_SNAPSHOT_READ_WORKERS",
+            default=2, lo=1, hi=_cpus,
+            doc="warm snapshot read pool width (SnapshotIter)"),
+        KnobSpec(
+            "convert_ahead", "DMLC_TPU_CONVERT_AHEAD",
+            default=4, lo=1, hi=64,
+            doc="converted-batch lookahead window (convert pool "
+                "max_ahead / natural-block prefetch capacity)"),
+        KnobSpec(
+            "prefetch", "DMLC_TPU_PREFETCH",
+            default=2, lo=1, hi=16,
+            doc="device_put transfers issued ahead of consumption"),
+    )
+}
+
+
+def _parse_positive_int(raw: str, what: str) -> int:
+    """Loud validation of a tunable's env value: integers >= 1 only —
+    zero, negatives, and garbage raise instead of silently defaulting
+    (a typo'd knob must fail the run, not quietly mistune it)."""
+    try:
+        value = int(raw)
+    except (TypeError, ValueError):
+        raise DMLCError(
+            f"{what}={raw!r}: not an integer — worker counts and queue "
+            f"depths must be whole numbers >= 1 (docs/data.md autotune "
+            f"section lists every knob)") from None
+    check(value >= 1,
+          f"{what}={value}: must be >= 1 (0/negative would disable the "
+          f"stage; unset the variable to use the default instead)")
+    return value
+
+
+def resolve(name: str, explicit: Optional[int] = None) -> int:
+    """The one knob read path: explicit argument > env > table default.
+
+    Explicit arguments keep the historical clamp-to-floor behavior
+    (``max(lo, int(value))`` — callers constructing pipelines
+    programmatically are allowed to pass 0 and get the floor); env
+    values are validated LOUDLY via :func:`_parse_positive_int`.
+    """
+    spec = KNOB_TABLE.get(name)
+    check(spec is not None, f"unknown knob {name!r}; registered knobs: "
+                            f"{sorted(KNOB_TABLE)}")
+    if explicit is not None:
+        return max(spec.lo, int(explicit))
+    if spec.env:
+        raw = os.environ.get(spec.env, "").strip()
+        if raw:
+            return _parse_positive_int(raw, spec.env)
+    return spec.default_value()
+
+
+def bounds(name: str) -> Tuple[int, int]:
+    """The autotuner's hard caps for ``name``: the table's ``[lo, hi]``
+    narrowed by ``DMLC_TPU_AUTOTUNE_MIN_<KNOB>`` /
+    ``DMLC_TPU_AUTOTUNE_MAX_<KNOB>`` env overrides (validated loudly;
+    an inverted pair raises)."""
+    spec = KNOB_TABLE.get(name)
+    check(spec is not None, f"unknown knob {name!r}; registered knobs: "
+                            f"{sorted(KNOB_TABLE)}")
+    lo, hi = spec.lo, spec.hi_value()
+    env_lo = os.environ.get(f"DMLC_TPU_AUTOTUNE_MIN_{name.upper()}",
+                            "").strip()
+    env_hi = os.environ.get(f"DMLC_TPU_AUTOTUNE_MAX_{name.upper()}",
+                            "").strip()
+    if env_lo:
+        lo = _parse_positive_int(env_lo,
+                                 f"DMLC_TPU_AUTOTUNE_MIN_{name.upper()}")
+    if env_hi:
+        hi = _parse_positive_int(env_hi,
+                                 f"DMLC_TPU_AUTOTUNE_MAX_{name.upper()}")
+    check(lo <= hi,
+          f"autotune bounds for {name}: min {lo} > max {hi} "
+          f"(check the DMLC_TPU_AUTOTUNE_MIN/MAX_{name.upper()} pair)")
+    return lo, hi
+
+
+def autotune_enabled(explicit: Optional[bool] = None) -> bool:
+    """The master switch: an explicit argument wins; otherwise
+    ``DMLC_TPU_AUTOTUNE=1`` arms the controller (any other value — or
+    unset — leaves it off, the historical static-knob behavior)."""
+    if explicit is not None:
+        return bool(explicit)
+    return os.environ.get("DMLC_TPU_AUTOTUNE", "").strip() == "1"
+
+
+def autotune_interval(explicit: Optional[int] = None) -> int:
+    """Mid-epoch controller pacing: run a tuning step every N delivered
+    batches (0 = epoch boundaries only, the default). Explicit argument
+    > ``DMLC_TPU_AUTOTUNE_INTERVAL`` env (validated: integer >= 0) >
+    0."""
+    if explicit is not None:
+        value = int(explicit)
+        check(value >= 0, f"autotune_interval={value}: must be >= 0")
+        return value
+    raw = os.environ.get("DMLC_TPU_AUTOTUNE_INTERVAL", "").strip()
+    if not raw:
+        return 0
+    try:
+        value = int(raw)
+    except ValueError:
+        raise DMLCError(
+            f"DMLC_TPU_AUTOTUNE_INTERVAL={raw!r}: not an integer") from None
+    check(value >= 0,
+          f"DMLC_TPU_AUTOTUNE_INTERVAL={value}: must be >= 0 "
+          "(0 = tune at epoch boundaries only)")
+    return value
