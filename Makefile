@@ -25,8 +25,12 @@
 #                     (autotune_enabled/autotune_steps/
 #                     autotune_final_config — the feedback controller
 #                     climbs a starved config and emits the chosen knobs
-#                     as reusable env), and the telemetry contract
-#                     (telemetry_schema_version + per-stage span counts)
+#                     as reusable env), the tiered artifact store
+#                     (store_bytes/store_evictions/
+#                     store_rebuilds_after_eviction — every cache and
+#                     snapshot the legs publish is store-managed), and
+#                     the telemetry contract (telemetry_schema_version +
+#                     per-stage span counts)
 #   make fuzz         mutation fuzz of every native parse C-ABI entry point
 #                     (crash-safety; DMLC_FUZZ_ITERS to scale)
 #   make lint-retry   grep gate: no time.sleep inside retry-shaped loops
@@ -36,6 +40,10 @@
 #                     time.monotonic() stage timing outside
 #                     dmlc_tpu/utils/{telemetry,timer}.py (bookkeeping
 #                     must live on the telemetry registry/span tracer)
+#   make lint-store   grep gate: no direct os.replace / hand-allocated
+#                     .tmp publish of store-managed artifact formats
+#                     outside dmlc_tpu/store/ (publish must go through
+#                     the tiered artifact store — docs/store.md)
 
 PYTHON ?= python
 # bash + pipefail so a failing stage is never masked by the tee into CHECK.log
@@ -43,7 +51,7 @@ SHELL := /bin/bash
 .SHELLFLAGS := -o pipefail -c
 
 .PHONY: check test test-all sanitize parse-bench bench-smoke fuzz \
-	lint-retry lint-metrics
+	lint-retry lint-metrics lint-store
 
 # the tier-1 contract: slow-marked scale/soak tests are opt-in (test-all)
 test:
@@ -57,6 +65,9 @@ lint-retry:
 
 lint-metrics:
 	$(PYTHON) bin/lint_metrics.py
+
+lint-store:
+	$(PYTHON) bin/lint_store.py
 
 fuzz:
 	$(PYTHON) native/test/fuzz_parse.py
@@ -124,6 +135,12 @@ bench-smoke:
 	        f'autotune_final_config incomplete: {acfg}'; \
 	    assert line.get('input_wait_seconds') is not None, \
 	        'input_wait_seconds missing'; \
+	    assert line.get('store_bytes'), \
+	        'store_bytes missing/zero (artifacts not store-managed)'; \
+	    assert line.get('store_evictions') is not None, \
+	        'store_evictions missing'; \
+	    assert line.get('store_rebuilds_after_eviction') is not None, \
+	        'store_rebuilds_after_eviction missing'; \
 	    assert line.get('telemetry_schema_version') == 1, \
 	        'telemetry_schema_version missing/mismatched'; \
 	    assert line.get('trace_spans'), 'trace_spans missing/zero'; \
@@ -157,7 +174,11 @@ bench-smoke:
 	    print('bench-smoke: autotune OK:', line['autotune_steps'], \
 	          'steps,', line.get('autotune_adjustments'), \
 	          'adjustments, converged', line.get('autotune_converged'), \
-	          ', config', acfg)"
+	          ', config', acfg); \
+	    print('bench-smoke: artifact store OK:', line['store_bytes'], \
+	          'managed bytes,', line['store_evictions'], 'evictions,', \
+	          line['store_rebuilds_after_eviction'], \
+	          'rebuilds after eviction')"
 
 parse-bench:
 	mkdir -p native/build
@@ -177,6 +198,8 @@ check:
 	$(MAKE) --no-print-directory lint-retry 2>&1 | tee -a CHECK.log
 	@echo "-- lint-metrics (ad-hoc bookkeeping gate) --" | tee -a CHECK.log
 	$(MAKE) --no-print-directory lint-metrics 2>&1 | tee -a CHECK.log
+	@echo "-- lint-store (direct artifact-publish gate) --" | tee -a CHECK.log
+	$(MAKE) --no-print-directory lint-store 2>&1 | tee -a CHECK.log
 	@echo "-- pytest --" | tee -a CHECK.log
 	$(PYTHON) -m pytest tests/ -q -m 'not slow' 2>&1 | tee -a CHECK.log
 	@echo "-- sanitizers --" | tee -a CHECK.log

@@ -910,6 +910,25 @@ def run_child() -> None:
             line.update(autotune_leg(path, size_mb))
         except Exception as exc:  # noqa: BLE001 - the headline must still print
             log(f"bench: autotune leg failed: {exc}")
+    # tiered artifact store contract (docs/store.md): the cache/snapshot
+    # legs above published their artifacts THROUGH the store, so the
+    # registry gauge must show managed bytes; evictions/rebuilds are 0 on
+    # an unbudgeted bench run and nonzero only under
+    # DMLC_TPU_STORE_BUDGET_BYTES (make bench-smoke gates the fields)
+    try:
+        from dmlc_tpu.store import store_counters
+
+        sc = store_counters()
+        line["store_bytes"] = sc["store_bytes"]
+        line["store_evictions"] = sc["store_evictions"]
+        line["store_rebuilds_after_eviction"] = \
+            sc["store_rebuilds_after_eviction"]
+        log(f"bench: artifact store: {sc['store_bytes']} managed bytes, "
+            f"{sc['store_evictions']} evictions, "
+            f"{sc['store_rebuilds_after_eviction']} rebuilds after "
+            f"eviction")
+    except Exception as exc:  # noqa: BLE001 - the headline must still print
+        log(f"bench: store counters failed: {exc}")
     # always-on telemetry contract (docs/observability.md): the schema
     # version + per-stage span counts ride the JSON line, proving the span
     # tracer covered the whole measurement (make bench-smoke gates these)

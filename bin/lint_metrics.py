@@ -65,9 +65,10 @@ _PATTERNS = (
 _KNOB_PATTERN = (
     re.compile(r"(?:environ(?:\.get)?\s*[\(\[]|\bgetenv\s*\()\s*['\"]"
                r"DMLC_TPU_(?:[A-Z0-9_]*_WORKERS|PREFETCH|CONVERT_AHEAD|"
-               r"AUTOTUNE[A-Z0-9_]*)['\"]"),
+               r"AUTOTUNE[A-Z0-9_]*|STORE[A-Z0-9_]*)['\"]"),
     "ad-hoc tunable env read — register the knob in "
-    "dmlc_tpu/utils/knobs.py (KNOB_TABLE) and read it via knobs.resolve")
+    "dmlc_tpu/utils/knobs.py (KNOB_TABLE / a validated accessor like "
+    "store_budget_bytes) and read it through that module")
 
 
 def scan_source(text: str,

@@ -40,6 +40,7 @@ from dmlc_tpu.data.parsers import Parser
 from dmlc_tpu.data.row_block import (
     CooBlock, DenseBlock, RowBlock, RowBlockContainer,
 )
+from dmlc_tpu.io import block_cache as _block_cache
 from dmlc_tpu.io import resilience as _resilience
 from dmlc_tpu.io import snapshot as _snapshot
 from dmlc_tpu.io.threaded_iter import OrderedWorkerPool, ThreadedIter
@@ -50,6 +51,14 @@ from dmlc_tpu.utils import knobs as _knobs
 from dmlc_tpu.utils import telemetry as _telemetry
 from dmlc_tpu.utils.check import CacheCorruptionError, DMLCError, check
 from dmlc_tpu.utils.timer import StageMeter, get_time
+
+
+def _store_counters() -> dict:
+    """The tiered store's counter triple for ``stats()['store']``
+    (lazy import: the store manager sits above this module's io deps)."""
+    from dmlc_tpu.store import store_counters
+
+    return store_counters()
 
 
 # resume marker: yielded by the natural-block producer for skipped blocks
@@ -860,11 +869,9 @@ class DeviceIter:
         the source (sequential states) or rebuilds deterministically
         (plan states); the stream stays byte-identical either way."""
         _resilience.record_event("snapshot_corruptions")
-        self._drop_snap_reader()
-        try:
-            os.remove(self.snapshot_path)
-        except OSError:
-            pass
+        self._drop_snap_reader()  # releases the reader's eviction pin
+        _block_cache._artifact_store(self.snapshot_path).discard(
+            self.snapshot_path)
 
     def _rebuild_snapshot(self) -> None:
         """Deterministic full rebuild (vanished/corrupt snapshot under a
@@ -874,11 +881,9 @@ class DeviceIter:
         byte-identical to the lost ones and the plan stream continues
         unbroken at the same position."""
         _resilience.record_event("snapshot_rebuilds")
-        self._drop_snap_reader()
-        try:
-            os.remove(self.snapshot_path)
-        except OSError:
-            pass
+        self._drop_snap_reader()  # releases the reader's eviction pin
+        _block_cache._artifact_store(self.snapshot_path).discard(
+            self.snapshot_path)
         self._teardown_producer()
         self._snap_serving = False
         self._abort_snapshot_writer()
@@ -2017,4 +2022,10 @@ class DeviceIter:
             "staging_ring": (self._ring.stats() if self._ring is not None
                              else None),
             "resilience": resilience,
+            # tiered artifact store (docs/store.md): live on-disk bytes
+            # under management across every store this process touched,
+            # plus the process-wide eviction / eviction-triggered-rebuild
+            # tallies — process-wide because budget pressure from ANY
+            # pipeline is what evicts this one's artifacts
+            "store": _store_counters(),
         }

@@ -1504,11 +1504,8 @@ class BlockCacheIter(Parser):
         if corruption:
             _resilience.record_event("cache_corruptions")
             _resilience.record_event("cache_rebuilds")
-        self._drop_reader()
-        try:
-            os.remove(self.cache_file)
-        except OSError:
-            pass
+        self._drop_reader()  # releases the reader's eviction pin first
+        self._bc._artifact_store(self.cache_file).discard(self.cache_file)
         self._abort_writer()
         base = self.base
         base.before_first()
@@ -1543,11 +1540,8 @@ class BlockCacheIter(Parser):
         exactly at the broken block."""
         _resilience.record_event("cache_corruptions")
         _resilience.record_event("cache_rebuilds")
-        self._drop_reader()
-        try:
-            os.remove(self.cache_file)
-        except OSError:
-            pass
+        self._drop_reader()  # releases the reader's eviction pin first
+        self._bc._artifact_store(self.cache_file).discard(self.cache_file)
         self._abort_writer()
         self._mode = "cold"
         self._shadow = True

@@ -31,8 +31,11 @@ Integrity: each block carries a crc32 over its whole ``[pos, end)`` span
 (checked on every warm read — zlib crc runs at GB/s, noise next to the
 text parse it replaces), the footer carries its own crc, and both file
 ends carry the magic so truncation is detected structurally. The writer
-streams to ``<path>.tmp``, fsyncs, and atomically publishes with
-``os.replace`` — a crash can never leave a torn-but-valid-looking cache.
+streams to a store-allocated staging file and publishes through the
+tiered artifact store (:mod:`dmlc_tpu.store`: fsync + atomic rename +
+manifest record + byte-budget enforcement) — a crash can never leave a
+torn-but-valid-looking cache, and readers pin the cache they serve so
+eviction can never take a tier away mid-epoch (docs/store.md).
 
 Staleness: a cache is keyed by a **source signature** (file sizes+mtimes,
 partition ``splitN.partK``, parser/format/engine config —
@@ -61,6 +64,21 @@ from dmlc_tpu.utils.timer import get_time
 
 BLOCK_CACHE_MAGIC = b"DMLCBC01"
 BLOCK_CACHE_VERSION = 1
+
+
+def _store_manager():
+    """Lazy import of the tiered-store manager (it sits above the
+    resilience/telemetry layers, so the io formats bind to it at call
+    time, never at package init)."""
+    from dmlc_tpu.store import manager
+
+    return manager
+
+
+def _artifact_store(path: str):
+    """The :class:`~dmlc_tpu.store.manager.ArtifactStore` owning
+    ``path``'s directory."""
+    return _store_manager().store_for(path)
 _TAIL_FMT = "<QQI"  # footer offset, footer length, footer crc32
 _TAIL_LEN = struct.calcsize(_TAIL_FMT) + len(BLOCK_CACHE_MAGIC)
 _ALIGN = 64
@@ -158,8 +176,9 @@ def read_segments(buf, arrays: Dict[str, list]) -> Dict[str, np.ndarray]:
 def finish_container(f, tmp_path: str, path: str, footer: dict,
                      magic: bytes) -> None:
     """The shared publish tail: write the crc'd JSON ``footer`` + tail
-    record + closing ``magic``, fsync, close, and atomically rename
-    ``tmp_path`` -> ``path``. One implementation so a crash can never
+    record + closing ``magic``, then publish through the artifact store
+    (:mod:`dmlc_tpu.store` — fsync + atomic rename + manifest record +
+    byte-budget enforcement). One implementation so a crash can never
     leave a torn-but-valid-looking container of either format."""
     payload = json.dumps(footer, sort_keys=True,
                          separators=(",", ":")).encode()
@@ -168,13 +187,9 @@ def finish_container(f, tmp_path: str, path: str, footer: dict,
     f.write(struct.pack(_TAIL_FMT, off, len(payload),
                         zlib.crc32(payload) & 0xFFFFFFFF))
     f.write(magic)
-    # fsync BEFORE the atomic rename: without it a crash between write
-    # and rename can publish a complete-looking file whose data blocks
-    # never hit the platter (same protocol as CachedInputSplit)
-    f.flush()
-    os.fsync(f.fileno())
-    f.close()
-    os.replace(tmp_path, path)
+    _artifact_store(path).publish_file(
+        tmp_path, path, tier=_store_manager().tier_for_magic(magic),
+        signature=footer.get("signature"), fobj=f)
 
 
 def open_container(path: str, magic: bytes, version: int, what: str):
@@ -222,15 +237,19 @@ def open_container(path: str, magic: bytes, version: int, what: str):
 
 
 class BlockCacheWriter:
-    """Streams checksummed columnar block segments to ``<path>.tmp``;
-    :meth:`finish` writes the footer, fsyncs, and atomically publishes."""
+    """Streams checksummed columnar block segments to a store-allocated
+    staging file; :meth:`finish` writes the footer and publishes through
+    the artifact store (fsync + atomic rename + manifest + budget)."""
 
     def __init__(self, path: str, signature: Optional[dict] = None):
         self.path = path
-        self.tmp_path = path + ".tmp"
         self._sig = signature or {}
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
+        # process-unique staging name from the store: two writers racing
+        # the same path (concurrent service workers) can never clobber
+        # each other's half-written bytes (docs/store.md)
+        self.tmp_path = _artifact_store(path).stage_path(path)
         self._f = open(self.tmp_path, "wb")
         self._f.write(_HEADER)
         self._entries: List[dict] = []
@@ -313,6 +332,7 @@ class BlockCacheReader:
                  verify: bool = True):
         self.path = path
         self.verify = verify
+        self._store_pinned = False
         self._file, self._mm, footer = open_container(
             path, BLOCK_CACHE_MAGIC, BLOCK_CACHE_VERSION,
             f"block cache {path}")
@@ -326,6 +346,11 @@ class BlockCacheReader:
                 raise DMLCError(
                     f"block cache {path}: source signature mismatch "
                     f"(stale cache)")
+            # pin/refcount (docs/store.md): while this reader serves the
+            # cache, a byte-budget squeeze may never evict it — a warm
+            # epoch cannot lose its tier mid-epoch. Dropped at close().
+            _artifact_store(path).pin(path)
+            self._store_pinned = True
         except Exception:
             self.close()
             raise
@@ -386,6 +411,15 @@ class BlockCacheReader:
         return segments
 
     def close(self) -> None:
+        # the eviction pin drops first, unconditionally — even when
+        # exported views keep the mmap alive (an unlinked-but-mapped file
+        # keeps serving on POSIX, so releasing the pin is always safe)
+        if getattr(self, "_store_pinned", False):
+            self._store_pinned = False
+            try:
+                _artifact_store(self.path).drop(self.path)
+            except OSError:
+                pass
         # best-effort: the mmap cannot close while exported views are
         # alive (BufferError) — GC reclaims it once the last view dies
         mm = getattr(self, "_mm", None)
@@ -463,16 +497,18 @@ def open_block_cache(path: str, signature: Optional[dict] = None,
                      verify: bool = True) -> Optional[BlockCacheReader]:
     """Open a published cache, or None when it is missing or must be
     rebuilt (unreadable / wrong version / signature mismatch — the stale
-    file is dropped and a ``cache_invalidations`` resilience event
-    counted)."""
+    file is dropped via the store and a ``cache_invalidations``
+    resilience event counted). A miss on a path the store manifest marks
+    as EVICTED counts a ``store_rebuilds_after_eviction`` event — the
+    rebuild the caller now runs is the budget's doing (docs/store.md)."""
     if not os.path.exists(path):
+        # light probe: only consults the store when the directory already
+        # carries a manifest (never creates state for an unmanaged dir)
+        _store_manager().note_missing(path)
         return None
     try:
         return BlockCacheReader(path, signature=signature, verify=verify)
     except DMLCError:
         _resilience.record_event("cache_invalidations")
-        try:
-            os.remove(path)
-        except OSError:
-            pass
+        _artifact_store(path).discard(path)
         return None

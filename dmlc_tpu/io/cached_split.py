@@ -9,10 +9,13 @@ name from URISpec.
 
 Improvements over the reference:
 
-- the cache is written to ``<file>.tmp``, **fsynced**, and renamed on
-  completion — a crashed first pass can never leave a truncated cache, and
-  a crash between write and rename can never publish a cache whose frames
-  never hit the platter;
+- the cache is staged to a store-allocated ``.tmp``, **fsynced**, and
+  atomically published through the tiered artifact store
+  (:mod:`dmlc_tpu.store` — manifest record, byte-budget enforcement,
+  orphan-``.tmp`` GC; docs/store.md) — a crashed first pass can never
+  leave a truncated cache, a crash between write and rename can never
+  publish a cache whose frames never hit the platter, and a warm pass
+  pins the cache so eviction cannot take it away mid-epoch;
 - cache format v1 is versioned (``DMLCCHK1`` header) and every frame is
   ``[u64 size][u32 crc32][bytes]`` — a warm pass verifies each frame, and
   a failed check is a classified **cache fault**
@@ -61,18 +64,47 @@ class CachedInputSplit(InputSplit):
               "cache-only record extraction")
         self._detached: Optional[InputSplitBase] = None
         self.cache_file = cache_file
-        self._tmp_file = cache_file + ".tmp"
+        self._tmp_file: Optional[str] = None  # store-allocated per pass
         self._capacity = capacity
         self._chunk: Optional[_Chunk] = None
         self._iter: Optional[ThreadedIter] = None
+        self._pinned = False
         self._mode = "cached" if self._cache_usable() else "preproc"
+        if self._mode == "cached":
+            self._pin_cache()
         self._start_iter()
+
+    def _store(self):
+        from dmlc_tpu.io.block_cache import _artifact_store
+
+        return _artifact_store(self.cache_file)
+
+    def _pin_cache(self) -> None:
+        """Eviction pin (docs/store.md): while this split serves the
+        cache, a byte-budget squeeze may never evict it."""
+        if not self._pinned:
+            self._store().pin(self.cache_file)
+            self._pinned = True
+
+    def _unpin_cache(self) -> None:
+        if self._pinned:
+            self._pinned = False
+            try:
+                self._store().drop(self.cache_file)
+            except OSError:
+                pass
 
     def _cache_usable(self) -> bool:
         """A published cache with the current format header. A header from
         another format/version (including the headerless v0 layout) is a
         stale cache: drop it and rebuild from source."""
         if not os.path.exists(self.cache_file):
+            # an eviction-vanished cache heals via rebuild; the store
+            # counts store_rebuilds_after_eviction (docs/store.md). The
+            # light probe never creates state for an unmanaged dir.
+            from dmlc_tpu.io.block_cache import _store_manager
+
+            _store_manager().note_missing(self.cache_file)
             return False
         try:
             with open(self.cache_file, "rb") as fi:
@@ -82,10 +114,8 @@ class CachedInputSplit(InputSplit):
         if head == CHUNK_CACHE_MAGIC:
             return True
         _resilience.record_event("cache_invalidations")
-        try:
-            os.remove(self.cache_file)
-        except OSError:
-            pass
+        self._unpin_cache()
+        self._store().discard(self.cache_file)
         return False
 
     @property
@@ -111,6 +141,8 @@ class CachedInputSplit(InputSplit):
 
     def _preproc_chunks(self) -> Iterator[bytes]:
         """First pass: pull from base, tee every chunk to the cache file."""
+        store = self._store()
+        self._tmp_file = store.stage_path(self.cache_file)
         with open(self._tmp_file, "wb") as fo:
             fo.write(CHUNK_CACHE_MAGIC)
             while True:
@@ -122,14 +154,15 @@ class CachedInputSplit(InputSplit):
                                      zlib.crc32(data) & 0xFFFFFFFF))
                 fo.write(data)
                 yield data
-            # fsync BEFORE the atomic rename: os.replace orders the rename
-            # against nothing — without the fsync a crash in the window can
-            # publish a complete-looking cache whose frames were never
-            # flushed (torn frames that later passes would read as valid)
-            fo.flush()
-            os.fsync(fo.fileno())
-        os.replace(self._tmp_file, self.cache_file)
+            # atomic publish through the store: fsync BEFORE the rename
+            # (a crash in the window can never publish a complete-looking
+            # cache whose frames were never flushed), manifest record,
+            # byte-budget enforcement (docs/store.md)
+            store.publish_file(self._tmp_file, self.cache_file,
+                               tier="chunk_cache", fobj=fo)
+        self._tmp_file = None
         self._mode = "cached"
+        self._pin_cache()
 
     def _cached_chunks(self) -> Iterator[bytes]:
         served_bytes = 0
@@ -169,10 +202,8 @@ class CachedInputSplit(InputSplit):
             # boundary, so a mid-chunk tail still starts at a record head
             _resilience.record_event("cache_corruptions")
             _resilience.record_event("cache_rebuilds")
-            try:
-                os.remove(self.cache_file)
-            except OSError:
-                pass
+            self._unpin_cache()
+            self._store().discard(self.cache_file)
             self._mode = "preproc"
             self.base.before_first()
             skip = served_bytes
@@ -215,14 +246,11 @@ class CachedInputSplit(InputSplit):
     def before_first(self) -> None:
         self._chunk = None
         if self._mode == "preproc":
-            # first pass was interrupted mid-write: drop the partial cache
-            # and restart the pass (the tmp/rename protocol keeps the real
-            # cache file untouched)
+            # first pass was interrupted mid-write: drop the partial
+            # staging file and restart the pass (the stage/publish
+            # protocol keeps the real cache file untouched)
             self._iter.destroy()
-            try:
-                os.remove(self._tmp_file)
-            except OSError:
-                pass
+            self._drop_tmp()
             self.base.before_first()
             self._start_iter()
         else:
@@ -237,12 +265,18 @@ class CachedInputSplit(InputSplit):
         if self._base is not None:
             self._base.hint_chunk_size(chunk_size)
 
+    def _drop_tmp(self) -> None:
+        tmp, self._tmp_file = self._tmp_file, None
+        if tmp is not None:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
     def close(self) -> None:
         if self._iter is not None:
             self._iter.destroy()
         if self._base is not None:
             self._base.close()
-        try:
-            os.remove(self._tmp_file)
-        except OSError:
-            pass
+        self._unpin_cache()
+        self._drop_tmp()

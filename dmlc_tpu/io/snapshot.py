@@ -98,10 +98,11 @@ def quantize_int8(arr) -> Tuple[np.ndarray, np.ndarray]:
 
 
 class SnapshotWriter:
-    """Streams checksummed device-layout batches to ``<path>.tmp``;
-    :meth:`finish` writes the footer (geometry + per-batch resume
-    annotations), fsyncs, and atomically publishes — the shadow half of
-    a cold epoch (the convert stage's output tees in here)."""
+    """Streams checksummed device-layout batches to a store-allocated
+    staging file; :meth:`finish` writes the footer (geometry + per-batch
+    resume annotations) and publishes through the artifact store — the
+    shadow half of a cold epoch (the convert stage's output tees in
+    here)."""
 
     def __init__(self, path: str, signature: Optional[dict] = None,
                  geometry: Optional[dict] = None):
@@ -109,11 +110,13 @@ class SnapshotWriter:
 
         self._bc = _bc
         self.path = path
-        self.tmp_path = path + ".tmp"
         self._sig = signature or {}
         self._geom = _bc._normalize(geometry or {})
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
+        # process-unique staging name from the store (docs/store.md):
+        # concurrent writers can never clobber each other's bytes
+        self.tmp_path = _bc._artifact_store(path).stage_path(path)
         self._f = open(self.tmp_path, "wb")
         self._f.write(_bc.container_header(SNAPSHOT_MAGIC, SNAPSHOT_VERSION))
         self._entries: List[dict] = []
@@ -203,6 +206,7 @@ class SnapshotReader:
         self._bc = _bc
         self.path = path
         self.verify = verify
+        self._store_pinned = False
         self._file, self._mm, footer = _bc.open_container(
             path, SNAPSHOT_MAGIC, SNAPSHOT_VERSION, f"snapshot {path}")
         try:
@@ -223,6 +227,10 @@ class SnapshotReader:
                 raise DMLCError(
                     f"snapshot {path}: batch geometry mismatch "
                     f"(stored {self.geometry})")
+            # pin/refcount (docs/store.md): a warm epoch streaming this
+            # snapshot can never lose it to a byte-budget eviction
+            _bc._artifact_store(path).pin(path)
+            self._store_pinned = True
         except Exception:
             self.close()
             raise
@@ -292,6 +300,14 @@ class SnapshotReader:
         return (entry["kind"], *out)
 
     def close(self) -> None:
+        # the eviction pin drops first, unconditionally (see the
+        # block-cache reader: an unlinked-but-mapped file keeps serving)
+        if getattr(self, "_store_pinned", False):
+            self._store_pinned = False
+            try:
+                self._bc._artifact_store(self.path).drop(self.path)
+            except OSError:
+                pass
         # best-effort: the mmap cannot close while exported views are
         # alive (BufferError) — GC reclaims it once the last view dies
         mm = getattr(self, "_mm", None)
@@ -312,20 +328,24 @@ def open_snapshot(path: str, signature: Optional[dict] = None,
                   verify: bool = True) -> Optional[SnapshotReader]:
     """Open a published snapshot, or None when it is missing or must be
     rebuilt (unreadable / wrong version / signature mismatch / **batch
-    geometry mismatch** — the stale file is dropped and a
+    geometry mismatch** — the stale file is dropped via the store and a
     ``snapshot_invalidations`` resilience event counted, so callers
-    simply fall back to a cold convert pass)."""
+    simply fall back to a cold convert pass). A miss on a path the store
+    manifest marks as EVICTED counts ``store_rebuilds_after_eviction``
+    (docs/store.md)."""
+    from dmlc_tpu.io import block_cache as _bc
+
     if not os.path.exists(path):
+        # light probe: only consults the store when the directory already
+        # carries a manifest (never creates state for an unmanaged dir)
+        _bc._store_manager().note_missing(path)
         return None
     try:
         return SnapshotReader(path, signature=signature, geometry=geometry,
                               verify=verify)
     except DMLCError:
         _resilience.record_event("snapshot_invalidations")
-        try:
-            os.remove(path)
-        except OSError:
-            pass
+        _bc._artifact_store(path).discard(path)
         return None
 
 

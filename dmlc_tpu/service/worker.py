@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import socket
 import threading
 from typing import Dict, List, Optional, Tuple
@@ -145,6 +146,12 @@ class ParseWorker:
                 else f"{self.host}:{self.port}")
             self._cond = threading.Condition()
             self._store: Dict[int, _PartStore] = {}
+            # artifact-store pins held for parts this worker serves: a
+            # block cache published while parsing a part stays pinned for
+            # the worker's life, so a fleet-wide byte-budget squeeze can
+            # never evict the tier a relaunched/failed-over worker would
+            # re-serve the part from (docs/store.md pin semantics)
+            self._artifact_pins: List[str] = []
             self._stop = threading.Event()
             self._dead = False
             self._conns: set = set()
@@ -272,6 +279,8 @@ class ParseWorker:
                 # stream), not the tier — tuning on it would shrink the
                 # width the next healthy part needs
                 self._retune_parse_tier(parser)
+            if store.error is None:
+                self._pin_part_artifact(parser)
             if parser is not None:
                 parser.close()
             with self._cond:
@@ -279,6 +288,35 @@ class ParseWorker:
                 self._cond.notify_all()
         logger.info("worker %s: part %d parsed (%d blocks)",
                     self.worker_id, part, len(store.frames))
+
+    def _pin_part_artifact(self, parser) -> None:
+        """Hold the eviction pin on a part's published block cache for
+        the worker's life (pins are dropped at close/kill; a REAL crash
+        needs no drop — pins of dead pids are ignored at manifest
+        replay). ``parser.close()`` releases the reader's own pin, so
+        this one is what keeps the artifact resident between serves."""
+        path = getattr(parser, "cache_file", None)
+        if not path or not os.path.exists(path):
+            return
+        try:
+            from dmlc_tpu.store import store_for
+
+            store_for(path).pin(path)
+            self._artifact_pins.append(path)
+        except Exception as exc:  # noqa: BLE001 - a pin failure must
+            # never fail the part: the artifact just stays evictable
+            logger.warning("worker %s: artifact pin of %s failed: %s",
+                           self.worker_id, path, exc)
+
+    def _drop_artifact_pins(self) -> None:
+        pins, self._artifact_pins = self._artifact_pins, []
+        for path in pins:
+            try:
+                from dmlc_tpu.store import store_for
+
+                store_for(path).drop(path)
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                pass
 
     def _hb_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_interval):
@@ -506,6 +544,10 @@ class ParseWorker:
 
     def _teardown(self) -> None:
         self._stop.set()
+        # release artifact pins: close() is a graceful exit, and kill()
+        # emulates a dead pid (whose journaled pins replay as ignored) —
+        # in-process the explicit drop is the faithful equivalent
+        self._drop_artifact_pins()
         with self._cond:
             self._cond.notify_all()
         try:

@@ -1,0 +1,29 @@
+"""Unified tiered-store manager for published on-disk artifacts
+(chunk caches / block caches / device-native snapshots): one directory
+layout + crash-safe manifest, atomic publish with orphan GC, pin/drop
+refcounts, byte budgets with cost-aware eviction. See
+:mod:`dmlc_tpu.store.manager` and docs/store.md."""
+
+from dmlc_tpu.store.manager import (
+    COMPACT_BYTES,
+    COMPACT_LINES,
+    MAGIC_TIERS,
+    MANIFEST_NAME,
+    STORE_DIRNAME,
+    TIER_COST,
+    TIERS,
+    ArtifactStore,
+    note_missing,
+    reset_stores,
+    signature_hash,
+    store_counters,
+    store_for,
+    tier_for_magic,
+)
+
+__all__ = [
+    "ArtifactStore", "COMPACT_BYTES", "COMPACT_LINES", "MAGIC_TIERS",
+    "MANIFEST_NAME", "STORE_DIRNAME", "TIER_COST", "TIERS",
+    "note_missing", "reset_stores", "signature_hash", "store_counters",
+    "store_for", "tier_for_magic",
+]

@@ -163,6 +163,43 @@ def bounds(name: str) -> Tuple[int, int]:
     return lo, hi
 
 
+def store_budget_bytes(explicit: Optional[int] = None) -> Optional[int]:
+    """The artifact store's total on-disk byte budget
+    (docs/store.md): explicit argument > ``DMLC_TPU_STORE_BUDGET_BYTES``
+    env (validated loudly: integer >= 1) > None (unbounded — the
+    historical fill-the-volume behavior). Not an autotune knob — the
+    budget is the operator's capacity contract, never a value the
+    controller may move — but it lives here so the knob lint gate covers
+    the read and a typo'd budget fails the run instead of silently
+    unbounding the store."""
+    if explicit is not None:
+        value = int(explicit)
+        check(value >= 1,
+              f"store_budget_bytes={value}: must be >= 1 (omit the "
+              f"budget entirely for an unbounded store)")
+        return value
+    raw = os.environ.get("DMLC_TPU_STORE_BUDGET_BYTES", "").strip()
+    if not raw:
+        return None
+    return _parse_positive_int(raw, "DMLC_TPU_STORE_BUDGET_BYTES")
+
+
+def store_gc_age_seconds(explicit: Optional[int] = None) -> int:
+    """Minimum age before an orphaned ``.tmp`` staging file is
+    garbage-collected at store open (docs/store.md): explicit argument >
+    ``DMLC_TPU_STORE_GC_AGE_SECONDS`` env (validated: integer >= 1) >
+    600. The gate exists so a LIVE concurrent writer's in-flight staging
+    file is never raced."""
+    if explicit is not None:
+        value = int(explicit)
+        check(value >= 1, f"store_gc_age_seconds={value}: must be >= 1")
+        return value
+    raw = os.environ.get("DMLC_TPU_STORE_GC_AGE_SECONDS", "").strip()
+    if not raw:
+        return 600
+    return _parse_positive_int(raw, "DMLC_TPU_STORE_GC_AGE_SECONDS")
+
+
 def autotune_enabled(explicit: Optional[bool] = None) -> bool:
     """The master switch: an explicit argument wins; otherwise
     ``DMLC_TPU_AUTOTUNE=1`` arms the controller (any other value — or

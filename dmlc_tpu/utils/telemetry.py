@@ -83,6 +83,10 @@ INPUT_WAIT_METRIC = "input_wait_seconds"
 # gauges + a steps counter, labeled by pipeline scope
 AUTOTUNE_KNOB_METRIC = "autotune_knob"
 AUTOTUNE_STEP_METRIC = "autotune_steps"
+# tiered artifact store (dmlc_tpu.store): live on-disk bytes under
+# management, gauge labeled (root, tier) — evictions/rebuilds ride the
+# resilience counter like every other classified event (docs/store.md)
+STORE_BYTES_METRIC = "store_bytes"
 
 
 # ---------------- pipeline scoping ----------------
@@ -564,12 +568,21 @@ def pod_snapshot() -> dict:
     transfer = REGISTRY.sum_by(STAGE_WALL_METRIC, "stage").get("transfer")
     if transfer:
         stages["transfer"] = stages.get("transfer", 0.0) + transfer
+    events = REGISTRY.sum_by(RESILIENCE_METRIC, "event")
     return {
         "telemetry_schema_version": SCHEMA_VERSION,
         "stages": {k: round(v, 4) for k, v in stages.items() if k},
-        "resilience": {k: int(round(v)) for k, v in
-                       REGISTRY.sum_by(RESILIENCE_METRIC, "event").items()
-                       if k},
+        "resilience": {k: int(round(v)) for k, v in events.items() if k},
+        # tiered artifact store (docs/store.md): this host's live bytes
+        # under management + its eviction/rebuild tallies, so the pod
+        # table shows which rank's disk the budget is squeezing
+        "store": {
+            "store_bytes": int(REGISTRY.sum(STORE_BYTES_METRIC)),
+            "store_evictions": int(round(
+                events.get("store_evictions", 0))),
+            "store_rebuilds_after_eviction": int(round(
+                events.get("store_rebuilds_after_eviction", 0))),
+        },
         "spans": span_counts(),
         "spans_dropped": spans_dropped(),
     }
@@ -604,6 +617,11 @@ def format_pod_table(by_rank: Dict[int, dict]) -> str:
             cells.append(f"{v:>{width}.3f}")
         res = snap.get("resilience") or {}
         hot = {k: v for k, v in sorted(res.items()) if v}
+        # store_evictions/rebuilds already ride the resilience dict;
+        # surface the rank's live store bytes next to them when nonzero
+        store_bytes = (snap.get("store") or {}).get("store_bytes")
+        if store_bytes:
+            hot["store_bytes"] = int(store_bytes)
         lines.append(f"{rank:>4}  " + "  ".join(cells)
                      + f"  {hot if hot else '-'}")
     lines.append("-" * len(header))
