@@ -21,7 +21,11 @@
 #                     with convert busy ~0; bf16 halves stored bytes), the
 #                     data-service leg (service_workers/
 #                     service_mb_per_sec/service_vs_local_speedup from a
-#                     localhost 2-worker fleet), the online-autotuner leg
+#                     localhost 2-worker fleet, plus the control-plane
+#                     resilience quartet dispatcher_restarts/
+#                     worker_reregistrations/parts_reclaimed/
+#                     control_plane_retries — present and ZERO on a
+#                     clean run), the online-autotuner leg
 #                     (autotune_enabled/autotune_steps/
 #                     autotune_final_config — the feedback controller
 #                     climbs a starved config and emits the chosen knobs
@@ -125,6 +129,14 @@ bench-smoke:
 	        'service_mb_per_sec missing'; \
 	    assert line.get('service_vs_local_speedup'), \
 	        'service_vs_local_speedup missing'; \
+	    cp = [k for k in ('dispatcher_restarts', \
+	        'worker_reregistrations', 'parts_reclaimed', \
+	        'control_plane_retries') if line.get(k) is None]; \
+	    assert not cp, f'control-plane counters missing: {cp}'; \
+	    hot = {k: line[k] for k in ('dispatcher_restarts', \
+	        'worker_reregistrations', 'parts_reclaimed', \
+	        'control_plane_retries') if line[k]}; \
+	    assert not hot, f'control-plane events on a clean run: {hot}'; \
 	    assert line.get('autotune_enabled') is True, \
 	        'autotune_enabled missing (autotune leg did not run)'; \
 	    assert line.get('autotune_steps') is not None, \

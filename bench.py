@@ -496,8 +496,15 @@ def service_leg(path: str, size_mb: float, workers: int = 2):
     identical config. ``service_vs_local_speedup > 1`` means the fleet's
     parallel parse beats the single-host serial pass even after paying
     the frame encode + loopback TCP + decode tax — the disaggregation
-    claim at smoke scale (arXiv:2210.14826)."""
+    claim at smoke scale (arXiv:2210.14826). Also emits the
+    control-plane resilience quartet (``dispatcher_restarts`` /
+    ``worker_reregistrations`` / ``parts_reclaimed`` /
+    ``control_plane_retries``, docs/service.md control-plane recovery):
+    all four MUST read zero on a clean run — a nonzero value on healthy
+    infrastructure means the dispatcher restarted or a control RPC
+    retried mid-bench, which taints the throughput numbers."""
     from dmlc_tpu.data import create_parser
+    from dmlc_tpu.io import resilience as _resilience
     from dmlc_tpu.service import LocalFleet, ServiceParser
 
     num_parts = workers
@@ -511,6 +518,7 @@ def service_leg(path: str, size_mb: float, workers: int = 2):
             rows += 1
         parser.close()
     local_dt = time.monotonic() - t0
+    res_base = _resilience.counters_snapshot()
     # fleet construction is inside the timed region: the workers' parallel
     # parse IS the work being measured, not a warm pre-parse
     t0 = time.monotonic()
@@ -526,14 +534,21 @@ def service_leg(path: str, size_mb: float, workers: int = 2):
         if client is not None:
             client.close()
         fleet.close()
+    res = _resilience.counters_delta(res_base)
     log(f"bench: service {workers}-worker fleet {sblocks} blocks in "
         f"{service_dt:.2f}s = {size_mb/service_dt:.1f} MB/s vs local "
         f"serial {size_mb/local_dt:.1f} MB/s -> speedup "
-        f"x{local_dt/service_dt:.2f}")
+        f"x{local_dt/service_dt:.2f} (control plane: "
+        f"{res['dispatcher_restarts']} restarts, "
+        f"{res['control_plane_retries']} retries)")
     return {
         "service_workers": workers,
         "service_mb_per_sec": round(size_mb / service_dt, 2),
         "service_vs_local_speedup": round(local_dt / service_dt, 3),
+        "dispatcher_restarts": res["dispatcher_restarts"],
+        "worker_reregistrations": res["worker_reregistrations"],
+        "parts_reclaimed": res["parts_reclaimed"],
+        "control_plane_retries": res["control_plane_retries"],
     }
 
 
@@ -1104,6 +1119,8 @@ def main() -> int:
                           "bf16_line_rate_trimmed_mb_per_sec",
                           "service_workers", "service_mb_per_sec",
                           "service_vs_local_speedup",
+                          "dispatcher_restarts", "worker_reregistrations",
+                          "parts_reclaimed", "control_plane_retries",
                           "autotune_enabled", "autotune_steps",
                           "autotune_adjustments", "autotune_converged",
                           "autotune_gap_stage", "autotune_final_config",

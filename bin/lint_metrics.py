@@ -11,7 +11,12 @@ numbers never reached ``stats()`` or a trace. ``make lint-metrics`` keeps
 that from creeping back. It FAILS on, anywhere under ``dmlc_tpu/`` —
 every package, including ``dmlc_tpu/service/`` (whose frame
 encode/send/recv/decode timing must ride the span tracer, and whose
-failover events must go through ``record_event``),
+failover AND control-plane recovery events — ``dispatcher_restarts``,
+``worker_reregistrations``, ``parts_reclaimed``,
+``control_plane_retries``, recorded around the dispatcher's
+AppendJournal-backed assignment journal — must go through
+``record_event``; an ad-hoc counter beside the journal fails here the
+way a hand-rolled journal publish fails ``make lint-store``),
 ``dmlc_tpu/data/epoch.py`` (the epoch planner is pure plan math: any
 timing it ever grows must pair with the ``cache_read`` spans its
 consumer records), and ``dmlc_tpu/io/snapshot.py`` (the device-native

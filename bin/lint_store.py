@@ -20,6 +20,15 @@ under ``dmlc_tpu/`` outside ``dmlc_tpu/store/``:
   of one signature can never clobber each other, and orphan GC can
   find crashed writers' leftovers).
 
+The gate equally covers the data-service dispatcher's assignment
+journal (``dmlc_tpu/service/dispatcher.py``, docs/service.md
+control-plane recovery): it persists through the shared
+``dmlc_tpu.store.journal.AppendJournal`` — the same flock'd
+append/torn-tail-skip/atomic-compaction substrate as the store manifest
+— so a hand-rolled ``.tmp`` staging name or a direct ``os.replace``
+compaction beside it fails here, exactly like a direct artifact
+publish would.
+
 Sanctioned exceptions (non-artifact files, listed in ``ALLOWED``):
 ``utils/telemetry.py`` (Chrome-trace export writes a trace JSON, not a
 store-managed artifact).

@@ -15,7 +15,7 @@ Plan grammar (``;``-separated clauses)::
     op         := 'read' | 'open' | 'write' | 'request' | 'connect' | ...
     occurrence := N | N '..' M | N '+'        (1-based, per clause)
     error      := 'http-<code>' | 'reset' | 'timeout' | 'unreachable'
-                  | 'corrupt'                 (default: 'http-503')
+                  | 'corrupt' | 'conn' | 'torn'   (default: 'http-503')
 
 The op is the call-site label passed to ``maybe_fail``: ``read`` fires on
 stream block fetches, ``open`` on metadata/stat/open requests, ``write``
@@ -25,6 +25,14 @@ seam). ``cache_read`` fires on cache-frame/segment reads (the chunk cache
 and the block cache), where the natural error class is ``corrupt`` — a
 :class:`~dmlc_tpu.utils.check.CacheCorruptionError` that exercises the
 drop-cache/re-parse/rewrite healing path without touching bytes on disk.
+The control-plane ops cover the data service (docs/service.md):
+``dispatch_rpc`` fires on every dispatcher round trip (workers, clients,
+fleet bootstrap — the seam sits inside ``service.dispatcher.request``)
+and ``worker_rpc`` on client->worker connections (stream / find /
+count). Their natural error classes are ``conn`` (connection refused —
+the peer is down, e.g. a dispatcher between kill and restart) and
+``torn`` (the peer died mid-reply), both retryable, so chaos plans
+drive dispatcher-restart and torn-reply-storm paths deterministically.
 ``~substr`` restricts a clause to calls whose subject (URL/path)
 contains the substring; occurrences are counted per clause over its
 matching calls only, so plans are deterministic under interleaving from
@@ -36,6 +44,8 @@ Examples::
     open~part-3@1=http-403  # opening part-3 fails fatally once
     read@4=reset            # the 4th read dies with a connection reset
     connect@2+=timeout      # every guarded attempt from the 2nd on hangs
+    dispatch_rpc@2..4=conn  # dispatcher unreachable for three round trips
+    worker_rpc@1=torn       # first client->worker exchange dies mid-reply
 
 Activate with the :func:`inject` context manager, or process-wide with
 ``DMLC_FAULT_PLAN`` (the env hook — read lazily on the first guarded
@@ -79,6 +89,13 @@ def _build_error(spec: str, what: str) -> BaseException:
     if spec == "corrupt":
         return CacheCorruptionError(
             f"injected cache corruption: {what or 'fault://injected'}")
+    if spec == "conn":
+        return ConnectionRefusedError(
+            111, f"injected: connection refused: "
+                 f"{what or 'fault://injected'}")
+    if spec == "torn":
+        return ConnectionError(
+            f"injected: torn reply from {what or 'fault://injected'}")
     raise DMLCError(f"fault plan: unknown error class {spec!r}")
 
 

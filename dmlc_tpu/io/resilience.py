@@ -181,13 +181,28 @@ class _Counters:
     ``service_giveups``
                   service streams abandoned with the failure budget
                   exhausted (no live worker took the part)
+    ``dispatcher_restarts``
+                  data-service control-plane restarts a client observed
+                  (the dispatcher's generation token advanced mid-run)
+    ``worker_reregistrations``
+                  parse workers re-attaching to a restarted/recovered
+                  dispatcher (generation change or declared-dead zombie)
+    ``parts_reclaimed``
+                  fully-parsed parts a restarted dispatcher adopted from
+                  worker frame stores instead of re-issuing for re-parse
+    ``control_plane_retries``
+                  dispatcher round trips (register / locate / next_split
+                  / reclaim ...) that failed transiently and were
+                  retried under the shared policy
     """
 
     _KEYS = ("attempts", "retries", "resumes", "giveups", "fatal",
              "producer_restarts", "producer_giveups",
              "parse_restarts", "parse_giveups",
              "cache_corruptions", "cache_invalidations", "cache_rebuilds",
-             "service_retries", "service_failovers", "service_giveups")
+             "service_retries", "service_failovers", "service_giveups",
+             "dispatcher_restarts", "worker_reregistrations",
+             "parts_reclaimed", "control_plane_retries")
 
     def bump(self, key: str, n: int = 1) -> None:
         record_event(key, n)

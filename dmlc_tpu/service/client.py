@@ -21,6 +21,18 @@ stream request), counting ``service_retries`` per interruption and
 ``service_failovers`` when the resume landed on a different worker;
 exhausted budgets count ``service_giveups`` and surface as ``DMLCError``.
 
+The control plane is covered too (docs/service.md control-plane
+recovery): every dispatcher round trip runs under the shared
+``RetryPolicy`` (``control_plane_retries`` per transient re-attempt —
+connection refused between a dispatcher kill and its restart, torn
+replies), and every dispatcher response carries a monotonic generation
+token. A bump means the dispatcher restarted: the client counts a
+``dispatcher_restarts`` and simply continues — its ``(part, block)``
+cursor is client-owned state, revalidated against the recovered
+dispatcher by the very next ``locate``, so the epoch resumes
+byte-identically whether the part was reclaimed from a surviving
+worker's frame store or re-parsed.
+
 Checkpoints: ``state_dict()`` is ``(part, block)`` — O(1) to restore
 into a **fresh** client/connection. ``load_state`` additionally accepts
 the parser chain's annotation states (the ``kind='split'``/``'chunks'``
@@ -38,6 +50,7 @@ from typing import Dict, Optional
 
 from dmlc_tpu.data.parsers import Parser
 from dmlc_tpu.data.row_block import DenseBlock, RowBlock
+from dmlc_tpu.io import faults as _faults
 from dmlc_tpu.io import resilience as _resilience
 from dmlc_tpu.service import dispatcher as _dispatch
 from dmlc_tpu.service.frame import (
@@ -84,9 +97,13 @@ class ServiceParser(Parser):
         # remote reads, its own retry backoffs) is slow, not dead —
         # misclassifying it as lost would re-queue all its parts
         self._stream_timeout = float(stream_timeout)
-        cfg = self._policy.call(
-            lambda: _dispatch.request(service, {"cmd": "config"}),
-            op="service_config", what=service)
+        self._closed = threading.Event()
+        # the dispatcher's monotonic generation token: an advance means
+        # the control plane restarted (docs/service.md) — counted as
+        # dispatcher_restarts, after which the (part, block) cursor is
+        # revalidated by the next locate and the epoch rides through
+        self._gen: Optional[int] = None
+        cfg = self._control({"cmd": "config"})
         self.uri = cfg["uri"]
         self.num_parts = int(cfg["num_parts"])
         self.parser_config = dict(cfg.get("parser") or {})
@@ -124,8 +141,48 @@ class ServiceParser(Parser):
         self._bytes = 0
         self._recv_seconds = 0.0
         self._decode_seconds = 0.0
-        self._closed = threading.Event()
         self._last_annot: Optional[dict] = None
+
+    # ---------------- control plane ----------------
+
+    def _control(self, req: dict) -> dict:
+        """One policy-guarded dispatcher round trip: transient
+        control-plane faults (connection refused while the dispatcher
+        restarts, torn replies — ``dispatcher.request`` classifies them)
+        back off and retry under the shared policy, counting
+        ``control_plane_retries``; an exhausted budget surfaces as the
+        retryable :class:`ServiceUnavailableError` so the stream-fault
+        layer above keeps healing. The response's generation stamp is
+        inspected, so a dispatcher restart is detected at the next
+        control exchange."""
+        try:
+            resp = self._policy.call(
+                lambda: _dispatch.request(self.service, req),
+                op="control_plane", what=self.service,
+                on_retry=lambda: _resilience.record_event(
+                    "control_plane_retries"))
+        except DMLCError as exc:
+            if _resilience.classify(exc) != _resilience.RETRYABLE:
+                raise
+            raise ServiceUnavailableError(
+                f"service {self.service}: control plane unreachable "
+                f"({req.get('cmd')}): {exc}") from exc
+        self._note_generation(resp)
+        return resp
+
+    def _note_generation(self, resp: dict) -> None:
+        gen = resp.get("gen")
+        if gen is None:
+            return
+        gen = int(gen)
+        if self._gen is not None and gen > self._gen:
+            # the control plane restarted and recovered mid-run: count
+            # it; the (part, block) cursor is client-owned, so the next
+            # locate against the recovered dispatcher revalidates it and
+            # the epoch continues byte-identically
+            _resilience.record_event("dispatcher_restarts")
+        if self._gen is None or gen > self._gen:
+            self._gen = gen
 
     # ---------------- connection plumbing ----------------
 
@@ -148,8 +205,7 @@ class ServiceParser(Parser):
         worker must surface, not spin forever."""
         deadline = get_time() + self._policy.attempt_timeout
         while not self._closed.is_set():
-            resp = _dispatch.request(self.service,
-                                     {"cmd": "locate", "part": self._part})
+            resp = self._control({"cmd": "locate", "part": self._part})
             if not resp.get("wait"):
                 return resp
             if get_time() >= deadline:
@@ -164,6 +220,10 @@ class ServiceParser(Parser):
             return self._sock
         owner = self._locate_owner()
         self._pending_owner = str(owner["worker"])
+        # the worker_rpc fault-plan seam: chaos plans break client->
+        # worker data-plane connects deterministically (docs/resilience.md)
+        _faults.maybe_fail(
+            "worker_rpc", f"{owner['worker']} stream part {self._part}")
         sock = socket.create_connection(
             (owner["host"], int(owner["port"])),
             timeout=self._connect_timeout)
@@ -201,8 +261,7 @@ class ServiceParser(Parser):
         elif lost is not None:
             self._failover_from = lost
             try:
-                _dispatch.request(self.service,
-                                  {"cmd": "report_lost", "worker": lost})
+                self._control({"cmd": "report_lost", "worker": lost})
             except (OSError, DMLCError, ValueError):
                 pass  # dispatcher unreachable too: the locate poll decides
         used = self._stream_failures
@@ -223,11 +282,11 @@ class ServiceParser(Parser):
             try:
                 sock = self._ensure_stream()
                 kind, meta, payload = recv_frame(sock)
-            except (ConnectionError, OSError, ValueError,
+            except (ConnectionError, OSError,
                     ServiceFrameError, ServiceUnavailableError) as exc:
-                # ValueError: a torn dispatcher reply mid-crash is JSON
-                # garbage — the same transient fault as the connection
-                # dropping, so it must fail over, not kill the epoch
+                # torn dispatcher replies arrive as ConnectionError —
+                # dispatcher.request classifies them centrally, so no
+                # call-site ValueError special case survives here
                 self._recv_seconds += get_time() - t0
                 self._on_stream_fault(exc)
                 continue
@@ -330,7 +389,13 @@ class ServiceParser(Parser):
                 sock.close()
             if not line:
                 raise ConnectionError(f"part {part}: empty reply")
-            resp = json.loads(line)
+            try:
+                resp = json.loads(line)
+            except ValueError as exc:
+                # a torn worker reply (died mid-response) is the same
+                # transient fault as the connection dropping
+                raise ConnectionError(
+                    f"part {part}: torn reply {line[:64]!r}") from exc
             if "error" in resp:
                 # the located worker cannot answer authoritatively (stale
                 # assignment, interrupted parse): heal exactly like the
@@ -338,16 +403,15 @@ class ServiceParser(Parser):
                 # and retry against the new owner. A wrong count/find
                 # would silently restore the wrong position.
                 try:
-                    _dispatch.request(self.service, {
-                        "cmd": "report_lost",
-                        "worker": str(owner["worker"])})
+                    self._control({"cmd": "report_lost",
+                                   "worker": str(owner["worker"])})
                 except (OSError, DMLCError, ValueError):
                     pass
                 raise ServiceUnavailableError(
                     f"part {part}: {resp['error']}")
             return resp
 
-        return self._policy.call(attempt, op="service_query",
+        return self._policy.call(attempt, op="worker_rpc",
                                  what=f"part {part}")
 
     def _locate_with_part(self, part: int) -> dict:

@@ -15,9 +15,12 @@ newline-terminated response — the same short-lived-connection shape the
 rabit tracker uses for ``heartbeat``/``metrics``). Commands:
 
 ``config``                      -> the dataset spec workers/clients parse
-``register worker host port``   -> join the fleet (idempotent; a re-
-                                   registration after death re-queues
-                                   nothing — the worker starts fresh)
+``register worker host port``   -> join the fleet (re-registration of a
+                                   worker already seen alive THIS
+                                   generation is treated as a crash-
+                                   restart: its parts re-queue at the
+                                   front until a ``reclaim`` adopts them
+                                   back)
 ``next_split worker``           -> ``{"part": k}`` | ``{"part": null}``
                                    (nothing to do) — doubles as liveness
 ``heartbeat worker``            -> liveness only
@@ -26,12 +29,42 @@ rabit tracker uses for ``heartbeat``/``metrics``). Commands:
                                    the part awaits (re)assignment
 ``report_lost worker``          -> a client observed the worker dead: all
                                    its parts re-queue at the FRONT
+``part_done part worker``       -> the owner finished parsing the part
+                                   (journaled: a restarted dispatcher
+                                   keeps it done instead of re-issuing)
+``reclaim worker parts``        -> the worker re-announces the fully-
+                                   parsed parts its frame store still
+                                   holds: a restarted dispatcher ADOPTS
+                                   them (no fleet-wide re-parse), and
+                                   journal-complete parts the worker no
+                                   longer holds re-queue
 ``status``                      -> registry snapshot (tests, operators)
+
+Every response is stamped with the dispatcher's monotonic ``gen``
+generation token, so workers and clients detect a restart at their next
+control exchange (docs/service.md control-plane recovery).
+
+**Crash recovery**: with ``journal_path=`` set, every state transition —
+dataset registration, worker register/death, part grant / complete /
+re-issue / reclaim — is appended to a flock'd JSONL journal (the shared
+:class:`~dmlc_tpu.store.journal.AppendJournal` substrate: torn-tail skip
+at replay, atomic compaction). A restarted ``Dispatcher(journal_path=
+...)`` replays it into the exact assignment state: **completed parts
+stay done** (their owners get a liveness grace window to re-attach),
+**in-flight parts re-queue at the front**, and the generation token
+bumps so the fleet re-registers and reclaims. The journal records no
+epoch state by design: epochs live with clients and worker frame stores
+(``before_first`` re-serves without dispatcher involvement), so the
+assignment journal is epoch-invariant.
 
 The dispatcher is deliberately dataset-state-free about *blocks*: block
 ordering, resume, and exactly-once delivery live with the client (global
 order is part-major), so the dispatcher never becomes a data-plane
 bottleneck — it serves O(workers + failovers) tiny requests per epoch.
+Concurrent connection handlers are capped (``DMLC_TPU_DISPATCH_WORKERS``
+via the knob table); excess connections shed with a retryable ``busy``
+reply, so a reconnect storm from a recovering fleet cannot exhaust
+threads exactly when the dispatcher must stay responsive.
 """
 
 from __future__ import annotations
@@ -41,22 +74,40 @@ import logging
 import socket
 import threading
 from collections import deque
-from typing import Dict, Optional
+from typing import Deque, Dict, List, Optional, Set
 
+from dmlc_tpu.io import faults as _faults
+from dmlc_tpu.store import journal as _journal_mod
+from dmlc_tpu.store.journal import AppendJournal
+from dmlc_tpu.utils import knobs as _knobs
+from dmlc_tpu.utils.check import check
 from dmlc_tpu.utils.timer import get_time
 
 logger = logging.getLogger("dmlc_tpu.service")
 
+# journal compaction threshold: past this many lines at replay the
+# journal is rewritten as the live state (dataset + start + registers +
+# grant/complete pairs). Assignment journals are naturally small —
+# O(parts + workers + failovers), epochs append nothing — so this only
+# triggers after many restart cycles.
+JOURNAL_COMPACT_LINES = 4096
+
 
 class _WorkerInfo:
-    __slots__ = ("worker", "host", "port", "last_seen", "alive")
+    __slots__ = ("worker", "host", "port", "last_seen", "alive",
+                 "registered_gen")
 
-    def __init__(self, worker: str, host: str, port: int, now: float):
+    def __init__(self, worker: str, host: str, port: int, now: float,
+                 registered_gen: Optional[int] = None):
         self.worker = worker
         self.host = host
         self.port = port
         self.last_seen = now
         self.alive = True
+        # the generation this worker last sent `register` in; None for a
+        # worker restored from the journal that has not re-attached yet
+        # (its frame-store contents are unknown until it reclaims)
+        self.registered_gen = registered_gen
 
 
 class Dispatcher:
@@ -69,6 +120,11 @@ class Dispatcher:
     local parse with the same config. ``liveness_timeout`` (seconds)
     declares a worker dead when its polls/heartbeats go stale; client
     ``report_lost`` reports short-circuit that wait.
+
+    ``journal_path`` arms crash recovery: state transitions journal to
+    an append-only JSONL file and a restart on the same address replays
+    them (see the module docstring). Without it the dispatcher is the
+    historical in-memory-only control plane (generation fixed at 1).
     """
 
     def __init__(self, uri: str, num_parts: int,
@@ -76,7 +132,9 @@ class Dispatcher:
                  host: str = "127.0.0.1", port: int = 0,
                  liveness_timeout: float = 10.0,
                  plan: Optional[dict] = None,
-                 snapshot: Optional[dict] = None):
+                 snapshot: Optional[dict] = None,
+                 journal_path: Optional[str] = None,
+                 journal_compact_lines: int = JOURNAL_COMPACT_LINES):
         self.uri = uri
         self.num_parts = int(num_parts)
         self.parser = dict(parser or {})
@@ -99,41 +157,203 @@ class Dispatcher:
         # FCFS visitation queue: parts not yet assigned this epoch.
         # Re-issued parts (dead owner) go to the FRONT so failover work
         # heals before fresh parts are handed out.
-        self._todo: deque = deque(range(self.num_parts))
+        self._todo: Deque[int] = deque(range(self.num_parts))
         self._assigned: Dict[int, str] = {}   # part -> worker id
+        self._completed: Set[int] = set()     # parts whose parse finished
+        self.generation = 1
+        self._journal: Optional[AppendJournal] = None
+        if journal_path:
+            self._journal = AppendJournal(journal_path)
+            self._recover(int(journal_compact_lines))
+        # connection-handler cap (knob table; docs/service.md): excess
+        # connections shed with a retryable `busy` reply instead of
+        # spawning an unbounded thread per connection — a reconnect storm
+        # from a recovering fleet must not exhaust threads exactly when
+        # the control plane needs to stay responsive
+        self._handler_slots = threading.Semaphore(
+            _knobs.resolve("dispatch_workers"))
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
         self._sock.listen(128)
         self.host, self.port = self._sock.getsockname()[:2]
+        # in-flight handler connections, force-closed at close()/kill():
+        # a dead process's sockets drop with it, and a restart must be
+        # able to rebind the SAME port immediately (lingering accepted
+        # sockets without SO_REUSEADDR would hold it)
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         self._closed = False
         self._thread = threading.Thread(target=self._serve, daemon=True,
                                         name="service-dispatcher")
         self._thread.start()
-        logger.info("dispatcher for %s (%d parts) on %s:%d",
-                    uri, num_parts, self.host, self.port)
+        logger.info("dispatcher for %s (%d parts) on %s:%d gen %d",
+                    uri, num_parts, self.host, self.port, self.generation)
 
     @property
     def address(self) -> str:
         return f"{self.host}:{self.port}"
 
+    # ---------------- journal + replay ----------------
+
+    def _journal_append(self, event: dict, sync: bool = True) -> None:
+        """Journal one state transition (no-op without a journal). All
+        assignment events fsync: the journal IS the recovery contract,
+        and its volume is O(parts + workers + failovers) per run."""
+        if self._journal is not None:
+            self._journal.append(event, sync=sync)
+
+    def _recover(self, compact_lines: int) -> None:
+        """Replay the journal into the exact assignment state: completed
+        parts stay done with their owner, in-flight parts re-queue at
+        the FRONT (lowest first — clients consume part-major), replayed
+        workers get a fresh liveness window to re-attach, and the
+        generation token bumps past every `start` ever journaled."""
+        with self._journal.locked():
+            lines = self._journal.read_lines()
+            events = _journal_mod.decode_events(lines)
+            last_gen = 0
+            seen_dataset = False
+            todo = self._todo
+            in_todo = set(todo)
+            assigned, completed = self._assigned, self._completed
+            workers: Dict[str, tuple] = {}
+            for ev in events:
+                op = ev.get("op")
+                if op == "dataset":
+                    check(int(ev.get("num_parts", self.num_parts))
+                          == self.num_parts,
+                          f"dispatcher journal {self._journal.path}: "
+                          f"journaled dataset has "
+                          f"{ev.get('num_parts')} parts, constructor "
+                          f"says {self.num_parts} — a restart must "
+                          f"recover the SAME dataset")
+                    seen_dataset = True
+                elif op == "start":
+                    last_gen = max(last_gen, int(ev.get("gen", 0) or 0))
+                elif op == "register":
+                    workers[str(ev.get("worker"))] = (
+                        str(ev.get("host", "")), int(ev.get("port", 0)))
+                elif op == "dead":
+                    workers.pop(str(ev.get("worker")), None)
+                elif op == "grant":
+                    part = int(ev.get("part", -1))
+                    if part in in_todo:
+                        in_todo.discard(part)
+                        todo.remove(part)
+                    assigned[part] = str(ev.get("worker"))
+                elif op == "complete":
+                    part = int(ev.get("part", -1))
+                    if part in assigned:
+                        completed.add(part)
+                elif op == "reissue":
+                    part = int(ev.get("part", -1))
+                    assigned.pop(part, None)
+                    completed.discard(part)
+                    if 0 <= part < self.num_parts and part not in in_todo:
+                        in_todo.add(part)
+                        todo.appendleft(part)
+                elif op == "reclaim":
+                    part = int(ev.get("part", -1))
+                    if part in in_todo:
+                        in_todo.discard(part)
+                        todo.remove(part)
+                    assigned[part] = str(ev.get("worker"))
+                    completed.add(part)
+            # in-flight at the crash (granted, never completed): the
+            # owner's frames may be partial — re-queue at the front,
+            # lowest part first; reclaim re-adopts what survived
+            inflight = sorted(p for p in assigned if p not in completed)
+            for part in inflight:
+                assigned.pop(part)
+            # parts completed by a worker the journal no longer knows
+            # (dead without a reissue line — a torn tail can lose one):
+            # nothing serves them, so they re-queue behind the in-flight
+            orphaned = sorted(p for p, w in assigned.items()
+                              if w not in workers)
+            for part in orphaned:
+                assigned.pop(part)
+                completed.discard(part)
+            for part in reversed(inflight + orphaned):
+                if part not in in_todo:
+                    in_todo.add(part)
+                    todo.appendleft(part)
+            now = get_time()
+            # replayed workers start a fresh liveness window: a worker
+            # that survived the dispatcher re-attaches within it (its
+            # next poll sees the generation bump), one that died with
+            # the dispatcher goes stale and its parts re-issue normally
+            self._workers = {
+                w: _WorkerInfo(w, h, p, now) for w, (h, p) in
+                workers.items()}
+            self.generation = last_gen + 1
+            if len(lines) > compact_lines:
+                self._journal.rewrite(self._live_events())
+            if not seen_dataset:
+                self._journal.append(
+                    {"op": "dataset", "uri": self.uri,
+                     "num_parts": self.num_parts}, sync=True)
+            self._journal.append(
+                {"op": "start", "gen": self.generation}, sync=True)
+            if events:
+                logger.info(
+                    "dispatcher: recovered from %s — gen %d, %d parts "
+                    "done, %d re-queued, %d workers awaiting re-attach",
+                    self._journal.path, self.generation,
+                    len(self._completed), len(inflight) + len(orphaned),
+                    len(self._workers))
+
+    def _live_events(self) -> List[dict]:
+        """The current state as a canonical journal (compaction): the
+        dataset, the last start, live workers, and grant+complete pairs
+        for done parts. Unassigned parts are implicit (replay seeds the
+        queue from ``range(num_parts)``); the queue's front-ordering
+        normalizes to ascending across a compaction."""
+        events: List[dict] = [
+            {"op": "dataset", "uri": self.uri,
+             "num_parts": self.num_parts},
+            {"op": "start", "gen": self.generation - 1},
+        ]
+        for info in self._workers.values():
+            if info.alive:
+                events.append({"op": "register", "worker": info.worker,
+                               "host": info.host, "port": info.port})
+        for part in sorted(self._completed):
+            worker = self._assigned.get(part)
+            if worker is None:
+                continue
+            events.append({"op": "grant", "part": part, "worker": worker})
+            events.append({"op": "complete", "part": part,
+                           "worker": worker})
+        return events
+
     # ---------------- assignment core (lock held) ----------------
+
+    def _requeue_locked(self, parts, worker: str, why: str) -> None:
+        """Re-issue ``parts`` at the FRONT, lowest part first (clients
+        consume part-major, so the earliest lost part is the one
+        blocking them), journaling each re-queue."""
+        parts = sorted(parts)
+        for part in parts:
+            self._assigned.pop(part, None)
+            self._completed.discard(part)
+        for part in reversed(parts):
+            self._todo.appendleft(part)
+            self._journal_append({"op": "reissue", "part": part,
+                                  "worker": worker})
+        if parts:
+            logger.warning("dispatcher: worker %s %s; re-issuing parts %s",
+                           worker, why, parts)
 
     def _mark_dead_locked(self, worker: str) -> None:
         info = self._workers.get(worker)
         if info is None or not info.alive:
             return
         info.alive = False
-        lost = sorted(p for p, w in self._assigned.items() if w == worker)
-        for part in lost:
-            del self._assigned[part]
-        # re-issue at the front, lowest part first (clients consume
-        # part-major, so the earliest lost part is the one blocking them)
-        for part in reversed(lost):
-            self._todo.appendleft(part)
-        if lost:
-            logger.warning("dispatcher: worker %s lost; re-issuing parts %s",
-                           worker, lost)
+        self._journal_append({"op": "dead", "worker": worker})
+        self._requeue_locked(
+            [p for p, w in self._assigned.items() if w == worker],
+            worker, "lost")
 
     def _reap_stale_locked(self, now: float) -> None:
         if self.liveness_timeout <= 0:
@@ -148,6 +368,13 @@ class Dispatcher:
     # ---------------- request handlers ----------------
 
     def _handle(self, req: dict) -> dict:
+        resp = self._dispatch_cmd(req)
+        # the monotonic generation token: peers detect a restart at
+        # their next control exchange and re-register/revalidate
+        resp["gen"] = self.generation
+        return resp
+
+    def _dispatch_cmd(self, req: dict) -> dict:
         cmd = req.get("cmd")
         now = get_time()
         with self._lock:
@@ -157,8 +384,25 @@ class Dispatcher:
                         "snapshot": self.snapshot}
             if cmd == "register":
                 worker = str(req["worker"])
+                prev = self._workers.get(worker)
+                if (prev is not None and prev.alive
+                        and prev.registered_gen == self.generation):
+                    # a worker id already seen alive THIS generation is
+                    # re-registering: the process crash-restarted fast
+                    # (before the liveness reaper fired) and its frame
+                    # store is presumed gone — re-queue everything it
+                    # owned; the reclaim that follows adopts back what
+                    # actually survived (docs/service.md)
+                    self._requeue_locked(
+                        [p for p, w in self._assigned.items()
+                         if w == worker],
+                        worker, "re-registered (crash-restart)")
                 self._workers[worker] = _WorkerInfo(
-                    worker, str(req["host"]), int(req["port"]), now)
+                    worker, str(req["host"]), int(req["port"]), now,
+                    registered_gen=self.generation)
+                self._journal_append({"op": "register", "worker": worker,
+                                      "host": str(req["host"]),
+                                      "port": int(req["port"])})
                 return {"ok": True}
             if cmd == "heartbeat":
                 info = self._workers.get(str(req.get("worker")))
@@ -172,14 +416,37 @@ class Dispatcher:
                     # unregistered/declared-dead workers get no splits —
                     # a zombie must re-register before it can own parts
                     return {"part": None, "register": True}
+                if info.registered_gen != self.generation:
+                    # journal-restored worker that has not re-attached
+                    # this generation: its frame-store contents are
+                    # unknown until the register+reclaim handshake, and
+                    # a grant riding the SAME reply as the generation
+                    # bump would race the reclaim into a duplicate parse
+                    info.last_seen = now
+                    return {"part": None, "register": True}
                 info.last_seen = now
                 self._reap_stale_locked(now)
                 if not self._todo:
                     return {"part": None}
                 part = self._todo.popleft()
                 self._assigned[part] = worker
+                self._journal_append({"op": "grant", "part": part,
+                                      "worker": worker})
                 logger.info("dispatcher: part %d -> worker %s", part, worker)
                 return {"part": part}
+            if cmd == "part_done":
+                worker = str(req["worker"])
+                part = int(req["part"])
+                if (self._assigned.get(part) == worker
+                        and part not in self._completed):
+                    # journaled completion: a restarted dispatcher keeps
+                    # the part done instead of re-queuing it as in-flight
+                    self._completed.add(part)
+                    self._journal_append({"op": "complete", "part": part,
+                                          "worker": worker})
+                return {"ok": True}
+            if cmd == "reclaim":
+                return self._reclaim_locked(req)
             if cmd == "locate":
                 part = int(req["part"])
                 if not 0 <= part < self.num_parts:
@@ -202,8 +469,51 @@ class Dispatcher:
                     "assigned": {str(p): w
                                  for p, w in self._assigned.items()},
                     "todo": list(self._todo),
+                    "completed": sorted(self._completed),
+                    "generation": self.generation,
                 }
         return {"error": f"unknown command {cmd!r}"}
+
+    def _reclaim_locked(self, req: dict) -> dict:
+        """Adopt the fully-parsed parts a (re-)registered worker's frame
+        store still holds — instead of forcing a fleet-wide re-parse —
+        and re-queue the journal-complete parts it no longer announces
+        (its store lost them, e.g. dispatcher AND worker both died).
+        Parts owned by ANOTHER live worker are never stolen; parts
+        granted this generation and still mid-parse are left alone (the
+        announce lists complete parts only)."""
+        worker = str(req["worker"])
+        info = self._workers.get(worker)
+        if info is None or not info.alive:
+            return {"error": f"reclaim from unregistered worker "
+                             f"{worker!r} (register first)"}
+        held = {int(p) for p in (req.get("parts") or [])
+                if 0 <= int(p) < self.num_parts}
+        adopted: List[int] = []
+        for part in sorted(held):
+            owner = self._assigned.get(part)
+            if owner == worker:
+                if part not in self._completed:
+                    self._completed.add(part)
+                    self._journal_append({"op": "complete", "part": part,
+                                          "worker": worker})
+                adopted.append(part)
+            elif owner is None and part in self._todo:
+                self._todo.remove(part)
+                self._assigned[part] = worker
+                self._completed.add(part)
+                self._journal_append({"op": "reclaim", "part": part,
+                                      "worker": worker})
+                adopted.append(part)
+            # else: owned by another live worker — exactly-once wins
+        stale = [p for p, w in self._assigned.items()
+                 if w == worker and p in self._completed
+                 and p not in held]
+        self._requeue_locked(stale, worker, "reclaimed without")
+        if adopted:
+            logger.info("dispatcher: worker %s reclaimed parts %s",
+                        worker, adopted)
+        return {"ok": True, "adopted": adopted}
 
     # ---------------- server loop ----------------
 
@@ -213,12 +523,39 @@ class Dispatcher:
                 conn, _ = self._sock.accept()
             except OSError:
                 return  # closed
-            # one thread per connection: requests are tiny, but a
+            try:
+                # accepted sockets do NOT inherit the listener's
+                # SO_REUSEADDR: without it, one lingering half-closed
+                # handler conn blocks a same-address restart's bind
+                conn.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            except OSError:
+                pass
+            # one thread per connection — requests are tiny, but a
             # half-open client blocking the ONLY serve thread for its
             # read timeout would queue every worker heartbeat behind it —
-            # long enough to trip the liveness reaper on a healthy fleet
+            # capped by the handler semaphore: excess connections shed
+            # with a retryable busy reply instead of a new thread
+            if not self._handler_slots.acquire(blocking=False):
+                self._shed(conn)
+                continue
+            with self._conns_lock:
+                self._conns.add(conn)
             threading.Thread(target=self._serve_one, args=(conn,),
                              daemon=True).start()
+
+    def _shed(self, conn) -> None:
+        """Refuse one connection with a retryable busy reply (callers
+        heal through the shared RetryPolicy — see :func:`request`)."""
+        try:
+            conn.settimeout(1.0)
+            conn.sendall(b'{"busy": true}\n')
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _serve_one(self, conn) -> None:
         try:
@@ -231,30 +568,75 @@ class Dispatcher:
                     req = json.loads(line)
                     resp = self._handle(req)
                 except (ValueError, KeyError, TypeError) as exc:
-                    resp = {"error": f"bad request: {exc}"}
+                    resp = {"error": f"bad request: {exc}",
+                            "gen": self.generation}
                 f.write(json.dumps(resp).encode() + b"\n")
                 f.flush()
         except OSError as exc:
             logger.debug("dispatcher: connection error: %s", exc)
         finally:
+            self._handler_slots.release()
+            with self._conns_lock:
+                self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:
                 pass
 
+    def kill(self) -> None:
+        """Crash-simulate the dispatcher (``kill -9``): the listener
+        drops with no goodbye and the in-memory assignment state is
+        abandoned — the fsync'd journal is all a restart recovers from.
+        Mechanically identical to :meth:`close` (the journal is
+        append-only, so there is nothing graceful to skip); kept
+        separate so chaos tests state their intent."""
+        self.close()
+
     def close(self) -> None:
         self._closed = True
+        # shutdown BEFORE close: a thread blocked in accept() holds a
+        # kernel reference to the fd, so close() alone leaves the old
+        # LISTEN socket alive until the syscall returns — and a restart
+        # on the same address then cannot bind. shutdown wakes accept
+        # immediately; the join guarantees the reference is dropped.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
             pass
+        if threading.current_thread() is not self._thread:
+            self._thread.join(timeout=5.0)
+        # force-drop in-flight handler connections, exactly like the
+        # kernel does for a dead process — otherwise a lingering
+        # half-open peer keeps the port and a same-address restart
+        # cannot bind
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
 
 
 def request(address: str, req: dict, timeout: float = 10.0) -> dict:
     """One dispatcher round trip (shared by workers and clients).
     ``address`` is ``host:port``. Transport failures surface as their
-    natural ConnectionError/OSError classes — callers run this under a
-    :class:`~dmlc_tpu.io.resilience.RetryPolicy` where retry is wanted."""
+    natural ConnectionError/OSError classes; a torn or empty reply (the
+    dispatcher died mid-response) and a shed ``busy`` reply are wrapped
+    in retryable ``ConnectionError`` HERE, so every caller — workers,
+    clients, fleet bootstrap — heals through the shared
+    :class:`~dmlc_tpu.io.resilience.RetryPolicy` instead of re-deriving
+    the classification at call sites. The ``dispatch_rpc`` fault-plan op
+    fires on every round trip (docs/resilience.md grammar)."""
+    _faults.maybe_fail("dispatch_rpc", f"{address} {req.get('cmd', '')}")
     host, _, port = address.rpartition(":")
     with socket.create_connection((host, int(port)), timeout=timeout) as s:
         s.settimeout(timeout)
@@ -263,8 +645,20 @@ def request(address: str, req: dict, timeout: float = 10.0) -> dict:
             f.flush()
             line = f.readline()
     if not line:
-        raise ConnectionError(f"dispatcher {address}: empty response")
-    resp = json.loads(line)
+        raise ConnectionError(f"dispatcher {address}: empty reply "
+                              f"(died mid-response)")
+    try:
+        resp = json.loads(line)
+    except ValueError as exc:
+        # a torn reply mid-crash is JSON garbage — the same transient
+        # fault as the connection dropping, classified ONCE here
+        raise ConnectionError(
+            f"dispatcher {address}: torn reply "
+            f"{line[:64]!r}") from exc
+    if resp.get("busy"):
+        raise ConnectionError(
+            f"dispatcher {address}: busy (handler slots exhausted; "
+            f"retry after backoff)")
     if "error" in resp:
         from dmlc_tpu.utils.check import DMLCError
 

@@ -14,6 +14,7 @@ from typing import List, Optional
 
 from dmlc_tpu.service.dispatcher import Dispatcher
 from dmlc_tpu.service.worker import ParseWorker
+from dmlc_tpu.utils.check import check
 
 
 class LocalFleet:
@@ -25,6 +26,12 @@ class LocalFleet:
     worker fetches its rank from it and feeds the pod-telemetry table
     over the ``metrics`` heartbeat (workers then bootstrap in parallel —
     rank assignment is a barrier across the fleet).
+
+    ``journal_path`` arms dispatcher crash recovery and the chaos API:
+    :meth:`kill_dispatcher` crash-simulates the control plane,
+    :meth:`restart_dispatcher` recovers it from the journal **on the
+    same address**, so the live workers and clients ride through
+    (docs/service.md control-plane recovery).
     """
 
     def __init__(self, uri: str, num_parts: int, num_workers: int = 2,
@@ -34,10 +41,13 @@ class LocalFleet:
                  heartbeat_interval: float = 1.0,
                  plan: Optional[dict] = None,
                  snapshot: Optional[dict] = None,
-                 autotune: Optional[bool] = None):
-        self.dispatcher = Dispatcher(uri, num_parts, parser=parser,
-                                     liveness_timeout=liveness_timeout,
-                                     plan=plan, snapshot=snapshot)
+                 autotune: Optional[bool] = None,
+                 journal_path: Optional[str] = None):
+        self._dispatcher_args = dict(
+            uri=uri, num_parts=num_parts, parser=parser,
+            liveness_timeout=liveness_timeout, plan=plan,
+            snapshot=snapshot, journal_path=journal_path)
+        self.dispatcher = Dispatcher(**self._dispatcher_args)
         self.tracker = None
         tracker_addr = None
         if tracker:
@@ -98,6 +108,34 @@ class LocalFleet:
         w = self.workers[index]
         w.kill()
         return w
+
+    def kill_dispatcher(self) -> Dispatcher:
+        """Crash-simulate the dispatcher (``kill -9``): its listener
+        drops with no goodbye and the in-memory assignment state is
+        abandoned; workers poll a dead socket (classified retryable) and
+        clients' locate loops consume stream-failure budget until
+        :meth:`restart_dispatcher` recovers the control plane."""
+        self.dispatcher.kill()
+        return self.dispatcher
+
+    def restart_dispatcher(self) -> Dispatcher:
+        """Restart the dispatcher from its journal on the SAME address:
+        replay restores the exact assignment state (completed parts stay
+        done, in-flight parts re-queue at the front) and the generation
+        bump drives the fleet's re-register + reclaim handshake. The old
+        dispatcher is killed first if still alive. Requires
+        ``journal_path`` — without it the replacement would re-issue
+        every part for a fleet-wide re-parse."""
+        check(self._dispatcher_args.get("journal_path"),
+              "LocalFleet.restart_dispatcher needs journal_path= — "
+              "an unjournaled dispatcher cannot recover its assignment "
+              "state (docs/service.md control-plane recovery)")
+        old = self.dispatcher
+        if not old._closed:
+            old.kill()
+        self.dispatcher = Dispatcher(host=old.host, port=old.port,
+                                     **self._dispatcher_args)
+        return self.dispatcher
 
     def close(self) -> None:
         for w in self.workers:

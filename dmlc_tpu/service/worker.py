@@ -28,6 +28,20 @@ connections drop mid-frame, the dispatcher is NOT told (clients
 is gone, exactly like a dead process. The dispatcher re-issues the dead
 worker's parts and a live worker re-parses them; parsing is
 deterministic, so the re-served frames are byte-identical.
+
+Control-plane failure model (docs/service.md control-plane recovery): a
+dispatcher-unreachable round trip is a classified retryable fault —
+every control RPC runs under the shared
+:class:`~dmlc_tpu.io.resilience.RetryPolicy` (backoff + jitter,
+``control_plane_retries`` counted per re-attempt). Every dispatcher
+response carries a monotonic generation token; a bump means the
+dispatcher restarted, so the worker re-attaches
+(``worker_reregistrations``): it re-registers and **reclaims** — sends
+the new ``reclaim`` command re-announcing the fully-parsed parts still
+in its frame store, which the recovered dispatcher adopts
+(``parts_reclaimed``) instead of re-issuing them for a fleet-wide
+re-parse. Completed parts also ``part_done`` to the dispatcher as they
+finish, journaling the completion so a later restart keeps them done.
 """
 
 from __future__ import annotations
@@ -39,6 +53,7 @@ import socket
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from dmlc_tpu.io import resilience as _resilience
 from dmlc_tpu.service import dispatcher as _dispatch
 from dmlc_tpu.service.frame import (
     annot_key,
@@ -87,7 +102,12 @@ class ParseWorker:
         self.dispatcher = dispatcher
         self.poll_interval = float(poll_interval)
         self.heartbeat_interval = float(heartbeat_interval)
-        cfg = _dispatch.request(dispatcher, {"cmd": "config"})
+        # control RPCs heal through the shared policy (backoff + jitter,
+        # control_plane_retries per re-attempt) — a dispatcher between
+        # kill and restart is retryable, not fatal (docs/service.md)
+        self._policy = _resilience.default_policy()
+        self._gen: Optional[int] = None
+        cfg = self._request({"cmd": "config"}, reattach=False)
         self.uri = cfg["uri"]
         self.num_parts = int(cfg["num_parts"])
         self._parser_cfg = dict(cfg.get("parser") or {})
@@ -146,6 +166,10 @@ class ParseWorker:
                 else f"{self.host}:{self.port}")
             self._cond = threading.Condition()
             self._store: Dict[int, _PartStore] = {}
+            # every part this worker ever parsed, in order — the
+            # no-re-parse evidence chaos tests assert on (a reclaimed
+            # part must appear exactly once across the fleet)
+            self.parts_parsed: List[int] = []
             # artifact-store pins held for parts this worker serves: a
             # block cache published while parsing a part stays pinned for
             # the worker's life, so a fleet-wide byte-budget squeeze can
@@ -156,9 +180,12 @@ class ParseWorker:
             self._dead = False
             self._conns: set = set()
             self._conns_lock = threading.Lock()
-            _dispatch.request(dispatcher, {
-                "cmd": "register", "worker": self.worker_id,
-                "host": self.host, "port": self.port})
+            self._register()
+            # announce the (empty) frame store: a same-id restart (e.g.
+            # rank0 relaunched by the tracker) re-queues any stale parts
+            # the dispatcher still maps to this id, immediately instead
+            # of waiting for clients to trip over them
+            self._reclaim()
         except BaseException:
             # a failed bootstrap must not leak the bound listener or a
             # live heartbeat thread for a worker that never existed
@@ -182,6 +209,72 @@ class ParseWorker:
             t.start()
         logger.info("parse worker %s serving on %s:%d", self.worker_id,
                     self.host, self.port)
+
+    # ---------------- control plane ----------------
+
+    def _request(self, req: dict, reattach: bool = True) -> dict:
+        """One policy-guarded dispatcher round trip: transient faults
+        (connection refused while the dispatcher restarts, torn replies)
+        back off with jitter and retry under the shared policy, counting
+        ``control_plane_retries``. A generation bump in the response
+        triggers the re-attach handshake (register + reclaim) unless
+        ``reattach=False`` (bootstrap, and the handshake's own RPCs)."""
+        resp = self._policy.call(
+            lambda: _dispatch.request(self.dispatcher, req),
+            op="control_plane", what=self.dispatcher,
+            on_retry=lambda: _resilience.record_event(
+                "control_plane_retries"))
+        if self._note_generation(resp) and reattach:
+            self._reattach()
+        return resp
+
+    def _note_generation(self, resp: dict) -> bool:
+        """Track the dispatcher's generation token; True when it
+        advanced past the last one seen (= the dispatcher restarted)."""
+        gen = resp.get("gen")
+        if gen is None:
+            return False
+        gen = int(gen)
+        changed = self._gen is not None and gen > self._gen
+        if self._gen is None or gen > self._gen:
+            self._gen = gen
+        return changed
+
+    def _register(self) -> None:
+        self._request({"cmd": "register", "worker": self.worker_id,
+                       "host": self.host, "port": self.port},
+                      reattach=False)
+
+    def _reclaim(self) -> None:
+        """Re-announce the fully-parsed parts still in the frame store
+        so a restarted dispatcher adopts them instead of re-issuing them
+        for a fleet-wide re-parse (counted as ``parts_reclaimed``). An
+        empty announce is still useful: it re-queues any stale parts the
+        dispatcher maps to this id whose frames this incarnation does
+        not hold."""
+        with self._cond:
+            held = sorted(p for p, s in self._store.items()
+                          if s.complete and s.error is None)
+        resp = self._request({"cmd": "reclaim", "worker": self.worker_id,
+                              "parts": held}, reattach=False)
+        adopted = resp.get("adopted") or []
+        if adopted:
+            _resilience.record_event("parts_reclaimed", len(adopted))
+            logger.info("worker %s: dispatcher adopted reclaimed parts %s",
+                        self.worker_id, adopted)
+
+    def _reattach(self) -> None:
+        """The dispatcher restarted (generation bump) or declared this
+        worker dead: re-register and reclaim the frame store
+        (docs/service.md control-plane recovery)."""
+        _resilience.record_event("worker_reregistrations")
+        logger.info("worker %s: re-attaching to dispatcher %s (gen %s)",
+                    self.worker_id, self.dispatcher, self._gen)
+        try:
+            self._register()
+            self._reclaim()
+        except (OSError, DMLCError, ValueError):
+            pass  # the next poll retries; dispatcher liveness covers us
 
     # ---------------- parse side ----------------
 
@@ -225,20 +318,22 @@ class ParseWorker:
 
     def _split_loop(self) -> None:
         while not self._stop.is_set():
+            gen_before = self._gen
             try:
-                resp = _dispatch.request(
-                    self.dispatcher,
+                resp = self._request(
                     {"cmd": "next_split", "worker": self.worker_id})
             except (OSError, DMLCError, ValueError):
+                # the policy's budget is spent and the dispatcher is
+                # still unreachable: poll-wait and try a fresh budget
                 self._stop.wait(self.poll_interval)
                 continue
-            if resp.get("register"):
-                try:  # dispatcher restarted / declared us dead: rejoin
-                    _dispatch.request(self.dispatcher, {
-                        "cmd": "register", "worker": self.worker_id,
-                        "host": self.host, "port": self.port})
-                except (OSError, DMLCError, ValueError):
-                    pass
+            if resp.get("register") and self._gen == gen_before:
+                # declared dead (zombie) with no restart involved —
+                # rejoin AND reclaim, so the frames this incarnation
+                # still serves are adopted back instead of re-parsing
+                # fleet-wide (a generation bump in the same reply was
+                # already handled inside _request)
+                self._reattach()
                 self._stop.wait(self.poll_interval)
                 continue
             part = resp.get("part")
@@ -251,6 +346,7 @@ class ParseWorker:
         store = _PartStore()
         with self._cond:
             self._store[part] = store
+            self.parts_parsed.append(part)
             self._cond.notify_all()
         parser = None
         try:
@@ -286,6 +382,18 @@ class ParseWorker:
             with self._cond:
                 store.complete = True
                 self._cond.notify_all()
+            if store.error is None and not self._stop.is_set():
+                # journal the completion at the dispatcher: a restarted
+                # control plane then keeps the part DONE instead of
+                # re-queuing it as in-flight. Best-effort — a miss is
+                # healed by the reclaim handshake (the response's
+                # generation stamp triggers re-attach right here when
+                # the dispatcher restarted mid-parse)
+                try:
+                    self._request({"cmd": "part_done", "part": part,
+                                   "worker": self.worker_id})
+                except (OSError, DMLCError, ValueError):
+                    pass
         logger.info("worker %s: part %d parsed (%d blocks)",
                     self.worker_id, part, len(store.frames))
 

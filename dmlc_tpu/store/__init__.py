@@ -2,8 +2,11 @@
 (chunk caches / block caches / device-native snapshots): one directory
 layout + crash-safe manifest, atomic publish with orphan GC, pin/drop
 refcounts, byte budgets with cost-aware eviction. See
-:mod:`dmlc_tpu.store.manager` and docs/store.md."""
+:mod:`dmlc_tpu.store.manager` and docs/store.md. The flock'd append-only
+JSONL substrate (:class:`~dmlc_tpu.store.journal.AppendJournal`) is
+shared with the data-service dispatcher's assignment journal."""
 
+from dmlc_tpu.store.journal import AppendJournal
 from dmlc_tpu.store.manager import (
     COMPACT_BYTES,
     COMPACT_LINES,
@@ -22,6 +25,7 @@ from dmlc_tpu.store.manager import (
 )
 
 __all__ = [
+    "AppendJournal",
     "ArtifactStore", "COMPACT_BYTES", "COMPACT_LINES", "MAGIC_TIERS",
     "MANIFEST_NAME", "STORE_DIRNAME", "TIER_COST", "TIERS",
     "note_missing", "reset_stores", "signature_hash", "store_counters",

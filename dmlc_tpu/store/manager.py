@@ -18,7 +18,11 @@ artifacts is the dominant cost lever. This module is that state manager:
   Appends happen under an ``flock`` so concurrent processes (e.g. two
   service workers) never tear it; a torn final line from a crash is
   skipped at replay. The journal compacts automatically past
-  :data:`COMPACT_LINES` lines.
+  :data:`COMPACT_LINES` lines. The append/flock/torn-tail/compaction
+  mechanics live in the shared :class:`~dmlc_tpu.store.journal.\
+AppendJournal` — the same substrate the data-service dispatcher's
+  assignment journal recovers from (docs/service.md control-plane
+  recovery).
 - **Atomic publish through the store.** Writers stage to a
   process-unique ``<path>.<pid>.<seq>.tmp`` (:meth:`ArtifactStore.\
 stage_path` — two processes publishing the same signature can never
@@ -66,18 +70,13 @@ import json
 import os
 import re
 import threading
-from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 from dmlc_tpu.io import resilience as _resilience
+from dmlc_tpu.store.journal import AppendJournal
 from dmlc_tpu.utils import knobs as _knobs
 from dmlc_tpu.utils import telemetry as _telemetry
 from dmlc_tpu.utils.check import check
-
-try:  # POSIX cross-process lock; on platforms without it the store
-    import fcntl as _fcntl  # degrades to in-process locking only
-except ImportError:  # pragma: no cover - non-POSIX
-    _fcntl = None
 
 # the sidecar directory one ArtifactStore owns inside its root
 STORE_DIRNAME = ".dmlc_store"
@@ -181,8 +180,11 @@ class ArtifactStore:
         self._dir = os.path.join(self.root, STORE_DIRNAME)
         self._manifest = os.path.join(self._dir, MANIFEST_NAME)
         self._lock_path = os.path.join(self._dir, LOCK_NAME)
-        self._mu = threading.RLock()
         os.makedirs(self._dir, exist_ok=True)
+        # the shared append-only JSONL substrate (flock'd appends,
+        # torn-tail skip, atomic rewrite) — store.journal.AppendJournal
+        self._journal = AppendJournal(self._manifest,
+                                      lock_path=self._lock_path)
         with self._locked():
             self._gc_orphans_locked()
             state = self._replay_locked()
@@ -192,44 +194,20 @@ class ArtifactStore:
 
     # ---------------- locking ----------------
 
-    @contextmanager
     def _locked(self):
-        """In-process mutex + cross-process ``flock`` over the sidecar.
-        NEVER nested (a second ``flock`` on a fresh fd of the same file
-        from the same process would deadlock) — public methods take it
-        once and call ``*_locked`` helpers."""
-        with self._mu:
-            f = open(self._lock_path, "a+")
-            try:
-                if _fcntl is not None:
-                    _fcntl.flock(f.fileno(), _fcntl.LOCK_EX)
-                yield
-            finally:
-                try:
-                    if _fcntl is not None:
-                        _fcntl.flock(f.fileno(), _fcntl.LOCK_UN)
-                finally:
-                    f.close()
+        """In-process mutex + cross-process ``flock`` over the sidecar
+        (the journal's lock, reentrant per thread)."""
+        return self._journal.locked()
 
     # ---------------- journal ----------------
 
     def _append_locked(self, event: dict, sync: bool = False) -> None:
-        line = json.dumps(event, sort_keys=True,
-                          separators=(",", ":")) + "\n"
-        with open(self._manifest, "a") as f:
-            f.write(line)
-            if sync:
-                # publish/evict records must survive a crash — a lost
-                # pin/drop line only loses an ephemeral per-pid refcount
-                f.flush()
-                os.fsync(f.fileno())
+        # publish/evict records must survive a crash — a lost pin/drop
+        # line only loses an ephemeral per-pid refcount
+        self._journal.append(event, sync=sync)
 
     def _read_lines_locked(self) -> List[str]:
-        try:
-            with open(self._manifest, "r") as f:
-                return f.read().splitlines()
-        except OSError:
-            return []
+        return self._journal.read_lines()
 
     def _replay_locked(self) -> Dict[str, _Entry]:
         """Reconstruct live state from the journal. Undecodable lines
@@ -300,26 +278,19 @@ class ArtifactStore:
                               nlines: int) -> None:
         if nlines <= COMPACT_LINES:
             return
-        tmp = self._manifest + f".{os.getpid()}.compact"
-        with open(tmp, "w") as f:
+
+        def live_events():
             for e in sorted(entries.values(), key=lambda e: e.seq):
-                f.write(json.dumps(
-                    {"op": "publish", "path": e.name, "tier": e.tier,
-                     "bytes": e.bytes, "sig": e.sig,
-                     "cost": TIER_COST[e.tier]},
-                    sort_keys=True, separators=(",", ":")) + "\n")
+                yield {"op": "publish", "path": e.name, "tier": e.tier,
+                       "bytes": e.bytes, "sig": e.sig,
+                       "cost": TIER_COST[e.tier]}
                 if e.evicted:
-                    f.write(json.dumps({"op": "evict", "path": e.name},
-                                       sort_keys=True,
-                                       separators=(",", ":")) + "\n")
+                    yield {"op": "evict", "path": e.name}
                 for pid, n in e.pins.items():
                     for _ in range(n):
-                        f.write(json.dumps(
-                            {"op": "pin", "path": e.name, "pid": pid},
-                            sort_keys=True, separators=(",", ":")) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._manifest)
+                        yield {"op": "pin", "path": e.name, "pid": pid}
+
+        self._journal.rewrite(live_events())
         # replayed seqs are now compacted-file line numbers; entries keep
         # their relative LRU order, which is all eviction consults
 

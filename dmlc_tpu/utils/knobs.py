@@ -97,6 +97,13 @@ KNOB_TABLE: Dict[str, KnobSpec] = {
             "prefetch", "DMLC_TPU_PREFETCH",
             default=2, lo=1, hi=16,
             doc="device_put transfers issued ahead of consumption"),
+        KnobSpec(
+            "dispatch_workers", "DMLC_TPU_DISPATCH_WORKERS",
+            default=32, lo=1, hi=1024,
+            doc="data-service dispatcher concurrent connection-handler "
+                "cap; excess connections shed with a retryable busy "
+                "reply (docs/service.md control-plane recovery). Not an "
+                "autotuned knob — the controller maps no stage to it"),
     )
 }
 

@@ -184,9 +184,11 @@ def test_dispatcher_fcfs_exactly_once_and_reissue(tmp_path):
     try:
         addr = disp.address
         cfg = svc_dispatcher.request(addr, {"cmd": "config"})
+        # every response carries the monotonic generation token (1 for a
+        # journal-less dispatcher's whole life — no restart can recover)
         assert cfg == {"uri": "dummy.libsvm", "num_parts": 4,
                        "parser": {"format": "libsvm"}, "plan": {},
-                       "snapshot": {}}
+                       "snapshot": {}, "gen": 1}
         # unregistered workers get no splits
         resp = svc_dispatcher.request(addr, {"cmd": "next_split",
                                              "worker": "ghost"})
@@ -502,14 +504,16 @@ def test_service_tracker_fleet_pod_metrics(corpus):
 
 
 def test_lint_gates_cover_service_dir():
-    """make lint-metrics / lint-retry scan dmlc_tpu/service: the new
-    subsystem keeps its bookkeeping on the telemetry layer and its
-    backoff on the shared RetryPolicy."""
+    """make lint-metrics / lint-retry / lint-store scan dmlc_tpu/service:
+    the subsystem keeps its bookkeeping on the telemetry layer, its
+    backoff on the shared RetryPolicy, and its dispatcher journal on the
+    store's AppendJournal substrate (a hand-rolled .tmp publish or
+    ad-hoc counter beside the journal fails the gates)."""
     import importlib.util
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     svc = os.path.join(root, "dmlc_tpu", "service")
-    for tool in ("lint_metrics", "lint_retry"):
+    for tool in ("lint_metrics", "lint_retry", "lint_store"):
         spec = importlib.util.spec_from_file_location(
             tool, os.path.join(root, "bin", f"{tool}.py"))
         mod = importlib.util.module_from_spec(spec)

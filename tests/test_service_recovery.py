@@ -1,0 +1,587 @@
+"""Tier-1 chaos suite for the crash-recoverable data-service control
+plane (docs/service.md control-plane recovery): dispatcher journal
+replay (torn-tail skip, compaction, exact assignment state), the
+generation token, the worker reclaim handshake, live-worker re-register
+semantics, busy shedding, the extended fault-plan grammar
+(``dispatch_rpc``/``worker_rpc``, ``conn``/``torn``), and the
+process-level acceptance runs — dispatcher ``kill -9`` + restart
+mid-epoch with a live 2-worker fleet stays byte-identical with exact
+resilience counters, dispatcher+worker concurrent death heals, and a
+torn-reply storm is deterministic. A ``slow``-marked soak loops
+kill/restart cycles over a multi-epoch run."""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.io import faults, resilience
+from dmlc_tpu.service import LocalFleet, ServiceParser
+from dmlc_tpu.service import dispatcher as svc_dispatcher
+from dmlc_tpu.store.journal import AppendJournal
+from dmlc_tpu.utils.check import DMLCError
+
+from tests.test_service import (  # noqa: F401  (corpus fixture)
+    NUM_PARTS,
+    PARSER_CFG,
+    _assert_blocks_equal,
+    _drain,
+    _local_blocks,
+    _write_corpus,
+    corpus,
+)
+
+# fast control-plane cadence for chaos tests: tight polls, liveness long
+# enough that a healthy worker is never reaped by accident
+FLEET_KW = dict(num_workers=2, parser=PARSER_CFG, poll_interval=0.02,
+                heartbeat_interval=0.1, liveness_timeout=5.0)
+
+
+def _req(disp, cmd, **kw):
+    return svc_dispatcher.request(disp.address, dict({"cmd": cmd}, **kw))
+
+
+def _wait_for(predicate, timeout=8.0, interval=0.02, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _wait_all_parts_done(address, num_parts, timeout=10.0):
+    def done():
+        status = svc_dispatcher.request(address, {"cmd": "status"})
+        return len(status["completed"]) == num_parts
+    _wait_for(done, timeout=timeout, what=f"{num_parts} parts completed")
+
+
+# ---------------------------------------------------------------------------
+# AppendJournal (the shared substrate)
+
+def test_append_journal_roundtrip_and_torn_tail(tmp_path):
+    j = AppendJournal(str(tmp_path / "j.jsonl"))
+    j.append({"op": "a", "n": 1})
+    j.append({"op": "b", "n": 2}, sync=True)
+    with open(j.path, "a") as f:
+        f.write('{"op": "c", "n":')  # torn tail of a crashed append
+    assert j.read_events() == [{"op": "a", "n": 1}, {"op": "b", "n": 2}]
+    # rewrite is atomic and replaces the whole file, torn tail included
+    j.rewrite([{"op": "d"}])
+    assert j.read_events() == [{"op": "d"}]
+    assert len(j.read_lines()) == 1
+
+
+def test_append_journal_locked_is_reentrant(tmp_path):
+    j = AppendJournal(str(tmp_path / "j.jsonl"))
+    with j.locked():
+        with j.locked():  # a second flock on a fresh fd would deadlock
+            j.append({"op": "nested"})
+    assert j.read_events() == [{"op": "nested"}]
+
+
+# ---------------------------------------------------------------------------
+# dispatcher journal + replay
+
+def test_dispatcher_journal_fresh_boot_and_generation(tmp_path):
+    jp = str(tmp_path / "disp.jsonl")
+    disp = svc_dispatcher.Dispatcher("d.libsvm", 3, journal_path=jp,
+                                     liveness_timeout=0)
+    try:
+        assert disp.generation == 1
+        assert _req(disp, "status")["gen"] == 1
+        events = AppendJournal(jp).read_events()
+        assert {"op": "dataset", "uri": "d.libsvm",
+                "num_parts": 3} in events
+        assert {"op": "start", "gen": 1} in events
+    finally:
+        disp.close()
+    # a restart replays the journal and bumps the generation token
+    disp2 = svc_dispatcher.Dispatcher("d.libsvm", 3, journal_path=jp,
+                                      liveness_timeout=0)
+    try:
+        assert disp2.generation == 2
+        assert _req(disp2, "config")["gen"] == 2
+    finally:
+        disp2.close()
+
+
+def test_dispatcher_journal_replay_exact_assignment_state(tmp_path):
+    """Completed parts stay done with their owner; in-flight parts
+    re-queue at the FRONT (lowest first); replayed workers keep serving
+    without re-registering first."""
+    jp = str(tmp_path / "disp.jsonl")
+    disp = svc_dispatcher.Dispatcher("d", 4, journal_path=jp,
+                                     liveness_timeout=0)
+    _req(disp, "register", worker="a", host="127.0.0.1", port=111)
+    _req(disp, "register", worker="b", host="127.0.0.1", port=222)
+    assert _req(disp, "next_split", worker="a")["part"] == 0
+    assert _req(disp, "next_split", worker="b")["part"] == 1
+    assert _req(disp, "next_split", worker="a")["part"] == 2
+    _req(disp, "part_done", worker="a", part=0)
+    _req(disp, "part_done", worker="b", part=1)
+    disp.kill()  # kill -9: in-memory state is gone, journal survives
+
+    disp2 = svc_dispatcher.Dispatcher("d", 4, journal_path=jp,
+                                      liveness_timeout=0)
+    try:
+        status = _req(disp2, "status")
+        assert status["generation"] == 2
+        assert status["completed"] == [0, 1]
+        assert status["assigned"] == {"0": "a", "1": "b"}
+        # part 2 was in-flight at the crash: re-queued AT THE FRONT
+        assert status["todo"] == [2, 3]
+        # completed parts locate to their replayed owner immediately
+        loc = _req(disp2, "locate", part=0)
+        assert (loc["worker"], loc["port"]) == ("a", 111)
+        # replayed workers must RE-ATTACH before new grants: their frame
+        # store is unknown until the register+reclaim handshake, and a
+        # grant riding the generation-bump reply would race the reclaim
+        # into a duplicate parse
+        resp = _req(disp2, "next_split", worker="b")
+        assert resp["part"] is None and resp.get("register")
+        _req(disp2, "register", worker="b", host="127.0.0.1", port=222)
+        assert _req(disp2, "next_split", worker="b")["part"] == 2
+    finally:
+        disp2.close()
+
+
+def test_dispatcher_journal_torn_tail_skipped(tmp_path):
+    jp = str(tmp_path / "disp.jsonl")
+    disp = svc_dispatcher.Dispatcher("d", 2, journal_path=jp,
+                                     liveness_timeout=0)
+    _req(disp, "register", worker="a", host="h", port=1)
+    assert _req(disp, "next_split", worker="a")["part"] == 0
+    _req(disp, "part_done", worker="a", part=0)
+    disp.kill()
+    with open(jp, "a") as f:
+        f.write('{"op": "grant", "part": 1, "wor')  # crashed mid-append
+    disp2 = svc_dispatcher.Dispatcher("d", 2, journal_path=jp,
+                                      liveness_timeout=0)
+    try:
+        status = _req(disp2, "status")
+        assert status["completed"] == [0]
+        assert status["todo"] == [1]  # the torn grant never happened
+    finally:
+        disp2.close()
+
+
+def test_dispatcher_journal_compaction_preserves_state(tmp_path):
+    jp = str(tmp_path / "disp.jsonl")
+    disp = svc_dispatcher.Dispatcher("d", 3, journal_path=jp,
+                                     liveness_timeout=0)
+    _req(disp, "register", worker="a", host="h", port=1)
+    assert _req(disp, "next_split", worker="a")["part"] == 0
+    _req(disp, "part_done", worker="a", part=0)
+    disp.kill()
+    lines_before = len(AppendJournal(jp).read_lines())
+    disp2 = svc_dispatcher.Dispatcher("d", 3, journal_path=jp,
+                                      liveness_timeout=0,
+                                      journal_compact_lines=1)
+    try:
+        status = _req(disp2, "status")
+        assert status["completed"] == [0]
+        assert status["assigned"] == {"0": "a"}
+        assert status["generation"] == 2
+    finally:
+        disp2.close()
+    # the compacted journal is the canonical live state + the new start
+    lines = AppendJournal(jp).read_lines()
+    assert len(lines) < lines_before + 2
+    ops = [json.loads(raw)["op"] for raw in lines]
+    assert ops.count("dataset") == 1 and "complete" in ops
+    # and a third boot replays the compacted form identically
+    disp3 = svc_dispatcher.Dispatcher("d", 3, journal_path=jp,
+                                      liveness_timeout=0)
+    try:
+        status = _req(disp3, "status")
+        assert status["completed"] == [0]
+        assert status["generation"] == 3
+    finally:
+        disp3.close()
+
+
+def test_dispatcher_journal_num_parts_mismatch_rejected(tmp_path):
+    jp = str(tmp_path / "disp.jsonl")
+    svc_dispatcher.Dispatcher("d", 3, journal_path=jp,
+                              liveness_timeout=0).kill()
+    with pytest.raises(DMLCError):
+        svc_dispatcher.Dispatcher("d", 5, journal_path=jp,
+                                  liveness_timeout=0)
+
+
+# ---------------------------------------------------------------------------
+# reclaim protocol + live-worker re-register (satellite)
+
+def test_reclaim_adopts_requeued_and_confirms_completed(tmp_path):
+    jp = str(tmp_path / "disp.jsonl")
+    disp = svc_dispatcher.Dispatcher("d", 4, journal_path=jp,
+                                     liveness_timeout=0)
+    _req(disp, "register", worker="a", host="h", port=1)
+    assert _req(disp, "next_split", worker="a")["part"] == 0
+    _req(disp, "part_done", worker="a", part=0)
+    assert _req(disp, "next_split", worker="a")["part"] == 1
+    # part 1 completes but the part_done is LOST with the dispatcher
+    disp.kill()
+    disp2 = svc_dispatcher.Dispatcher("d", 4, journal_path=jp,
+                                      liveness_timeout=0)
+    try:
+        assert _req(disp2, "status")["todo"] == [1, 2, 3]  # 1 in-flight
+        _req(disp2, "register", worker="a", host="h", port=1)
+        resp = _req(disp2, "reclaim", worker="a", parts=[0, 1])
+        # 0 was journal-complete (confirmed), 1 was re-queued (adopted)
+        assert resp["adopted"] == [0, 1]
+        status = _req(disp2, "status")
+        assert status["completed"] == [0, 1]
+        assert status["todo"] == [2, 3]
+        assert _req(disp2, "locate", part=1)["worker"] == "a"
+    finally:
+        disp2.close()
+
+
+def test_reclaim_requeues_unannounced_and_never_steals(tmp_path):
+    jp = str(tmp_path / "disp.jsonl")
+    disp = svc_dispatcher.Dispatcher("d", 4, journal_path=jp,
+                                     liveness_timeout=0)
+    _req(disp, "register", worker="a", host="h", port=1)
+    _req(disp, "register", worker="b", host="h", port=2)
+    assert _req(disp, "next_split", worker="a")["part"] == 0
+    assert _req(disp, "next_split", worker="b")["part"] == 1
+    _req(disp, "part_done", worker="a", part=0)
+    _req(disp, "part_done", worker="b", part=1)
+    disp.kill()
+    disp2 = svc_dispatcher.Dispatcher("d", 4, journal_path=jp,
+                                      liveness_timeout=0)
+    try:
+        # a restarted EMPTY worker 'a' (same id, frames gone): announcing
+        # nothing re-queues its journal-complete part at the front
+        _req(disp2, "register", worker="a", host="h", port=7)
+        resp = _req(disp2, "reclaim", worker="a", parts=[])
+        assert resp["adopted"] == []
+        status = _req(disp2, "status")
+        assert status["todo"][0] == 0 and 0 not in status["completed"]
+        # and reclaiming a part OWNED by another live worker never
+        # steals it (exactly-once wins)
+        resp = _req(disp2, "reclaim", worker="a", parts=[1])
+        assert resp["adopted"] == []
+        assert _req(disp2, "locate", part=1)["worker"] == "b"
+    finally:
+        disp2.close()
+
+
+def test_live_worker_reregister_is_crash_restart(tmp_path):
+    """Satellite: re-registration of a worker already alive THIS
+    generation re-queues its parts at the front instead of stranding
+    clients on an empty frame store until the liveness reaper fires."""
+    disp = svc_dispatcher.Dispatcher("d", 4, liveness_timeout=0)
+    try:
+        _req(disp, "register", worker="a", host="h", port=1)
+        assert _req(disp, "next_split", worker="a")["part"] == 0
+        assert _req(disp, "next_split", worker="a")["part"] == 1
+        _req(disp, "part_done", worker="a", part=0)
+        # fast crash-restart: same id re-registers while still "alive"
+        _req(disp, "register", worker="a", host="h", port=9)
+        status = _req(disp, "status")
+        assert status["assigned"] == {}
+        assert status["todo"] == [0, 1, 2, 3]  # re-queued AT THE FRONT
+        assert status["completed"] == []
+        assert _req(disp, "locate", part=0).get("wait")
+        # the fresh incarnation's (empty) reclaim changes nothing more;
+        # a warm incarnation would adopt back what it still holds
+        assert _req(disp, "reclaim", worker="a",
+                    parts=[0])["adopted"] == [0]
+        assert _req(disp, "locate", part=0)["worker"] == "a"
+    finally:
+        disp.close()
+
+
+# ---------------------------------------------------------------------------
+# torn replies, busy shedding, fault-plan grammar
+
+def _one_shot_server(reply: bytes):
+    """A fake dispatcher that answers one connection with ``reply`` and
+    hangs up — the torn/busy reply shapes request() must classify."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+
+    def run():
+        try:
+            conn, _ = srv.accept()
+            conn.recv(4096)
+            if reply:
+                conn.sendall(reply)
+            conn.close()
+        except OSError:
+            pass
+
+    threading.Thread(target=run, daemon=True).start()
+    host, port = srv.getsockname()[:2]
+    return srv, f"{host}:{port}"
+
+
+@pytest.mark.parametrize("reply", [b"", b'{"uri": "d", "num_par',
+                                   b'{"busy": true}\n'])
+def test_request_classifies_torn_empty_busy_replies(reply):
+    """Satellite: torn/empty/busy dispatcher replies are wrapped in a
+    retryable ConnectionError inside request() — every caller heals
+    through the shared policy, no call-site special cases."""
+    srv, addr = _one_shot_server(reply)
+    try:
+        with pytest.raises(ConnectionError) as exc_info:
+            svc_dispatcher.request(addr, {"cmd": "config"}, timeout=5.0)
+        assert resilience.classify(exc_info.value) == resilience.RETRYABLE
+    finally:
+        srv.close()
+
+
+def test_dispatcher_sheds_busy_over_handler_cap(monkeypatch):
+    """Satellite: the connection-handler cap (knob table) sheds excess
+    connections with a retryable busy reply instead of spawning an
+    unbounded thread per connection."""
+    monkeypatch.setenv("DMLC_TPU_DISPATCH_WORKERS", "1")
+    disp = svc_dispatcher.Dispatcher("d", 1, liveness_timeout=0)
+    try:
+        # occupy the single handler slot with a half-open connection
+        # (the handler blocks in readline until its 10s read timeout)
+        hog = socket.create_connection((disp.host, disp.port), timeout=5.0)
+        time.sleep(0.2)  # let the accept loop hand the slot over
+        with pytest.raises(ConnectionError) as exc_info:
+            _req(disp, "status")
+        assert "busy" in str(exc_info.value)
+        assert resilience.classify(exc_info.value) == resilience.RETRYABLE
+        hog.close()
+        _wait_for(lambda: _try_status(disp), timeout=5.0,
+                  what="handler slot released after the hog hung up")
+    finally:
+        disp.close()
+
+
+def _try_status(disp) -> bool:
+    try:
+        return _req(disp, "status")["gen"] == 1
+    except ConnectionError:
+        return False
+
+
+def test_fault_plan_conn_and_torn_error_classes():
+    plan = faults.FaultPlan("dispatch_rpc@1=conn;worker_rpc@1=torn")
+    exc = plan.check("dispatch_rpc", "127.0.0.1:1 locate")
+    assert isinstance(exc, ConnectionRefusedError)
+    assert resilience.classify(exc) == resilience.RETRYABLE
+    exc = plan.check("worker_rpc", "rank0 stream part 2")
+    assert isinstance(exc, ConnectionError)
+    assert resilience.classify(exc) == resilience.RETRYABLE
+    assert plan.fired() == 2
+
+
+def test_fault_plan_dispatch_rpc_heals_through_policy(corpus, tmp_path):
+    """An injected dispatcher-unreachable burst on the client's locate
+    path heals through the shared policy with exact counters and a
+    byte-identical epoch — no restart involved."""
+    local = _local_blocks(corpus)
+    fleet = LocalFleet(corpus, NUM_PARTS, **FLEET_KW)
+    try:
+        base = resilience.counters_snapshot()
+        # ~locate scopes the clause to the client (workers poll
+        # next_split through the same seam and must not eat it)
+        with faults.inject("dispatch_rpc~locate@1..2=conn") as plan:
+            sp = ServiceParser(fleet.address)
+            got = _drain(sp)
+            sp.close()
+        _assert_blocks_equal(got, local)
+        assert plan.fired() == 2
+        delta = resilience.counters_delta(base)
+        assert delta["control_plane_retries"] == 2
+        assert delta["dispatcher_restarts"] == 0
+        assert delta["service_retries"] == 0  # absorbed below the stream
+    finally:
+        fleet.close()
+
+
+def test_fault_plan_worker_rpc_torn_storm(corpus):
+    """worker_rpc=torn breaks client->worker connects deterministically;
+    the stream layer fails over and the epoch stays byte-identical."""
+    local = _local_blocks(corpus)
+    fleet = LocalFleet(corpus, NUM_PARTS, **FLEET_KW)
+    try:
+        base = resilience.counters_snapshot()
+        with faults.inject("worker_rpc~stream@1=torn") as plan:
+            sp = ServiceParser(fleet.address)
+            got = _drain(sp)
+            sp.close()
+        _assert_blocks_equal(got, local)
+        assert plan.fired() == 1
+        assert resilience.counters_delta(base)["service_retries"] == 1
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# process-level chaos: kill -9 the control plane mid-epoch
+
+def test_dispatcher_killed_mid_epoch_byte_identical(corpus, tmp_path):
+    """THE acceptance run: a 2-worker fleet with a journaled dispatcher;
+    the dispatcher is kill -9'd mid-epoch and restarted from the journal
+    on the same address — the client epoch completes byte-identical to a
+    no-fault run with exactly 1 dispatcher_restarts, >= 1
+    parts_reclaimed, and 0 re-parses of reclaimed parts."""
+    local = _local_blocks(corpus, 4)
+    fleet = LocalFleet(corpus, 4, journal_path=str(tmp_path / "j.jsonl"),
+                       **FLEET_KW)
+    try:
+        sp = ServiceParser(fleet.address)
+        base = resilience.counters_snapshot()
+        got = [sp.next_block() for _ in range(5)]  # mid-epoch
+        # every part parsed exactly once so far; kill once assignment
+        # state is maximal (all parts granted+done) — the recovery must
+        # then re-parse NOTHING
+        _wait_all_parts_done(fleet.address, 4)
+        fleet.kill_dispatcher()
+        fleet.restart_dispatcher()
+        assert fleet.dispatcher.generation == 2
+        got.extend(_drain(sp))
+        sp.close()
+        _assert_blocks_equal(got, local)
+        # the workers re-attach (register + reclaim) within a poll
+        _wait_for(lambda: resilience.counters_delta(base)
+                  ["worker_reregistrations"] >= 2,
+                  what="both workers re-attached")
+        _wait_for(lambda: resilience.counters_delta(base)
+                  ["parts_reclaimed"] >= 1, what="parts reclaimed")
+        delta = resilience.counters_delta(base)
+        assert delta["dispatcher_restarts"] == 1
+        assert delta["service_giveups"] == 0
+        # 0 re-parses of reclaimed parts: fleet-wide, every part was
+        # parsed exactly once — recovery adopted frame stores wholesale
+        parsed = sorted(p for w in fleet.workers for p in w.parts_parsed)
+        assert parsed == [0, 1, 2, 3]
+        # and the journal-backed assignment survived byte-exact
+        status = svc_dispatcher.request(fleet.address, {"cmd": "status"})
+        assert status["completed"] == [0, 1, 2, 3]
+    finally:
+        fleet.close()
+
+
+def test_client_rides_through_dispatcher_downtime(corpus, tmp_path):
+    """The client hits the dead window itself (locate against a killed
+    dispatcher), consumes control-plane retries, and resumes
+    byte-identically once the journal restart lands."""
+    local = _local_blocks(corpus)
+    fleet = LocalFleet(corpus, NUM_PARTS,
+                       journal_path=str(tmp_path / "j.jsonl"), **FLEET_KW)
+    try:
+        sp = ServiceParser(
+            fleet.address,
+            retry_policy=resilience.RetryPolicy(
+                max_attempts=8, base_delay=0.02, max_delay=0.1,
+                attempt_timeout=5.0))
+        base = resilience.counters_snapshot()
+        got = [sp.next_block() for _ in range(2)]
+        _wait_all_parts_done(fleet.address, NUM_PARTS)
+        fleet.kill_dispatcher()
+        # drop the live stream so the next pull MUST locate against the
+        # dead dispatcher (otherwise the data plane rides over the whole
+        # window without a single control RPC)
+        sp._drop_stream()
+        restarter = threading.Timer(0.4,
+                                    lambda: fleet.restart_dispatcher())
+        restarter.start()
+        try:
+            got.extend(_drain(sp))
+        finally:
+            restarter.join()
+        sp.close()
+        _assert_blocks_equal(got, local)
+        delta = resilience.counters_delta(base)
+        assert delta["dispatcher_restarts"] == 1
+        assert delta["control_plane_retries"] >= 1
+        assert delta["service_giveups"] == 0
+    finally:
+        fleet.close()
+
+
+def test_dispatcher_and_worker_concurrent_death(corpus, tmp_path):
+    """Dispatcher AND one worker die together; the dispatcher restarts
+    from the journal, the survivor reclaims its share, and the dead
+    worker's parts re-issue (stale liveness) for a byte-identical
+    epoch."""
+    local = _local_blocks(corpus, 4)
+    fleet = LocalFleet(corpus, 4, num_workers=2, parser=PARSER_CFG,
+                       poll_interval=0.02, heartbeat_interval=0.1,
+                       liveness_timeout=0.6,
+                       journal_path=str(tmp_path / "j.jsonl"))
+    try:
+        sp = ServiceParser(fleet.address)
+        base = resilience.counters_snapshot()
+        got = [sp.next_block() for _ in range(3)]
+        _wait_all_parts_done(fleet.address, 4)
+        # kill the owner of the LAST part (its frames cannot already sit
+        # in the client's TCP buffer) plus the dispatcher
+        status = svc_dispatcher.request(fleet.address, {"cmd": "status"})
+        victim = next(i for i, w in enumerate(fleet.workers)
+                      if w.worker_id == status["assigned"]["3"])
+        fleet.kill_dispatcher()
+        fleet.kill_worker(victim)
+        fleet.restart_dispatcher()
+        got.extend(_drain(sp))
+        sp.close()
+        _assert_blocks_equal(got, local)
+        delta = resilience.counters_delta(base)
+        assert delta["dispatcher_restarts"] == 1
+        assert delta["service_giveups"] == 0
+        # the survivor re-parsed the dead worker's share: strictly more
+        # fleet-wide parses than parts, every part covered
+        survivor = fleet.workers[1 - victim]
+        assert set(survivor.parts_parsed) >= {3}
+    finally:
+        fleet.close()
+
+
+def test_restart_dispatcher_requires_journal(corpus):
+    fleet = LocalFleet(corpus, NUM_PARTS, **FLEET_KW)
+    try:
+        with pytest.raises(DMLCError):
+            fleet.restart_dispatcher()
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# soak
+
+@pytest.mark.slow
+def test_kill_restart_soak_multi_epoch(tmp_path):
+    """Loop dispatcher kill/restart cycles across a multi-epoch run:
+    every epoch must stay byte-identical and the restart count exact."""
+    path = _write_corpus(tmp_path / "soak.libsvm", rows=12000)
+    local = _local_blocks(path, 4)
+    fleet = LocalFleet(path, 4, journal_path=str(tmp_path / "j.jsonl"),
+                       **FLEET_KW)
+    try:
+        sp = ServiceParser(fleet.address)
+        base = resilience.counters_snapshot()
+        cycles = 4
+        for cycle in range(cycles):
+            got = [sp.next_block() for _ in range(1 + cycle)]
+            _wait_all_parts_done(fleet.address, 4)
+            fleet.kill_dispatcher()
+            fleet.restart_dispatcher()
+            got.extend(_drain(sp))
+            _assert_blocks_equal(got, local)
+            sp.before_first()  # next epoch re-serves from frame stores
+        sp.close()
+        delta = resilience.counters_delta(base)
+        assert delta["dispatcher_restarts"] == cycles
+        assert delta["service_giveups"] == 0
+        assert fleet.dispatcher.generation == 1 + cycles
+        parsed = sorted(p for w in fleet.workers for p in w.parts_parsed)
+        assert parsed == [0, 1, 2, 3]  # reclaim kept every cycle re-parse-free
+    finally:
+        fleet.close()
