@@ -131,11 +131,15 @@ bench-smoke:
 	        'service_vs_local_speedup missing'; \
 	    cp = [k for k in ('dispatcher_restarts', \
 	        'worker_reregistrations', 'parts_reclaimed', \
-	        'control_plane_retries') if line.get(k) is None]; \
+	        'control_plane_retries', 'worker_drains', 'drain_handoffs', \
+	        'preemption_notices', 'speculative_reissues', \
+	        'speculative_wins', 'worker_joins') if line.get(k) is None]; \
 	    assert not cp, f'control-plane counters missing: {cp}'; \
 	    hot = {k: line[k] for k in ('dispatcher_restarts', \
 	        'worker_reregistrations', 'parts_reclaimed', \
-	        'control_plane_retries') if line[k]}; \
+	        'control_plane_retries', 'worker_drains', 'drain_handoffs', \
+	        'preemption_notices', 'speculative_reissues', \
+	        'speculative_wins', 'worker_joins') if line[k]}; \
 	    assert not hot, f'control-plane events on a clean run: {hot}'; \
 	    assert line.get('autotune_enabled') is True, \
 	        'autotune_enabled missing (autotune leg did not run)'; \

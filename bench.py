@@ -499,10 +499,14 @@ def service_leg(path: str, size_mb: float, workers: int = 2):
     claim at smoke scale (arXiv:2210.14826). Also emits the
     control-plane resilience quartet (``dispatcher_restarts`` /
     ``worker_reregistrations`` / ``parts_reclaimed`` /
-    ``control_plane_retries``, docs/service.md control-plane recovery):
-    all four MUST read zero on a clean run — a nonzero value on healthy
-    infrastructure means the dispatcher restarted or a control RPC
-    retried mid-bench, which taints the throughput numbers."""
+    ``control_plane_retries``, docs/service.md control-plane recovery)
+    AND the elastic-membership sextet (``worker_drains`` /
+    ``drain_handoffs`` / ``preemption_notices`` /
+    ``speculative_reissues`` / ``speculative_wins`` / ``worker_joins``,
+    docs/service.md elastic membership): all ten MUST read zero on a
+    clean run — a nonzero value on healthy infrastructure means the
+    control plane restarted, a worker was preempted/hedged, or the fleet
+    churned mid-bench, any of which taints the throughput numbers."""
     from dmlc_tpu.data import create_parser
     from dmlc_tpu.io import resilience as _resilience
     from dmlc_tpu.service import LocalFleet, ServiceParser
@@ -549,6 +553,12 @@ def service_leg(path: str, size_mb: float, workers: int = 2):
         "worker_reregistrations": res["worker_reregistrations"],
         "parts_reclaimed": res["parts_reclaimed"],
         "control_plane_retries": res["control_plane_retries"],
+        "worker_drains": res["worker_drains"],
+        "drain_handoffs": res["drain_handoffs"],
+        "preemption_notices": res["preemption_notices"],
+        "speculative_reissues": res["speculative_reissues"],
+        "speculative_wins": res["speculative_wins"],
+        "worker_joins": res["worker_joins"],
     }
 
 
@@ -1121,6 +1131,9 @@ def main() -> int:
                           "service_vs_local_speedup",
                           "dispatcher_restarts", "worker_reregistrations",
                           "parts_reclaimed", "control_plane_retries",
+                          "worker_drains", "drain_handoffs",
+                          "preemption_notices", "speculative_reissues",
+                          "speculative_wins", "worker_joins",
                           "autotune_enabled", "autotune_steps",
                           "autotune_adjustments", "autotune_converged",
                           "autotune_gap_stage", "autotune_final_config",

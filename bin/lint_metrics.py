@@ -32,7 +32,9 @@ the span tracer and its invalidation/corruption events go through
   ``telemetry.record_span``) or ``telemetry.span``.
 - ad-hoc TUNABLE env reads (``DMLC_TPU_*_WORKERS``,
   ``DMLC_TPU_PREFETCH``, ``DMLC_TPU_CONVERT_AHEAD``,
-  ``DMLC_TPU_AUTOTUNE*``) — every pipeline tunable must be a row in the
+  ``DMLC_TPU_AUTOTUNE*``, ``DMLC_TPU_STORE*``,
+  ``DMLC_TPU_HEDGE_FACTOR``, ``DMLC_TPU_DRAIN_DEADLINE``) — every
+  pipeline tunable must be a row in the
   autotune knob table (``dmlc_tpu/utils/knobs.py``, read via
   ``knobs.resolve``) so the feedback controller knows its bounds and the
   value is validated loudly; a point-of-use ``os.environ.get`` parse is
@@ -70,7 +72,8 @@ _PATTERNS = (
 _KNOB_PATTERN = (
     re.compile(r"(?:environ(?:\.get)?\s*[\(\[]|\bgetenv\s*\()\s*['\"]"
                r"DMLC_TPU_(?:[A-Z0-9_]*_WORKERS|PREFETCH|CONVERT_AHEAD|"
-               r"AUTOTUNE[A-Z0-9_]*|STORE[A-Z0-9_]*)['\"]"),
+               r"AUTOTUNE[A-Z0-9_]*|STORE[A-Z0-9_]*|HEDGE_FACTOR|"
+               r"DRAIN_DEADLINE)['\"]"),
     "ad-hoc tunable env read — register the knob in "
     "dmlc_tpu/utils/knobs.py (KNOB_TABLE / a validated accessor like "
     "store_budget_bytes) and read it through that module")

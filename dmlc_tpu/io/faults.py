@@ -33,6 +33,12 @@ count). Their natural error classes are ``conn`` (connection refused —
 the peer is down, e.g. a dispatcher between kill and restart) and
 ``torn`` (the peer died mid-reply), both retryable, so chaos plans
 drive dispatcher-restart and torn-reply-storm paths deterministically.
+``preempt`` is the elastic-membership seam: every parse worker checks it
+once per heartbeat with its worker id as the subject, and ANY firing —
+whatever error class the clause names — is consumed as a preemption
+notice (``preemption_notices``) that begins a graceful drain rather than
+surfacing as an exception, so rolling-preemption chaos is one plan away
+(``preempt~rank0@1``).
 ``~substr`` restricts a clause to calls whose subject (URL/path)
 contains the substring; occurrences are counted per clause over its
 matching calls only, so plans are deterministic under interleaving from
@@ -46,6 +52,7 @@ Examples::
     connect@2+=timeout      # every guarded attempt from the 2nd on hangs
     dispatch_rpc@2..4=conn  # dispatcher unreachable for three round trips
     worker_rpc@1=torn       # first client->worker exchange dies mid-reply
+    preempt~rank0@1         # worker rank0 gets a preemption notice: drains
 
 Activate with the :func:`inject` context manager, or process-wide with
 ``DMLC_FAULT_PLAN`` (the env hook — read lazily on the first guarded

@@ -194,6 +194,30 @@ class _Counters:
                   dispatcher round trips (register / locate / next_split
                   / reclaim ...) that failed transiently and were
                   retried under the shared policy
+    ``worker_drains``
+                  graceful worker drains begun (SIGTERM, preemption
+                  notice, or operator drain): the dispatcher stopped
+                  granting, re-issued the worker's unstarted parts, and
+                  the worker served out its frame-store-complete parts
+    ``drain_handoffs``
+                  parts a client finished streaming off a draining
+                  worker gracefully (drain END / moved-hint failover) —
+                  handoffs, not socket-timeout failovers
+    ``preemption_notices``
+                  preemption signals workers observed
+                  (``DMLC_TPU_PREEMPTION_NOTICE`` file/env, or the
+                  ``preempt`` fault-plan op) — each triggers a drain
+    ``speculative_reissues``
+                  straggler parts the dispatcher speculatively re-issued
+                  to a second worker (stuck past
+                  ``DMLC_TPU_HEDGE_FACTOR`` x the fleet median)
+    ``speculative_wins``
+                  of those, races the speculative worker won
+                  (first-complete-wins; the stuck primary's later
+                  completion is deduped)
+    ``worker_joins``
+                  brand-new workers that joined a LIVE fleet mid-epoch
+                  (registered after work had already been granted)
     """
 
     _KEYS = ("attempts", "retries", "resumes", "giveups", "fatal",
@@ -202,7 +226,9 @@ class _Counters:
              "cache_corruptions", "cache_invalidations", "cache_rebuilds",
              "service_retries", "service_failovers", "service_giveups",
              "dispatcher_restarts", "worker_reregistrations",
-             "parts_reclaimed", "control_plane_retries")
+             "parts_reclaimed", "control_plane_retries",
+             "worker_drains", "drain_handoffs", "preemption_notices",
+             "speculative_reissues", "speculative_wins", "worker_joins")
 
     def bump(self, key: str, n: int = 1) -> None:
         record_event(key, n)

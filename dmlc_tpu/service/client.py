@@ -33,6 +33,16 @@ dispatcher by the very next ``locate``, so the epoch resumes
 byte-identically whether the part was reclaimed from a surviving
 worker's frame store or re-parsed.
 
+Elastic membership (docs/service.md): a *draining* worker (preemption
+notice, SIGTERM, operator drain) hands off gracefully instead of timing
+out. The client learns re-assignments from ``moved`` / ``draining``
+hints on ``locate`` (it sends the owner it last used as ``have``), a
+drain-flagged ERROR frame relocates WITHOUT blaming the worker or
+spending retry budget (the part was proactively re-issued), and a
+drain-flagged END confirms the handoff back to the dispatcher
+(``handoff`` RPC) so the drain can complete before its deadline. Each
+graceful move or confirmed handoff counts ``drain_handoffs``.
+
 Checkpoints: ``state_dict()`` is ``(part, block)`` — O(1) to restore
 into a **fresh** client/connection. ``load_state`` additionally accepts
 the parser chain's annotation states (the ``kind='split'``/``'chunks'``
@@ -137,6 +147,15 @@ class ServiceParser(Parser):
         # block from the SAME worker; only a repeat escalates to
         # report_lost (which re-queues the worker's whole share)
         self._soft_retry_owner: Optional[str] = None
+        # elastic-membership state (docs/service.md): the owner we last
+        # located the CURRENT part at (sent as `have` so the dispatcher
+        # can hint `moved` when the part was re-assigned), the owner a
+        # graceful drain notice moved us off (pending handoff), and a
+        # bound on consecutive drain moves (a drain gone wrong must fall
+        # back to the normal fault budget, never spin)
+        self._last_located: Optional[str] = None
+        self._drain_move_from: Optional[str] = None
+        self._drain_moves = 0
         self._stream_failures = 0
         self._bytes = 0
         self._recv_seconds = 0.0
@@ -205,7 +224,13 @@ class ServiceParser(Parser):
         worker must surface, not spin forever."""
         deadline = get_time() + self._policy.attempt_timeout
         while not self._closed.is_set():
-            resp = self._control({"cmd": "locate", "part": self._part})
+            req = {"cmd": "locate", "part": self._part}
+            if self._last_located is not None:
+                # tell the dispatcher which owner we were on: a draining
+                # re-assignment comes back as a `moved` hint, so the
+                # failover happens here — not on a dead socket's timeout
+                req["have"] = self._last_located
+            resp = self._control(req)
             if not resp.get("wait"):
                 return resp
             if get_time() >= deadline:
@@ -219,6 +244,13 @@ class ServiceParser(Parser):
         if self._sock is not None:
             return self._sock
         owner = self._locate_owner()
+        if self._drain_move_from is not None and owner.get("moved"):
+            # the dispatcher's `moved` hint: the drain re-issue landed
+            # and this part left the owner we were on — the handoff
+            # completed before any socket died (docs/service.md)
+            _resilience.record_event("drain_handoffs")
+            self._drain_move_from = None
+        self._last_located = str(owner["worker"])
         self._pending_owner = str(owner["worker"])
         # the worker_rpc fault-plan seam: chaos plans break client->
         # worker data-plane connects deterministically (docs/resilience.md)
@@ -300,6 +332,7 @@ class ServiceParser(Parser):
                 self._delivered += 1
                 self._stream_failures = 0  # progress resets the budget
                 self._soft_retry_owner = None
+                self._drain_moves = 0
                 self._last_annot = meta.get("resume")
                 return block
             if kind == KIND_SNAPSHOT:
@@ -325,6 +358,7 @@ class ServiceParser(Parser):
                 self._delivered += 1
                 self._stream_failures = 0
                 self._soft_retry_owner = None
+                self._drain_moves = 0
                 self._last_annot = resume
                 return block
             if kind == KIND_END:
@@ -338,10 +372,34 @@ class ServiceParser(Parser):
                         f"part {self._part} truncated: END after block "
                         f"{self._pos} of {total}"))
                     continue
+                if meta.get("draining"):
+                    # the part was served out by a DRAINING worker:
+                    # confirm the handoff so the drain can complete
+                    # before its deadline instead of waiting it out
+                    self._confirm_handoff(self._part, self._owner)
                 self._drop_stream()
                 self._part += 1
                 self._pos = 0
+                self._last_located = None
+                self._drain_move_from = None
                 continue
+            if kind == KIND_ERROR and meta.get("draining"):
+                # GRACEFUL drain notice: the worker is leaving and the
+                # dispatcher already re-issued this part. Relocate right
+                # away — no report_lost (the worker still serves its
+                # complete parts), no retry budget, no backoff. Bounded:
+                # repeated drain notices with no progress fall through
+                # to the normal fault path so a drain gone wrong still
+                # consumes budget instead of spinning.
+                self._drain_moves += 1
+                if self._drain_moves <= 3:
+                    mover = self._owner or self._pending_owner
+                    self._drop_stream()
+                    self._drain_move_from = mover
+                    # keep `have` pointing at the drained-off owner so
+                    # the relocate's `moved` hint is meaningful
+                    self._last_located = mover
+                    continue
             # KIND_ERROR (worker reassigned / parse failure): retryable —
             # the dispatcher may have moved the part; ERROR text rides the
             # chained cause for the give-up message
@@ -349,6 +407,20 @@ class ServiceParser(Parser):
                 f"worker error frame: {meta.get('error')}"
                 if kind == KIND_ERROR else f"unknown frame kind {kind}"))
         return None
+
+    def _confirm_handoff(self, part: int, worker: Optional[str]) -> None:
+        """Best-effort drain-handoff confirmation (``drain_handoffs``):
+        tells the dispatcher this client is done streaming ``part`` from
+        the draining ``worker``. A miss only delays the drain until its
+        deadline — never a correctness problem."""
+        if worker is None:
+            return
+        _resilience.record_event("drain_handoffs")
+        try:
+            self._control({"cmd": "handoff", "part": int(part),
+                           "worker": worker})
+        except (OSError, DMLCError, ValueError):
+            pass  # deadline backstop covers it
 
     def before_first(self) -> None:
         self._drop_stream()
@@ -359,6 +431,9 @@ class ServiceParser(Parser):
         self._failover_from = None
         self._soft_retry_owner = None
         self._last_annot = None
+        self._last_located = None
+        self._drain_move_from = None
+        self._drain_moves = 0
 
     # ---------------- checkpoint / resume ----------------
 
@@ -415,12 +490,12 @@ class ServiceParser(Parser):
                                  what=f"part {part}")
 
     def _locate_with_part(self, part: int) -> dict:
-        prev = self._part
-        self._part = part
+        prev, prev_located = self._part, self._last_located
+        self._part, self._last_located = part, None
         try:
             return self._locate_owner()
         finally:
-            self._part = prev
+            self._part, self._last_located = prev, prev_located
 
     def _part_counts_until(self, stop_part: int) -> int:
         """Total blocks in parts [0, stop_part) — the global-delivery
@@ -434,6 +509,9 @@ class ServiceParser(Parser):
         self._failover_from = None
         self._soft_retry_owner = None
         self._last_annot = None
+        self._last_located = None
+        self._drain_move_from = None
+        self._drain_moves = 0
         kind = state.get("kind")
         if self.snapshot and kind != "service":
             # per-part batch counts differ from block counts and packed

@@ -20,7 +20,22 @@ rabit tracker uses for ``heartbeat``/``metrics``). Commands:
                                    generation is treated as a crash-
                                    restart: its parts re-queue at the
                                    front until a ``reclaim`` adopts them
-                                   back)
+                                   back). A brand-new worker id arriving
+                                   after work has started is a **live
+                                   join** (journaled ``join`` event,
+                                   ``worker_joins`` counter): it enters
+                                   the grant rotation immediately
+``drain worker [deadline]``     -> begin a graceful drain: no new grants,
+                                   unstarted parts re-issue at the front
+                                   immediately, frame-store-complete
+                                   parts keep serving until clients
+                                   confirm ``handoff`` or the drain
+                                   deadline expires (docs/service.md
+                                   elastic membership)
+``handoff worker part``         -> a client confirms it finished
+                                   streaming ``part`` from the draining
+                                   ``worker``; when every served part is
+                                   confirmed the drain completes early
 ``next_split worker``           -> ``{"part": k}`` | ``{"part": null}``
                                    (nothing to do) — doubles as liveness
 ``heartbeat worker``            -> liveness only
@@ -57,6 +72,34 @@ epoch state by design: epochs live with clients and worker frame stores
 (``before_first`` re-serves without dispatcher involvement), so the
 assignment journal is epoch-invariant.
 
+**Worker lifecycle** (docs/service.md elastic membership): every worker
+walks JOINING -> ACTIVE -> DRAINING -> DEAD. ``JOINING`` is a
+journal-restored worker awaiting its re-attach handshake (it keeps
+serving completed parts but gets no grants); ``register`` makes it
+``ACTIVE`` (grant rotation); a ``drain`` request makes it ``DRAINING``
+(no new grants, unstarted parts proactively re-issued, completed parts
+keep serving until ``handoff``-confirmed or the drain deadline — clients
+learn re-assignments from ``moved``/``draining`` hints on ``locate``, so
+failover happens before the socket dies); ``DEAD`` is terminal (stale
+heartbeats, ``report_lost``, or a completed drain). Transitions journal,
+so membership state survives dispatcher restarts.
+
+**Straggler hedging**: the dispatcher tracks per-part grant->complete
+latency; once at least :data:`HEDGE_MIN_SAMPLES` parts have completed,
+an in-flight part stuck past ``DMLC_TPU_HEDGE_FACTOR`` times the fleet
+median (and past :data:`HEDGE_MIN_AGE_S`) is **speculatively re-issued**
+to a second active worker (journaled ``spec_grant``,
+``speculative_reissues``). First ``part_done`` wins — a win by the
+speculative worker counts ``speculative_wins`` and flips ``locate`` to
+the winner; the loser's completion is deduped (exactly-once preserved:
+parsing is deterministic, so either stream is byte-identical).
+
+A background **reaper tick thread** (interval derived from
+``liveness_timeout``) drives liveness, drain deadlines, and the hedging
+check on wall-clock time, so a quiet fleet — no poll or heartbeat
+traffic at all — still reaps dead workers, expires drains, and hedges
+stragglers.
+
 The dispatcher is deliberately dataset-state-free about *blocks*: block
 ordering, resume, and exactly-once delivery live with the client (global
 order is part-major), so the dispatcher never becomes a data-plane
@@ -72,11 +115,13 @@ from __future__ import annotations
 import json
 import logging
 import socket
+import statistics
 import threading
 from collections import deque
 from typing import Deque, Dict, List, Optional, Set
 
 from dmlc_tpu.io import faults as _faults
+from dmlc_tpu.io import resilience as _resilience
 from dmlc_tpu.store import journal as _journal_mod
 from dmlc_tpu.store.journal import AppendJournal
 from dmlc_tpu.utils import knobs as _knobs
@@ -92,22 +137,55 @@ logger = logging.getLogger("dmlc_tpu.service")
 # triggers after many restart cycles.
 JOURNAL_COMPACT_LINES = 4096
 
+# worker lifecycle states (docs/service.md elastic membership)
+JOINING = "joining"      # journal-restored, awaiting register+reclaim
+ACTIVE = "active"        # in the grant rotation
+DRAINING = "draining"    # no new grants; serving until handoff/deadline
+DEAD = "dead"            # terminal
+
+# straggler hedging guards: never hedge before this many completion
+# latency samples exist (a 2-part dataset can never produce a meaningful
+# median), and never hedge a part younger than this wall-clock floor —
+# hedging targets seconds-scale stalls, and the floor must sit well
+# above any plausible healthy-part latency (a loaded CI host pausing a
+# smoke-scale part for a second must not fire a speculative parse, or
+# the bench-smoke zero gate on `speculative_reissues` turns flaky)
+HEDGE_MIN_SAMPLES = 3
+HEDGE_MIN_AGE_S = 5.0
+# completion-latency window the fleet median is computed over
+HEDGE_LATENCY_WINDOW = 64
+
 
 class _WorkerInfo:
-    __slots__ = ("worker", "host", "port", "last_seen", "alive",
-                 "registered_gen")
+    __slots__ = ("worker", "host", "port", "last_seen", "state",
+                 "registered_gen", "drain_deadline", "handed_off",
+                 "drained")
 
     def __init__(self, worker: str, host: str, port: int, now: float,
-                 registered_gen: Optional[int] = None):
+                 registered_gen: Optional[int] = None,
+                 state: Optional[str] = None):
         self.worker = worker
         self.host = host
         self.port = port
         self.last_seen = now
-        self.alive = True
         # the generation this worker last sent `register` in; None for a
         # worker restored from the journal that has not re-attached yet
         # (its frame-store contents are unknown until it reclaims)
         self.registered_gen = registered_gen
+        # lifecycle: a journal-restored worker is JOINING until its
+        # re-attach handshake lands; a registered one is ACTIVE
+        self.state = state or (ACTIVE if registered_gen is not None
+                               else JOINING)
+        self.drain_deadline: Optional[float] = None
+        self.handed_off: Set[int] = set()
+        # True only for a worker whose DRAIN completed (handoffs
+        # confirmed or deadline expired): its next poll reads `drained`
+        # and exits instead of re-attaching as a zombie
+        self.drained = False
+
+    @property
+    def alive(self) -> bool:
+        return self.state != DEAD
 
 
 class Dispatcher:
@@ -160,6 +238,31 @@ class Dispatcher:
         self._todo: Deque[int] = deque(range(self.num_parts))
         self._assigned: Dict[int, str] = {}   # part -> worker id
         self._completed: Set[int] = set()     # parts whose parse finished
+        # ---- elastic membership + hedging state ----
+        # True once a client has located a part: a brand-new worker id
+        # registering after that point is a mid-epoch LIVE JOIN
+        # (worker_joins) — capacity added under load. Grant activity
+        # alone does not qualify: fleet bootstrap interleaves sibling
+        # registrations with the first workers' polls, and those are
+        # founding members, not joins.
+        self._clients_active = False
+        # per-part grant timestamps (in-flight ages) and the fleet's
+        # recent grant->complete latencies (the hedging median)
+        self._grant_times: Dict[int, float] = {}
+        self._latencies: Deque[float] = deque(maxlen=HEDGE_LATENCY_WINDOW)
+        # part -> second (speculative) owner; the primary stays in
+        # _assigned until one of them completes (first part_done wins).
+        # _spec_times stamps the speculative grant so a win's latency
+        # sample measures the HEDGE parse — sampling from the stuck
+        # primary's grant would append > threshold by construction and
+        # progressively desensitize the median
+        self._spec: Dict[int, str] = {}
+        self._spec_times: Dict[int, float] = {}
+        # parts flagged for speculative re-issue, awaiting a poll from a
+        # worker that is not the stuck primary
+        self._hedge_todo: Deque[int] = deque()
+        self._hedge_factor = _knobs.resolve("hedge_factor")
+        self._drain_deadline_s = float(_knobs.resolve("drain_deadline"))
         self.generation = 1
         self._journal: Optional[AppendJournal] = None
         if journal_path:
@@ -187,6 +290,23 @@ class Dispatcher:
         self._thread = threading.Thread(target=self._serve, daemon=True,
                                         name="service-dispatcher")
         self._thread.start()
+        # background reaper tick: liveness used to be checked only inside
+        # RPC handling, so a QUIET fleet (no poll/heartbeat traffic at
+        # all) never reaped a dead worker. The tick makes liveness, drain
+        # deadlines, and the straggler-hedging check wall-clock-driven;
+        # interval derives from liveness_timeout (several checks per
+        # window) with a floor so drain/hedge stay responsive even when
+        # liveness detection is disabled (liveness_timeout <= 0).
+        if self.liveness_timeout > 0:
+            tick = min(max(self.liveness_timeout / 4.0, 0.05), 2.0)
+        else:
+            tick = 0.25
+        self._tick_interval = tick
+        self._tick_stop = threading.Event()
+        self._tick_thread = threading.Thread(
+            target=self._tick_loop, daemon=True,
+            name="service-dispatcher-tick")
+        self._tick_thread.start()
         logger.info("dispatcher for %s (%d parts) on %s:%d gen %d",
                     uri, num_parts, self.host, self.port, self.generation)
 
@@ -218,6 +338,7 @@ class Dispatcher:
             in_todo = set(todo)
             assigned, completed = self._assigned, self._completed
             workers: Dict[str, tuple] = {}
+            draining: Set[str] = set()
             for ev in events:
                 op = ev.get("op")
                 if op == "dataset":
@@ -234,17 +355,39 @@ class Dispatcher:
                 elif op == "register":
                     workers[str(ev.get("worker"))] = (
                         str(ev.get("host", "")), int(ev.get("port", 0)))
+                    draining.discard(str(ev.get("worker")))
                 elif op == "dead":
                     workers.pop(str(ev.get("worker")), None)
+                    draining.discard(str(ev.get("worker")))
+                elif op == "drain":
+                    # a drain in flight at the crash: the worker stays out
+                    # of the grant rotation after replay (its completed
+                    # parts keep serving; the drain deadline re-arms)
+                    if str(ev.get("worker")) in workers:
+                        draining.add(str(ev.get("worker")))
+                elif op == "join":
+                    pass  # membership rides `register`; join is the record
                 elif op == "grant":
                     part = int(ev.get("part", -1))
                     if part in in_todo:
                         in_todo.discard(part)
                         todo.remove(part)
                     assigned[part] = str(ev.get("worker"))
+                elif op == "spec_grant":
+                    # the speculative twin of a grant: the part is already
+                    # out of todo; whoever journals `complete` first owns
+                    # it (the dedupe below), so replay needs no side state
+                    pass
                 elif op == "complete":
                     part = int(ev.get("part", -1))
-                    if part in assigned:
+                    if 0 <= part < self.num_parts:
+                        if part in in_todo:
+                            in_todo.discard(part)
+                            todo.remove(part)
+                        # the completing worker wins the part — for a
+                        # hedged part this is the first-complete owner,
+                        # which may be the speculative worker
+                        assigned[part] = str(ev.get("worker"))
                         completed.add(part)
                 elif op == "reissue":
                     part = int(ev.get("part", -1))
@@ -279,13 +422,20 @@ class Dispatcher:
                     in_todo.add(part)
                     todo.appendleft(part)
             now = get_time()
-            # replayed workers start a fresh liveness window: a worker
-            # that survived the dispatcher re-attaches within it (its
-            # next poll sees the generation bump), one that died with
-            # the dispatcher goes stale and its parts re-issue normally
-            self._workers = {
-                w: _WorkerInfo(w, h, p, now) for w, (h, p) in
-                workers.items()}
+            # replayed workers start a fresh liveness window in the
+            # JOINING state: a worker that survived the dispatcher
+            # re-attaches within it (its next poll sees the generation
+            # bump), one that died with the dispatcher goes stale and
+            # its parts re-issue normally. A worker that was DRAINING at
+            # the crash replays as draining — still out of the grant
+            # rotation, still serving, deadline re-armed fresh.
+            self._workers = {}
+            for w, (h, p) in workers.items():
+                info = _WorkerInfo(w, h, p, now)
+                if w in draining:
+                    info.state = DRAINING
+                    info.drain_deadline = now + self._drain_deadline_s
+                self._workers[w] = info
             self.generation = last_gen + 1
             if len(lines) > compact_lines:
                 self._journal.rewrite(self._live_events())
@@ -318,6 +468,11 @@ class Dispatcher:
             if info.alive:
                 events.append({"op": "register", "worker": info.worker,
                                "host": info.host, "port": info.port})
+        for info in self._workers.values():
+            # a drain in progress must survive compaction, or a restart
+            # would put the draining worker back in the grant rotation
+            if info.state == DRAINING:
+                events.append({"op": "drain", "worker": info.worker})
         for part in sorted(self._completed):
             worker = self._assigned.get(part)
             if worker is None:
@@ -337,6 +492,12 @@ class Dispatcher:
         for part in parts:
             self._assigned.pop(part, None)
             self._completed.discard(part)
+            self._drop_spec_locked(part)
+            self._grant_times.pop(part, None)
+            try:
+                self._hedge_todo.remove(part)
+            except ValueError:
+                pass
         for part in reversed(parts):
             self._todo.appendleft(part)
             self._journal_append({"op": "reissue", "part": part,
@@ -345,15 +506,61 @@ class Dispatcher:
             logger.warning("dispatcher: worker %s %s; re-issuing parts %s",
                            worker, why, parts)
 
+    def _drop_spec_locked(self, part: int) -> Optional[str]:
+        """Forget a part's speculative grant (and its grant stamp);
+        returns the speculative worker, if any."""
+        self._spec_times.pop(part, None)
+        return self._spec.pop(part, None)
+
+    def _drop_worker_specs_locked(self, worker: str) -> None:
+        """Forget every speculative grant ``worker`` holds — its
+        speculative parses die with it (death, drain, departure)."""
+        for part in [p for p, w in self._spec.items() if w == worker]:
+            self._drop_spec_locked(part)
+
+    def _inherit_or_requeue_locked(self, worker: str, parts,
+                                   why: str) -> List[int]:
+        """``worker`` is giving up ``parts``: promote each hedged part's
+        speculative twin to primary (the hedge already has a live parse
+        going — re-queuing would waste it) and re-queue the rest at the
+        front. Returns the re-queued parts."""
+        requeue = []
+        for part in parts:
+            spec_stamp = self._spec_times.get(part)
+            spec = self._drop_spec_locked(part)
+            if spec is not None and part not in self._completed:
+                # the hedge worker inherits the part outright; its clock
+                # restarts at ITS spec grant — keeping the stuck
+                # primary's stamp would re-flag the part for hedging at
+                # the very next tick and poison the latency median
+                self._assigned[part] = spec
+                self._grant_times[part] = (spec_stamp if spec_stamp
+                                           is not None else get_time())
+                self._journal_append({"op": "grant", "part": part,
+                                      "worker": spec})
+                logger.info("dispatcher: part %d inherited by hedge "
+                            "worker %s (%s %s)", part, spec, worker, why)
+            else:
+                requeue.append(part)
+        self._requeue_locked(requeue, worker, why)
+        return requeue
+
+    def _release_worker_parts_locked(self, worker: str, why: str) -> None:
+        """A worker left (death or completed drain): drop speculative
+        grants it held itself, then inherit-or-requeue everything it
+        owned (completed parts re-queue too — its frame store is gone)."""
+        self._drop_worker_specs_locked(worker)
+        parts = sorted(p for p, o in self._assigned.items()
+                       if o == worker)
+        self._inherit_or_requeue_locked(worker, parts, why)
+
     def _mark_dead_locked(self, worker: str) -> None:
         info = self._workers.get(worker)
         if info is None or not info.alive:
             return
-        info.alive = False
+        info.state = DEAD
         self._journal_append({"op": "dead", "worker": worker})
-        self._requeue_locked(
-            [p for p, w in self._assigned.items() if w == worker],
-            worker, "lost")
+        self._release_worker_parts_locked(worker, "lost")
 
     def _reap_stale_locked(self, now: float) -> None:
         if self.liveness_timeout <= 0:
@@ -364,6 +571,106 @@ class Dispatcher:
                                "(last seen %.1fs ago)", info.worker,
                                now - info.last_seen)
                 self._mark_dead_locked(info.worker)
+
+    # ---------------- drain + hedging (lock held) ----------------
+
+    def _finish_drain_locked(self, info: _WorkerInfo, why: str) -> None:
+        """Complete a drain: the worker leaves the fleet for good — its
+        next poll reads ``drained`` and exits instead of re-attaching.
+        Handoff-confirmed completed parts stay ASSIGNED to the departed
+        worker and re-queue lazily at the next ``locate``: every client
+        that confirmed already streamed them, so an eager re-issue here
+        would make the always-polling fleet re-parse frames nobody asked
+        for. Everything else (unconfirmed completed parts included —
+        their frames die with the worker) releases through the normal
+        death path (re-queue / hedge inheritance) right now."""
+        if info.state != DRAINING:
+            return
+        info.drained = True
+        logger.info("dispatcher: drain of worker %s complete (%s)",
+                    info.worker, why)
+        info.state = DEAD
+        self._journal_append({"op": "dead", "worker": info.worker})
+        keep = {p for p in info.handed_off
+                if self._assigned.get(p) == info.worker
+                and p in self._completed}
+        self._drop_worker_specs_locked(info.worker)
+        self._inherit_or_requeue_locked(
+            info.worker,
+            sorted(p for p, o in self._assigned.items()
+                   if o == info.worker and p not in keep),
+            why)
+
+    def _maybe_finish_drain_locked(self, info: _WorkerInfo) -> None:
+        """Complete the drain as soon as every still-assigned
+        frame-store-complete part is handoff-confirmed — vacuously so
+        for a worker with nothing to serve out (preempted before any
+        part completed), which must exit within its notice window, not
+        idle out the full deadline."""
+        if info.state != DRAINING:
+            return
+        serving = {p for p, w in self._assigned.items()
+                   if w == info.worker and p in self._completed}
+        if serving <= info.handed_off:
+            self._finish_drain_locked(
+                info, "all served parts handed off"
+                if serving else "nothing left to serve")
+
+    def _expire_drains_locked(self, now: float) -> None:
+        for info in list(self._workers.values()):
+            if info.state != DRAINING:
+                continue
+            # the serving set can shrink without a handoff RPC (e.g. a
+            # report_lost re-queued a part): re-check completion on the
+            # wall-clock tick too, then the deadline backstop
+            self._maybe_finish_drain_locked(info)
+            if (info.state == DRAINING and info.drain_deadline is not None
+                    and now >= info.drain_deadline):
+                self._finish_drain_locked(info, "drain deadline expired")
+
+    def _hedge_check_locked(self, now: float) -> None:
+        """Flag in-flight parts stuck past ``hedge_factor`` times the
+        fleet's median grant->complete latency for speculative re-issue.
+        Guarded by a minimum sample count and an absolute age floor so
+        ordinary jitter on fast parts can never trigger a duplicate
+        parse; the flagged part is granted to the next polling worker
+        that is not the stuck primary."""
+        if len(self._latencies) < HEDGE_MIN_SAMPLES:
+            return
+        threshold = max(self._hedge_factor
+                        * statistics.median(self._latencies),
+                        HEDGE_MIN_AGE_S)
+        for part, granted_at in list(self._grant_times.items()):
+            if (part in self._completed or part in self._spec
+                    or part in self._hedge_todo):
+                continue
+            owner = self._assigned.get(part)
+            info = self._workers.get(owner) if owner is not None else None
+            if info is None or info.state != ACTIVE:
+                continue  # death/drain paths own those parts
+            age = now - granted_at
+            if age <= threshold:
+                continue
+            if not any(w.state == ACTIVE and w.worker != owner
+                       and w.registered_gen == self.generation
+                       for w in self._workers.values()):
+                continue  # nobody to hedge onto
+            self._hedge_todo.append(part)
+            logger.warning(
+                "dispatcher: part %d on worker %s stuck %.2fs "
+                "(> %.2fs = %dx fleet median); flagging for "
+                "speculative re-issue", part, owner, age, threshold,
+                self._hedge_factor)
+
+    def _tick_loop(self) -> None:
+        """The wall-clock driver behind liveness, drain deadlines, and
+        hedging — RPC traffic is no longer required for any of them."""
+        while not self._tick_stop.wait(self._tick_interval):
+            now = get_time()
+            with self._lock:
+                self._reap_stale_locked(now)
+                self._expire_drains_locked(now)
+                self._hedge_check_locked(now)
 
     # ---------------- request handlers ----------------
 
@@ -393,9 +700,7 @@ class Dispatcher:
                     # store is presumed gone — re-queue everything it
                     # owned; the reclaim that follows adopts back what
                     # actually survived (docs/service.md)
-                    self._requeue_locked(
-                        [p for p, w in self._assigned.items()
-                         if w == worker],
+                    self._release_worker_parts_locked(
                         worker, "re-registered (crash-restart)")
                 self._workers[worker] = _WorkerInfo(
                     worker, str(req["host"]), int(req["port"]), now,
@@ -403,6 +708,15 @@ class Dispatcher:
                 self._journal_append({"op": "register", "worker": worker,
                                       "host": str(req["host"]),
                                       "port": int(req["port"])})
+                if prev is None and self._clients_active:
+                    # a brand-new worker id arriving while clients are
+                    # consuming: a mid-epoch LIVE JOIN — it is in the
+                    # grant rotation and the re-issue serving set from
+                    # this very reply
+                    self._journal_append({"op": "join", "worker": worker})
+                    _resilience.record_event("worker_joins")
+                    logger.info("dispatcher: worker %s joined the live "
+                                "fleet", worker)
                 return {"ok": True}
             if cmd == "heartbeat":
                 info = self._workers.get(str(req.get("worker")))
@@ -413,9 +727,18 @@ class Dispatcher:
                 worker = str(req["worker"])
                 info = self._workers.get(worker)
                 if info is None or not info.alive:
+                    if info is not None and info.drained:
+                        # drain complete: tell the worker to exit instead
+                        # of re-attaching as a zombie
+                        return {"part": None, "drained": True}
                     # unregistered/declared-dead workers get no splits —
                     # a zombie must re-register before it can own parts
                     return {"part": None, "register": True}
+                if info.state == DRAINING:
+                    # draining workers get NO new work; the poll doubles
+                    # as liveness while they serve out their parts
+                    info.last_seen = now
+                    return {"part": None, "draining": True}
                 if info.registered_gen != self.generation:
                     # journal-restored worker that has not re-attached
                     # this generation: its frame-store contents are
@@ -426,10 +749,31 @@ class Dispatcher:
                     return {"part": None, "register": True}
                 info.last_seen = now
                 self._reap_stale_locked(now)
+                # speculative re-issues first: a flagged straggler part
+                # goes to the first polling worker that is NOT the stuck
+                # primary (journaled spec_grant; first part_done wins)
+                for _ in range(len(self._hedge_todo)):
+                    part = self._hedge_todo.popleft()
+                    if (part in self._completed or part in self._spec
+                            or part not in self._assigned):
+                        continue  # stale flag
+                    if self._assigned.get(part) == worker:
+                        self._hedge_todo.append(part)
+                        continue
+                    self._spec[part] = worker
+                    self._spec_times[part] = now
+                    self._journal_append({"op": "spec_grant",
+                                          "part": part, "worker": worker})
+                    _resilience.record_event("speculative_reissues")
+                    logger.warning("dispatcher: part %d speculatively "
+                                   "re-issued to worker %s (primary %s)",
+                                   part, worker, self._assigned.get(part))
+                    return {"part": part}
                 if not self._todo:
                     return {"part": None}
                 part = self._todo.popleft()
                 self._assigned[part] = worker
+                self._grant_times[part] = now
                 self._journal_append({"op": "grant", "part": part,
                                       "worker": worker})
                 logger.info("dispatcher: part %d -> worker %s", part, worker)
@@ -437,13 +781,63 @@ class Dispatcher:
             if cmd == "part_done":
                 worker = str(req["worker"])
                 part = int(req["part"])
-                if (self._assigned.get(part) == worker
-                        and part not in self._completed):
+                primary = self._assigned.get(part)
+                spec = self._spec.get(part)
+                if (part not in self._completed
+                        and worker in (primary, spec)):
                     # journaled completion: a restarted dispatcher keeps
-                    # the part done instead of re-queuing it as in-flight
+                    # the part done instead of re-queuing it as in-flight.
+                    # For a hedged part the FIRST completion wins; the
+                    # loser's later part_done is deduped right here.
                     self._completed.add(part)
+                    # the latency sample measures the WINNER's own
+                    # grant->complete time (the spec grant stamp for a
+                    # speculative win) — never the stuck primary's age,
+                    # which exceeds the hedge threshold by construction
+                    # and would desensitize the median
+                    granted_at = self._grant_times.pop(part, None)
+                    if spec is not None and worker == spec:
+                        self._assigned[part] = worker
+                        granted_at = self._spec_times.get(part, granted_at)
+                        _resilience.record_event("speculative_wins")
+                        logger.info("dispatcher: speculative worker %s "
+                                    "won part %d over %s", worker, part,
+                                    primary)
+                    self._drop_spec_locked(part)
                     self._journal_append({"op": "complete", "part": part,
                                           "worker": worker})
+                    if granted_at is not None:
+                        self._latencies.append(max(0.0, now - granted_at))
+                elif part not in self._completed:
+                    # a completion for a part we had RE-QUEUED (its
+                    # grant didn't survive a dispatcher restart, or a
+                    # report_lost blamed a still-live worker): the
+                    # frames exist, so adopt it exactly as `reclaim`
+                    # would instead of letting the queue force a
+                    # duplicate parse (no latency sample — the grant
+                    # stamp died with the re-queue)
+                    info = self._workers.get(worker)
+                    if (info is not None and info.alive
+                            and part in self._todo):
+                        self._todo.remove(part)
+                        self._assigned[part] = worker
+                        self._completed.add(part)
+                        self._journal_append(
+                            {"op": "complete", "part": part,
+                             "worker": worker})
+                        logger.info("dispatcher: adopted completion of "
+                                    "re-queued part %d from worker %s",
+                                    part, worker)
+                return {"ok": True}
+            if cmd == "drain":
+                return self._drain_locked(req, now)
+            if cmd == "handoff":
+                worker = str(req["worker"])
+                part = int(req["part"])
+                info = self._workers.get(worker)
+                if info is not None and info.state == DRAINING:
+                    info.handed_off.add(part)
+                    self._maybe_finish_drain_locked(info)
                 return {"ok": True}
             if cmd == "reclaim":
                 return self._reclaim_locked(req)
@@ -451,28 +845,108 @@ class Dispatcher:
                 part = int(req["part"])
                 if not 0 <= part < self.num_parts:
                     return {"error": f"part {part} out of range"}
+                self._clients_active = True  # a consumer is attached
                 self._reap_stale_locked(now)
                 owner = self._assigned.get(part)
                 info = self._workers.get(owner) if owner is not None else None
                 if info is None or not info.alive:
+                    if owner is not None:
+                        # the part stayed assigned to a departed drained
+                        # worker (handoff-confirmed — see
+                        # _finish_drain_locked) for exactly this moment:
+                        # a client still wants it, so NOW it re-queues
+                        self._requeue_locked(
+                            [part], owner, "located after its drained "
+                            "owner left")
                     return {"wait": True}
-                return {"worker": info.worker, "host": info.host,
+                resp = {"worker": info.worker, "host": info.host,
                         "port": info.port}
+                if info.state == DRAINING:
+                    # the owner is leaving: clients should finish this
+                    # stream promptly and confirm with `handoff`
+                    resp["draining"] = True
+                have = req.get("have")
+                if have is not None and str(have) != info.worker:
+                    # the part moved off the worker the client last
+                    # used: the client takes this hint as confirmation
+                    # that a drain re-issue landed (drain_handoffs) —
+                    # no dead-socket timeout involved (docs/service.md)
+                    resp["moved"] = True
+                return resp
             if cmd == "report_lost":
                 self._mark_dead_locked(str(req["worker"]))
                 return {"ok": True}
             if cmd == "status":
                 return {
                     "workers": {w: {"host": i.host, "port": i.port,
-                                    "alive": i.alive}
+                                    "alive": i.alive, "state": i.state}
                                 for w, i in self._workers.items()},
                     "assigned": {str(p): w
                                  for p, w in self._assigned.items()},
                     "todo": list(self._todo),
                     "completed": sorted(self._completed),
+                    "hedged": {str(p): w for p, w in self._spec.items()},
                     "generation": self.generation,
                 }
         return {"error": f"unknown command {cmd!r}"}
+
+    def _drain_locked(self, req: dict, now: float) -> dict:
+        """Begin (or report) a graceful drain: the worker leaves the
+        grant rotation immediately, its unstarted/in-flight parts
+        proactively re-issue at the front (hedged parts are inherited by
+        their speculative worker), and its frame-store-complete parts
+        keep serving until every one is ``handoff``-confirmed or the
+        drain deadline expires. Idempotent — repeats report state."""
+        worker = str(req["worker"])
+        info = self._workers.get(worker)
+        if info is None or not info.alive:
+            return {"ok": False, "unknown": True}
+        # an EXPLICIT deadline of 0 means "leave now" — only an absent
+        # field falls back to the knob default (0 is falsy, so `or`
+        # would silently re-arm the 30s window the caller opted out of)
+        raw_deadline = req.get("deadline")
+        deadline_s = (float(raw_deadline) if raw_deadline is not None
+                      else self._drain_deadline_s)
+        if info.state == DRAINING:
+            # a repeat drain may TIGHTEN the window (eviction imminent:
+            # drain(deadline=0) means leave now), never loosen it
+            if raw_deadline is not None:
+                new_at = now + deadline_s
+                if (info.drain_deadline is None
+                        or new_at < info.drain_deadline):
+                    info.drain_deadline = new_at
+        else:
+            info.state = DRAINING
+            info.drain_deadline = now + deadline_s
+            info.handed_off = set()
+            self._journal_append({"op": "drain", "worker": worker})
+            _resilience.record_event("worker_drains")
+            # speculative grants the drainer held die with the drain
+            self._drop_worker_specs_locked(worker)
+            # proactive re-issue of everything NOT frame-store-complete
+            # (those keep serving out): failover starts now, not when
+            # the worker's sockets die. A hedged part is inherited by
+            # its speculative worker instead of re-queued.
+            pending = self._inherit_or_requeue_locked(
+                worker,
+                sorted(p for p, w in self._assigned.items()
+                       if w == worker and p not in self._completed),
+                "draining")
+            logger.warning(
+                "dispatcher: draining worker %s (deadline %.1fs, "
+                "%d unstarted parts re-issued, %d complete parts "
+                "serving out)", worker, deadline_s, len(pending),
+                sum(1 for p, w in self._assigned.items()
+                    if w == worker and p in self._completed))
+            # nothing to serve out (preempted before any part
+            # completed)? the drain is already done — exit within the
+            # notice window instead of idling out the deadline
+            self._maybe_finish_drain_locked(info)
+        serving = sorted(p for p, w in self._assigned.items()
+                         if w == worker and p in self._completed)
+        return {"ok": True, "serving": serving,
+                "deadline_s": round(
+                    max(0.0, (info.drain_deadline or now) - now), 3)}
 
     def _reclaim_locked(self, req: dict) -> dict:
         """Adopt the fully-parsed parts a (re-)registered worker's frame
@@ -594,6 +1068,11 @@ class Dispatcher:
 
     def close(self) -> None:
         self._closed = True
+        # stop the background reaper tick first (clean shutdown: the
+        # tick must never fire against a half-closed dispatcher)
+        self._tick_stop.set()
+        if threading.current_thread() is not self._tick_thread:
+            self._tick_thread.join(timeout=5.0)
         # shutdown BEFORE close: a thread blocked in accept() holds a
         # kernel reference to the fd, so close() alone leaves the old
         # LISTEN socket alive until the syscall returns — and a restart

@@ -47,6 +47,9 @@ class LocalFleet:
             uri=uri, num_parts=num_parts, parser=parser,
             liveness_timeout=liveness_timeout, plan=plan,
             snapshot=snapshot, journal_path=journal_path)
+        self._worker_args = dict(poll_interval=poll_interval,
+                                 heartbeat_interval=heartbeat_interval,
+                                 autotune=autotune)
         self.dispatcher = Dispatcher(**self._dispatcher_args)
         self.tracker = None
         tracker_addr = None
@@ -107,6 +110,35 @@ class LocalFleet:
         """Crash-simulate one worker (see :meth:`ParseWorker.kill`)."""
         w = self.workers[index]
         w.kill()
+        return w
+
+    def add_worker(self, **kwargs) -> ParseWorker:
+        """LIVE JOIN (docs/service.md elastic membership): boot one more
+        worker against the running dispatcher mid-epoch — it enters the
+        grant rotation and the re-issue serving set immediately
+        (journaled ``join`` event, ``worker_joins`` counter). Joined
+        workers skip the tracker (rank worlds are fixed at rendezvous;
+        elastic capacity is dispatcher-side membership). ``kwargs``
+        override the fleet's worker knobs (``straggle_seconds``, ...)."""
+        kw = dict(poll_interval=self._worker_args["poll_interval"],
+                  heartbeat_interval=self._worker_args[
+                      "heartbeat_interval"],
+                  autotune=self._worker_args["autotune"])
+        kw.update(kwargs)
+        w = ParseWorker(self.dispatcher.address, **kw)
+        self.workers.append(w)
+        return w
+
+    def drain_worker(self, index: int,
+                     deadline: Optional[float] = None) -> ParseWorker:
+        """Gracefully drain one worker (preemption-notice path, see
+        :meth:`ParseWorker.drain`): it stops taking grants, its
+        unstarted parts re-issue at the front, and it serves out its
+        frame-store-complete parts until clients confirm handoff or the
+        deadline (``DMLC_TPU_DRAIN_DEADLINE``) expires — then exits. The
+        worker stays in :attr:`workers` (close() is idempotent)."""
+        w = self.workers[index]
+        w.drain(reason="fleet drain_worker", deadline=deadline)
         return w
 
     def kill_dispatcher(self) -> Dispatcher:

@@ -177,13 +177,30 @@ def snapshot_from_frame(meta: dict, payload: bytes) -> tuple:
     return (meta["kind"], *out)
 
 
-def encode_end_frame(part: int, blocks: int) -> bytes:
-    """End-of-part marker carrying the part's total block count."""
-    return _pack(KIND_END, {"part": int(part), "blocks": int(blocks)})
+def encode_end_frame(part: int, blocks: int,
+                     draining: bool = False) -> bytes:
+    """End-of-part marker carrying the part's total block count.
+
+    ``draining=True`` marks an END served by a worker mid-drain: the
+    client confirms the handoff to the dispatcher (``drain_handoffs``)
+    so the drain can complete before its deadline (docs/service.md
+    elastic membership). The key is only present when set, so default
+    END frames stay byte-identical to the v1 golden pin.
+    """
+    meta = {"part": int(part), "blocks": int(blocks)}
+    if draining:
+        meta["draining"] = True
+    return _pack(KIND_END, meta)
 
 
-def encode_error_frame(message: str) -> bytes:
-    return _pack(KIND_ERROR, {"error": str(message)})
+def encode_error_frame(message: str, draining: bool = False) -> bytes:
+    """ERROR frame; ``draining=True`` marks a *graceful* drain notice —
+    the part was proactively re-issued and the client should relocate
+    without blaming (no ``report_lost``) or spending retry budget."""
+    meta = {"error": str(message)}
+    if draining:
+        meta["draining"] = True
+    return _pack(KIND_ERROR, meta)
 
 
 def decode_frame(data: bytes) -> Tuple[int, dict, bytes]:

@@ -29,6 +29,27 @@ is gone, exactly like a dead process. The dispatcher re-issues the dead
 worker's parts and a live worker re-parses them; parsing is
 deterministic, so the re-served frames are byte-identical.
 
+Graceful exit model (docs/service.md elastic membership): preemptible
+capacity comes with a NOTICE, and wasting it means re-parsing everything
+the worker held. :meth:`drain` begins a graceful departure — triggered
+by the operator (``LocalFleet.drain_worker``), by SIGTERM
+(``handle_sigterm=True``, main-thread processes), by the
+``DMLC_TPU_PREEMPTION_NOTICE`` file/env signal, or by the ``preempt``
+fault-plan op (chaos harness), the latter two checked every heartbeat
+and counted as ``preemption_notices``. The worker tells the dispatcher
+to drain it (no new grants; unstarted parts proactively re-issue), marks
+any in-progress parse as a *draining* ERROR so clients relocate
+immediately instead of waiting for a dead socket, and keeps SERVING its
+frame-store-complete parts (ENDs carry a ``draining`` flag so clients
+confirm handoffs) until the dispatcher reports the drain complete or the
+drain deadline (``DMLC_TPU_DRAIN_DEADLINE``) expires — then exits
+cleanly.
+
+Chaos knobs: :meth:`kill` (crash), :meth:`drain` (preemption), and
+``straggle_seconds`` — an artificial per-block stall that turns this
+worker into a deterministic straggler so the dispatcher's speculative
+hedging path is testable without racy scheduling tricks.
+
 Control-plane failure model (docs/service.md control-plane recovery): a
 dispatcher-unreachable round trip is a classified retryable fault —
 every control RPC runs under the shared
@@ -53,6 +74,7 @@ import socket
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from dmlc_tpu.io import faults as _faults
 from dmlc_tpu.io import resilience as _resilience
 from dmlc_tpu.service import dispatcher as _dispatch
 from dmlc_tpu.service.frame import (
@@ -63,6 +85,7 @@ from dmlc_tpu.service.frame import (
     send_frame,
 )
 from dmlc_tpu.utils.check import DMLCError
+from dmlc_tpu.utils.timer import get_time
 
 logger = logging.getLogger("dmlc_tpu.service")
 
@@ -98,10 +121,25 @@ class ParseWorker:
                  tracker_world: int = -1,
                  poll_interval: float = 0.2,
                  heartbeat_interval: float = 2.0,
-                 autotune: Optional[bool] = None):
+                 autotune: Optional[bool] = None,
+                 drain_deadline: Optional[float] = None,
+                 handle_sigterm: bool = False,
+                 straggle_seconds: float = 0.0):
         self.dispatcher = dispatcher
         self.poll_interval = float(poll_interval)
         self.heartbeat_interval = float(heartbeat_interval)
+        # graceful-drain state (docs/service.md elastic membership):
+        # `_draining` flips once and never back; the local deadline is a
+        # backstop in case the dispatcher never confirms completion
+        self._draining = threading.Event()
+        self._drain_deadline = drain_deadline
+        self._drain_deadline_at: Optional[float] = None
+        self._sigterm_seen = False
+        self.drained = False
+        # chaos harness: a deterministic straggler — sleep this long
+        # before publishing each parsed block, so hedging tests need no
+        # scheduler tricks (docs/service.md elastic membership)
+        self.straggle_seconds = float(straggle_seconds)
         # control RPCs heal through the shared policy (backoff + jitter,
         # control_plane_retries per re-attempt) — a dispatcher between
         # kill and restart is retryable, not fatal (docs/service.md)
@@ -207,6 +245,8 @@ class ParseWorker:
         ]
         for t in self._threads:
             t.start()
+        if handle_sigterm:
+            self.install_signal_handlers()
         logger.info("parse worker %s serving on %s:%d", self.worker_id,
                     self.host, self.port)
 
@@ -266,7 +306,25 @@ class ParseWorker:
     def _reattach(self) -> None:
         """The dispatcher restarted (generation bump) or declared this
         worker dead: re-register and reclaim the frame store
-        (docs/service.md control-plane recovery)."""
+        (docs/service.md control-plane recovery). A DRAINING worker is
+        leaving, not rejoining — it re-sends the drain instead, so the
+        recovered dispatcher keeps it out of the grant rotation; but if
+        the dispatcher no longer knows it at all (declared dead before
+        the drain landed), the drain RPC is refused ``unknown`` — then
+        it must register + reclaim FIRST, putting its frame-store-
+        complete parts back into the serving set, and re-announce the
+        drain in the same breath, so it re-enters the fleet as DRAINING,
+        never as a grant-eligible ACTIVE."""
+        if self._draining.is_set():
+            resp = self._announce_drain()
+            if resp is not None and resp.get("unknown"):
+                try:
+                    self._register()
+                    self._reclaim()
+                except (OSError, DMLCError, ValueError):
+                    return  # the next poll retries
+                self._announce_drain()
+            return
         _resilience.record_event("worker_reregistrations")
         logger.info("worker %s: re-attaching to dispatcher %s (gen %s)",
                     self.worker_id, self.dispatcher, self._gen)
@@ -275,6 +333,154 @@ class ParseWorker:
             self._reclaim()
         except (OSError, DMLCError, ValueError):
             pass  # the next poll retries; dispatcher liveness covers us
+
+    # ---------------- graceful drain ----------------
+
+    def _drain_seconds(self) -> float:
+        if self._drain_deadline is not None:
+            return float(self._drain_deadline)
+        from dmlc_tpu.utils import knobs as _knobs
+
+        return float(_knobs.resolve("drain_deadline"))
+
+    def drain(self, reason: str = "operator",
+              deadline: Optional[float] = None) -> None:
+        """Begin a graceful departure (docs/service.md elastic
+        membership): tell the dispatcher to stop granting and re-issue
+        this worker's unstarted parts, abandon any in-progress parse
+        (clients get a *draining* ERROR and relocate immediately), and
+        keep serving frame-store-complete parts until the dispatcher
+        confirms the drain or the deadline expires. Idempotent."""
+        if self._stop.is_set():
+            return
+        if self._draining.is_set():
+            # already draining: an explicit deadline may TIGHTEN the
+            # window (eviction imminent — drain(deadline=0) means leave
+            # now), never loosen it
+            if deadline is not None:
+                new_at = get_time() + float(deadline)
+                if (self._drain_deadline_at is None
+                        or new_at < self._drain_deadline_at):
+                    self._drain_deadline_at = new_at
+                    logger.warning(
+                        "worker %s: drain deadline tightened to %.1fs "
+                        "(%s)", self.worker_id, float(deadline), reason)
+                    self._announce_drain()
+            return
+        if deadline is not None:
+            self._drain_deadline = float(deadline)
+        ddl = self._drain_seconds()
+        self._draining.set()
+        self._drain_deadline_at = get_time() + ddl
+        logger.warning("worker %s: draining (%s; deadline %.1fs)",
+                       self.worker_id, reason, ddl)
+        with self._cond:
+            self._cond.notify_all()  # wake streams of the aborted parse
+        self._announce_drain()
+
+    def _announce_drain(self) -> Optional[dict]:
+        """Send (or RE-send) the idempotent ``drain`` RPC; returns the
+        reply, or None when the RPC failed outright. A single
+        announcement is not reliable: the RPC can fail, or land while
+        the dispatcher transiently considers this worker dead
+        (``unknown``) — and a later re-register would heal it back to
+        ACTIVE, silently desyncing membership. The split loop therefore
+        re-announces (via :meth:`_reattach`) whenever a poll reply shows
+        the dispatcher does not have us DRAINING; the local deadline
+        backstop bounds it all."""
+        remaining = max(0.0, (self._drain_deadline_at or get_time())
+                        - get_time())
+        try:
+            resp = self._request({"cmd": "drain", "worker": self.worker_id,
+                                  "deadline": remaining}, reattach=False)
+        except (OSError, DMLCError, ValueError) as exc:
+            logger.warning("worker %s: drain RPC failed (%s); will "
+                           "re-announce from the split loop",
+                           self.worker_id, exc)
+            return None
+        if not resp.get("ok"):
+            logger.warning("worker %s: dispatcher refused drain: %s",
+                           self.worker_id, resp)
+        return resp
+
+    def _check_preemption(self) -> None:
+        """The preemption-notice seam, checked every heartbeat: the
+        ``DMLC_TPU_PREEMPTION_NOTICE`` env names a notice file (value
+        ``1`` means 'notice already served'), and the ``preempt``
+        fault-plan op injects notices deterministically — ANY firing,
+        whatever its error class, is consumed as the notice. Either
+        counts ``preemption_notices`` and begins the drain."""
+        if self._draining.is_set() or self._stop.is_set():
+            return
+        notice = os.environ.get("DMLC_TPU_PREEMPTION_NOTICE", "").strip()
+        noticed = bool(notice) and (notice == "1" or os.path.exists(notice))
+        why = f"preemption notice {notice!r}"
+        if not noticed:
+            try:
+                _faults.maybe_fail("preempt", self.worker_id)
+            except Exception as exc:  # noqa: BLE001 - the raise IS the notice
+                noticed = True
+                why = f"injected preemption notice ({exc})"
+        if noticed:
+            _resilience.record_event("preemption_notices")
+            self.drain(reason=why)
+
+    def install_signal_handlers(self) -> bool:
+        """Route SIGTERM to :meth:`drain` (the k8s/preemptible-VM exit
+        contract). Only the main thread may install handlers; returns
+        False (and stays signal-free) anywhere else."""
+        import signal
+
+        def _on_term(signum, frame):  # noqa: ARG001 - signal contract
+            # the handler runs on the user's MAIN thread mid-eviction:
+            # it must not block on drain()'s policy-retried dispatcher
+            # RPC (an unreachable dispatcher would freeze the training
+            # loop for most of the grace window), so the drain protocol
+            # runs on a background thread. Orchestrators re-send SIGTERM
+            # through the grace period: only the first notice counts
+            # (handlers never run concurrently with themselves, so the
+            # seen-flag needs no lock; drain() is idempotent besides).
+            if (self._sigterm_seen or self._draining.is_set()
+                    or self._stop.is_set()):
+                return
+            self._sigterm_seen = True
+            _resilience.record_event("preemption_notices")
+            threading.Thread(
+                target=self.drain, kwargs={"reason": "SIGTERM"},
+                daemon=True,
+                name=f"service-worker-{self.worker_id}-drain").start()
+
+        try:
+            signal.signal(signal.SIGTERM, _on_term)
+            return True
+        except ValueError:  # not the main thread
+            logger.warning("worker %s: SIGTERM handler needs the main "
+                           "thread; rely on DMLC_TPU_PREEMPTION_NOTICE "
+                           "or drain() instead", self.worker_id)
+            return False
+
+    def _finish_drain(self) -> None:
+        """Drain complete (dispatcher confirmed, or the local deadline
+        backstop fired): serve out any stream still in flight, then
+        leave the fleet cleanly. The dispatcher's completion is keyed on
+        handoff confirmations, but handoffs are per PART and clients are
+        anonymous — ANOTHER client may still be mid-stream on a part a
+        first client already confirmed, and killing its socket here
+        would force exactly the ungraceful timeout failover (plus a
+        re-parse) the drain protocol exists to prevent. Bounded by what
+        remains of the notice window."""
+        if self.drained:
+            return
+        self.drained = True
+        deadline = self._drain_deadline_at
+        while deadline is not None and get_time() < deadline:
+            with self._conns_lock:
+                busy = bool(self._conns)
+            if not busy:
+                break
+            self._stop.wait(0.05)
+        logger.info("worker %s: drain complete; exiting", self.worker_id)
+        self.close()
 
     # ---------------- parse side ----------------
 
@@ -318,6 +524,13 @@ class ParseWorker:
 
     def _split_loop(self) -> None:
         while not self._stop.is_set():
+            if (self._draining.is_set()
+                    and self._drain_deadline_at is not None
+                    and get_time() >= self._drain_deadline_at):
+                # local backstop: the dispatcher never confirmed (or is
+                # gone) — the notice window is up, exit anyway
+                self._finish_drain()
+                return
             gen_before = self._gen
             try:
                 resp = self._request(
@@ -325,6 +538,34 @@ class ParseWorker:
             except (OSError, DMLCError, ValueError):
                 # the policy's budget is spent and the dispatcher is
                 # still unreachable: poll-wait and try a fresh budget
+                self._stop.wait(self.poll_interval)
+                continue
+            if resp.get("drained"):
+                # the dispatcher completed our drain (handoffs confirmed
+                # or deadline expired): exit cleanly
+                self._finish_drain()
+                return
+            if resp.get("draining"):
+                if not self._draining.is_set():
+                    # the drain was initiated AT the dispatcher (operator
+                    # RPC): adopt it locally so the whole protocol runs —
+                    # abandon the in-progress parse with a draining
+                    # ERROR, flag ENDs for handoff confirmation, arm the
+                    # local deadline backstop. drain() re-sends the RPC,
+                    # which is idempotent dispatcher-side.
+                    self.drain(reason="dispatcher-initiated drain")
+                self._stop.wait(self.poll_interval)
+                continue
+            if self._draining.is_set():
+                # reaching here means the reply carried neither
+                # `draining` nor `drained`: the dispatcher does NOT have
+                # us DRAINING (it missed the drain RPC, declared us dead,
+                # or a restart healed us back to ACTIVE). _reattach
+                # re-announces — registering + reclaiming first when
+                # we're unknown — and the drain's proactive re-issue
+                # re-queues any part this very reply may have granted,
+                # which we must not parse.
+                self._reattach()
                 self._stop.wait(self.poll_interval)
                 continue
             if resp.get("register") and self._gen == gen_before:
@@ -354,9 +595,22 @@ class ParseWorker:
             while True:
                 if self._stop.is_set():
                     return  # killed mid-parse: the part stays incomplete
+                if self._draining.is_set():
+                    # the dispatcher already re-issued this part; end the
+                    # streams gracefully so clients relocate NOW instead
+                    # of waiting out a dead socket (the drain ERROR is
+                    # not blamed and costs clients no retry budget)
+                    store.error = (f"worker {self.worker_id} draining; "
+                                   f"part {part} re-issued")
+                    logger.info("worker %s: abandoning part %d mid-parse "
+                                "(draining)", self.worker_id, part)
+                    return
                 block = parser.next_block()
                 if block is None:
                     break
+                if self.straggle_seconds > 0:
+                    # chaos harness: deterministic straggler (docstring)
+                    self._stop.wait(self.straggle_seconds)
                 annot = getattr(block, "resume_state", None)
                 frame = encode_block_frame(block, annot)
                 with self._cond:
@@ -428,6 +682,9 @@ class ParseWorker:
 
     def _hb_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_interval):
+            # preemption notices beat liveness: an eviction window is
+            # short, so the drain must start on THIS beat
+            self._check_preemption()
             try:
                 _dispatch.request(self.dispatcher, {
                     "cmd": "heartbeat", "worker": self.worker_id})
@@ -509,12 +766,18 @@ class ParseWorker:
                 if i < len(store.frames):
                     frame = store.frames[i]
                 elif store.error is not None:
-                    frame = encode_error_frame(store.error)
+                    # mid-drain this is a GRACEFUL notice (the part was
+                    # re-issued): the client relocates without blaming
+                    frame = encode_error_frame(
+                        store.error, draining=self._draining.is_set())
                     send_frame(conn, frame)
                     return
                 else:
-                    send_frame(conn,
-                               encode_end_frame(part, len(store.frames)))
+                    # a draining END asks the client to confirm the
+                    # handoff with the dispatcher (docs/service.md)
+                    send_frame(conn, encode_end_frame(
+                        part, len(store.frames),
+                        draining=self._draining.is_set()))
                     return
             send_frame(conn, frame)  # the sendall runs outside the lock
             i += 1
@@ -565,7 +828,10 @@ class ParseWorker:
             if self._dead:
                 return
             if store.error is not None:
-                send_frame(conn, encode_error_frame(store.error))
+                # mid-drain this is a GRACEFUL notice (the part was
+                # re-issued): the client relocates without blaming
+                send_frame(conn, encode_error_frame(
+                    store.error, draining=self._draining.is_set()))
                 return
             # single-packer claim: concurrent first requests must not
             # each decode + repack the whole part — one thread packs,
@@ -597,7 +863,10 @@ class ParseWorker:
             if self._dead:
                 return  # crash simulation: drop mid-stream, no goodbye
             send_frame(conn, frames[i])
-        send_frame(conn, encode_end_frame(part, len(frames)))
+        # a draining END asks the client to confirm the handoff with
+        # the dispatcher, same as the CSR path (docs/service.md)
+        send_frame(conn, encode_end_frame(part, len(frames),
+                                          draining=self._draining.is_set()))
 
     def _serve_find(self, conn, part: int, key: str) -> None:
         """Block index whose resume annotation matches ``key`` — the

@@ -689,6 +689,66 @@ def get_host_ip(host_ip: Optional[str] = None) -> str:
     return host_ip
 
 
+def start_rabit_tracker(args) -> None:
+    """Standalone rabit tracker (reference tracker.py:450-470): start the
+    rendezvous server, print the worker env contract between the
+    ``DMLC_TRACKER_ENV_START`` / ``DMLC_TRACKER_ENV_END`` sentinels on
+    stdout — the machine-readable block external launchers scrape for
+    rank/coordinator env — then block until every worker has sent
+    ``shutdown``."""
+    import sys
+
+    envs = {"DMLC_NUM_WORKER": args.num_workers,
+            "DMLC_NUM_SERVER": args.num_servers}
+    lt = float(os.environ.get("DMLC_LIVENESS_TIMEOUT") or 0)
+    rabit = RabitTracker(get_host_ip(args.host_ip), args.num_workers,
+                         liveness_timeout=lt if lt > 0 else None)
+    envs.update(rabit.worker_envs())
+    rabit.start(args.num_workers)
+    sys.stdout.write("DMLC_TRACKER_ENV_START\n")
+    # simply write configuration to stdout (the reference's exact shape:
+    # one KEY=value line per env, values str()'d)
+    for k, v in envs.items():
+        sys.stdout.write(f"{k}={v}\n")
+    sys.stdout.write("DMLC_TRACKER_ENV_END\n")
+    sys.stdout.flush()
+    rabit.join()
+    rabit.close()
+
+
+def main() -> None:
+    """``python -m dmlc_tpu.tracker.tracker --num-workers N ...`` — the
+    standalone tracker CLI (reference tracker.py:473-502): external
+    launchers start it, parse the env block off stdout, export those
+    variables to their workers, and wait for the process to exit when
+    the job's ranks all shut down."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="Start a standalone rabit tracker and print the "
+                    "DMLC_TRACKER_ENV_START/END worker env block.")
+    parser.add_argument("--num-workers", required=True, type=int,
+                        help="number of worker ranks to rendezvous")
+    parser.add_argument("--num-servers", default=0, type=int,
+                        help="number of parameter servers (only 0 is "
+                             "supported standalone, as in the reference)")
+    parser.add_argument("--host-ip", default=None, type=str,
+                        help="tracker bind/advertise IP (default: "
+                             "auto-discover; 'dns' uses the FQDN)")
+    parser.add_argument("--log-level", default="INFO", type=str,
+                        choices=["INFO", "DEBUG"],
+                        help="logging level")
+    args = parser.parse_args()
+    fmt = "%(asctime)s-%(levelname)s:%(name)s:%(message)s"
+    level = logging.DEBUG if args.log_level == "DEBUG" else logging.INFO
+    logging.basicConfig(format=fmt, level=level)
+    if args.num_servers == 0:
+        start_rabit_tracker(args)
+    else:
+        raise RuntimeError(
+            "do not yet support start ps tracker in standalone mode.")
+
+
 def submit(num_workers: int, num_servers: int, fun_submit,
            host_ip: Optional[str] = None, pscmd: Optional[str] = None):
     """Start the right tracker, call the backend launcher, wait
@@ -731,3 +791,7 @@ def submit(num_workers: int, num_servers: int, fun_submit,
         rabit.close()
     else:
         pserver.join()
+
+
+if __name__ == "__main__":
+    main()

@@ -104,6 +104,23 @@ KNOB_TABLE: Dict[str, KnobSpec] = {
                 "cap; excess connections shed with a retryable busy "
                 "reply (docs/service.md control-plane recovery). Not an "
                 "autotuned knob — the controller maps no stage to it"),
+        KnobSpec(
+            "hedge_factor", "DMLC_TPU_HEDGE_FACTOR",
+            default=4, lo=1, hi=64,
+            doc="straggler-hedging threshold: an in-flight part stuck "
+                "past this multiple of the fleet's median "
+                "grant->complete latency is speculatively re-issued to "
+                "a second worker, first-complete-wins (docs/service.md "
+                "elastic membership). Not an autotuned knob — hedging "
+                "policy is the operator's duplicate-work budget"),
+        KnobSpec(
+            "drain_deadline", "DMLC_TPU_DRAIN_DEADLINE",
+            default=30, lo=1, hi=86400,
+            doc="seconds a draining worker keeps serving its "
+                "frame-store-complete parts before the drain force-"
+                "completes and remaining parts re-issue (docs/service.md "
+                "elastic membership). Not an autotuned knob — the "
+                "deadline is the preemption notice window"),
     )
 }
 

@@ -73,6 +73,8 @@ class TestKnobTable:
         ("snapshot_read_workers", "DMLC_TPU_SNAPSHOT_READ_WORKERS"),
         ("prefetch", "DMLC_TPU_PREFETCH"),
         ("convert_ahead", "DMLC_TPU_CONVERT_AHEAD"),
+        ("hedge_factor", "DMLC_TPU_HEDGE_FACTOR"),
+        ("drain_deadline", "DMLC_TPU_DRAIN_DEADLINE"),
     ])
     def test_env_garbage_zero_negative_reject_loudly(self, name, env,
                                                      monkeypatch):

@@ -1,0 +1,559 @@
+"""Tier-1 suite for elastic fleet membership (docs/service.md elastic
+membership): the worker lifecycle state machine (JOINING -> ACTIVE ->
+DRAINING -> DEAD), graceful preemption-aware drain (proactive re-issue,
+``moved``/``draining`` hints, handoff confirmation, deadline semantics),
+live join under load, straggler hedging (speculative re-issue,
+first-complete-wins dedupe), the background reaper tick (liveness with
+zero RPC traffic), the ``preempt`` fault-plan op, and the acceptance
+runs — drain + replace mid-epoch stays byte-identical with exact
+counters and zero re-parses of the drained worker's frame-store-complete
+parts; a fault-injected straggler is hedged with exactly-once preserved.
+A ``slow``-marked rolling-preemption soak preempts and replaces every
+worker once over a multi-epoch run."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from dmlc_tpu.io import faults, resilience
+from dmlc_tpu.service import LocalFleet, ParseWorker, ServiceParser
+from dmlc_tpu.service import dispatcher as svc_dispatcher
+from dmlc_tpu.store.journal import AppendJournal
+
+from tests.test_service import (  # noqa: F401  (corpus fixture)
+    NUM_PARTS,
+    PARSER_CFG,
+    _assert_blocks_equal,
+    _drain,
+    _local_blocks,
+    _write_corpus,
+    corpus,
+)
+from tests.test_service_recovery import (  # noqa: F401
+    FLEET_KW,
+    _req,
+    _wait_all_parts_done,
+    _wait_for,
+)
+
+
+# ---------------------------------------------------------------------------
+# background reaper tick (satellite): liveness without any RPC traffic
+
+def test_background_reaper_requeues_silent_dead_worker():
+    """A dead worker on a QUIET fleet (no poll/heartbeat/client traffic
+    at all) is reaped by the background tick thread and its parts
+    re-queue — internal state is inspected directly, so not a single
+    RPC drives the detection."""
+    disp = svc_dispatcher.Dispatcher("d", 2, liveness_timeout=0.3)
+    try:
+        _req(disp, "register", worker="a", host="h", port=1)
+        assert _req(disp, "next_split", worker="a")["part"] == 0
+        # silence: no RPC of any kind from here on
+
+        def reaped():
+            with disp._lock:
+                return (disp._workers["a"].state == "dead"
+                        and list(disp._todo) == [0, 1])
+        _wait_for(reaped, timeout=5.0,
+                  what="silent dead worker reaped by the tick thread")
+    finally:
+        disp.close()
+
+
+def test_reaper_tick_stops_on_close():
+    disp = svc_dispatcher.Dispatcher("d", 1, liveness_timeout=0.2)
+    tick = disp._tick_thread
+    assert tick.is_alive()
+    disp.close()
+    tick.join(timeout=5.0)
+    assert not tick.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# drain protocol units (dispatcher RPC level)
+
+def test_drain_stops_grants_reissues_unstarted_keeps_complete():
+    disp = svc_dispatcher.Dispatcher("d", 4, liveness_timeout=0)
+    try:
+        base = resilience.counters_snapshot()
+        _req(disp, "register", worker="a", host="h", port=1)
+        _req(disp, "register", worker="b", host="h", port=2)
+        assert _req(disp, "next_split", worker="a")["part"] == 0
+        assert _req(disp, "next_split", worker="a")["part"] == 1
+        _req(disp, "part_done", worker="a", part=0)
+        resp = _req(disp, "drain", worker="a", deadline=30)
+        assert resp["ok"] and resp["serving"] == [0]
+        assert 0 < resp["deadline_s"] <= 30
+        status = _req(disp, "status")
+        assert status["workers"]["a"]["state"] == "draining"
+        assert status["workers"]["a"]["alive"]  # draining still serves
+        # the unstarted part 1 re-issued AT THE FRONT; complete part 0
+        # stays assigned to the drainer
+        assert status["todo"] == [1, 2, 3]
+        assert status["assigned"] == {"0": "a"}
+        # no new grants for the drainer — the poll stays liveness
+        resp = _req(disp, "next_split", worker="a")
+        assert resp["part"] is None and resp.get("draining")
+        # other workers pick up the re-issued part first
+        assert _req(disp, "next_split", worker="b")["part"] == 1
+        # locate of the complete part names the drainer WITH the hint
+        loc = _req(disp, "locate", part=0)
+        assert loc["worker"] == "a" and loc.get("draining")
+        # a client that was on another worker sees the move hint
+        loc = _req(disp, "locate", part=0, have="zzz")
+        assert loc.get("moved") and loc.get("draining")
+        # drain is idempotent: one worker_drains however often asked
+        _req(disp, "drain", worker="a", deadline=30)
+        delta = resilience.counters_delta(base)
+        assert delta["worker_drains"] == 1
+    finally:
+        disp.close()
+
+
+def test_drain_handoff_confirmation_completes_drain_early():
+    disp = svc_dispatcher.Dispatcher("d", 2, liveness_timeout=0)
+    try:
+        _req(disp, "register", worker="a", host="h", port=1)
+        assert _req(disp, "next_split", worker="a")["part"] == 0
+        assert _req(disp, "next_split", worker="a")["part"] == 1
+        _req(disp, "part_done", worker="a", part=0)
+        _req(disp, "part_done", worker="a", part=1)
+        _req(disp, "drain", worker="a", deadline=60)
+        # confirming every served part ends the drain long before the
+        # deadline: the worker's next poll reads `drained` and exits
+        _req(disp, "handoff", worker="a", part=0)
+        status = _req(disp, "status")
+        assert status["workers"]["a"]["state"] == "draining"
+        _req(disp, "handoff", worker="a", part=1)
+        status = _req(disp, "status")
+        assert status["workers"]["a"]["state"] == "dead"
+        resp = _req(disp, "next_split", worker="a")
+        assert resp["part"] is None and resp.get("drained")
+        # handoff-confirmed parts do NOT re-queue eagerly (the clients
+        # that confirmed already streamed them — an eager re-issue
+        # would re-parse frames nobody asked for) ...
+        assert status["todo"] == []
+        assert status["assigned"] == {"0": "a", "1": "a"}
+        # ... they re-queue lazily the moment a client locates one
+        assert _req(disp, "locate", part=0).get("wait")
+        status = _req(disp, "status")
+        assert status["todo"] == [0]
+        assert "0" not in status["assigned"]
+    finally:
+        disp.close()
+
+
+def test_repeat_drain_tightens_deadline_never_loosens():
+    """A second drain request with an explicit deadline TIGHTENS the
+    notice window (eviction imminent: deadline=0 means leave now); a
+    longer deadline never loosens an armed drain."""
+    disp = svc_dispatcher.Dispatcher("d", 2, liveness_timeout=0)
+    try:
+        _req(disp, "register", worker="a", host="h", port=1)
+        assert _req(disp, "next_split", worker="a")["part"] == 0
+        _req(disp, "part_done", worker="a", part=0)
+        r1 = _req(disp, "drain", worker="a", deadline=60)
+        assert r1["deadline_s"] > 30
+        r2 = _req(disp, "drain", worker="a", deadline=120)  # no loosening
+        assert r2["deadline_s"] <= 60
+        r3 = _req(disp, "drain", worker="a", deadline=0)  # leave NOW
+        assert r3["deadline_s"] == 0
+        _wait_for(lambda: _req(disp, "status")["workers"]["a"]["state"]
+                  == "dead", timeout=5.0, what="deadline=0 force-drain")
+        # the unconfirmed completed part released through the death
+        # path, at the FRONT of the never-granted remainder
+        assert _req(disp, "status")["todo"] == [0, 1]
+    finally:
+        disp.close()
+
+
+def test_drain_deadline_expires_via_tick():
+    disp = svc_dispatcher.Dispatcher("d", 2, liveness_timeout=0)
+    try:
+        _req(disp, "register", worker="a", host="h", port=1)
+        assert _req(disp, "next_split", worker="a")["part"] == 0
+        _req(disp, "part_done", worker="a", part=0)
+        _req(disp, "drain", worker="a", deadline=0.3)
+
+        def expired():
+            return _req(disp, "status")["workers"]["a"]["state"] == "dead"
+        _wait_for(expired, timeout=5.0, what="drain deadline expiry")
+        resp = _req(disp, "next_split", worker="a")
+        assert resp.get("drained")
+    finally:
+        disp.close()
+
+
+def test_drain_survives_dispatcher_restart(tmp_path):
+    """A drain in flight is journaled: the replayed worker comes back
+    DRAINING — out of the grant rotation, completed parts still
+    assigned — and compaction preserves it."""
+    jp = str(tmp_path / "disp.jsonl")
+    disp = svc_dispatcher.Dispatcher("d", 3, journal_path=jp,
+                                     liveness_timeout=0)
+    _req(disp, "register", worker="a", host="h", port=1)
+    assert _req(disp, "next_split", worker="a")["part"] == 0
+    _req(disp, "part_done", worker="a", part=0)
+    _req(disp, "drain", worker="a", deadline=60)
+    disp.kill()
+    disp2 = svc_dispatcher.Dispatcher("d", 3, journal_path=jp,
+                                      liveness_timeout=0,
+                                      journal_compact_lines=1)
+    try:
+        status = _req(disp2, "status")
+        assert status["workers"]["a"]["state"] == "draining"
+        assert status["assigned"] == {"0": "a"}
+        resp = _req(disp2, "next_split", worker="a")
+        assert resp["part"] is None and resp.get("draining")
+    finally:
+        disp2.close()
+    # the compacted journal still carries the drain
+    ops = [e["op"] for e in AppendJournal(jp).read_events()]
+    assert "drain" in ops
+    disp3 = svc_dispatcher.Dispatcher("d", 3, journal_path=jp,
+                                      liveness_timeout=0)
+    try:
+        assert _req(disp3, "status")["workers"]["a"]["state"] == "draining"
+    finally:
+        disp3.close()
+
+
+# ---------------------------------------------------------------------------
+# live join units
+
+def test_worker_join_counted_only_with_live_clients():
+    disp = svc_dispatcher.Dispatcher("d", 4, liveness_timeout=0)
+    try:
+        base = resilience.counters_snapshot()
+        # founding members: registrations interleaved with grants but
+        # BEFORE any client locate — not joins
+        _req(disp, "register", worker="a", host="h", port=1)
+        assert _req(disp, "next_split", worker="a")["part"] == 0
+        _req(disp, "register", worker="b", host="h", port=2)
+        assert resilience.counters_delta(base)["worker_joins"] == 0
+        # a client attaches...
+        _req(disp, "locate", part=0)
+        # ...and now a brand-new id is a LIVE JOIN, granted immediately
+        _req(disp, "register", worker="c", host="h", port=3)
+        delta = resilience.counters_delta(base)
+        assert delta["worker_joins"] == 1
+        assert _req(disp, "next_split", worker="c")["part"] == 1
+        # re-registration of a known id is a re-attach, never a join
+        _req(disp, "register", worker="c", host="h", port=3)
+        assert resilience.counters_delta(base)["worker_joins"] == 1
+    finally:
+        disp.close()
+
+
+# ---------------------------------------------------------------------------
+# straggler hedging units
+
+def test_hedging_speculative_reissue_first_complete_wins(monkeypatch):
+    monkeypatch.setenv("DMLC_TPU_HEDGE_FACTOR", "2")
+    # shrink the absolute age floor so the test's ms-scale parts can
+    # trip the hedge without a multi-second wait
+    monkeypatch.setattr(svc_dispatcher, "HEDGE_MIN_AGE_S", 0.2)
+    disp = svc_dispatcher.Dispatcher("d", 5, liveness_timeout=0)
+    try:
+        base = resilience.counters_snapshot()
+        _req(disp, "register", worker="slow", host="h", port=1)
+        _req(disp, "register", worker="fast", host="h", port=2)
+        assert _req(disp, "next_split", worker="slow")["part"] == 0
+        # three quick completions build the latency median
+        for part, worker in ((1, "fast"), (2, "fast"), (3, "fast")):
+            assert _req(disp, "next_split",
+                        worker=worker)["part"] == part
+            _req(disp, "part_done", worker=worker, part=part)
+        # part 0 is now stuck well past factor x median (and the
+        # shrunken absolute floor); the tick flags it and the next poll
+        # from a NON-primary worker gets the speculative grant
+        def hedged():
+            resp = _req(disp, "next_split", worker="fast")
+            return resp["part"] == 0
+        _wait_for(hedged, timeout=8.0, what="speculative re-issue")
+        delta = resilience.counters_delta(base)
+        assert delta["speculative_reissues"] == 1
+        status = _req(disp, "status")
+        assert status["hedged"] == {"0": "fast"}
+        assert status["assigned"]["0"] == "slow"  # primary until a win
+        # first complete wins: the speculative worker lands first
+        _req(disp, "part_done", worker="fast", part=0)
+        delta = resilience.counters_delta(base)
+        assert delta["speculative_wins"] == 1
+        status = _req(disp, "status")
+        assert status["assigned"]["0"] == "fast"
+        assert status["completed"] == [0, 1, 2, 3]
+        assert status["hedged"] == {}
+        # the stuck primary's late completion is deduped: nothing moves
+        _req(disp, "part_done", worker="slow", part=0)
+        status2 = _req(disp, "status")
+        assert status2["assigned"]["0"] == "fast"
+        assert resilience.counters_delta(base)["speculative_wins"] == 1
+    finally:
+        disp.close()
+
+
+def test_hedging_never_fires_without_samples_or_spare_worker(monkeypatch):
+    monkeypatch.setenv("DMLC_TPU_HEDGE_FACTOR", "1")
+    monkeypatch.setattr(svc_dispatcher, "HEDGE_MIN_AGE_S", 0.2)
+    disp = svc_dispatcher.Dispatcher("d", 3, liveness_timeout=0)
+    try:
+        base = resilience.counters_snapshot()
+        _req(disp, "register", worker="only", host="h", port=1)
+        assert _req(disp, "next_split", worker="only")["part"] == 0
+        _req(disp, "part_done", worker="only", part=0)
+        assert _req(disp, "next_split", worker="only")["part"] == 1
+        time.sleep(1.6)  # several ticks, past the absolute age floor
+        # < HEDGE_MIN_SAMPLES latencies AND no second active worker:
+        # no speculative re-issue may ever fire
+        assert resilience.counters_delta(base)["speculative_reissues"] == 0
+        assert _req(disp, "status")["hedged"] == {}
+    finally:
+        disp.close()
+
+
+def test_spec_grant_complete_replay(tmp_path):
+    """Journaled speculative-grant/complete dedupe: replay lands the
+    hedged part on the journaled winner exactly once."""
+    jp = str(tmp_path / "disp.jsonl")
+    j = AppendJournal(jp)
+    j.append({"op": "dataset", "uri": "d", "num_parts": 2})
+    j.append({"op": "start", "gen": 1})
+    j.append({"op": "register", "worker": "slow", "host": "h", "port": 1})
+    j.append({"op": "register", "worker": "fast", "host": "h", "port": 2})
+    j.append({"op": "grant", "part": 0, "worker": "slow"})
+    j.append({"op": "spec_grant", "part": 0, "worker": "fast"})
+    j.append({"op": "complete", "part": 0, "worker": "fast"}, sync=True)
+    disp = svc_dispatcher.Dispatcher("d", 2, journal_path=jp,
+                                     liveness_timeout=0)
+    try:
+        status = _req(disp, "status")
+        assert status["completed"] == [0]
+        assert status["assigned"] == {"0": "fast"}  # the winner serves
+        assert status["todo"] == [1]
+    finally:
+        disp.close()
+
+
+# ---------------------------------------------------------------------------
+# worker-side drain triggers
+
+def test_preemption_notice_file_triggers_drain(corpus, tmp_path,
+                                               monkeypatch):
+    notice = tmp_path / "preempt.notice"
+    monkeypatch.setenv("DMLC_TPU_PREEMPTION_NOTICE", str(notice))
+    base = resilience.counters_snapshot()
+    fleet = LocalFleet(corpus, NUM_PARTS, num_workers=1,
+                       parser=PARSER_CFG, poll_interval=0.02,
+                       heartbeat_interval=0.05, liveness_timeout=5.0)
+    try:
+        sp = ServiceParser(fleet.address)
+        local = _local_blocks(corpus)
+        _assert_blocks_equal(_drain(sp), local)
+        sp.close()
+        assert not fleet.workers[0]._draining.is_set()
+        notice.write_text("")  # the eviction notice arrives
+        # wait on the DISPATCHER-side counter: the local _draining flag
+        # sets before the drain RPC lands, so waiting on it races the
+        # worker_drains bump
+        _wait_for(lambda: resilience.counters_delta(
+            base).get("worker_drains", 0) == 1, timeout=5.0,
+            what="notice-file drain")
+        assert fleet.workers[0]._draining.is_set()
+        delta = resilience.counters_delta(base)
+        assert delta["preemption_notices"] == 1
+        assert delta["worker_drains"] == 1
+    finally:
+        fleet.close()
+
+
+def test_preempt_fault_op_triggers_drain(corpus):
+    """The chaos-grammar path: `preempt@1` is consumed as a preemption
+    notice by exactly one worker's heartbeat — it drains gracefully
+    instead of surfacing an error."""
+    base = resilience.counters_snapshot()
+    with faults.inject("preempt@1") as plan:
+        fleet = LocalFleet(corpus, NUM_PARTS, num_workers=2,
+                           parser=PARSER_CFG, poll_interval=0.02,
+                           heartbeat_interval=0.05, liveness_timeout=5.0)
+        try:
+            _wait_for(lambda: resilience.counters_delta(base)
+                      ["worker_drains"] == 1, timeout=5.0,
+                      what="injected preemption drain")
+            assert plan.fired() == 1
+            delta = resilience.counters_delta(base)
+            assert delta["preemption_notices"] == 1
+            # the OTHER worker still serves the whole epoch
+            sp = ServiceParser(fleet.address)
+            _assert_blocks_equal(_drain(sp), _local_blocks(corpus))
+            sp.close()
+            assert resilience.counters_delta(base)["service_giveups"] == 0
+        finally:
+            fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: drain + live join mid-epoch
+
+def test_drain_and_replace_mid_epoch_byte_identical(corpus):
+    """THE elastic acceptance run: a live 3-worker fleet mid-epoch; one
+    worker is preempted (drain) while a replacement add_worker()s in —
+    the epoch completes byte-identically with exactly 1 worker_drains,
+    >= 1 drain_handoffs, 1 worker_joins, 0 service_giveups, and ZERO
+    re-parses of the drained worker's frame-store-complete parts."""
+    local = _local_blocks(corpus, 6)
+    base = resilience.counters_snapshot()
+    fleet = LocalFleet(corpus, 6, num_workers=3, parser=PARSER_CFG,
+                       poll_interval=0.02, heartbeat_interval=0.1,
+                       liveness_timeout=5.0)
+    try:
+        sp = ServiceParser(fleet.address)
+        got = [sp.next_block() for _ in range(2)]  # mid-epoch
+        # drain once assignment is maximal: every part granted + done,
+        # so the drained worker's whole share is frame-store-complete
+        # and the zero-re-parse invariant is assertable exactly
+        _wait_all_parts_done(fleet.address, 6)
+        status = _req(fleet.dispatcher, "status")
+        # preempt the owner of the LAST part (its frames cannot already
+        # sit in the client's TCP buffer, so the client must stream from
+        # the DRAINING worker and confirm >= 1 handoff)
+        victim_id = status["assigned"]["5"]
+        victim = next(i for i, w in enumerate(fleet.workers)
+                      if w.worker_id == victim_id)
+        victim_parts = sorted(p for p, w in status["assigned"].items()
+                              if w == victim_id)
+        fleet.drain_worker(victim, deadline=30)
+        fleet.add_worker()  # the replacement joins the LIVE fleet
+        got.extend(_drain(sp))
+        sp.close()
+        _assert_blocks_equal(got, local)
+        delta = resilience.counters_delta(base)
+        assert delta["worker_drains"] == 1
+        assert delta["worker_joins"] == 1
+        assert delta["drain_handoffs"] >= 1
+        assert delta["service_giveups"] == 0
+        assert delta["service_retries"] == 0  # handoffs, not faults
+        # zero re-parses of the drained worker's frame-store-complete
+        # parts: fleet-wide, every part parsed exactly once
+        parsed = sorted(p for w in fleet.workers for p in w.parts_parsed)
+        assert parsed == list(range(6))
+        assert sorted(
+            str(p) for p in fleet.workers[victim].parts_parsed) \
+            == victim_parts
+    finally:
+        fleet.close()
+
+
+def test_drain_mid_parse_proactive_reissue(corpus):
+    """Drain while the victim is mid-parse: its in-flight part is
+    proactively re-issued, the draining worker ends that stream with a
+    GRACEFUL notice (no report_lost, no retry budget), and the client
+    resumes on the new owner — counted as a drain handoff."""
+    local = _local_blocks(corpus, 2)
+    base = resilience.counters_snapshot()
+    # one deliberately slow worker so the drain reliably lands mid-parse
+    disp = svc_dispatcher.Dispatcher(corpus, 2, parser=PARSER_CFG,
+                                     liveness_timeout=5.0)
+    slow = ParseWorker(disp.address, poll_interval=0.02,
+                       heartbeat_interval=0.1, straggle_seconds=0.5)
+    fast = None
+    sp = None
+    try:
+        _wait_for(lambda: _req(disp, "status")["assigned"],
+                  what="slow worker claims a part")
+        sp = ServiceParser(disp.address)
+        slow.drain(deadline=30)  # mid-parse of its first part
+        fast = ParseWorker(disp.address, poll_interval=0.02,
+                           heartbeat_interval=0.1)
+        got = _drain(sp)
+        _assert_blocks_equal(got, local)
+        delta = resilience.counters_delta(base)
+        assert delta["worker_drains"] == 1
+        assert delta["service_giveups"] == 0
+        # every block came from the fast worker's re-parse: the drained
+        # worker abandoned mid-parse, nothing was lost
+        assert sorted(fast.parts_parsed) == [0, 1]
+    finally:
+        if sp is not None:
+            sp.close()
+        slow.close()
+        if fast is not None:
+            fast.close()
+        disp.close()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: straggler hedging end to end
+
+def test_straggler_hedged_speculative_win_byte_identical(tmp_path,
+                                                         monkeypatch):
+    """A fault-injected slow worker (straggle_seconds chaos knob) stalls
+    its part; the dispatcher speculatively re-issues it to the fast
+    worker, which wins the race — >= 1 speculative_reissues and
+    speculative_wins with exactly-once, byte-identical delivery."""
+    monkeypatch.setenv("DMLC_TPU_HEDGE_FACTOR", "2")
+    # the injected straggler stalls 1.5s — drop the absolute floor under
+    # that so the hedge fires inside the stall
+    monkeypatch.setattr(svc_dispatcher, "HEDGE_MIN_AGE_S", 0.3)
+    path = _write_corpus(tmp_path / "s.libsvm", rows=1200)
+    local = _local_blocks(path, 4)
+    base = resilience.counters_snapshot()
+    disp = svc_dispatcher.Dispatcher(path, 4, parser=PARSER_CFG,
+                                     liveness_timeout=2.0)
+    slow = ParseWorker(disp.address, poll_interval=0.02,
+                       heartbeat_interval=0.1, straggle_seconds=1.5)
+    fast = ParseWorker(disp.address, poll_interval=0.02,
+                       heartbeat_interval=0.1)
+    sp = None
+    try:
+        sp = ServiceParser(disp.address)
+        got = _drain(sp)
+        _assert_blocks_equal(got, local)
+        delta = resilience.counters_delta(base)
+        assert delta["speculative_reissues"] >= 1
+        assert delta["speculative_wins"] >= 1
+        assert delta["service_giveups"] == 0
+    finally:
+        if sp is not None:
+            sp.close()
+        slow.close()
+        fast.close()
+        disp.close()
+
+
+# ---------------------------------------------------------------------------
+# soak: rolling preemption
+
+@pytest.mark.slow
+def test_rolling_preemption_soak(tmp_path):
+    """Every worker of a 3-worker fleet is preempted (drained) and
+    replaced exactly once across a multi-epoch run: every epoch stays
+    byte-identical and the membership counters are exact."""
+    path = _write_corpus(tmp_path / "soak.libsvm", rows=12000)
+    local = _local_blocks(path, 6)
+    base = resilience.counters_snapshot()
+    fleet = LocalFleet(path, 6, num_workers=3, parser=PARSER_CFG,
+                       poll_interval=0.02, heartbeat_interval=0.1,
+                       liveness_timeout=5.0)
+    try:
+        sp = ServiceParser(fleet.address)
+        for cycle in range(3):
+            got = [sp.next_block() for _ in range(1 + cycle)]
+            _wait_all_parts_done(fleet.address, 6)
+            fleet.drain_worker(cycle, deadline=30)
+            fleet.add_worker()
+            got.extend(_drain(sp))
+            _assert_blocks_equal(got, local)
+            sp.before_first()
+        # final epoch on the fully-replaced fleet
+        _assert_blocks_equal(_drain(sp), local)
+        sp.close()
+        delta = resilience.counters_delta(base)
+        assert delta["worker_drains"] == 3
+        assert delta["worker_joins"] == 3
+        assert delta["service_giveups"] == 0
+    finally:
+        fleet.close()
