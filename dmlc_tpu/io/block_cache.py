@@ -270,6 +270,15 @@ class BlockCacheWriter:
         f = self._f
         pos = _pad_to(f, _ALIGN)
         end, crc, arrays = write_segments(f, segments)
+        self._append_entry(t_span, pos, end, crc, arrays, rows, num_col,
+                           resume)
+
+    def _append_entry(self, t_span, pos, end, crc, arrays, rows, num_col,
+                      resume) -> None:
+        """Shared bookkeeping tail of both append paths (resume JSON
+        normalization, footer entry, totals, cache_write span) — one
+        source of truth so the two write paths cannot drift a footer
+        apart."""
         # resume annotations round-trip through JSON (tuples -> lists,
         # dict order normalized) so cold- and warm-served states compare
         # equal byte for byte
@@ -287,6 +296,29 @@ class BlockCacheWriter:
         # real stage even though stats() folds it into supply wall)
         _telemetry.record_span("cache_write", t_span, get_time() - t_span,
                                rows=int(rows))
+
+    def add_block_encoded(self, encoded, resume: Optional[dict] = None) -> None:
+        """Append one PRE-ENCODED block span — the zero re-encode cold
+        path. ``encoded`` is an
+        :class:`~dmlc_tpu.data.batch_parser.EncodedSegments`: the native
+        batch parser already materialized the exact ``[pos, end)`` bytes
+        this writer would produce (canonical segment order, 64-byte
+        alignment, zero gap bytes) plus the span's zlib-compatible crc32
+        and the footer ``arrays`` schema, so the tee is ONE buffer write
+        and offset translation — no per-array ``tobytes`` copies, no
+        Python-side crc pass. Byte-identical output to
+        :meth:`add_block` on the same block (golden-pinned)."""
+        check(self._f is not None and not self._finished,
+              "BlockCacheWriter: writer already finished/aborted")
+        t_span = get_time()
+        f = self._f
+        pos = _pad_to(f, _ALIGN)
+        f.write(encoded.data)
+        arrays = {name: [dt, pos + int(off), int(nb)]
+                  for name, (dt, off, nb) in encoded.arrays.items()}
+        self._append_entry(t_span, pos, pos + int(encoded.nbytes),
+                           int(encoded.crc), arrays, encoded.rows,
+                           encoded.num_col, resume)
 
     def finish(self) -> None:
         """Write footer + tail, fsync, atomically publish at ``path``."""

@@ -102,19 +102,32 @@ def encode_block_frame(block: RowBlock,
     delivered by local parsing through a cache.
     """
     t0 = get_time()
-    buf = io.BytesIO()
-    _, _, arrays = write_segments(buf, block.to_segments())
+    encoded = getattr(block, "encoded", None)
+    if encoded is not None:
+        # batch-engine block: the native parse already materialized the
+        # exact segment payload (offsets span-relative == payload-
+        # relative) — the frame reuses those bytes with zero re-encode,
+        # the same single materialization the cache tee appends
+        payload = memoryview(encoded.data)
+        arrays = {name: [dt, int(off), int(nb)]
+                  for name, (dt, off, nb) in encoded.arrays.items()}
+        rows, num_col = int(encoded.rows), int(encoded.num_col)
+    else:
+        buf = io.BytesIO()
+        _, _, arrays = write_segments(buf, block.to_segments())
+        payload = buf.getvalue()
+        rows, num_col = len(block), block.num_col
     resume_json = (json.loads(json.dumps(resume))
                    if resume is not None else None)
     meta = {
-        "rows": len(block),
-        "num_col": block.num_col,
+        "rows": rows,
+        "num_col": num_col,
         "resume": resume_json,
         "arrays": arrays,
     }
-    out = _pack(KIND_BLOCK, meta, buf.getvalue())
+    out = _pack(KIND_BLOCK, meta, payload)
     _telemetry.record_span("service_encode", t0, get_time() - t0,
-                           rows=len(block))
+                           rows=rows)
     return out
 
 

@@ -224,6 +224,37 @@ def store_gc_age_seconds(explicit: Optional[int] = None) -> int:
     return _parse_positive_int(raw, "DMLC_TPU_STORE_GC_AGE_SECONDS")
 
 
+PARSE_ENGINES = ("auto", "native-batch", "native", "python")
+
+
+def parse_engine(explicit: Optional[str] = None) -> str:
+    """The text-parse engine selector (docs/data.md engine-selection
+    table): explicit argument (the ``engine=`` knob of ``create_parser``
+    or a ``?engine=`` URI arg) > ``DMLC_TPU_PARSE_ENGINE`` env >
+    ``auto``. Values:
+
+    - ``auto``: today's routing — fully-native stream reader for plain
+      local corpora, the native chunk feeder for remote ones, the Python
+      engine otherwise;
+    - ``native-batch``: the chunk-batch SIMD parser that materializes
+      block-cache segment spans directly (the cold-path engine);
+    - ``native``: the streaming native reader only;
+    - ``python``: the vectorized numpy engine (the historical
+      ``?engine=python`` opt-out).
+
+    Not an autotuned knob — engine choice changes which code parses, so
+    it is pinned by the operator; it lives here so the knob lint gate
+    covers the env read and a typo'd engine fails the run loudly."""
+    raw = (explicit if explicit is not None
+           else os.environ.get("DMLC_TPU_PARSE_ENGINE", "").strip() or "auto")
+    value = str(raw).strip().lower()
+    check(value in PARSE_ENGINES,
+          f"parse engine {raw!r}: must be one of {PARSE_ENGINES} "
+          f"(DMLC_TPU_PARSE_ENGINE / create_parser(engine=...) / "
+          f"?engine= URI arg — docs/data.md engine-selection table)")
+    return value
+
+
 def autotune_enabled(explicit: Optional[bool] = None) -> bool:
     """The master switch: an explicit argument wins; otherwise
     ``DMLC_TPU_AUTOTUNE=1`` arms the controller (any other value — or

@@ -8,7 +8,10 @@
 # Usage: sh native/run_sanitizers.sh
 set -eu
 cd "$(dirname "$0")"
-SRCS="src/parse.cc src/reader.cc src/recordio.cc"
+# keep in sync with Makefile NATIVE_SRCS, CMakeLists.txt, and
+# dmlc_tpu/native/__init__.py _SRCS — a .cc missing here is a silent
+# sanitizer coverage gap
+SRCS="src/parse.cc src/reader.cc src/recordio.cc src/batch_parse.cc"
 LOG=SANITIZE.log
 : > "$LOG"
 

@@ -1,0 +1,890 @@
+// Chunk-at-a-time SIMD batch parser that materializes block-cache v1
+// (DMLCBC01) segment spans directly — the cold-path promotion of ROADMAP
+// item 3 (arXiv:2101.12127 input pipelines must saturate the host;
+// arXiv:2501.10546 cold/first-epoch throughput dominates fleet cost).
+//
+// Where parse.cc's one-shot entry points hand Python separate malloc'd
+// arrays that the block-cache writer then RE-ENCODES per block
+// (ascontiguousarray + tobytes + per-array file writes + a Python-side
+// crc pass), this path parses a whole chunk and writes the arrays
+// STRAIGHT INTO one buffer laid out exactly as a DMLCBC01 block span:
+// canonical segment order (offset, label, weight, qid, field, index,
+// value), every present array start padded to 64-byte alignment, raw
+// little-endian C-order payloads, with a zlib-compatible crc32 computed
+// over the span while it is still cache-hot. Python mmap-views the
+// arrays zero-copy for the RowBlock AND appends the identical bytes to
+// the cache file / service frame with one write — a single
+// materialization serves parse output, warm cache, and wire.
+//
+// Pipeline per chunk:
+//   1. SIMD scan (AVX2 / SSE2 / NEON, runtime-dispatched, portable
+//      scalar fallback) over the whole chunk: EOL positions ('\n' AND
+//      '\r' — CRLF and CR-only corpora index cleanly, a CRLF pair
+//      yields an empty span that the line loop skips) + delimiter
+//      counts for exact output reservation.
+//   2. Line spans fan out across nthread workers BY LINE COUNT (the
+//      byte-based split of parse.cc skews when line lengths vary);
+//      each worker runs the branch-light strtonum.h token loops.
+//   3. Merge writes the per-thread results once, into their final
+//      segment offsets, applying the indexing-mode conversion
+//      (libsvm_parser.h:159-168 heuristic) during the copy.
+//
+// Semantics are byte-identical to parse.cc's scanners and the Python
+// engine (pinned by the tests/test_native_batch.py A/B parity matrix):
+//   libsvm: label[:weight] [qid:N] idx[:val]... , '#' comments, BOM
+//           skip, all-or-none weight/qid, lazy binary->valued promotion.
+//   csv:    single-char delimiter, uniform columns, label/weight column
+//           split with synthetic 0..k-1 index / strided offset arrays
+//           (the same skeleton csv_cells_to_block builds host-side).
+//   libfm:  label field:idx:val triples; heuristic needs BOTH mins > 0.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#if defined(__x86_64__) || defined(__i386__)
+#include <immintrin.h>
+#endif
+#if defined(__aarch64__)
+#include <arm_neon.h>
+#endif
+
+#include "api.h"
+#include "strtonum.h"
+
+namespace dmlc_tpu {
+namespace batch {
+
+// ---------------- zlib-compatible crc32 (slice-by-8) ----------------
+//
+// The block cache's per-block integrity word is Python zlib.crc32
+// (IEEE 802.3 polynomial, init/xorout 0xFFFFFFFF). Computing it here —
+// while the merged span is still in cache — removes the Python-side crc
+// pass from the cold path; tests pin equality against zlib.crc32.
+
+static uint32_t g_crc_tab[8][256];
+static std::atomic<bool> g_crc_ready{false};
+
+static void crc32_init() {
+  if (g_crc_ready.load(std::memory_order_acquire)) return;
+  static std::atomic<bool> building{false};
+  bool expected = false;
+  if (building.compare_exchange_strong(expected, true)) {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      g_crc_tab[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = g_crc_tab[0][i];
+      for (int t = 1; t < 8; ++t) {
+        c = g_crc_tab[0][c & 0xFF] ^ (c >> 8);
+        g_crc_tab[t][i] = c;
+      }
+    }
+    g_crc_ready.store(true, std::memory_order_release);
+  } else {
+    while (!g_crc_ready.load(std::memory_order_acquire)) {
+      std::this_thread::yield();
+    }
+  }
+}
+
+uint32_t crc32_span(const void* data, size_t len) {
+  crc32_init();
+  const unsigned char* p = static_cast<const unsigned char*>(data);
+  uint32_t c = 0xFFFFFFFFu;
+  while (len >= 8) {
+    uint32_t lo;
+    uint32_t hi;
+    memcpy(&lo, p, 4);
+    memcpy(&hi, p + 4, 4);
+    lo ^= c;
+    c = g_crc_tab[7][lo & 0xFF] ^ g_crc_tab[6][(lo >> 8) & 0xFF] ^
+        g_crc_tab[5][(lo >> 16) & 0xFF] ^ g_crc_tab[4][lo >> 24] ^
+        g_crc_tab[3][hi & 0xFF] ^ g_crc_tab[2][(hi >> 8) & 0xFF] ^
+        g_crc_tab[1][(hi >> 16) & 0xFF] ^ g_crc_tab[0][hi >> 24];
+    p += 8;
+    len -= 8;
+  }
+  while (len--) c = g_crc_tab[0][(c ^ *p++) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// ---------------- SIMD chunk scan ----------------
+//
+// One pass over the chunk produces the EOL position index (both '\n'
+// and '\r', so CRLF / CR-only corpora and unterminated final records
+// all reduce to the same span arithmetic) and the delimiter count for
+// exact output reservation. ISA picked once at runtime: AVX2 when the
+// host has it, SSE2 on any x86-64, NEON on aarch64, scalar elsewhere.
+
+struct ChunkScan {
+  std::vector<int64_t> eols;  // ascending offsets of every EOL byte
+  int64_t delims = 0;         // ':' (sparse formats) or the csv delimiter
+};
+
+static inline void scan_tail_scalar(const char* data, int64_t begin,
+                                    int64_t end, char delim, ChunkScan* out) {
+  for (int64_t i = begin; i < end; ++i) {
+    const char c = data[i];
+    if (c == '\n' || c == '\r') {
+      out->eols.push_back(i);
+    } else if (c == delim) {
+      ++out->delims;
+    }
+  }
+}
+
+#if defined(__x86_64__) || defined(__i386__)
+
+__attribute__((target("avx2"))) static void scan_avx2(const char* data,
+                                                      int64_t len, char delim,
+                                                      ChunkScan* out) {
+  const __m256i vnl = _mm256_set1_epi8('\n');
+  const __m256i vcr = _mm256_set1_epi8('\r');
+  const __m256i vdl = _mm256_set1_epi8(delim);
+  int64_t i = 0;
+  int64_t delims = 0;
+  for (; i + 32 <= len; i += 32) {
+    const __m256i v =
+        _mm256_loadu_si256(reinterpret_cast<const __m256i*>(data + i));
+    uint32_t eol = static_cast<uint32_t>(_mm256_movemask_epi8(
+        _mm256_or_si256(_mm256_cmpeq_epi8(v, vnl), _mm256_cmpeq_epi8(v, vcr))));
+    delims += __builtin_popcount(
+        static_cast<uint32_t>(_mm256_movemask_epi8(_mm256_cmpeq_epi8(v, vdl))));
+    while (eol) {
+      out->eols.push_back(i + __builtin_ctz(eol));
+      eol &= eol - 1;
+    }
+  }
+  out->delims += delims;
+  scan_tail_scalar(data, i, len, delim, out);
+}
+
+static void scan_sse2(const char* data, int64_t len, char delim,
+                      ChunkScan* out) {
+  const __m128i vnl = _mm_set1_epi8('\n');
+  const __m128i vcr = _mm_set1_epi8('\r');
+  const __m128i vdl = _mm_set1_epi8(delim);
+  int64_t i = 0;
+  int64_t delims = 0;
+  for (; i + 16 <= len; i += 16) {
+    const __m128i v =
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(data + i));
+    uint32_t eol = static_cast<uint32_t>(_mm_movemask_epi8(
+        _mm_or_si128(_mm_cmpeq_epi8(v, vnl), _mm_cmpeq_epi8(v, vcr))));
+    delims += __builtin_popcount(
+        static_cast<uint32_t>(_mm_movemask_epi8(_mm_cmpeq_epi8(v, vdl))));
+    while (eol) {
+      out->eols.push_back(i + __builtin_ctz(eol));
+      eol &= eol - 1;
+    }
+  }
+  out->delims += delims;
+  scan_tail_scalar(data, i, len, delim, out);
+}
+#endif  // x86
+
+#if defined(__aarch64__)
+
+static void scan_neon(const char* data, int64_t len, char delim,
+                      ChunkScan* out) {
+  const uint8x16_t vnl = vdupq_n_u8('\n');
+  const uint8x16_t vcr = vdupq_n_u8('\r');
+  const uint8x16_t vdl = vdupq_n_u8(delim);
+  int64_t i = 0;
+  int64_t delims = 0;
+  for (; i + 16 <= len; i += 16) {
+    const uint8x16_t v = vld1q_u8(reinterpret_cast<const uint8_t*>(data + i));
+    const uint8x16_t eolv = vorrq_u8(vceqq_u8(v, vnl), vceqq_u8(v, vcr));
+    const uint8x16_t dlv = vceqq_u8(v, vdl);
+    // 0xFF lanes -> 1s, horizontal add = matches in this block
+    delims += vaddvq_u8(vshrq_n_u8(dlv, 7));
+    // nibble-compress the match mask to one u64: 4 bits per byte lane
+    uint64_t mask = vget_lane_u64(
+        vreinterpret_u64_u8(vshrn_n_u16(vreinterpretq_u16_u8(eolv), 4)), 0);
+    while (mask) {
+      out->eols.push_back(i + (__builtin_ctzll(mask) >> 2));
+      mask &= mask - 1;  // clears one bit of the low set nibble
+      mask &= mask - 1;
+      mask &= mask - 1;
+      mask &= mask - 1;
+    }
+  }
+  out->delims += delims;
+  scan_tail_scalar(data, i, len, delim, out);
+}
+#endif  // aarch64
+
+static int detect_simd_level() {
+#if defined(__x86_64__) || defined(__i386__)
+  return __builtin_cpu_supports("avx2") ? 2 : 1;
+#elif defined(__aarch64__)
+  return 3;
+#else
+  return 0;
+#endif
+}
+
+static int simd_level() {
+  static const int level = detect_simd_level();
+  return level;
+}
+
+static void scan_chunk(const char* data, int64_t len, char delim,
+                       ChunkScan* out) {
+  // EOLs are ~1/30 of bytes in ML text corpora: reserve on that ratio so
+  // the push_back loop never reallocs more than once
+  out->eols.reserve(static_cast<size_t>(len / 24) + 8);
+#if defined(__x86_64__) || defined(__i386__)
+  if (simd_level() >= 2) {
+    scan_avx2(data, len, delim, out);
+  } else {
+    scan_sse2(data, len, delim, out);
+  }
+#elif defined(__aarch64__)
+  scan_neon(data, len, delim, out);
+#else
+  scan_tail_scalar(data, 0, len, delim, out);
+#endif
+}
+
+// Non-empty line spans from the EOL index: a CRLF pair yields an empty
+// span between '\r' and '\n' (dropped), the unterminated final record —
+// bytes past the last EOL — becomes the last span. (first, last) are
+// byte offsets into the chunk.
+struct LineSpan {
+  int32_t begin;
+  int32_t end;
+};
+
+static void build_spans(int64_t len, const std::vector<int64_t>& eols,
+                        std::vector<LineSpan>* spans) {
+  spans->reserve(eols.size() + 1);
+  int64_t cur = 0;
+  for (int64_t e : eols) {
+    if (e > cur) {
+      spans->push_back({static_cast<int32_t>(cur), static_cast<int32_t>(e)});
+    }
+    cur = e + 1;
+  }
+  if (len > cur) {
+    spans->push_back({static_cast<int32_t>(cur), static_cast<int32_t>(len)});
+  }
+}
+
+// ---------------- per-thread sparse parts ----------------
+
+struct Part {
+  std::vector<int64_t> row_nnz;
+  std::vector<float> label;
+  std::vector<float> weight;   // empty or per-row
+  std::vector<int64_t> qid;    // empty or per-row (libsvm only)
+  std::vector<uint64_t> index;
+  std::vector<uint64_t> field;  // libfm only
+  std::vector<float> value;    // empty (all-binary) or per-entry
+  std::vector<float> cells;    // csv only: row-major uniform cells
+  int64_t ncol = -1;           // csv only
+  uint64_t min_index = UINT64_MAX;
+  uint64_t min_field = UINT64_MAX;
+  std::string error;
+};
+
+// One libsvm line — the exact token semantics of parse.cc's
+// parse_libsvm_range body (comment strip, label[:weight], qid:N,
+// idx[:val] with lazy binary->valued promotion, loud trailing garbage).
+static inline bool parse_libsvm_line(const char* q, const char* lend,
+                                     Part* out) {
+  const char* hash = static_cast<const char*>(memchr(q, '#', lend - q));
+  const char* effective_end = hash ? hash : lend;
+  double label;
+  const char* after;
+  if (!parse_value(q, effective_end, &after, &label)) {
+    return true;  // blank / comment-only / unparsable-label line: skipped
+  }
+  q = after;
+  bool has_weight = false;
+  double weight = 1.0;
+  if (q != effective_end && *q == ':') {
+    ++q;
+    if (!parse_value(q, effective_end, &after, &weight)) {
+      out->error = "libsvm: bad label:weight";
+      return false;
+    }
+    q = after;
+    has_weight = true;
+  }
+  out->label.push_back(static_cast<float>(label));
+  if (has_weight) {
+    if (out->weight.size() != out->label.size() - 1) {
+      out->error = "libsvm: label:weight must be set on every row or none";
+      return false;
+    }
+    out->weight.push_back(static_cast<float>(weight));
+  } else if (!out->weight.empty()) {
+    out->error = "libsvm: label:weight must be set on every row or none";
+    return false;
+  }
+  while (q != effective_end && is_space(*q)) ++q;
+  if (effective_end - q >= 4 && memcmp(q, "qid:", 4) == 0) {
+    uint64_t qid;
+    if (!parse_uint(q + 4, effective_end, &after, &qid)) {
+      out->error = "libsvm: bad qid";
+      return false;
+    }
+    if (out->qid.size() != out->label.size() - 1) {
+      out->error = "libsvm: qid must appear on every row or none";
+      return false;
+    }
+    out->qid.push_back(static_cast<int64_t>(qid));
+    q = after;
+  } else if (!out->qid.empty()) {
+    out->error = "libsvm: qid must appear on every row or none";
+    return false;
+  }
+  int64_t nnz = 0;
+  while (true) {
+    uint64_t idx;
+    if (!parse_uint(q, effective_end, &after, &idx)) break;
+    q = after;
+    out->index.push_back(idx);
+    if (idx < out->min_index) out->min_index = idx;
+    ++nnz;
+    if (q != effective_end && *q == ':') {
+      double v;
+      ++q;
+      if (!parse_value(q, effective_end, &after, &v)) {
+        out->error = "libsvm: bad idx:value";
+        return false;
+      }
+      q = after;
+      if (out->value.size() + 1 < out->index.size()) {
+        out->value.resize(out->index.size() - 1, 1.0f);
+      }
+      out->value.push_back(static_cast<float>(v));
+    } else if (!out->value.empty()) {
+      out->value.push_back(1.0f);
+    }
+  }
+  while (q != effective_end && is_space(*q)) ++q;
+  if (q != effective_end) {
+    out->error = "libsvm: malformed feature token";
+    return false;
+  }
+  out->row_nnz.push_back(nnz);
+  return true;
+}
+
+static inline bool parse_libfm_line(const char* q, const char* lend,
+                                    Part* out) {
+  const char* hash = static_cast<const char*>(memchr(q, '#', lend - q));
+  const char* effective_end = hash ? hash : lend;
+  double label;
+  const char* after;
+  if (!parse_value(q, effective_end, &after, &label)) return true;
+  q = after;
+  out->label.push_back(static_cast<float>(label));
+  int64_t nnz = 0;
+  while (true) {
+    uint64_t fld;
+    uint64_t idx;
+    double v;
+    if (!parse_uint(q, effective_end, &after, &fld)) break;
+    q = after;
+    if (q == effective_end || *q != ':' ||
+        !parse_uint(q + 1, effective_end, &after, &idx)) {
+      out->error = "libfm: features must be field:index:value triples";
+      return false;
+    }
+    q = after;
+    if (q == effective_end || *q != ':' ||
+        !parse_value(q + 1, effective_end, &after, &v)) {
+      out->error = "libfm: features must be field:index:value triples";
+      return false;
+    }
+    q = after;
+    out->field.push_back(fld);
+    out->index.push_back(idx);
+    out->value.push_back(static_cast<float>(v));
+    if (idx < out->min_index) out->min_index = idx;
+    if (fld < out->min_field) out->min_field = fld;
+    ++nnz;
+  }
+  while (q != effective_end && is_space(*q)) ++q;
+  if (q != effective_end) {
+    out->error = "libfm: malformed feature token";
+    return false;
+  }
+  out->row_nnz.push_back(nnz);
+  return true;
+}
+
+static inline bool parse_csv_line(const char* q, const char* lend, char delim,
+                                  Part* out) {
+  int64_t cols = 0;
+  while (true) {
+    while (q != lend && is_space(*q) && *q != delim) ++q;
+    double v = 0.0;
+    const char* after;
+    if (q == lend || *q == delim) {
+      out->error = "csv: empty cell in row";
+      return false;
+    }
+    if (!parse_value(q, lend, &after, &v)) {
+      out->error = "csv: unparseable cell in row";
+      return false;
+    }
+    q = after;
+    out->cells.push_back(static_cast<float>(v));
+    ++cols;
+    while (q != lend && is_space(*q) && *q != delim) ++q;
+    if (q == lend) break;
+    if (*q == delim) {
+      ++q;
+      continue;
+    }
+    out->error = "csv: unexpected character in row";
+    return false;
+  }
+  if (out->ncol < 0) {
+    out->ncol = cols;
+  } else if (cols != out->ncol) {
+    out->error = "csv: ragged rows in chunk";
+    return false;
+  }
+  out->row_nnz.push_back(cols);
+  return true;
+}
+
+static void parse_span_range(const char* data, const LineSpan* spans,
+                             size_t nspans, int fmt, char delim,
+                             size_t reserve_rows, size_t reserve_entries,
+                             Part* out) {
+  try {
+    out->row_nnz.reserve(reserve_rows);
+    out->label.reserve(reserve_rows);
+    if (fmt == 2) {
+      out->cells.reserve(reserve_entries);
+    } else {
+      out->index.reserve(reserve_entries);
+      out->value.reserve(reserve_entries);
+      if (fmt == 3) out->field.reserve(reserve_entries);
+    }
+    for (size_t i = 0; i < nspans; ++i) {
+      const char* q = data + spans[i].begin;
+      const char* lend = data + spans[i].end;
+      bool ok;
+      if (fmt == 3) {
+        ok = parse_libfm_line(q, lend, out);
+      } else if (fmt == 2) {
+        ok = parse_csv_line(q, lend, delim, out);
+      } else {
+        ok = parse_libsvm_line(q, lend, out);
+      }
+      if (!ok) return;
+    }
+    // lazy valued-promotion backfill at range end (parse.cc parity)
+    if (!out->value.empty() && out->value.size() != out->index.size()) {
+      out->value.resize(out->index.size(), 1.0f);
+    }
+  } catch (const std::exception& ex) {
+    out->error = std::string("parse failed: ") + ex.what();
+  } catch (...) {
+    out->error = "parse failed: unknown error";
+  }
+}
+
+// ---------------- segment-span assembly ----------------
+
+static const int64_t kAlign = 64;  // io/block_cache.py _ALIGN
+
+static inline int64_t align_up(int64_t v) {
+  return (v + kAlign - 1) / kAlign * kAlign;
+}
+
+static char* dup_err(const std::string& s) {
+  char* e = static_cast<char*>(malloc(s.size() + 1));
+  if (e) memcpy(e, s.c_str(), s.size() + 1);
+  return e;
+}
+
+static SegmentBlockResult* seg_error(SegmentBlockResult* res,
+                                     const std::string& msg) {
+  free(res->buf);
+  res->buf = nullptr;
+  res->buf_len = 0;
+  res->error = dup_err(msg);
+  return res;
+}
+
+// Lay out the present segments exactly as io/block_cache.write_segments
+// does at an aligned block start: pad-to-64 before every present array
+// (even a zero-length one — the Python writer records those too), raw
+// bytes, no trailing pad. Returns false on OOM.
+static bool layout_segments(SegmentBlockResult* res, const int64_t* sizes,
+                            const bool* present) {
+  int64_t pos = 0;
+  for (int s = 0; s < DMLC_SEG_COUNT; ++s) {
+    if (!present[s]) {
+      res->seg_off[s] = -1;
+      res->seg_len[s] = 0;
+      continue;
+    }
+    pos = align_up(pos);
+    res->seg_off[s] = pos;
+    res->seg_len[s] = sizes[s];
+    pos += sizes[s];
+  }
+  res->buf_len = pos;
+  res->buf = static_cast<char*>(malloc(pos > 0 ? pos : 1));
+  if (!res->buf) return false;
+  // zero the alignment gaps (they are crc'd and written to disk verbatim)
+  int64_t end = 0;
+  for (int s = 0; s < DMLC_SEG_COUNT; ++s) {
+    if (res->seg_off[s] < 0) continue;
+    if (res->seg_off[s] > end) {
+      memset(res->buf + end, 0, res->seg_off[s] - end);
+    }
+    end = res->seg_off[s] + res->seg_len[s];
+  }
+  return true;
+}
+
+static SegmentBlockResult* merge_sparse(std::vector<Part>& parts, int fmt,
+                                        int indexing_mode,
+                                        SegmentBlockResult* res) {
+  const bool libfm = fmt == 3;
+  for (auto& part : parts) {
+    if (!part.error.empty()) return seg_error(res, part.error);
+  }
+  int64_t n = 0;
+  int64_t nnz = 0;
+  bool any_weight = false;
+  bool any_qid = false;
+  bool any_value = false;
+  uint64_t min_index = UINT64_MAX;
+  uint64_t min_field = UINT64_MAX;
+  for (auto& part : parts) {
+    n += static_cast<int64_t>(part.label.size());
+    nnz += static_cast<int64_t>(part.index.size());
+    any_weight |= !part.weight.empty();
+    any_qid |= !part.qid.empty();
+    any_value |= !part.value.empty();
+    if (part.min_index < min_index) min_index = part.min_index;
+    if (part.min_field < min_field) min_field = part.min_field;
+  }
+  const char* fmtname = libfm ? "libfm" : "libsvm";
+  for (auto& part : parts) {
+    if (!part.label.empty()) {
+      if (any_weight && part.weight.size() != part.label.size()) {
+        return seg_error(res, std::string(fmtname) +
+                                  ": label:weight must be set on every row "
+                                  "or none");
+      }
+      if (any_qid && part.qid.size() != part.label.size()) {
+        return seg_error(res, std::string(fmtname) +
+                                  ": qid must appear on every row or none");
+      }
+    }
+    if (any_value && !part.index.empty() && part.value.empty()) {
+      part.value.resize(part.index.size(), 1.0f);
+    }
+  }
+  res->n_rows = n;
+  res->nnz = nnz;
+  if (n == 0) return res;  // empty chunk: no segments, caller drops it
+  int64_t sizes[DMLC_SEG_COUNT] = {0};
+  bool present[DMLC_SEG_COUNT] = {false};
+  sizes[DMLC_SEG_OFFSET] = (n + 1) * 8;
+  present[DMLC_SEG_OFFSET] = true;
+  sizes[DMLC_SEG_LABEL] = n * 4;
+  present[DMLC_SEG_LABEL] = true;
+  sizes[DMLC_SEG_WEIGHT] = n * 4;
+  present[DMLC_SEG_WEIGHT] = any_weight;
+  sizes[DMLC_SEG_QID] = n * 8;
+  present[DMLC_SEG_QID] = any_qid;
+  sizes[DMLC_SEG_FIELD] = nnz * 8;
+  // libfm blocks always carry a field array (possibly empty), matching
+  // the Python engine's field=np.empty(0) emit for feature-less chunks
+  present[DMLC_SEG_FIELD] = libfm;
+  sizes[DMLC_SEG_INDEX] = nnz * 8;
+  present[DMLC_SEG_INDEX] = true;  // possibly zero-length, still recorded
+  sizes[DMLC_SEG_VALUE] = nnz * 4;
+  present[DMLC_SEG_VALUE] = any_value;
+  if (!layout_segments(res, sizes, present)) {
+    return seg_error(res, "parse: out of memory merging batch chunk");
+  }
+  // indexing-mode conversion (libsvm_parser.h:159-168 / libfm heuristic
+  // needs both mins, libfm_parser.h:130-143), applied during the copy
+  bool convert = indexing_mode > 0;
+  if (indexing_mode < 0 && nnz > 0 && min_index > 0) {
+    convert = !libfm || min_field > 0;
+  }
+  const uint64_t off = convert ? 1 : 0;
+  int64_t* offset = reinterpret_cast<int64_t*>(res->buf +
+                                               res->seg_off[DMLC_SEG_OFFSET]);
+  float* label =
+      reinterpret_cast<float*>(res->buf + res->seg_off[DMLC_SEG_LABEL]);
+  float* weight =
+      any_weight
+          ? reinterpret_cast<float*>(res->buf + res->seg_off[DMLC_SEG_WEIGHT])
+          : nullptr;
+  int64_t* qid =
+      any_qid
+          ? reinterpret_cast<int64_t*>(res->buf + res->seg_off[DMLC_SEG_QID])
+          : nullptr;
+  uint64_t* field =
+      libfm
+          ? reinterpret_cast<uint64_t*>(res->buf + res->seg_off[DMLC_SEG_FIELD])
+          : nullptr;
+  uint64_t* index =
+      reinterpret_cast<uint64_t*>(res->buf + res->seg_off[DMLC_SEG_INDEX]);
+  float* value =
+      any_value
+          ? reinterpret_cast<float*>(res->buf + res->seg_off[DMLC_SEG_VALUE])
+          : nullptr;
+  int64_t row = 0;
+  int64_t ent = 0;
+  uint64_t max_index = 0;
+  offset[0] = 0;
+  for (auto& part : parts) {
+    const size_t pn = part.label.size();
+    if (pn) {
+      memcpy(label + row, part.label.data(), pn * sizeof(float));
+      if (weight) memcpy(weight + row, part.weight.data(), pn * sizeof(float));
+      if (qid) memcpy(qid + row, part.qid.data(), pn * sizeof(int64_t));
+      for (size_t i = 0; i < pn; ++i) {
+        offset[row + 1 + static_cast<int64_t>(i)] =
+            offset[row + static_cast<int64_t>(i)] + part.row_nnz[i];
+      }
+      row += static_cast<int64_t>(pn);
+    }
+    const size_t pe = part.index.size();
+    if (pe) {
+      for (size_t i = 0; i < pe; ++i) {
+        const uint64_t c = part.index[i] - off;
+        index[ent + static_cast<int64_t>(i)] = c;
+        if (c > max_index) max_index = c;
+      }
+      if (field) {
+        for (size_t i = 0; i < pe; ++i) {
+          field[ent + static_cast<int64_t>(i)] = part.field[i] - off;
+        }
+      }
+      if (value) {
+        // the pre-merge backfill resized every entry-bearing part's
+        // value to 1.0f defaults, so the copy is unconditional
+        memcpy(value + ent, part.value.data(), pe * sizeof(float));
+      }
+      ent += static_cast<int64_t>(pe);
+    }
+  }
+  res->num_col = nnz > 0 ? static_cast<int64_t>(max_index) + 1 : 0;
+  return res;
+}
+
+static SegmentBlockResult* merge_csv(std::vector<Part>& parts,
+                                     int32_t label_col, int32_t weight_col,
+                                     SegmentBlockResult* res) {
+  for (auto& part : parts) {
+    if (!part.error.empty()) return seg_error(res, part.error);
+  }
+  int64_t ncol = -1;
+  int64_t n = 0;
+  for (auto& part : parts) {
+    if (part.row_nnz.empty()) continue;
+    if (ncol < 0) ncol = part.ncol;
+    if (part.ncol != ncol) return seg_error(res, "csv: ragged rows in chunk");
+    n += static_cast<int64_t>(part.row_nnz.size());
+  }
+  res->n_rows = n;
+  if (n == 0) return res;
+  if (label_col >= ncol || weight_col >= ncol) {
+    return seg_error(res, "csv: label/weight column out of range");
+  }
+  if (label_col >= 0 && label_col == weight_col) {
+    return seg_error(res, "csv: label_column must differ from weight_column");
+  }
+  const int64_t lc = label_col;
+  const int64_t wc = weight_col;
+  const int64_t k = ncol - (lc >= 0 ? 1 : 0) - (wc >= 0 ? 1 : 0);
+  res->nnz = n * k;
+  int64_t sizes[DMLC_SEG_COUNT] = {0};
+  bool present[DMLC_SEG_COUNT] = {false};
+  sizes[DMLC_SEG_OFFSET] = (n + 1) * 8;
+  present[DMLC_SEG_OFFSET] = true;
+  sizes[DMLC_SEG_LABEL] = n * 4;  // zeros when label_col < 0 (engine parity)
+  present[DMLC_SEG_LABEL] = true;
+  sizes[DMLC_SEG_WEIGHT] = n * 4;
+  present[DMLC_SEG_WEIGHT] = wc >= 0;
+  sizes[DMLC_SEG_INDEX] = n * k * 8;
+  present[DMLC_SEG_INDEX] = true;
+  sizes[DMLC_SEG_VALUE] = n * k * 4;
+  present[DMLC_SEG_VALUE] = true;
+  if (!layout_segments(res, sizes, present)) {
+    return seg_error(res, "parse: out of memory merging batch chunk");
+  }
+  int64_t* offset = reinterpret_cast<int64_t*>(res->buf +
+                                               res->seg_off[DMLC_SEG_OFFSET]);
+  float* label =
+      reinterpret_cast<float*>(res->buf + res->seg_off[DMLC_SEG_LABEL]);
+  float* weight =
+      wc >= 0
+          ? reinterpret_cast<float*>(res->buf + res->seg_off[DMLC_SEG_WEIGHT])
+          : nullptr;
+  uint64_t* index =
+      reinterpret_cast<uint64_t*>(res->buf + res->seg_off[DMLC_SEG_INDEX]);
+  float* value =
+      reinterpret_cast<float*>(res->buf + res->seg_off[DMLC_SEG_VALUE]);
+  // synthetic skeleton: offset strided by k, index tiled 0..k-1 — the
+  // exact arrays csv_cells_to_block builds host-side
+  for (int64_t i = 0; i <= n; ++i) offset[i] = i * k;
+  for (int64_t i = 0; i < n; ++i) {
+    for (int64_t j = 0; j < k; ++j) index[i * k + j] = j;
+  }
+  // feature columns form <= 3 contiguous runs around label/weight
+  int64_t runs[3][2];
+  int nruns = 0;
+  int64_t at = 0;
+  while (at < ncol) {
+    if (at == lc || at == wc) {
+      ++at;
+      continue;
+    }
+    int64_t hi = at;
+    while (hi < ncol && hi != lc && hi != wc) ++hi;
+    runs[nruns][0] = at;
+    runs[nruns][1] = hi - at;
+    ++nruns;
+    at = hi;
+  }
+  int64_t row = 0;
+  for (auto& part : parts) {
+    const float* cells = part.cells.data();
+    const int64_t pn = static_cast<int64_t>(part.row_nnz.size());
+    for (int64_t i = 0; i < pn; ++i, ++row) {
+      const float* src = cells + i * ncol;
+      float* dst = value + row * k;
+      for (int r = 0; r < nruns; ++r) {
+        memcpy(dst, src + runs[r][0],
+               static_cast<size_t>(runs[r][1]) * sizeof(float));
+        dst += runs[r][1];
+      }
+      label[row] = lc >= 0 ? src[lc] : 0.0f;
+      if (weight) weight[row] = src[wc];
+    }
+  }
+  res->num_col = k > 0 ? k : 0;
+  return res;
+}
+
+}  // namespace batch
+}  // namespace dmlc_tpu
+
+// ---------------- C ABI ----------------
+
+using namespace dmlc_tpu;
+using namespace dmlc_tpu::batch;
+
+extern "C" {
+
+int dmlc_simd_level() { return simd_level(); }
+
+uint32_t dmlc_crc32(const void* data, int64_t len) {
+  return crc32_span(data, static_cast<size_t>(len));
+}
+
+SegmentBlockResult* dmlc_parse_batch(const char* data, int64_t len,
+                                     int nthread, int fmt, int indexing_mode,
+                                     char delim, int32_t label_col,
+                                     int32_t weight_col) {
+  auto* res =
+      static_cast<SegmentBlockResult*>(calloc(1, sizeof(SegmentBlockResult)));
+  if (!res) return nullptr;
+  for (int s = 0; s < DMLC_SEG_COUNT; ++s) res->seg_off[s] = -1;
+  res->simd_level = simd_level();
+  if (len < 0 || (len > 0 && !data)) {
+    return seg_error(res, "batch parse: bad buffer");
+  }
+  if (len > INT32_MAX) {
+    // line spans are int32-packed; chunk sizes are MBs in practice
+    return seg_error(res, "batch parse: chunk exceeds 2 GB");
+  }
+  const char* end = data + len;
+  if (end - data >= 3 && memcmp(data, "\xef\xbb\xbf", 3) == 0) data += 3;
+  len = end - data;
+  try {
+    ChunkScan scan;
+    const char scan_delim = fmt == 2 ? delim : ':';
+    scan_chunk(data, len, scan_delim, &scan);
+    std::vector<LineSpan> spans;
+    build_spans(len, scan.eols, &spans);
+    if (nthread < 1) nthread = 1;
+    // small chunks don't repay thread spawns (parse.cc clamp)
+    const int by_size = static_cast<int>(len / (512 * 1024)) + 1;
+    if (nthread > by_size) nthread = by_size;
+    if (nthread > static_cast<int>(spans.size()) && !spans.empty()) {
+      nthread = static_cast<int>(spans.size());
+    }
+    if (spans.empty()) nthread = 1;
+    std::vector<Part> parts(static_cast<size_t>(nthread));
+    const size_t per = spans.size() / static_cast<size_t>(nthread);
+    const size_t extra = spans.size() % static_cast<size_t>(nthread);
+    // reservation hints from the SIMD scan: rows from the span split,
+    // entries from the chunk-global delimiter count, proportionally
+    const size_t entries_hint =
+        static_cast<size_t>(scan.delims) / static_cast<size_t>(nthread) + 16;
+    std::vector<std::thread> threads;
+    size_t at = 0;
+    try {
+      for (int t = 0; t < nthread; ++t) {
+        const size_t cnt = per + (static_cast<size_t>(t) < extra ? 1 : 0);
+        const LineSpan* base = spans.data() + at;
+        Part* out = &parts[static_cast<size_t>(t)];
+        at += cnt;
+        if (t == nthread - 1) {
+          parse_span_range(data, base, cnt, fmt, delim, cnt + 1, entries_hint,
+                           out);
+        } else {
+          threads.emplace_back(parse_span_range, data, base, cnt, fmt, delim,
+                               cnt + 1, entries_hint, out);
+        }
+      }
+    } catch (...) {
+      // a std::thread ctor can throw (EAGAIN under a pids cgroup limit)
+      // after earlier workers spawned: join them before unwinding, or
+      // ~thread() on a joinable element calls std::terminate and the
+      // whole process aborts instead of surfacing res->error
+      for (auto& t : threads) t.join();
+      throw;
+    }
+    for (auto& t : threads) t.join();
+    if (fmt == 2) {
+      merge_csv(parts, label_col, weight_col, res);
+    } else {
+      merge_sparse(parts, fmt, indexing_mode, res);
+    }
+    if (!res->error && res->buf) {
+      res->crc32 = crc32_span(res->buf, static_cast<size_t>(res->buf_len));
+    }
+    return res;
+  } catch (const std::exception& ex) {
+    return seg_error(res, std::string("batch parse failed: ") + ex.what());
+  } catch (...) {
+    return seg_error(res, "batch parse failed: unknown error");
+  }
+}
+
+void dmlc_free_segblock(SegmentBlockResult* r) {
+  if (!r) return;
+  free(r->buf);
+  free(r->error);
+  free(r);
+}
+
+}  // extern "C"

@@ -1131,6 +1131,6 @@ void dmlc_free_csv_split(CsvSplitResult* r) {
   free(r);
 }
 
-int dmlc_native_abi_version() { return 15; }
+int dmlc_native_abi_version() { return 16; }
 
 }  // extern "C"

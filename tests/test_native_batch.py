@@ -1,0 +1,475 @@
+"""ISSUE 14 tentpole: the chunk-batch SIMD native parse engine
+(``engine='native-batch'``) that materializes block-cache v1 segment
+spans directly.
+
+The PR 3 per-engine A/B parity harness extended to the new engine: every
+format/config cell must parse byte-identically to the Python engine —
+clean, multi-partition, under fault-plan heals, and across checkpoint
+restores — and the cold-epoch tee must write a byte-identical
+``DMLCBC01`` cache with zero Python re-encode (the native span + crc are
+appended verbatim; ``add_block_encoded``).
+"""
+
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from dmlc_tpu import native
+from dmlc_tpu.data.batch_parser import NativeBatchParser
+from dmlc_tpu.data.parsers import ParallelTextParser, create_parser
+from dmlc_tpu.io import faults, resilience
+from dmlc_tpu.utils.check import DMLCError
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native core unavailable")
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    monkeypatch.setenv("DMLC_RETRY_BASE_MS", "1")
+    monkeypatch.setenv("DMLC_RETRY_MAX_MS", "5")
+    monkeypatch.delenv("DMLC_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("DMLC_TPU_PARSE_WORKERS", raising=False)
+    monkeypatch.delenv("DMLC_TPU_PARSE_ENGINE", raising=False)
+    faults.reset()
+    resilience.reset_counters()
+    yield
+    faults.reset()
+
+
+# ---------------- corpora ----------------
+
+def _libsvm_text(n=300, d=6, qid=False, weight=False, seed=0, binary=False,
+                 eol="\n", terminated=True):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n):
+        label = f"{i % 2}:{rng.random():.3f}" if weight else f"{i % 2}"
+        q = f" qid:{i // 10}" if qid else ""
+        if binary:
+            feats = " ".join(f"{j}" for j in range(1, d + 1))
+        else:
+            feats = " ".join(f"{j}:{rng.normal():.5f}" for j in range(d))
+        lines.append(f"{label}{q} {feats}")
+    text = eol.join(lines) + (eol if terminated else "")
+    return text.encode()
+
+
+def _libfm_text(n=300, d=5, seed=1):
+    rng = np.random.default_rng(seed)
+    return ("\n".join(
+        f"{i % 2} " + " ".join(f"{j % 3}:{j}:{rng.normal():.5f}"
+                               for j in range(d))
+        for i in range(n)) + "\n").encode()
+
+
+def _csv_text(n=300, d=5, seed=2):
+    rng = np.random.default_rng(seed)
+    return ("\n".join(
+        f"{i % 2}," + ",".join(f"{rng.normal():.5f}" for _ in range(d))
+        for i in range(n)) + "\n").encode()
+
+
+def _write(tmp_path, name, data):
+    p = tmp_path / name
+    p.write_bytes(data)
+    return str(p)
+
+
+def _drain_arrays(parser):
+    out = {}
+
+    def add(key, arr):
+        if arr is not None:
+            out.setdefault(key, []).append(np.asarray(arr))
+
+    while (b := parser.next_block()) is not None:
+        add("label", b.label)
+        add("index", b.index)
+        add("value", b.value)
+        add("weight", b.weight)
+        add("qid", b.qid)
+        add("field", b.field)
+        add("nnz", np.diff(np.asarray(b.offset)))
+    return {k: np.concatenate(v) for k, v in out.items()}
+
+
+def _assert_same(a, b):
+    assert set(a) == set(b), (sorted(a), sorted(b))
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+def _run(uri, fmt, engine, workers=1, part=0, nparts=1, **kw):
+    p = create_parser(uri, part, nparts, fmt, threaded=True,
+                      parse_workers=workers, engine=engine,
+                      chunk_bytes=2048, **kw)
+    try:
+        return _drain_arrays(p)
+    finally:
+        p.close()
+
+
+PARITY_MATRIX = [
+    ("libsvm", _libsvm_text(), ""),
+    ("libsvm", _libsvm_text(qid=True), ""),
+    ("libsvm", _libsvm_text(weight=True), ""),
+    ("libsvm", _libsvm_text(binary=True), ""),
+    ("libsvm", _libsvm_text(d=3, seed=7), "?indexing_mode=-1"),
+    ("libsvm", _libsvm_text(d=3, seed=8), "?indexing_mode=1"),
+    ("libsvm", _libsvm_text(eol="\r\n", terminated=False), ""),
+    ("libfm", _libfm_text(), ""),
+    ("libfm", _libfm_text(seed=5), "?indexing_mode=-1"),
+    ("csv", _csv_text(), "?label_column=0"),
+    ("csv", _csv_text(seed=9), "?label_column=0&weight_column=1"),
+    ("csv", _csv_text(seed=11), ""),
+]
+
+
+class TestParityAB:
+    @pytest.mark.parametrize("fmt,data,uri_args", PARITY_MATRIX)
+    @pytest.mark.parametrize("workers", [1, 4])
+    def test_epoch_byte_identical(self, tmp_path, fmt, data, uri_args,
+                                  workers):
+        uri = _write(tmp_path, f"c.{fmt}", data) + uri_args
+        _assert_same(_run(uri, fmt, "native-batch", workers),
+                     _run(uri, fmt, "python", workers))
+
+    def test_multi_partition_parity_and_union(self, tmp_path):
+        data = _libsvm_text(n=900, d=4, seed=3)
+        uri = _write(tmp_path, "parts.libsvm", data)
+        whole = _run(uri, "libsvm", "python")
+        parts = []
+        for part in range(3):
+            a = _run(uri, "libsvm", "native-batch", part=part, nparts=3)
+            b = _run(uri, "libsvm", "python", part=part, nparts=3)
+            _assert_same(a, b)
+            parts.append(a)
+        union = {k: np.concatenate([p[k] for p in parts]) for k in whole}
+        _assert_same(union, whole)
+
+    def test_crlf_noterm_partition_boundaries(self, tmp_path):
+        data = _libsvm_text(n=120, d=3, eol="\r\n", terminated=False)
+        uri = _write(tmp_path, "crlf.libsvm", data)
+        for nparts in (2, 3, 5):
+            for part in range(nparts):
+                _assert_same(
+                    _run(uri, "libsvm", "native-batch", part=part,
+                         nparts=nparts),
+                    _run(uri, "libsvm", "python", part=part, nparts=nparts))
+
+
+class TestEncodedSpan:
+    def test_encoded_contract(self, tmp_path):
+        """block.encoded carries the exact write_segments bytes + crc:
+        the one-materialization claim at the block level."""
+        import io as _io
+
+        from dmlc_tpu.io.block_cache import write_segments
+
+        uri = _write(tmp_path, "e.libsvm", _libsvm_text(n=200, d=5))
+        p = create_parser(uri, 0, 1, "libsvm", threaded=False,
+                          engine="native-batch", chunk_bytes=4096)
+        n = 0
+        while (b := p.next_block()) is not None:
+            enc = b.encoded
+            assert enc.rows == len(b)
+            assert zlib.crc32(enc.data) & 0xFFFFFFFF == enc.crc
+            buf = _io.BytesIO()
+            _, crc, arrays = write_segments(buf, b.to_segments())
+            assert buf.getvalue() == bytes(memoryview(enc.data))
+            assert crc == enc.crc
+            assert arrays == {k: [d, o, nb] for k, (d, o, nb)
+                              in enc.arrays.items()}
+            assert enc.num_col == b.num_col
+            n += 1
+        p.close()
+        assert n >= 1
+
+    @pytest.mark.parametrize("workers", [1, 4])
+    def test_cold_tee_cache_byte_identical(self, tmp_path, workers):
+        """The acceptance pin: a cold epoch teed through the batch
+        engine produces a byte-identical DMLCBC01 file to the Python
+        engine's (same signature, same blocks, same footer) — the
+        golden layout with zero re-encode."""
+        uri = _write(tmp_path, "tee.libsvm", _libsvm_text(n=600, d=5))
+
+        def build(engine):
+            cache = str(tmp_path / f"tee.{engine}.{workers}.bc")
+            p = create_parser(uri, 0, 1, "libsvm", threaded=True,
+                              parse_workers=workers, engine=engine,
+                              chunk_bytes=2048, block_cache=cache)
+            try:
+                while p.next_block() is not None:
+                    pass
+            finally:
+                p.close()
+            with open(cache, "rb") as f:
+                raw = f.read()
+            os.remove(cache)
+            return raw
+
+        a, b = build("native-batch"), build("python")
+        assert a == b
+        assert a[:8] == b"DMLCBC01" and a[-8:] == b"DMLCBC01"
+
+    def test_batch_built_cache_serves_warm_byte_identical(self, tmp_path):
+        """Warm epochs over a batch-engine-built cache deliver the exact
+        cold stream (parser bypassed)."""
+        uri = _write(tmp_path, "warm.libsvm", _libsvm_text(n=400, d=4))
+        cache = str(tmp_path / "warm.bc")
+        p = create_parser(uri, 0, 1, "libsvm", threaded=True,
+                          parse_workers=1, engine="native-batch",
+                          chunk_bytes=2048, block_cache=cache)
+        try:
+            cold = _drain_arrays(p)
+            assert p.cache_state == "cold"
+            p.before_first()
+            assert p.cache_state == "warm"
+            warm = _drain_arrays(p)
+        finally:
+            p.close()
+        _assert_same(cold, warm)
+
+    def test_service_frame_reuses_encoded_bytes(self, tmp_path):
+        """encode_block_frame over a batch-engine block (encoded
+        attached) must produce the same frame a re-encoded copy would —
+        the wire rides the same single materialization."""
+        from dmlc_tpu.data.row_block import RowBlock
+        from dmlc_tpu.service.frame import decode_frame, encode_block_frame
+
+        uri = _write(tmp_path, "f.libsvm", _libsvm_text(n=150, d=4))
+        p = create_parser(uri, 0, 1, "libsvm", threaded=False,
+                          engine="native-batch", chunk_bytes=4096)
+        block = p.next_block()
+        p.close()
+        assert block.encoded is not None
+        fast = encode_block_frame(block, resume={"kind": "blocks",
+                                                 "blocks": 1})
+        plain_block = RowBlock.from_segments(block.to_segments())
+        assert getattr(plain_block, "encoded", None) is None
+        plain = encode_block_frame(plain_block,
+                                   resume={"kind": "blocks", "blocks": 1})
+        assert bytes(fast) == bytes(plain)
+        kind, meta, payload = decode_frame(bytes(fast))  # structurally valid
+        assert meta["rows"] == len(block)
+
+    def test_simd_level_reported(self):
+        level = native.simd_level()
+        assert level in (0, 1, 2, 3)
+        out = native.parse_batch(b"1 1:2\n", "libsvm")
+        assert out["simd_level"] == level
+
+
+class TestCheckpoints:
+    @pytest.mark.parametrize("engines", [("native-batch", "python"),
+                                         ("python", "native-batch"),
+                                         ("native-batch", "native-batch")])
+    def test_cross_engine_resume_byte_identical(self, tmp_path, engines):
+        """A mid-stream checkpoint from one engine restores into the
+        other and replays the remainder byte-identically (the byte-exact
+        resume-annotation contract rides TextParserBase unchanged)."""
+        src_engine, dst_engine = engines
+        uri = _write(tmp_path, "ck.libsvm", _libsvm_text(n=500, d=4))
+
+        def parser(engine):
+            return create_parser(uri, 0, 1, "libsvm", threaded=True,
+                                 parse_workers=1, engine=engine,
+                                 chunk_bytes=2048)
+
+        full = parser(src_engine)
+        try:
+            ref = _drain_arrays(full)
+        finally:
+            full.close()
+        src = parser(src_engine)
+        try:
+            head = []
+            for _ in range(2):
+                b = src.next_block()
+                assert b is not None
+                head.append(np.asarray(b.label))
+            state = src.state_dict()
+        finally:
+            src.close()
+        dst = parser(dst_engine)
+        try:
+            dst.load_state(state)
+            tail = _drain_arrays(dst)
+        finally:
+            dst.close()
+        got = np.concatenate(head + [tail["label"]])
+        np.testing.assert_array_equal(got, ref["label"])
+
+    def test_parallel_wrap_and_stage_seconds(self, tmp_path):
+        uri = _write(tmp_path, "w.libsvm", _libsvm_text(n=300, d=4))
+        p = create_parser(uri, 0, 1, "libsvm", threaded=True,
+                          parse_workers=4, engine="native-batch",
+                          chunk_bytes=2048)
+        try:
+            assert isinstance(p, ParallelTextParser)
+            assert isinstance(p.base, NativeBatchParser)
+            while p.next_block() is not None:
+                pass
+            stages = p.stage_seconds()
+            assert set(stages) >= {"read", "parse"}
+            assert stages["parse"] > 0.0
+            stats = p.parallel_stats()
+            assert stats["parse_workers"] == 4
+        finally:
+            p.close()
+
+
+class TestEngineKnob:
+    def test_env_routes_engine(self, tmp_path, monkeypatch):
+        uri = _write(tmp_path, "env.libsvm", _libsvm_text(n=50, d=3))
+        monkeypatch.setenv("DMLC_TPU_PARSE_ENGINE", "native-batch")
+        p = create_parser(uri, 0, 1, "libsvm", threaded=False,
+                          chunk_bytes=4096)
+        try:
+            assert isinstance(p, NativeBatchParser)
+        finally:
+            p.close()
+
+    def test_uri_arg_routes_engine(self, tmp_path):
+        uri = _write(tmp_path, "uri.libsvm", _libsvm_text(n=50, d=3))
+        p = create_parser(uri + "?engine=native-batch", 0, 1, "libsvm",
+                          threaded=False, chunk_bytes=4096)
+        try:
+            assert isinstance(p, NativeBatchParser)
+        finally:
+            p.close()
+
+    def test_bad_engine_rejected_loudly(self, tmp_path, monkeypatch):
+        uri = _write(tmp_path, "bad.libsvm", _libsvm_text(n=10, d=2))
+        monkeypatch.setenv("DMLC_TPU_PARSE_ENGINE", "turbo")
+        with pytest.raises(DMLCError, match="parse engine"):
+            create_parser(uri, 0, 1, "libsvm", threaded=False)
+
+    def test_unsupported_dtype_falls_back_to_python(self, tmp_path):
+        """index_dtype != uint64 cannot ride the fixed segment layout:
+        the factory falls back to the Python engine (loud log) instead
+        of silently mis-typing the cache."""
+        uri = _write(tmp_path, "dt.libsvm", _libsvm_text(n=40, d=3))
+        p = create_parser(uri, 0, 1, "libsvm", threaded=False,
+                          index_dtype=np.uint32, engine="native-batch",
+                          chunk_bytes=4096)
+        try:
+            assert not isinstance(p, NativeBatchParser)
+            assert p.next_block() is not None  # the stream still serves
+        finally:
+            p.close()
+
+    def test_engine_outside_cache_signature(self, tmp_path):
+        """One cache serves every engine: a cache built under
+        engine=python opens warm under engine=native-batch (the knob is
+        stripped from the signature), even as a ?engine= URI arg."""
+        path = _write(tmp_path, "sig.libsvm", _libsvm_text(n=120, d=3))
+        cache = str(tmp_path / "sig.bc")
+        p = create_parser(path + "?engine=python", 0, 1, "libsvm",
+                          threaded=False, chunk_bytes=4096,
+                          block_cache=cache)
+        try:
+            while p.next_block() is not None:
+                pass
+            p.before_first()
+            assert p.cache_state == "warm"
+        finally:
+            p.close()
+        q = create_parser(path + "?engine=native-batch", 0, 1, "libsvm",
+                          threaded=False, chunk_bytes=4096,
+                          block_cache=cache)
+        try:
+            assert q.cache_state == "warm"  # no invalidation, no rebuild
+        finally:
+            q.close()
+
+
+class TestFaultHeal:
+    def test_remote_read_fault_heals_byte_identical(self, monkeypatch):
+        """The PR 3 harness's fail-then-succeed READ fault, through the
+        batch engine over a remote (HTTP) source: the resilient stream
+        stack under the ordinary split heals mid-read, the epoch is
+        byte-identical to a clean Python-engine run, and the retry is
+        counted."""
+        import http.server
+        import threading
+
+        data = _libsvm_text(n=400, d=4)
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_HEAD(self):
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+
+            def do_GET(self):
+                rng = self.headers.get("Range")
+                chunk = data
+                if rng:
+                    lo, hi = rng.split("=")[1].split("-")
+                    lo = int(lo)
+                    if lo >= len(data):
+                        self.send_response(416)
+                        self.end_headers()
+                        return
+                    chunk = data[lo:int(hi) + 1] if hi else data[lo:]
+                    self.send_response(206)
+                else:
+                    self.send_response(200)
+                self.send_header("Content-Length", str(len(chunk)))
+                self.end_headers()
+                self.wfile.write(chunk)
+
+        from dmlc_tpu.io import http_filesys
+
+        monkeypatch.setattr(http_filesys, "_BLOCK", 2048)
+        server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            uri = f"http://127.0.0.1:{server.server_address[1]}/c.libsvm"
+            clean = _run(uri, "libsvm", "python")
+            resilience.reset_counters()
+            with faults.inject("read@2..3=http-503") as plan:
+                healed = _run(uri, "libsvm", "native-batch")
+        finally:
+            server.shutdown()
+            server.server_close()
+        _assert_same(healed, clean)
+        snap = resilience.counters_snapshot()
+        assert plan.fired() == 2
+        assert snap["retries"] == 2
+        assert snap["giveups"] == 0
+
+    def test_fault_plan_heal_byte_identical(self, tmp_path, monkeypatch):
+        """A fail-then-succeed read fault under the batch engine heals
+        through the shared resilience machinery with the stream
+        delivered byte-identically and the retry counted."""
+        uri = _write(tmp_path, "fp.libsvm", _libsvm_text(n=400, d=4))
+        clean = _run(uri, "libsvm", "python")
+        resilience.reset_counters()
+        # chunk-cache decoration forces the resilient stream stack under
+        # the batch engine (mmap sources have no remote read to fault) —
+        # fault the cache_read path instead: corrupt once, heal, rebuild
+        cache = str(tmp_path / "fp.bc")
+        p = create_parser(uri, 0, 1, "libsvm", threaded=True,
+                          parse_workers=1, engine="native-batch",
+                          chunk_bytes=2048, block_cache=cache)
+        try:
+            while p.next_block() is not None:
+                pass
+            p.before_first()  # warm now
+            monkeypatch.setenv("DMLC_FAULT_PLAN", "cache_read@1=corrupt")
+            faults.reset()
+            healed = _drain_arrays(p)
+        finally:
+            p.close()
+        _assert_same(healed, clean)
+        snap = resilience.counters_snapshot()
+        assert snap["cache_corruptions"] == 1
+        assert snap["cache_rebuilds"] == 1
