@@ -179,6 +179,17 @@ bench-smoke:
 	        'preemption_notices', 'speculative_reissues', \
 	        'speculative_wins', 'worker_joins') if line[k]}; \
 	    assert not hot, f'control-plane events on a clean run: {hot}'; \
+	    assert line.get('service_jobs') == 2, \
+	        'service_jobs missing (two-job multi-tenant leg did not run)'; \
+	    spr = line.get('shared_parse_ratio'); \
+	    assert spr is not None and spr >= 0.5, \
+	        f'shared_parse_ratio {spr} < 0.5: the identical-corpus pair ' \
+	        'did not share its published artifacts (cross-job ' \
+	        'share-by-signature broken)'; \
+	    fse = line.get('fleet_scale_events'); \
+	    assert fse == 0, \
+	        f'fleet_scale_events {fse} != 0: the autoscaler flapped on a ' \
+	        'clean smoke run'; \
 	    assert line.get('autotune_enabled') is True, \
 	        'autotune_enabled missing (autotune leg did not run)'; \
 	    assert line.get('autotune_steps') is not None, \
@@ -229,6 +240,9 @@ bench-smoke:
 	          line['service_mb_per_sec'], 'MB/s with', \
 	          line['service_workers'], 'workers, vs-local x', \
 	          line['service_vs_local_speedup']); \
+	    print('bench-smoke: multi-tenant OK:', line['service_jobs'], \
+	          'jobs, shared_parse_ratio', spr, ',', fse, \
+	          'fleet scale events'); \
 	    print('bench-smoke: autotune OK:', line['autotune_steps'], \
 	          'steps,', line.get('autotune_adjustments'), \
 	          'adjustments, converged', line.get('autotune_converged'), \

@@ -576,10 +576,25 @@ def service_leg(path: str, size_mb: float, workers: int = 2):
     docs/service.md elastic membership): all ten MUST read zero on a
     clean run — a nonzero value on healthy infrastructure means the
     control plane restarted, a worker was preempted/hedged, or the fleet
-    churned mid-bench, any of which taints the throughput numbers."""
+    churned mid-bench, any of which taints the throughput numbers.
+
+    The **two-job multi-tenant leg** (ISSUE 15, docs/service.md
+    multi-tenant service) then registers the SAME corpus twice on one
+    fleet with share-by-signature armed and a knob-paced fleet
+    autoscaler attached: job A parses and publishes the shared block
+    caches, job B's parts all resolve to the published artifacts --
+    ``shared_parse_ratio`` (parses avoided / parts supplied) is 0.5 by
+    construction for the identical-corpus pair, gated ``>= 0.5`` by
+    ``make bench-smoke``. ``service_jobs`` counts the tenants and
+    ``fleet_scale_events`` the autoscaler's scale decisions -- which
+    must be ZERO on a clean run (no flapping: a fast healthy smoke run
+    gives the controller no sustained starvation to react to)."""
+    import tempfile
+
     from dmlc_tpu.data import create_parser
     from dmlc_tpu.io import resilience as _resilience
-    from dmlc_tpu.service import LocalFleet, ServiceParser
+    from dmlc_tpu.service import DEFAULT_JOB, LocalFleet, ServiceParser
+    from dmlc_tpu.utils import telemetry as _telemetry
 
     num_parts = workers
     cfg = {"format": "libsvm", "chunk_bytes": CHUNK_BYTES}
@@ -615,6 +630,52 @@ def service_leg(path: str, size_mb: float, workers: int = 2):
         f"x{local_dt/service_dt:.2f} (control plane: "
         f"{res['dispatcher_restarts']} restarts, "
         f"{res['control_plane_retries']} retries)")
+    # ---- two-job multi-tenant leg (docstring): same corpus, two jobs,
+    # share-by-signature, knob-paced autoscaler attached for the ride
+    tenant = "tenant-b"
+    res2_base = _resilience.counters_snapshot()
+    with tempfile.TemporaryDirectory(prefix="dmlc-svc-share-") as share:
+        fleet = LocalFleet(path, num_parts, num_workers=workers,
+                           parser=cfg, share_dir=share)
+        scaler = None
+        client = None
+        try:
+            # the autoscaler rides along on the clients' job-labeled
+            # wait counters; a clean smoke run must produce ZERO scale
+            # decisions (the fleet_scale_events == 0 gate)
+            scaler = fleet.autoscale(
+                source=lambda: {
+                    j: _telemetry.REGISTRY.sum(
+                        _telemetry.SERVICE_JOB_WAIT_METRIC, job=j)
+                    for j in (DEFAULT_JOB, tenant)},
+                start=True)
+            client = ServiceParser(fleet.address)
+            jobs_blocks = 0
+            while client.next_block() is not None:
+                jobs_blocks += 1
+            client.close()
+            # register the tenant AFTER job A published: its parts must
+            # all resolve to the shared artifacts (parse-once)
+            fleet.register_job(tenant, path, num_parts, parser=cfg)
+            client = ServiceParser(fleet.address, job=tenant)
+            tenant_blocks = 0
+            while client.next_block() is not None:
+                tenant_blocks += 1
+        finally:
+            if client is not None:
+                client.close()
+            if scaler is not None:
+                scaler.close()
+            fleet.close()
+    res2 = _resilience.counters_delta(res2_base)
+    parsed = res2["service_parts_parsed"]
+    shared = res2["service_parts_shared"]
+    shared_ratio = shared / max(1, parsed + shared)
+    scale_events = res2["fleet_scale_ups"] + res2["fleet_scale_downs"]
+    log(f"bench: service two-job leg: {jobs_blocks}+{tenant_blocks} "
+        f"blocks, {parsed} parts parsed / {shared} shared -> "
+        f"shared_parse_ratio {shared_ratio:.3f}, "
+        f"{scale_events} fleet scale events")
     return {
         "service_workers": workers,
         "service_mb_per_sec": round(size_mb / service_dt, 2),
@@ -629,6 +690,9 @@ def service_leg(path: str, size_mb: float, workers: int = 2):
         "speculative_reissues": res["speculative_reissues"],
         "speculative_wins": res["speculative_wins"],
         "worker_joins": res["worker_joins"],
+        "service_jobs": 2,
+        "shared_parse_ratio": round(shared_ratio, 3),
+        "fleet_scale_events": scale_events,
     }
 
 
@@ -1218,6 +1282,8 @@ def main() -> int:
                           "worker_drains", "drain_handoffs",
                           "preemption_notices", "speculative_reissues",
                           "speculative_wins", "worker_joins",
+                          "service_jobs", "shared_parse_ratio",
+                          "fleet_scale_events",
                           "autotune_enabled", "autotune_steps",
                           "autotune_adjustments", "autotune_converged",
                           "autotune_gap_stage", "autotune_final_config",

@@ -236,6 +236,7 @@ def create_row_block_iter(
     block_cache: Optional[str] = None,
     snapshot: Optional[str] = None,
     service: Optional[str] = None,
+    service_job: Optional[str] = None,
     shuffle_seed: Optional[int] = None,
     shuffle_window: int = 0,
     pod_sharding=False,
@@ -295,6 +296,7 @@ def create_row_block_iter(
         # serving unshuffled epochs the user asked to shuffle
         parser = create_parser(uri, part_index, num_parts, type_,
                                index_dtype=index_dtype, service=service,
+                               service_job=service_job,
                                shuffle_seed=shuffle_seed,
                                shuffle_window=shuffle_window,
                                pod_sharding=pod_sharding)

@@ -2031,6 +2031,7 @@ def create_parser(
     block_cache: Optional[str] = None,
     snapshot: Optional[str] = None,
     service: Optional[str] = None,
+    service_job: Optional[str] = None,
     shuffle_seed: Optional[int] = None,
     shuffle_window: int = 0,
     pod_sharding=False,
@@ -2126,8 +2127,13 @@ def create_parser(
               "ships device-layout snapshot frames "
               "(Dispatcher(snapshot=...), docs/service.md)")
         from dmlc_tpu.service.client import ServiceParser
+        from dmlc_tpu.service.dispatcher import DEFAULT_JOB
 
-        return ServiceParser(service)
+        # the registered job this client binds to (multi-tenant service,
+        # docs/service.md): explicit knob > `?job=` URI arg > default
+        job = (service_job if service_job is not None
+               else spec.args.get("job", DEFAULT_JOB))
+        return ServiceParser(service, job=job)
     if type_ == "auto":
         type_ = spec.args.get("format", "libsvm")
     bc_path = _resolve_block_cache(spec, part_index, num_parts, block_cache)

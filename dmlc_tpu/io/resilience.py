@@ -218,6 +218,23 @@ class _Counters:
     ``worker_joins``
                   brand-new workers that joined a LIVE fleet mid-epoch
                   (registered after work had already been granted)
+    ``service_parts_parsed``
+                  parts a service worker supplied by ACTUALLY parsing
+                  (cold pass — text ran through a parser somewhere in
+                  the fleet)
+    ``service_parts_shared``
+                  parts a service worker supplied from an
+                  already-published block-cache artifact instead of
+                  parsing — the cross-job share-by-signature win (a
+                  second job over the same corpus, or a relaunched
+                  worker re-serving its own publication); the bench
+                  two-job leg's ``shared_parse_ratio`` is
+                  shared / (parsed + shared)
+    ``fleet_scale_ups`` / ``fleet_scale_downs``
+                  fleet-autoscaler decisions: workers live-joined under
+                  sustained per-job input wait / gracefully drained
+                  under sustained idleness (docs/service.md fleet
+                  autoscaling) — both zero on a clean bench run
     """
 
     _KEYS = ("attempts", "retries", "resumes", "giveups", "fatal",
@@ -228,7 +245,9 @@ class _Counters:
              "dispatcher_restarts", "worker_reregistrations",
              "parts_reclaimed", "control_plane_retries",
              "worker_drains", "drain_handoffs", "preemption_notices",
-             "speculative_reissues", "speculative_wins", "worker_joins")
+             "speculative_reissues", "speculative_wins", "worker_joins",
+             "service_parts_parsed", "service_parts_shared",
+             "fleet_scale_ups", "fleet_scale_downs")
 
     def bump(self, key: str, n: int = 1) -> None:
         record_event(key, n)

@@ -1,21 +1,35 @@
 """Disaggregated RowBlock data service (tf.data service, arXiv:2210.14826).
 
-One shared preprocessing tier feeds many trainer clients: a
-:class:`~dmlc_tpu.service.dispatcher.Dispatcher` owns split assignment
-(first-come-first-served, exactly-once per epoch, re-issue on worker
+One shared **multi-tenant** preprocessing tier feeds many trainer jobs:
+a :class:`~dmlc_tpu.service.dispatcher.Dispatcher` owns a registry of N
+jobs (``register_job``) and their split assignment (exactly-once per
+epoch, round-robin grant rotation across jobs, re-issue on worker
 death), tracker-launchable
-:class:`~dmlc_tpu.service.worker.ParseWorker` s run the existing
-parser/block-cache stack and stream parsed RowBlocks as length-prefixed
-CRC'd frames in the block-cache v1 segment encoding
-(:mod:`~dmlc_tpu.service.frame`), and the
-:class:`~dmlc_tpu.service.client.ServiceParser` is a drop-in parser with
-classified retry + worker failover that feeds ``DeviceIter`` unchanged.
+:class:`~dmlc_tpu.service.worker.ParseWorker` s multiplex every job
+through the existing parser/block-cache stack — sharing published
+artifacts cross-job by store signature, so one corpus parses once
+fleet-wide — and stream parsed RowBlocks as length-prefixed CRC'd
+frames in the block-cache v1 segment encoding
+(:mod:`~dmlc_tpu.service.frame`); the
+:class:`~dmlc_tpu.service.client.ServiceParser` is a job-bound drop-in
+parser with classified retry + worker failover that feeds ``DeviceIter``
+unchanged, and the
+:class:`~dmlc_tpu.service.autoscale.FleetAutoscaler` grows/drains the
+worker fleet from the jobs' aggregated input-wait signal.
 See docs/service.md.
 """
 
+from dmlc_tpu.service.autoscale import FleetAutoscaler
 from dmlc_tpu.service.client import ServiceParser
-from dmlc_tpu.service.dispatcher import Dispatcher
+from dmlc_tpu.service.dispatcher import (
+    DEFAULT_JOB,
+    Dispatcher,
+    ServiceConfigError,
+    register_job,
+)
 from dmlc_tpu.service.fleet import LocalFleet
 from dmlc_tpu.service.worker import ParseWorker
 
-__all__ = ["Dispatcher", "LocalFleet", "ParseWorker", "ServiceParser"]
+__all__ = ["DEFAULT_JOB", "Dispatcher", "FleetAutoscaler", "LocalFleet",
+           "ParseWorker", "ServiceConfigError", "ServiceParser",
+           "register_job"]

@@ -1,0 +1,288 @@
+"""Input-wait-driven fleet autoscaler: capacity follows starvation.
+
+The fleet-granularity reuse of the PR 10 feedback-controller pattern
+(AUTOTUNE, arXiv:2101.12127 — measure a starvation signal, move ONE knob
+one step, hold through hysteresis): where ``DeviceIter``'s controller
+moves pipeline knobs toward ``gap_stage == transfer``, this one moves
+the **parse-fleet worker count** toward "no trainer waits on input",
+which is the tf.data-service scaling thesis (arXiv:2210.14826 §3.3 —
+the input tier scales independently of the trainers).
+
+**Signal.** Each :class:`~dmlc_tpu.service.client.ServiceParser` labels
+its consumer-side wire wait with its job on the telemetry registry
+(``service_job_input_wait_seconds``); worker/trainer ranks ship that to
+the tracker over the PR 6 ``metrics`` heartbeat, and
+``RabitTracker.pod_job_metrics()`` sums it fleet-wide per job. The
+autoscaler's ``source`` callable returns that aggregate —
+``{job: cumulative input_wait_seconds}`` — each control tick; the
+per-tick delta divided by the tick interval is the job's **wait
+fraction** (~1.0 = the job's trainers are fully input-bound, ~0 = the
+fleet keeps up).
+
+**Control law** (one decision per tick, docs/service.md fleet
+autoscaling):
+
+- *per-job fairness*: the decision signal is the **max** wait fraction
+  over jobs, never the mean — a starved job cannot be drowned by a
+  greedy (or idle) sibling averaging it away; and because the
+  dispatcher's grant rotation is round-robin across jobs, capacity
+  added for the starved job actually reaches it.
+- *grow*: ``starved_frac > grow_frac`` for ``up_ticks`` CONSECUTIVE
+  ticks and the live fleet is under ``DMLC_TPU_FLEET_MAX`` -> one
+  worker live-joins (``LocalFleet.add_worker()``, the PR 13 join path),
+  counted as ``fleet_scale_ups``.
+- *shrink*: EVERY job's wait fraction < ``shrink_frac`` for
+  ``down_ticks`` consecutive ticks and the live fleet is over
+  ``DMLC_TPU_FLEET_MIN`` -> the most recently added worker drains
+  gracefully (notice -> no new grants -> serve out -> exit; departure
+  is safe by construction, PR 13), counted as ``fleet_scale_downs``.
+- *hysteresis*: the consecutive-tick requirements plus a
+  ``cooldown_ticks`` freeze after every scale event — capacity changes
+  take a while to show in the wait signal, and reacting to a stale
+  window is exactly the flapping the bench gate forbids
+  (``fleet_scale_events`` must be 0 on a clean run).
+
+Knobs ride the validated knob table (``DMLC_TPU_FLEET_MIN`` /
+``DMLC_TPU_FLEET_MAX`` / ``DMLC_TPU_FLEET_SCALE_INTERVAL``,
+:mod:`dmlc_tpu.utils.knobs`). The controller itself is deliberately
+transport-agnostic and test-drivable: construct with ``start=False``
+and call :meth:`step` directly, or ``start=True`` for the background
+tick thread a deployment runs.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, List, Optional
+
+from dmlc_tpu.io import resilience as _resilience
+from dmlc_tpu.utils import knobs as _knobs
+from dmlc_tpu.utils.check import check
+from dmlc_tpu.utils.timer import get_time
+
+logger = logging.getLogger("dmlc_tpu.service")
+
+# decision verdicts (the history records one per tick)
+GROW = "grow"
+SHRINK = "shrink"
+HOLD = "hold"
+
+HISTORY_LIMIT = 128
+
+
+class FleetAutoscaler:
+    """Grow/drain a :class:`~dmlc_tpu.service.fleet.LocalFleet` from the
+    aggregated per-job input-wait signal.
+
+    ``source`` returns ``{job: cumulative input_wait_seconds}`` (the
+    shape of ``RabitTracker.pod_job_metrics()`` flattened to the wait
+    values — a tracker is adapted automatically when passed as
+    ``tracker=``). ``min_workers`` / ``max_workers`` / ``interval``
+    default to the ``fleet_min`` / ``fleet_max`` /
+    ``fleet_scale_interval`` knob rows; explicit arguments win (tests
+    drive sub-second intervals).
+    """
+
+    def __init__(self, fleet,
+                 source: Optional[Callable[[], Dict[str, float]]] = None,
+                 tracker=None,
+                 min_workers: Optional[int] = None,
+                 max_workers: Optional[int] = None,
+                 interval: Optional[float] = None,
+                 grow_frac: float = 0.5,
+                 shrink_frac: float = 0.1,
+                 up_ticks: int = 2,
+                 down_ticks: int = 4,
+                 cooldown_ticks: int = 2,
+                 start: bool = False):
+        check(source is not None or tracker is not None
+              or getattr(fleet, "tracker", None) is not None,
+              "FleetAutoscaler needs an input-wait source: pass "
+              "source= (a {job: wait_seconds} callable) or tracker=, "
+              "or build the fleet with tracker=True "
+              "(docs/service.md fleet autoscaling)")
+        self.fleet = fleet
+        if source is None:
+            trk = tracker if tracker is not None else fleet.tracker
+
+            def source():
+                return {job: rec.get("input_wait_seconds", 0.0)
+                        for job, rec in trk.pod_job_metrics().items()}
+        self._source = source
+        self.min_workers = _knobs.resolve("fleet_min", min_workers)
+        self.max_workers = _knobs.resolve("fleet_max", max_workers)
+        check(self.min_workers <= self.max_workers,
+              f"fleet autoscaler bounds inverted: min {self.min_workers}"
+              f" > max {self.max_workers} (check the DMLC_TPU_FLEET_MIN/"
+              f"MAX pair)")
+        self.interval = (float(interval) if interval is not None
+                         else float(_knobs.resolve("fleet_scale_interval")))
+        check(self.interval > 0,
+              f"fleet autoscaler interval {self.interval} must be > 0")
+        self.grow_frac = float(grow_frac)
+        self.shrink_frac = float(shrink_frac)
+        self.up_ticks = int(up_ticks)
+        self.down_ticks = int(down_ticks)
+        self.cooldown_ticks = int(cooldown_ticks)
+        self._last: Optional[Dict[str, float]] = None
+        self._last_t: Optional[float] = None
+        self._starved_streak = 0
+        self._idle_streak = 0
+        self._cooldown = 0
+        self.ticks = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        # workers this controller added, newest last — shrink drains
+        # these first (LIFO), so operator-provisioned baseline capacity
+        # outlives elastic capacity
+        self._added: List[object] = []
+        self.history: List[dict] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="fleet-autoscaler")
+            self._thread.start()
+
+    # ---------------- control loop ----------------
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.step()
+            except Exception as exc:  # noqa: BLE001 - the controller
+                # must never take the fleet down with it: a failed tick
+                # (tracker hiccup, fleet mid-close) logs and the next
+                # tick retries
+                logger.warning("fleet autoscaler: tick failed: %s", exc)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    # ---------------- one decision ----------------
+
+    def _live_count(self) -> int:
+        return len(self.fleet.live_workers())
+
+    def step(self, now: Optional[float] = None) -> dict:
+        """One control tick: read the signal, compute per-job wait
+        fractions for the window since the last tick, and make at most
+        one scale decision. Returns the decision record (also appended
+        to :attr:`history`)."""
+        now = get_time() if now is None else float(now)
+        waits = {str(j): float(v)
+                 for j, v in (self._source() or {}).items()}
+        if self._last is None or self._last_t is None:
+            # first tick primes the window — no decision can be made
+            # from a cumulative counter without a delta
+            self._last, self._last_t = waits, now
+            return self._record(HOLD, {}, "priming window")
+        window = max(now - self._last_t, 1e-9)
+        fracs = {}
+        for job, total in waits.items():
+            delta = max(0.0, total - self._last.get(job, 0.0))
+            fracs[job] = min(1.0, delta / window)
+        self._last, self._last_t = waits, now
+        self.ticks += 1
+        # per-job fairness: the decision signal is the WORST-OFF job
+        starved_frac = max(fracs.values(), default=0.0)
+        starved_job = max(fracs, key=fracs.get) if fracs else None
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            self._starved_streak = 0
+            self._idle_streak = 0
+            return self._record(HOLD, fracs,
+                                f"cooldown ({self._cooldown} left)")
+        if starved_frac > self.grow_frac:
+            self._starved_streak += 1
+            self._idle_streak = 0
+        elif fracs and starved_frac < self.shrink_frac:
+            self._idle_streak += 1
+            self._starved_streak = 0
+        else:
+            self._starved_streak = 0
+            self._idle_streak = 0
+        live = self._live_count()
+        if self._starved_streak >= self.up_ticks:
+            if live >= self.max_workers:
+                return self._record(
+                    HOLD, fracs, f"starved (job {starved_job} at "
+                    f"{starved_frac:.2f}) but at fleet_max "
+                    f"{self.max_workers}")
+            return self._grow(fracs, starved_job, starved_frac, live)
+        if self._idle_streak >= self.down_ticks:
+            if live <= self.min_workers:
+                return self._record(
+                    HOLD, fracs, f"idle but at fleet_min "
+                    f"{self.min_workers}")
+            return self._shrink(fracs, live)
+        return self._record(HOLD, fracs, "within hysteresis band")
+
+    def _grow(self, fracs: dict, job: Optional[str], frac: float,
+              live: int) -> dict:
+        worker = self.fleet.add_worker()
+        self._added.append(worker)
+        self.scale_ups += 1
+        self._starved_streak = 0
+        self._cooldown = self.cooldown_ticks
+        _resilience.record_event("fleet_scale_ups")
+        logger.warning(
+            "fleet autoscaler: job %s input-wait frac %.2f > %.2f — "
+            "grew fleet %d -> %d (worker %s live-joined)", job, frac,
+            self.grow_frac, live, live + 1, worker.worker_id)
+        return self._record(GROW, fracs,
+                            f"job {job} wait frac {frac:.2f}",
+                            worker=worker.worker_id)
+
+    def _shrink(self, fracs: dict, live: int) -> dict:
+        # drain elastic capacity LIFO; fall back to the fleet's newest
+        # live worker when the controller added none (operator scaled
+        # by hand, controller drains back)
+        victim = None
+        while self._added and victim is None:
+            cand = self._added.pop()
+            if cand in self.fleet.live_workers():
+                victim = cand
+        if victim is None:
+            victim = self.fleet.live_workers()[-1]
+        victim.drain(reason="fleet autoscaler shrink")
+        self.scale_downs += 1
+        self._idle_streak = 0
+        self._cooldown = self.cooldown_ticks
+        _resilience.record_event("fleet_scale_downs")
+        logger.warning(
+            "fleet autoscaler: all jobs idle — draining worker %s "
+            "(%d -> %d)", victim.worker_id, live, live - 1)
+        return self._record(SHRINK, fracs, "all jobs under "
+                            f"{self.shrink_frac:.2f}",
+                            worker=victim.worker_id)
+
+    def _record(self, action: str, fracs: dict, why: str,
+                worker: Optional[str] = None) -> dict:
+        rec = {"action": action,
+               "wait_fracs": {j: round(f, 4) for j, f in fracs.items()},
+               "fleet_size": self._live_count(),
+               "why": why}
+        if worker is not None:
+            rec["worker"] = worker
+        self.history.append(rec)
+        if len(self.history) > HISTORY_LIMIT:
+            del self.history[:len(self.history) - HISTORY_LIMIT]
+        return rec
+
+    def snapshot(self, history: int = 16) -> dict:
+        """The controller's decision record (operators/bench): bounds,
+        tick/scale tallies, and the recent decision history."""
+        return {
+            "min_workers": self.min_workers,
+            "max_workers": self.max_workers,
+            "interval": self.interval,
+            "ticks": self.ticks,
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "fleet_size": self._live_count(),
+            "history": list(self.history[-history:]),
+        }

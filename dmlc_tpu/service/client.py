@@ -6,6 +6,19 @@ contract against a dispatcher address, so it feeds ``DeviceIter`` (and
 ``create_parser(service=...)`` / ``create_row_block_iter(service=...)``
 or a ``#service=<host:port>`` URI suffix.
 
+**Job identity** (docs/service.md multi-tenant service): the client
+binds to ONE registered job (``job=``, default ``"default"`` — the
+dispatcher-constructor dataset), carries it on every control RPC and
+stream request, stamps it into checkpoints (a state restored into a
+client bound to a different job fails loudly — positions are only
+meaningful within one job's part-major order), and labels its
+consumer-side input wait with it on the telemetry registry
+(``service_job_input_wait_seconds``), which is the per-job signal the
+fleet autoscaler aggregates from the tracker pod table
+(docs/observability.md). Streams are byte-identical PER JOB: a job's
+delivered blocks match its single-job run exactly, whatever other jobs
+share the fleet or the underlying cached artifacts.
+
 Delivery order is **part-major**: part 0's blocks, then part 1's, ...
 — exactly the stream a single host produces looping
 ``create_parser(uri, p, num_parts)`` for ``p`` in order with the same
@@ -63,6 +76,8 @@ from dmlc_tpu.data.row_block import DenseBlock, RowBlock
 from dmlc_tpu.io import faults as _faults
 from dmlc_tpu.io import resilience as _resilience
 from dmlc_tpu.service import dispatcher as _dispatch
+from dmlc_tpu.service.dispatcher import DEFAULT_JOB
+from dmlc_tpu.utils import telemetry as _telemetry
 from dmlc_tpu.service.frame import (
     KIND_BLOCK,
     KIND_END,
@@ -95,12 +110,19 @@ class ServiceParser(Parser):
     one part-major visitation; ``before_first`` rewinds to part 0 —
     workers re-serve from their frame stores, nothing re-parses)."""
 
-    def __init__(self, service: str,
+    def __init__(self, service: str, job: str = DEFAULT_JOB,
                  retry_policy: Optional["_resilience.RetryPolicy"] = None,
                  connect_timeout: float = 10.0,
                  stream_timeout: float = 300.0):
         self.service = service
+        self.job = str(job)
         self._policy = retry_policy or _resilience.default_policy()
+        # consumer-side input wait, labeled by job: every second this
+        # client waits on the service's wire is the job's starvation
+        # signal — summed fleet-wide by the autoscaler via the tracker
+        # pod table (docs/service.md fleet autoscaling)
+        self._wait_metric = _telemetry.REGISTRY.counter(
+            _telemetry.SERVICE_JOB_WAIT_METRIC, job=self.job)
         self._connect_timeout = float(connect_timeout)
         # idle timeout on an ESTABLISHED stream, deliberately much larger
         # than the policy's attempt timeout: a worker mid-parse (slow
@@ -113,7 +135,7 @@ class ServiceParser(Parser):
         # dispatcher_restarts, after which the (part, block) cursor is
         # revalidated by the next locate and the epoch rides through
         self._gen: Optional[int] = None
-        cfg = self._control({"cmd": "config"})
+        cfg = self._control({"cmd": "config", "job": self.job})
         self.uri = cfg["uri"]
         self.num_parts = int(cfg["num_parts"])
         self.parser_config = dict(cfg.get("parser") or {})
@@ -224,7 +246,7 @@ class ServiceParser(Parser):
         worker must surface, not spin forever."""
         deadline = get_time() + self._policy.attempt_timeout
         while not self._closed.is_set():
-            req = {"cmd": "locate", "part": self._part}
+            req = {"cmd": "locate", "part": self._part, "job": self.job}
             if self._last_located is not None:
                 # tell the dispatcher which owner we were on: a draining
                 # re-assignment comes back as a `moved` hint, so the
@@ -260,7 +282,8 @@ class ServiceParser(Parser):
             (owner["host"], int(owner["port"])),
             timeout=self._connect_timeout)
         sock.settimeout(self._stream_timeout)
-        req = {"cmd": "stream", "part": self._part, "start": self._pos}
+        req = {"cmd": "stream", "part": self._part, "start": self._pos,
+               "job": self.job}
         if self.snapshot:
             req["snapshot"] = True
         sock.sendall(json.dumps(req).encode() + b"\n")
@@ -319,10 +342,14 @@ class ServiceParser(Parser):
                 # torn dispatcher replies arrive as ConnectionError —
                 # dispatcher.request classifies them centrally, so no
                 # call-site ValueError special case survives here
-                self._recv_seconds += get_time() - t0
+                dt = get_time() - t0
+                self._recv_seconds += dt
+                self._wait_metric.inc(dt)
                 self._on_stream_fault(exc)
                 continue
-            self._recv_seconds += get_time() - t0
+            dt = get_time() - t0
+            self._recv_seconds += dt
+            self._wait_metric.inc(dt)
             if kind == KIND_BLOCK:
                 t1 = get_time()
                 block = block_from_frame(meta, payload)
@@ -418,7 +445,7 @@ class ServiceParser(Parser):
         _resilience.record_event("drain_handoffs")
         try:
             self._control({"cmd": "handoff", "part": int(part),
-                           "worker": worker})
+                           "worker": worker, "job": self.job})
         except (OSError, DMLCError, ValueError):
             pass  # deadline backstop covers it
 
@@ -439,9 +466,11 @@ class ServiceParser(Parser):
 
     def state_dict(self) -> dict:
         """O(1) resume point: the next (part, block) to deliver —
-        restorable into a fresh client against the same service."""
-        return {"kind": "service", "part": self._part, "block": self._pos,
-                "blocks": self._delivered}
+        restorable into a fresh client against the same service AND the
+        same job (positions are only meaningful within one job's
+        part-major order, so the job rides the state)."""
+        return {"kind": "service", "job": self.job, "part": self._part,
+                "block": self._pos, "blocks": self._delivered}
 
     def _part_query(self, part: int, req: dict) -> dict:
         """One JSON request to the worker serving ``part`` (find/count),
@@ -456,8 +485,8 @@ class ServiceParser(Parser):
                 timeout=self._connect_timeout)
             try:
                 sock.settimeout(self._stream_timeout)
-                sock.sendall(json.dumps(dict(req, part=part)).encode()
-                             + b"\n")
+                sock.sendall(json.dumps(
+                    dict(req, part=part, job=self.job)).encode() + b"\n")
                 with sock.makefile("rb") as f:
                     line = f.readline()
             finally:
@@ -522,6 +551,18 @@ class ServiceParser(Parser):
                 f"'service' states only, got kind {kind!r} "
                 "(docs/service.md snapshot frames)")
         if kind == "service":
+            # legacy job-less states were written against the default
+            # job — defaulting to self.job would let them restore into
+            # ANY job-bound client and silently serve the wrong data
+            state_job = str(state.get("job", DEFAULT_JOB))
+            if state_job != self.job:
+                # a (part, block) cursor is a position in ONE job's
+                # part-major order — restoring it into another job would
+                # silently serve the wrong data
+                raise DMLCError(
+                    f"service checkpoint belongs to job {state_job!r}, "
+                    f"this client is bound to job {self.job!r} "
+                    f"(docs/service.md multi-tenant service)")
             self._part = int(state["part"])
             self._pos = int(state["block"])
             self._delivered = int(state.get(

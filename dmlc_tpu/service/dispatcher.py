@@ -1,20 +1,41 @@
-"""Data-service dispatcher: split assignment + worker registry.
+"""Data-service dispatcher: multi-job split assignment + worker registry.
 
 The control plane of the disaggregated RowBlock service (tf.data
-service's dispatcher role, arXiv:2210.14826 §3): it owns ONE dataset —
-a URI, its partition count, and the parser config every worker must use
-— and hands the ``num_parts`` :class:`~dmlc_tpu.io.input_split.InputSplit`
-partitions to parse workers **first-come-first-served, exactly once per
-epoch**. A split is re-issued only when its owner is declared dead (a
-client reported a broken stream, or heartbeats went stale), and re-issued
-splits jump the queue so a mid-stream failover heals before new work
-starts.
+service's dispatcher role, arXiv:2210.14826 §3): it owns a registry of
+**jobs** — each a dataset URI, its partition count, and the parser
+config every worker must use for it — and hands each job's ``num_parts``
+:class:`~dmlc_tpu.io.input_split.InputSplit` partitions to parse workers
+**exactly once per epoch**, rotating grants round-robin across jobs with
+pending work so one greedy job can never starve another (per-job
+fairness; docs/service.md multi-tenant service). A split is re-issued
+only when its owner is declared dead (a client reported a broken stream,
+or heartbeats went stale), and re-issued splits jump their job's queue so
+a mid-stream failover heals before new work starts.
+
+One dispatcher, MANY trainers: the constructor's ``uri``/``num_parts``
+register the backward-compatible ``default`` job, and ``register_job``
+(RPC or :meth:`Dispatcher.register_job`) adds more at any point — each
+with its own parser config, epoch-plan identity, and snapshot geometry.
+With ``share_dir=`` set, jobs that do not pin their own ``block_cache``
+are assigned one keyed by the job's **store signature** (a digest of
+``uri + num_parts + parser config``): two jobs over the same corpus with
+the same config resolve to the SAME published ``DMLCBC01`` artifacts
+through the PR 11 store manifest, so the fleet parses that corpus
+exactly once — the second job's parts serve warm (docs/store.md
+share-by-signature).
 
 Protocol: one JSON object per connection (newline-terminated request,
 newline-terminated response — the same short-lived-connection shape the
-rabit tracker uses for ``heartbeat``/``metrics``). Commands:
+rabit tracker uses for ``heartbeat``/``metrics``). Commands (``job``
+defaults to ``"default"`` wherever it appears, so the one-dataset
+protocol of PR 7-14 is a strict subset):
 
-``config``                      -> the dataset spec workers/clients parse
+``config [job]``                -> the job's dataset spec
+``register_job job uri num_parts [parser plan snapshot]``
+                                -> add a job to the registry (idempotent
+                                   for an identical spec; a conflicting
+                                   spec for an existing job is refused —
+                                   job identity is immutable)
 ``register worker host port``   -> join the fleet (re-registration of a
                                    worker already seen alive THIS
                                    generation is treated as a crash-
@@ -32,42 +53,57 @@ rabit tracker uses for ``heartbeat``/``metrics``). Commands:
                                    confirm ``handoff`` or the drain
                                    deadline expires (docs/service.md
                                    elastic membership)
-``handoff worker part``         -> a client confirms it finished
+``handoff worker part [job]``   -> a client confirms it finished
                                    streaming ``part`` from the draining
                                    ``worker``; when every served part is
                                    confirmed the drain completes early
-``next_split worker``           -> ``{"part": k}`` | ``{"part": null}``
-                                   (nothing to do) — doubles as liveness
+``next_split worker``           -> ``{"part": k, "job": j}`` |
+                                   ``{"part": null}`` (nothing to do) —
+                                   doubles as liveness
 ``heartbeat worker``            -> liveness only
-``locate part``                 -> ``{"worker", "host", "port"}`` of the
+``locate part [job]``           -> ``{"worker", "host", "port"}`` of the
                                    live owner, or ``{"wait": true}`` while
                                    the part awaits (re)assignment
 ``report_lost worker``          -> a client observed the worker dead: all
-                                   its parts re-queue at the FRONT
-``part_done part worker``       -> the owner finished parsing the part
+                                   its parts (every job) re-queue at the
+                                   FRONT
+``part_done part worker [job]`` -> the owner finished parsing the part
                                    (journaled: a restarted dispatcher
                                    keeps it done instead of re-issuing)
 ``reclaim worker parts``        -> the worker re-announces the fully-
                                    parsed parts its frame store still
-                                   holds: a restarted dispatcher ADOPTS
-                                   them (no fleet-wide re-parse), and
-                                   journal-complete parts the worker no
-                                   longer holds re-queue
-``status``                      -> registry snapshot (tests, operators)
+                                   holds (a flat list for the default
+                                   job, or ``{job: [parts]}``): a
+                                   restarted dispatcher ADOPTS them (no
+                                   fleet-wide re-parse), and journal-
+                                   complete parts the worker no longer
+                                   holds re-queue
+``status``                      -> registry snapshot (tests, operators);
+                                   legacy top-level assignment fields
+                                   mirror the default job, ``jobs``
+                                   carries every job's state
 
 Every response is stamped with the dispatcher's monotonic ``gen``
 generation token, so workers and clients detect a restart at their next
 control exchange (docs/service.md control-plane recovery).
 
 **Crash recovery**: with ``journal_path=`` set, every state transition —
-dataset registration, worker register/death, part grant / complete /
+job registration, worker register/death, part grant / complete /
 re-issue / reclaim — is appended to a flock'd JSONL journal (the shared
 :class:`~dmlc_tpu.store.journal.AppendJournal` substrate: torn-tail skip
-at replay, atomic compaction). A restarted ``Dispatcher(journal_path=
-...)`` replays it into the exact assignment state: **completed parts
-stay done** (their owners get a liveness grace window to re-attach),
-**in-flight parts re-queue at the front**, and the generation token
-bumps so the fleet re-registers and reclaims. The journal records no
+at replay, atomic compaction). Events are job-scoped (``job`` rides
+every assignment event of a non-default job; default-job events keep
+the exact PR 12 shapes, so legacy journals replay unchanged). A
+restarted ``Dispatcher(journal_path=...)`` replays into the exact
+per-job assignment state: **completed parts stay done** (their owners
+get a liveness grace window to re-attach), **in-flight parts re-queue
+at the front**, registered jobs come back with their full spec, and the
+generation token bumps so the fleet re-registers and reclaims. A journal
+that records a DIFFERENT dataset than the constructor supplies is a
+**fatal, non-retryable configuration error**
+(:class:`ServiceConfigError`): recovery must never silently serve the
+wrong corpus, and retrying cannot fix a disagreement between the journal
+on disk and the code constructing the dispatcher. The journal records no
 epoch state by design: epochs live with clients and worker frame stores
 (``before_first`` re-serves without dispatcher involvement), so the
 assignment journal is epoch-invariant.
@@ -84,15 +120,17 @@ failover happens before the socket dies); ``DEAD`` is terminal (stale
 heartbeats, ``report_lost``, or a completed drain). Transitions journal,
 so membership state survives dispatcher restarts.
 
-**Straggler hedging**: the dispatcher tracks per-part grant->complete
-latency; once at least :data:`HEDGE_MIN_SAMPLES` parts have completed,
-an in-flight part stuck past ``DMLC_TPU_HEDGE_FACTOR`` times the fleet
-median (and past :data:`HEDGE_MIN_AGE_S`) is **speculatively re-issued**
-to a second active worker (journaled ``spec_grant``,
-``speculative_reissues``). First ``part_done`` wins — a win by the
-speculative worker counts ``speculative_wins`` and flips ``locate`` to
-the winner; the loser's completion is deduped (exactly-once preserved:
-parsing is deterministic, so either stream is byte-identical).
+**Straggler hedging**: the dispatcher tracks per-job, per-part
+grant->complete latency; once at least :data:`HEDGE_MIN_SAMPLES` parts
+of a job have completed, an in-flight part stuck past
+``DMLC_TPU_HEDGE_FACTOR`` times that job's median (and past
+:data:`HEDGE_MIN_AGE_S`) is **speculatively re-issued** to a second
+active worker (journaled ``spec_grant``, ``speculative_reissues``).
+First ``part_done`` wins — a win by the speculative worker counts
+``speculative_wins`` and flips ``locate`` to the winner; the loser's
+completion is deduped (exactly-once preserved: parsing is
+deterministic, so either stream is byte-identical). Medians are per job
+so a slow-corpus job can never poison a fast job's hedge threshold.
 
 A background **reaper tick thread** (interval derived from
 ``liveness_timeout``) drives liveness, drain deadlines, and the hedging
@@ -102,39 +140,47 @@ stragglers.
 
 The dispatcher is deliberately dataset-state-free about *blocks*: block
 ordering, resume, and exactly-once delivery live with the client (global
-order is part-major), so the dispatcher never becomes a data-plane
-bottleneck — it serves O(workers + failovers) tiny requests per epoch.
-Concurrent connection handlers are capped (``DMLC_TPU_DISPATCH_WORKERS``
-via the knob table); excess connections shed with a retryable ``busy``
-reply, so a reconnect storm from a recovering fleet cannot exhaust
-threads exactly when the dispatcher must stay responsive.
+order is part-major per job), so the dispatcher never becomes a
+data-plane bottleneck — it serves O(jobs × (workers + failovers)) tiny
+requests per epoch. Concurrent connection handlers are capped
+(``DMLC_TPU_DISPATCH_WORKERS`` via the knob table); excess connections
+shed with a retryable ``busy`` reply, so a reconnect storm from a
+recovering fleet cannot exhaust threads exactly when the dispatcher must
+stay responsive.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import os
 import socket
 import statistics
 import threading
 from collections import deque
-from typing import Deque, Dict, List, Optional, Set
+from typing import Deque, Dict, List, Optional, Set, Tuple
 
 from dmlc_tpu.io import faults as _faults
 from dmlc_tpu.io import resilience as _resilience
 from dmlc_tpu.store import journal as _journal_mod
 from dmlc_tpu.store.journal import AppendJournal
+from dmlc_tpu.store.manager import signature_hash
 from dmlc_tpu.utils import knobs as _knobs
-from dmlc_tpu.utils.check import check
+from dmlc_tpu.utils.check import DMLCError, check
 from dmlc_tpu.utils.timer import get_time
 
 logger = logging.getLogger("dmlc_tpu.service")
 
+# the job the one-dataset constructor/protocol of PR 7-14 maps onto:
+# requests without a `job` field, journal events without one, and the
+# legacy reply shapes all refer to this job
+DEFAULT_JOB = "default"
+
 # journal compaction threshold: past this many lines at replay the
-# journal is rewritten as the live state (dataset + start + registers +
+# journal is rewritten as the live state (jobs + start + registers +
 # grant/complete pairs). Assignment journals are naturally small —
-# O(parts + workers + failovers), epochs append nothing — so this only
-# triggers after many restart cycles.
+# O(jobs × parts + workers + failovers), epochs append nothing — so this
+# only triggers after many restart cycles.
 JOURNAL_COMPACT_LINES = 4096
 
 # worker lifecycle states (docs/service.md elastic membership)
@@ -144,16 +190,28 @@ DRAINING = "draining"    # no new grants; serving until handoff/deadline
 DEAD = "dead"            # terminal
 
 # straggler hedging guards: never hedge before this many completion
-# latency samples exist (a 2-part dataset can never produce a meaningful
-# median), and never hedge a part younger than this wall-clock floor —
-# hedging targets seconds-scale stalls, and the floor must sit well
-# above any plausible healthy-part latency (a loaded CI host pausing a
-# smoke-scale part for a second must not fire a speculative parse, or
-# the bench-smoke zero gate on `speculative_reissues` turns flaky)
+# latency samples exist for the part's JOB (a 2-part dataset can never
+# produce a meaningful median), and never hedge a part younger than this
+# wall-clock floor — hedging targets seconds-scale stalls, and the floor
+# must sit well above any plausible healthy-part latency (a loaded CI
+# host pausing a smoke-scale part for a second must not fire a
+# speculative parse, or the bench-smoke zero gate on
+# `speculative_reissues` turns flaky)
 HEDGE_MIN_SAMPLES = 3
 HEDGE_MIN_AGE_S = 5.0
-# completion-latency window the fleet median is computed over
+# completion-latency window each job's hedging median is computed over
 HEDGE_LATENCY_WINDOW = 64
+
+
+class ServiceConfigError(DMLCError):
+    """Fatal service-configuration disagreement: the assignment journal
+    (or the live job registry) records a dataset identity that
+    contradicts what the caller supplies. Deliberately NOT retryable —
+    :func:`dmlc_tpu.io.resilience.classify` reads it as ``fatal``
+    (no transient cause is chained on), because re-attempting cannot
+    reconcile a journal on disk with conflicting constructor arguments;
+    the operator must either point the dispatcher at the dataset the
+    journal records or at a fresh ``journal_path``."""
 
 
 class _WorkerInfo:
@@ -177,7 +235,8 @@ class _WorkerInfo:
         self.state = state or (ACTIVE if registered_gen is not None
                                else JOINING)
         self.drain_deadline: Optional[float] = None
-        self.handed_off: Set[int] = set()
+        # (job, part) pairs clients confirmed streaming from a drainer
+        self.handed_off: Set[Tuple[str, int]] = set()
         # True only for a worker whose DRAIN completed (handoffs
         # confirmed or deadline expired): its next poll reads `drained`
         # and exits instead of re-attaching as a zombie
@@ -188,8 +247,78 @@ class _WorkerInfo:
         return self.state != DEAD
 
 
+class _JobState:
+    """One registered job: its immutable dataset spec plus the mutable
+    assignment state (FCFS queue, grants, completions, hedging books)
+    the dispatcher serves it from."""
+
+    __slots__ = ("job", "uri", "num_parts", "parser", "plan", "snapshot",
+                 "share_sig", "todo", "assigned", "completed",
+                 "clients_active", "grant_times", "latencies", "spec",
+                 "spec_times", "hedge_todo")
+
+    def __init__(self, job: str, uri: str, num_parts: int,
+                 parser: Optional[dict] = None,
+                 plan: Optional[dict] = None,
+                 snapshot: Optional[dict] = None,
+                 share_sig: Optional[str] = None):
+        self.job = str(job)
+        self.uri = uri
+        self.num_parts = int(num_parts)
+        self.parser = dict(parser or {})
+        # the epoch-plan identity of the job (shuffle_seed /
+        # shuffle_window, dmlc_tpu/data/epoch.py): shipped in `config` so
+        # every worker arms its block cache with the SAME plan and every
+        # client learns the seed its epochs are a function of — the one
+        # place each job's shuffle is decided (docs/service.md)
+        self.plan = dict(plan or {})
+        # snapshot-frame geometry ({batch_size, num_col, x_dtype}): when
+        # set, workers ALSO pack this job's parts into fixed-geometry
+        # device-layout batches (dmlc_tpu/io/snapshot.py encoding) and
+        # clients stream those instead of CSR blocks — per job, so a
+        # bf16-wire trainer and a CSR trainer can share one fleet
+        self.snapshot = dict(snapshot or {})
+        # the job's store signature when share-by-signature resolved its
+        # block cache (None for jobs that pinned their own or share_dir
+        # is off) — surfaced in status for operators/tests
+        self.share_sig = share_sig
+        # FCFS visitation queue: parts not yet assigned this epoch.
+        # Re-issued parts (dead owner) go to the FRONT so failover work
+        # heals before fresh parts are handed out.
+        self.todo: Deque[int] = deque(range(self.num_parts))
+        self.assigned: Dict[int, str] = {}   # part -> worker id
+        self.completed: Set[int] = set()     # parts whose parse finished
+        # True once a client has located a part of this job: a brand-new
+        # worker id registering after any job saw a client is a
+        # mid-epoch LIVE JOIN (worker_joins)
+        self.clients_active = False
+        # per-part grant timestamps (in-flight ages) and this job's
+        # recent grant->complete latencies (the hedging median)
+        self.grant_times: Dict[int, float] = {}
+        self.latencies: Deque[float] = deque(maxlen=HEDGE_LATENCY_WINDOW)
+        # part -> second (speculative) owner; the primary stays in
+        # `assigned` until one of them completes (first part_done wins)
+        self.spec: Dict[int, str] = {}
+        self.spec_times: Dict[int, float] = {}
+        # parts flagged for speculative re-issue, awaiting a poll from a
+        # worker that is not the stuck primary
+        self.hedge_todo: Deque[int] = deque()
+
+    def spec_dict(self) -> dict:
+        """The wire-shape dataset spec (`config` reply sans job key)."""
+        return {"uri": self.uri, "num_parts": self.num_parts,
+                "parser": self.parser, "plan": self.plan,
+                "snapshot": self.snapshot}
+
+
 class Dispatcher:
-    """Split-assignment server for one dataset.
+    """Split-assignment server for N registered jobs.
+
+    The constructor's ``uri``/``num_parts``/``parser``/``plan``/
+    ``snapshot`` register the ``default`` job (the PR 7-14 one-dataset
+    protocol is a strict subset of the multi-tenant one); more jobs
+    arrive via :meth:`register_job` / the ``register_job`` RPC. ``uri``
+    may be None for a dispatcher born empty (jobs registered later).
 
     ``parser`` is the config dict every worker builds its parser from
     (``format``/``type_``, ``chunk_bytes``, ``threaded``, ... — the
@@ -199,68 +328,45 @@ class Dispatcher:
     declares a worker dead when its polls/heartbeats go stale; client
     ``report_lost`` reports short-circuit that wait.
 
+    ``share_dir`` arms cross-job artifact sharing: a registering job
+    whose parser config carries no ``block_cache`` is assigned one at
+    ``share_dir/svc-<signature>.bc`` where the signature digests the
+    job's dataset identity (uri + num_parts + parser config), so jobs
+    over the same corpus with the same config converge on the same
+    published ``DMLCBC01`` artifacts and the fleet parses that corpus
+    exactly once (docs/store.md share-by-signature).
+
     ``journal_path`` arms crash recovery: state transitions journal to
     an append-only JSONL file and a restart on the same address replays
     them (see the module docstring). Without it the dispatcher is the
     historical in-memory-only control plane (generation fixed at 1).
     """
 
-    def __init__(self, uri: str, num_parts: int,
+    def __init__(self, uri: Optional[str] = None, num_parts: int = 0,
                  parser: Optional[dict] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  liveness_timeout: float = 10.0,
                  plan: Optional[dict] = None,
                  snapshot: Optional[dict] = None,
                  journal_path: Optional[str] = None,
-                 journal_compact_lines: int = JOURNAL_COMPACT_LINES):
-        self.uri = uri
-        self.num_parts = int(num_parts)
-        self.parser = dict(parser or {})
-        # the epoch-plan identity of the dataset (shuffle_seed /
-        # shuffle_window, dmlc_tpu/data/epoch.py): shipped in `config` so
-        # every worker arms its block cache with the SAME plan and every
-        # client learns the seed its epochs are a function of — the one
-        # place the fleet's shuffle is decided (docs/service.md)
-        self.plan = dict(plan or {})
-        # snapshot-frame geometry ({batch_size, num_col, x_dtype}): when
-        # set, workers ALSO pack each part into fixed-geometry device-
-        # layout batches (dmlc_tpu/io/snapshot.py encoding) and clients
-        # stream those instead of CSR blocks — x_dtype='bfloat16' halves
-        # the wire bytes. One dispatcher-owned knob, like the plan: the
-        # whole fleet serves one batch geometry or none (docs/service.md)
-        self.snapshot = dict(snapshot or {})
+                 journal_compact_lines: int = JOURNAL_COMPACT_LINES,
+                 share_dir: Optional[str] = None):
         self.liveness_timeout = float(liveness_timeout)
+        self.share_dir = share_dir
+        if share_dir:
+            os.makedirs(share_dir, exist_ok=True)
         self._lock = threading.Lock()
         self._workers: Dict[str, _WorkerInfo] = {}
-        # FCFS visitation queue: parts not yet assigned this epoch.
-        # Re-issued parts (dead owner) go to the FRONT so failover work
-        # heals before fresh parts are handed out.
-        self._todo: Deque[int] = deque(range(self.num_parts))
-        self._assigned: Dict[int, str] = {}   # part -> worker id
-        self._completed: Set[int] = set()     # parts whose parse finished
-        # ---- elastic membership + hedging state ----
-        # True once a client has located a part: a brand-new worker id
-        # registering after that point is a mid-epoch LIVE JOIN
-        # (worker_joins) — capacity added under load. Grant activity
-        # alone does not qualify: fleet bootstrap interleaves sibling
-        # registrations with the first workers' polls, and those are
-        # founding members, not joins.
-        self._clients_active = False
-        # per-part grant timestamps (in-flight ages) and the fleet's
-        # recent grant->complete latencies (the hedging median)
-        self._grant_times: Dict[int, float] = {}
-        self._latencies: Deque[float] = deque(maxlen=HEDGE_LATENCY_WINDOW)
-        # part -> second (speculative) owner; the primary stays in
-        # _assigned until one of them completes (first part_done wins).
-        # _spec_times stamps the speculative grant so a win's latency
-        # sample measures the HEDGE parse — sampling from the stuck
-        # primary's grant would append > threshold by construction and
-        # progressively desensitize the median
-        self._spec: Dict[int, str] = {}
-        self._spec_times: Dict[int, float] = {}
-        # parts flagged for speculative re-issue, awaiting a poll from a
-        # worker that is not the stuck primary
-        self._hedge_todo: Deque[int] = deque()
+        # the job registry, insertion-ordered (the grant rotation walks
+        # it round-robin); the constructor's dataset is the default job
+        self._jobs: Dict[str, _JobState] = {}
+        self._rr = 0  # grant-rotation cursor over the job order
+        if uri is not None:
+            check(int(num_parts) >= 1,
+                  f"Dispatcher: num_parts {num_parts} must be >= 1 for "
+                  f"dataset {uri!r}")
+            self._jobs[DEFAULT_JOB] = self._make_job(
+                DEFAULT_JOB, uri, int(num_parts), parser, plan, snapshot)
         self._hedge_factor = _knobs.resolve("hedge_factor")
         self._drain_deadline_s = float(_knobs.resolve("drain_deadline"))
         self.generation = 1
@@ -307,50 +413,249 @@ class Dispatcher:
             target=self._tick_loop, daemon=True,
             name="service-dispatcher-tick")
         self._tick_thread.start()
-        logger.info("dispatcher for %s (%d parts) on %s:%d gen %d",
-                    uri, num_parts, self.host, self.port, self.generation)
+        logger.info("dispatcher (%d job(s): %s) on %s:%d gen %d",
+                    len(self._jobs),
+                    ", ".join(f"{j.job}={j.uri}({j.num_parts})"
+                              for j in self._jobs.values()) or "none",
+                    self.host, self.port, self.generation)
+
+    # ---------------- default-job compatibility views ----------------
+
+    def _default(self) -> Optional[_JobState]:
+        return self._jobs.get(DEFAULT_JOB)
+
+    @property
+    def uri(self) -> Optional[str]:
+        job = self._default()
+        return job.uri if job is not None else None
+
+    @property
+    def num_parts(self) -> int:
+        job = self._default()
+        return job.num_parts if job is not None else 0
+
+    @property
+    def parser(self) -> dict:
+        job = self._default()
+        return job.parser if job is not None else {}
+
+    @property
+    def plan(self) -> dict:
+        job = self._default()
+        return job.plan if job is not None else {}
+
+    @property
+    def snapshot(self) -> dict:
+        job = self._default()
+        return job.snapshot if job is not None else {}
 
     @property
     def address(self) -> str:
         return f"{self.host}:{self.port}"
+
+    @property
+    def jobs(self) -> List[str]:
+        """Registered job names, grant-rotation order."""
+        with self._lock:
+            return list(self._jobs)
+
+    # ---------------- job registry ----------------
+
+    def _make_job(self, job: str, uri: str, num_parts: int,
+                  parser: Optional[dict], plan: Optional[dict],
+                  snapshot: Optional[dict],
+                  share_sig: Optional[str] = None) -> _JobState:
+        """Build a _JobState, resolving the share-by-signature block
+        cache when armed: a job without its own ``block_cache`` gets one
+        keyed by its dataset identity, so identical jobs converge on the
+        same published artifacts (store manifest sharing)."""
+        cfg = dict(parser or {})
+        if self.share_dir and not cfg.get("block_cache"):
+            share_sig = signature_hash(
+                {"uri": uri, "num_parts": int(num_parts), "parser": cfg})
+            cfg["block_cache"] = os.path.join(self.share_dir,
+                                              f"svc-{share_sig}.bc")
+        return _JobState(job, uri, num_parts, cfg, plan, snapshot,
+                         share_sig=share_sig)
+
+    def register_job(self, job: str, uri: str, num_parts: int,
+                     parser: Optional[dict] = None,
+                     plan: Optional[dict] = None,
+                     snapshot: Optional[dict] = None) -> dict:
+        """In-process job registration (the RPC's twin — LocalFleet and
+        tests use it directly). Returns the registered spec reply;
+        raises :class:`ServiceConfigError` when ``job`` exists with a
+        conflicting spec (job identity is immutable)."""
+        with self._lock:
+            resp = self._register_job_locked({
+                "job": job, "uri": uri, "num_parts": num_parts,
+                "parser": parser, "plan": plan, "snapshot": snapshot})
+        if "error" in resp:
+            raise ServiceConfigError(resp["error"])
+        return resp
+
+    def _register_job_locked(self, req: dict) -> dict:
+        job = str(req.get("job") or "")
+        uri = req.get("uri")
+        if not job:
+            return {"error": "register_job: empty job name"}
+        if not uri:
+            return {"error": f"register_job {job!r}: a dataset uri is "
+                             f"required"}
+        try:
+            num_parts = int(req.get("num_parts", 0))
+        except (TypeError, ValueError):
+            return {"error": f"register_job {job!r}: num_parts "
+                             f"{req.get('num_parts')!r} is not an integer"}
+        if num_parts < 1:
+            return {"error": f"register_job {job!r}: num_parts "
+                             f"{num_parts} must be >= 1"}
+        state = self._make_job(job, str(uri), num_parts,
+                               dict(req.get("parser") or {}),
+                               dict(req.get("plan") or {}),
+                               dict(req.get("snapshot") or {}))
+        prev = self._jobs.get(job)
+        if prev is not None:
+            if (prev.uri == state.uri
+                    and prev.num_parts == state.num_parts
+                    and prev.parser == state.parser
+                    and prev.plan == state.plan
+                    and prev.snapshot == state.snapshot):
+                # idempotent re-registration (a trainer restarting its
+                # client re-binds to the live job state)
+                return dict(prev.spec_dict(), job=job, ok=True,
+                            existing=True, share_sig=prev.share_sig)
+            return {"error":
+                    f"register_job {job!r}: job already registered with "
+                    f"a different spec (have uri={prev.uri!r} "
+                    f"num_parts={prev.num_parts} parser={prev.parser}; "
+                    f"got uri={state.uri!r} num_parts={state.num_parts} "
+                    f"parser={state.parser}) — job identity is "
+                    f"immutable; register the new dataset under a new "
+                    f"job name"}
+        self._jobs[job] = state
+        self._journal_append(self._job_event(state), sync=True)
+        logger.info("dispatcher: registered job %s -> %s (%d parts%s)",
+                    job, state.uri, state.num_parts,
+                    f", shared sig {state.share_sig}"
+                    if state.share_sig else "")
+        return dict(state.spec_dict(), job=job, ok=True, existing=False,
+                    share_sig=state.share_sig)
+
+    @staticmethod
+    def _job_event(state: _JobState) -> dict:
+        """The journal record of one job registration. The default job
+        keeps the exact PR 12 `dataset` shape (uri + num_parts only —
+        its full spec re-arrives with the constructor at restart);
+        non-default jobs journal the whole spec, because nothing else
+        re-supplies it across a restart."""
+        if state.job == DEFAULT_JOB:
+            return {"op": "dataset", "uri": state.uri,
+                    "num_parts": state.num_parts}
+        return {"op": "dataset", "job": state.job, "uri": state.uri,
+                "num_parts": state.num_parts, "parser": state.parser,
+                "plan": state.plan, "snapshot": state.snapshot,
+                "share_sig": state.share_sig}
 
     # ---------------- journal + replay ----------------
 
     def _journal_append(self, event: dict, sync: bool = True) -> None:
         """Journal one state transition (no-op without a journal). All
         assignment events fsync: the journal IS the recovery contract,
-        and its volume is O(parts + workers + failovers) per run."""
+        and its volume is O(jobs × parts + workers + failovers) per
+        run."""
         if self._journal is not None:
             self._journal.append(event, sync=sync)
 
+    def _job_tag(self, job: _JobState) -> dict:
+        """The job qualifier assignment events carry: empty for the
+        default job (byte-compatible with PR 12 journals), ``{"job": j}``
+        otherwise."""
+        return {} if job.job == DEFAULT_JOB else {"job": job.job}
+
+    def _replay_dataset_locked(self, ev: dict) -> None:
+        """Replay one job-registration event. A default-job record that
+        disagrees with the constructor — or a per-job record that
+        disagrees with an already-restored spec — is a fatal
+        configuration error, never an assertion and never retryable:
+        recovery must not silently serve the wrong corpus."""
+        name = str(ev.get("job") or DEFAULT_JOB)
+        if name == DEFAULT_JOB:
+            current = self._jobs.get(DEFAULT_JOB)
+            if current is None:
+                raise ServiceConfigError(
+                    f"dispatcher journal {self._journal.path} records "
+                    f"dataset {ev.get('uri')!r} ({ev.get('num_parts')} "
+                    f"parts) but this dispatcher was constructed with no "
+                    f"default dataset — recover with "
+                    f"Dispatcher(uri={ev.get('uri')!r}, "
+                    f"num_parts={ev.get('num_parts')}, ...) or point "
+                    f"journal_path at a fresh journal")
+            want_parts = int(ev.get("num_parts", current.num_parts))
+            want_uri = ev.get("uri", current.uri)
+            if want_parts != current.num_parts or want_uri != current.uri:
+                raise ServiceConfigError(
+                    f"dispatcher journal {self._journal.path}: journaled "
+                    f"dataset is {want_uri!r} with {want_parts} parts, "
+                    f"constructor says {current.uri!r} with "
+                    f"{current.num_parts} — a restart must recover the "
+                    f"SAME dataset. Restart the dispatcher with the "
+                    f"journaled dataset, or point journal_path at a "
+                    f"fresh journal to start over")
+            return
+        prev = self._jobs.get(name)
+        restored = _JobState(
+            name, ev.get("uri"), int(ev.get("num_parts", 0) or 0),
+            dict(ev.get("parser") or {}), dict(ev.get("plan") or {}),
+            dict(ev.get("snapshot") or {}),
+            share_sig=ev.get("share_sig"))
+        if prev is None:
+            self._jobs[name] = restored
+            return
+        if (prev.uri != restored.uri
+                or prev.num_parts != restored.num_parts
+                or prev.parser != restored.parser):
+            raise ServiceConfigError(
+                f"dispatcher journal {self._journal.path}: job {name!r} "
+                f"recorded twice with conflicting specs "
+                f"({prev.uri!r}/{prev.num_parts} vs "
+                f"{restored.uri!r}/{restored.num_parts}) — the journal "
+                f"is corrupt or two dispatchers shared one journal_path; "
+                f"point this dispatcher at a fresh journal")
+
     def _recover(self, compact_lines: int) -> None:
-        """Replay the journal into the exact assignment state: completed
-        parts stay done with their owner, in-flight parts re-queue at
-        the FRONT (lowest first — clients consume part-major), replayed
-        workers get a fresh liveness window to re-attach, and the
-        generation token bumps past every `start` ever journaled."""
+        """Replay the journal into the exact per-job assignment state:
+        completed parts stay done with their owner, in-flight parts
+        re-queue at the FRONT (lowest first — clients consume
+        part-major), replayed workers get a fresh liveness window to
+        re-attach, registered jobs are restored with their full spec,
+        and the generation token bumps past every `start` ever
+        journaled."""
         with self._journal.locked():
             lines = self._journal.read_lines()
             events = _journal_mod.decode_events(lines)
             last_gen = 0
-            seen_dataset = False
-            todo = self._todo
-            in_todo = set(todo)
-            assigned, completed = self._assigned, self._completed
+            journaled_jobs: Set[str] = set()
+            in_todo: Dict[str, Set[int]] = {}
             workers: Dict[str, tuple] = {}
             draining: Set[str] = set()
+
+            def books(name: str) -> Optional[Tuple[_JobState, Set[int]]]:
+                state = self._jobs.get(name)
+                if state is None:
+                    return None  # event for a job the journal lost
+                if name not in in_todo:
+                    in_todo[name] = set(state.todo)
+                return state, in_todo[name]
+
             for ev in events:
                 op = ev.get("op")
+                name = str(ev.get("job") or DEFAULT_JOB)
                 if op == "dataset":
-                    check(int(ev.get("num_parts", self.num_parts))
-                          == self.num_parts,
-                          f"dispatcher journal {self._journal.path}: "
-                          f"journaled dataset has "
-                          f"{ev.get('num_parts')} parts, constructor "
-                          f"says {self.num_parts} — a restart must "
-                          f"recover the SAME dataset")
-                    seen_dataset = True
-                elif op == "start":
+                    self._replay_dataset_locked(ev)
+                    journaled_jobs.add(name)
+                    continue
+                if op == "start":
                     last_gen = max(last_gen, int(ev.get("gen", 0) or 0))
                 elif op == "register":
                     workers[str(ev.get("worker"))] = (
@@ -368,59 +673,82 @@ class Dispatcher:
                 elif op == "join":
                     pass  # membership rides `register`; join is the record
                 elif op == "grant":
+                    got = books(name)
+                    if got is None:
+                        continue
+                    state, todo_set = got
                     part = int(ev.get("part", -1))
-                    if part in in_todo:
-                        in_todo.discard(part)
-                        todo.remove(part)
-                    assigned[part] = str(ev.get("worker"))
+                    if part in todo_set:
+                        todo_set.discard(part)
+                        state.todo.remove(part)
+                    state.assigned[part] = str(ev.get("worker"))
                 elif op == "spec_grant":
                     # the speculative twin of a grant: the part is already
                     # out of todo; whoever journals `complete` first owns
                     # it (the dedupe below), so replay needs no side state
                     pass
                 elif op == "complete":
+                    got = books(name)
+                    if got is None:
+                        continue
+                    state, todo_set = got
                     part = int(ev.get("part", -1))
-                    if 0 <= part < self.num_parts:
-                        if part in in_todo:
-                            in_todo.discard(part)
-                            todo.remove(part)
+                    if 0 <= part < state.num_parts:
+                        if part in todo_set:
+                            todo_set.discard(part)
+                            state.todo.remove(part)
                         # the completing worker wins the part — for a
                         # hedged part this is the first-complete owner,
                         # which may be the speculative worker
-                        assigned[part] = str(ev.get("worker"))
-                        completed.add(part)
+                        state.assigned[part] = str(ev.get("worker"))
+                        state.completed.add(part)
                 elif op == "reissue":
+                    got = books(name)
+                    if got is None:
+                        continue
+                    state, todo_set = got
                     part = int(ev.get("part", -1))
-                    assigned.pop(part, None)
-                    completed.discard(part)
-                    if 0 <= part < self.num_parts and part not in in_todo:
-                        in_todo.add(part)
-                        todo.appendleft(part)
+                    state.assigned.pop(part, None)
+                    state.completed.discard(part)
+                    if 0 <= part < state.num_parts \
+                            and part not in todo_set:
+                        todo_set.add(part)
+                        state.todo.appendleft(part)
                 elif op == "reclaim":
+                    got = books(name)
+                    if got is None:
+                        continue
+                    state, todo_set = got
                     part = int(ev.get("part", -1))
-                    if part in in_todo:
-                        in_todo.discard(part)
-                        todo.remove(part)
-                    assigned[part] = str(ev.get("worker"))
-                    completed.add(part)
-            # in-flight at the crash (granted, never completed): the
-            # owner's frames may be partial — re-queue at the front,
-            # lowest part first; reclaim re-adopts what survived
-            inflight = sorted(p for p in assigned if p not in completed)
-            for part in inflight:
-                assigned.pop(part)
-            # parts completed by a worker the journal no longer knows
-            # (dead without a reissue line — a torn tail can lose one):
-            # nothing serves them, so they re-queue behind the in-flight
-            orphaned = sorted(p for p, w in assigned.items()
-                              if w not in workers)
-            for part in orphaned:
-                assigned.pop(part)
-                completed.discard(part)
-            for part in reversed(inflight + orphaned):
-                if part not in in_todo:
-                    in_todo.add(part)
-                    todo.appendleft(part)
+                    if part in todo_set:
+                        todo_set.discard(part)
+                        state.todo.remove(part)
+                    state.assigned[part] = str(ev.get("worker"))
+                    state.completed.add(part)
+            requeued = 0
+            for name, state in self._jobs.items():
+                todo_set = in_todo.setdefault(name, set(state.todo))
+                # in-flight at the crash (granted, never completed): the
+                # owner's frames may be partial — re-queue at the front,
+                # lowest part first; reclaim re-adopts what survived
+                inflight = sorted(p for p in state.assigned
+                                  if p not in state.completed)
+                for part in inflight:
+                    state.assigned.pop(part)
+                # parts completed by a worker the journal no longer knows
+                # (dead without a reissue line — a torn tail can lose
+                # one): nothing serves them, so they re-queue behind the
+                # in-flight
+                orphaned = sorted(p for p, w in state.assigned.items()
+                                  if w not in workers)
+                for part in orphaned:
+                    state.assigned.pop(part)
+                    state.completed.discard(part)
+                for part in reversed(inflight + orphaned):
+                    if part not in todo_set:
+                        todo_set.add(part)
+                        state.todo.appendleft(part)
+                requeued += len(inflight) + len(orphaned)
             now = get_time()
             # replayed workers start a fresh liveness window in the
             # JOINING state: a worker that survived the dispatcher
@@ -439,31 +767,31 @@ class Dispatcher:
             self.generation = last_gen + 1
             if len(lines) > compact_lines:
                 self._journal.rewrite(self._live_events())
-            if not seen_dataset:
-                self._journal.append(
-                    {"op": "dataset", "uri": self.uri,
-                     "num_parts": self.num_parts}, sync=True)
+            else:
+                for name, state in self._jobs.items():
+                    if name not in journaled_jobs:
+                        self._journal.append(self._job_event(state),
+                                             sync=True)
             self._journal.append(
                 {"op": "start", "gen": self.generation}, sync=True)
             if events:
                 logger.info(
-                    "dispatcher: recovered from %s — gen %d, %d parts "
-                    "done, %d re-queued, %d workers awaiting re-attach",
-                    self._journal.path, self.generation,
-                    len(self._completed), len(inflight) + len(orphaned),
-                    len(self._workers))
+                    "dispatcher: recovered from %s — gen %d, %d job(s), "
+                    "%d parts done, %d re-queued, %d workers awaiting "
+                    "re-attach", self._journal.path, self.generation,
+                    len(self._jobs),
+                    sum(len(j.completed) for j in self._jobs.values()),
+                    requeued, len(self._workers))
 
     def _live_events(self) -> List[dict]:
         """The current state as a canonical journal (compaction): the
-        dataset, the last start, live workers, and grant+complete pairs
-        for done parts. Unassigned parts are implicit (replay seeds the
-        queue from ``range(num_parts)``); the queue's front-ordering
+        jobs, the last start, live workers, and grant+complete pairs
+        for done parts. Unassigned parts are implicit (replay seeds each
+        queue from ``range(num_parts)``); the queues' front-ordering
         normalizes to ascending across a compaction."""
-        events: List[dict] = [
-            {"op": "dataset", "uri": self.uri,
-             "num_parts": self.num_parts},
-            {"op": "start", "gen": self.generation - 1},
-        ]
+        events: List[dict] = [self._job_event(state)
+                              for state in self._jobs.values()]
+        events.append({"op": "start", "gen": self.generation - 1})
         for info in self._workers.values():
             if info.alive:
                 events.append({"op": "register", "worker": info.worker,
@@ -473,86 +801,97 @@ class Dispatcher:
             # would put the draining worker back in the grant rotation
             if info.state == DRAINING:
                 events.append({"op": "drain", "worker": info.worker})
-        for part in sorted(self._completed):
-            worker = self._assigned.get(part)
-            if worker is None:
-                continue
-            events.append({"op": "grant", "part": part, "worker": worker})
-            events.append({"op": "complete", "part": part,
-                           "worker": worker})
+        for state in self._jobs.values():
+            tag = self._job_tag(state)
+            for part in sorted(state.completed):
+                worker = state.assigned.get(part)
+                if worker is None:
+                    continue
+                events.append(dict({"op": "grant", "part": part,
+                                    "worker": worker}, **tag))
+                events.append(dict({"op": "complete", "part": part,
+                                    "worker": worker}, **tag))
         return events
 
     # ---------------- assignment core (lock held) ----------------
 
-    def _requeue_locked(self, parts, worker: str, why: str) -> None:
-        """Re-issue ``parts`` at the FRONT, lowest part first (clients
-        consume part-major, so the earliest lost part is the one
-        blocking them), journaling each re-queue."""
+    def _requeue_locked(self, job: _JobState, parts, worker: str,
+                        why: str) -> None:
+        """Re-issue ``parts`` of ``job`` at the FRONT, lowest part first
+        (clients consume part-major, so the earliest lost part is the
+        one blocking them), journaling each re-queue."""
         parts = sorted(parts)
+        tag = self._job_tag(job)
         for part in parts:
-            self._assigned.pop(part, None)
-            self._completed.discard(part)
-            self._drop_spec_locked(part)
-            self._grant_times.pop(part, None)
+            job.assigned.pop(part, None)
+            job.completed.discard(part)
+            self._drop_spec_locked(job, part)
+            job.grant_times.pop(part, None)
             try:
-                self._hedge_todo.remove(part)
+                job.hedge_todo.remove(part)
             except ValueError:
                 pass
         for part in reversed(parts):
-            self._todo.appendleft(part)
-            self._journal_append({"op": "reissue", "part": part,
-                                  "worker": worker})
+            job.todo.appendleft(part)
+            self._journal_append(dict({"op": "reissue", "part": part,
+                                       "worker": worker}, **tag))
         if parts:
-            logger.warning("dispatcher: worker %s %s; re-issuing parts %s",
-                           worker, why, parts)
+            logger.warning("dispatcher: worker %s %s; re-issuing "
+                           "job %s parts %s", worker, why, job.job, parts)
 
-    def _drop_spec_locked(self, part: int) -> Optional[str]:
+    def _drop_spec_locked(self, job: _JobState,
+                          part: int) -> Optional[str]:
         """Forget a part's speculative grant (and its grant stamp);
         returns the speculative worker, if any."""
-        self._spec_times.pop(part, None)
-        return self._spec.pop(part, None)
+        job.spec_times.pop(part, None)
+        return job.spec.pop(part, None)
 
     def _drop_worker_specs_locked(self, worker: str) -> None:
-        """Forget every speculative grant ``worker`` holds — its
-        speculative parses die with it (death, drain, departure)."""
-        for part in [p for p, w in self._spec.items() if w == worker]:
-            self._drop_spec_locked(part)
+        """Forget every speculative grant ``worker`` holds, every job —
+        its speculative parses die with it (death, drain, departure)."""
+        for job in self._jobs.values():
+            for part in [p for p, w in job.spec.items() if w == worker]:
+                self._drop_spec_locked(job, part)
 
-    def _inherit_or_requeue_locked(self, worker: str, parts,
-                                   why: str) -> List[int]:
-        """``worker`` is giving up ``parts``: promote each hedged part's
-        speculative twin to primary (the hedge already has a live parse
-        going — re-queuing would waste it) and re-queue the rest at the
-        front. Returns the re-queued parts."""
+    def _inherit_or_requeue_locked(self, job: _JobState, worker: str,
+                                   parts, why: str) -> List[int]:
+        """``worker`` is giving up ``parts`` of ``job``: promote each
+        hedged part's speculative twin to primary (the hedge already has
+        a live parse going — re-queuing would waste it) and re-queue the
+        rest at the front. Returns the re-queued parts."""
         requeue = []
+        tag = self._job_tag(job)
         for part in parts:
-            spec_stamp = self._spec_times.get(part)
-            spec = self._drop_spec_locked(part)
-            if spec is not None and part not in self._completed:
+            spec_stamp = job.spec_times.get(part)
+            spec = self._drop_spec_locked(job, part)
+            if spec is not None and part not in job.completed:
                 # the hedge worker inherits the part outright; its clock
                 # restarts at ITS spec grant — keeping the stuck
                 # primary's stamp would re-flag the part for hedging at
                 # the very next tick and poison the latency median
-                self._assigned[part] = spec
-                self._grant_times[part] = (spec_stamp if spec_stamp
-                                           is not None else get_time())
-                self._journal_append({"op": "grant", "part": part,
-                                      "worker": spec})
-                logger.info("dispatcher: part %d inherited by hedge "
-                            "worker %s (%s %s)", part, spec, worker, why)
+                job.assigned[part] = spec
+                job.grant_times[part] = (spec_stamp if spec_stamp
+                                         is not None else get_time())
+                self._journal_append(dict({"op": "grant", "part": part,
+                                           "worker": spec}, **tag))
+                logger.info("dispatcher: job %s part %d inherited by "
+                            "hedge worker %s (%s %s)", job.job, part,
+                            spec, worker, why)
             else:
                 requeue.append(part)
-        self._requeue_locked(requeue, worker, why)
+        self._requeue_locked(job, requeue, worker, why)
         return requeue
 
     def _release_worker_parts_locked(self, worker: str, why: str) -> None:
         """A worker left (death or completed drain): drop speculative
         grants it held itself, then inherit-or-requeue everything it
-        owned (completed parts re-queue too — its frame store is gone)."""
+        owned across every job (completed parts re-queue too — its frame
+        store is gone)."""
         self._drop_worker_specs_locked(worker)
-        parts = sorted(p for p, o in self._assigned.items()
-                       if o == worker)
-        self._inherit_or_requeue_locked(worker, parts, why)
+        for job in self._jobs.values():
+            parts = sorted(p for p, o in job.assigned.items()
+                           if o == worker)
+            self._inherit_or_requeue_locked(job, worker, parts, why)
 
     def _mark_dead_locked(self, worker: str) -> None:
         info = self._workers.get(worker)
@@ -571,6 +910,9 @@ class Dispatcher:
                                "(last seen %.1fs ago)", info.worker,
                                now - info.last_seen)
                 self._mark_dead_locked(info.worker)
+
+    def _clients_active_locked(self) -> bool:
+        return any(j.clients_active for j in self._jobs.values())
 
     # ---------------- drain + hedging (lock held) ----------------
 
@@ -591,15 +933,24 @@ class Dispatcher:
                     info.worker, why)
         info.state = DEAD
         self._journal_append({"op": "dead", "worker": info.worker})
-        keep = {p for p in info.handed_off
-                if self._assigned.get(p) == info.worker
-                and p in self._completed}
         self._drop_worker_specs_locked(info.worker)
-        self._inherit_or_requeue_locked(
-            info.worker,
-            sorted(p for p, o in self._assigned.items()
-                   if o == info.worker and p not in keep),
-            why)
+        for job in self._jobs.values():
+            keep = {p for (j, p) in info.handed_off
+                    if j == job.job
+                    and job.assigned.get(p) == info.worker
+                    and p in job.completed}
+            self._inherit_or_requeue_locked(
+                job, info.worker,
+                sorted(p for p, o in job.assigned.items()
+                       if o == info.worker and p not in keep),
+                why)
+
+    def _serving_locked(self, worker: str) -> Set[Tuple[str, int]]:
+        """The frame-store-complete (job, part) pairs ``worker`` still
+        owns — what a drain must hand off before completing early."""
+        return {(job.job, p) for job in self._jobs.values()
+                for p, w in job.assigned.items()
+                if w == worker and p in job.completed}
 
     def _maybe_finish_drain_locked(self, info: _WorkerInfo) -> None:
         """Complete the drain as soon as every still-assigned
@@ -609,8 +960,7 @@ class Dispatcher:
         idle out the full deadline."""
         if info.state != DRAINING:
             return
-        serving = {p for p, w in self._assigned.items()
-                   if w == info.worker and p in self._completed}
+        serving = self._serving_locked(info.worker)
         if serving <= info.handed_off:
             self._finish_drain_locked(
                 info, "all served parts handed off"
@@ -629,38 +979,40 @@ class Dispatcher:
                 self._finish_drain_locked(info, "drain deadline expired")
 
     def _hedge_check_locked(self, now: float) -> None:
-        """Flag in-flight parts stuck past ``hedge_factor`` times the
-        fleet's median grant->complete latency for speculative re-issue.
-        Guarded by a minimum sample count and an absolute age floor so
-        ordinary jitter on fast parts can never trigger a duplicate
-        parse; the flagged part is granted to the next polling worker
-        that is not the stuck primary."""
-        if len(self._latencies) < HEDGE_MIN_SAMPLES:
-            return
-        threshold = max(self._hedge_factor
-                        * statistics.median(self._latencies),
-                        HEDGE_MIN_AGE_S)
-        for part, granted_at in list(self._grant_times.items()):
-            if (part in self._completed or part in self._spec
-                    or part in self._hedge_todo):
+        """Flag in-flight parts stuck past ``hedge_factor`` times their
+        JOB's median grant->complete latency for speculative re-issue.
+        Guarded by a minimum per-job sample count and an absolute age
+        floor so ordinary jitter on fast parts can never trigger a
+        duplicate parse; the flagged part is granted to the next polling
+        worker that is not the stuck primary."""
+        for job in self._jobs.values():
+            if len(job.latencies) < HEDGE_MIN_SAMPLES:
                 continue
-            owner = self._assigned.get(part)
-            info = self._workers.get(owner) if owner is not None else None
-            if info is None or info.state != ACTIVE:
-                continue  # death/drain paths own those parts
-            age = now - granted_at
-            if age <= threshold:
-                continue
-            if not any(w.state == ACTIVE and w.worker != owner
-                       and w.registered_gen == self.generation
-                       for w in self._workers.values()):
-                continue  # nobody to hedge onto
-            self._hedge_todo.append(part)
-            logger.warning(
-                "dispatcher: part %d on worker %s stuck %.2fs "
-                "(> %.2fs = %dx fleet median); flagging for "
-                "speculative re-issue", part, owner, age, threshold,
-                self._hedge_factor)
+            threshold = max(self._hedge_factor
+                            * statistics.median(job.latencies),
+                            HEDGE_MIN_AGE_S)
+            for part, granted_at in list(job.grant_times.items()):
+                if (part in job.completed or part in job.spec
+                        or part in job.hedge_todo):
+                    continue
+                owner = job.assigned.get(part)
+                info = (self._workers.get(owner)
+                        if owner is not None else None)
+                if info is None or info.state != ACTIVE:
+                    continue  # death/drain paths own those parts
+                age = now - granted_at
+                if age <= threshold:
+                    continue
+                if not any(w.state == ACTIVE and w.worker != owner
+                           and w.registered_gen == self.generation
+                           for w in self._workers.values()):
+                    continue  # nobody to hedge onto
+                job.hedge_todo.append(part)
+                logger.warning(
+                    "dispatcher: job %s part %d on worker %s stuck "
+                    "%.2fs (> %.2fs = %dx job median); flagging for "
+                    "speculative re-issue", job.job, part, owner, age,
+                    threshold, self._hedge_factor)
 
     def _tick_loop(self) -> None:
         """The wall-clock driver behind liveness, drain deadlines, and
@@ -681,14 +1033,43 @@ class Dispatcher:
         resp["gen"] = self.generation
         return resp
 
+    def _job_for(self, req: dict) -> Optional[_JobState]:
+        """The job a request addresses (absent field = default job)."""
+        return self._jobs.get(str(req.get("job") or DEFAULT_JOB))
+
+    def _grant_rotation_locked(self) -> List[_JobState]:
+        """The job visitation order for the NEXT grant: round-robin from
+        the rotation cursor, so every job with pending work gets a turn
+        before any job gets a second one — a greedy many-part job cannot
+        drown a starved one (docs/service.md grant fairness)."""
+        order = list(self._jobs.values())
+        if not order:
+            return []
+        k = self._rr % len(order)
+        return order[k:] + order[:k]
+
     def _dispatch_cmd(self, req: dict) -> dict:
         cmd = req.get("cmd")
         now = get_time()
         with self._lock:
             if cmd == "config":
-                return {"uri": self.uri, "num_parts": self.num_parts,
-                        "parser": self.parser, "plan": self.plan,
-                        "snapshot": self.snapshot}
+                job = self._job_for(req)
+                if job is None:
+                    if "job" in req:
+                        return {"error": f"unknown job {req.get('job')!r}"
+                                         f" (register_job first; "
+                                         f"registered: "
+                                         f"{sorted(self._jobs)})"}
+                    # a dispatcher born empty: workers boot against this
+                    # and fetch real job specs lazily per grant
+                    return {"uri": None, "num_parts": 0, "parser": {},
+                            "plan": {}, "snapshot": {}}
+                resp = job.spec_dict()
+                if "job" in req:
+                    resp["job"] = job.job
+                return resp
+            if cmd == "register_job":
+                return self._register_job_locked(req)
             if cmd == "register":
                 worker = str(req["worker"])
                 prev = self._workers.get(worker)
@@ -708,7 +1089,7 @@ class Dispatcher:
                 self._journal_append({"op": "register", "worker": worker,
                                       "host": str(req["host"]),
                                       "port": int(req["port"])})
-                if prev is None and self._clients_active:
+                if prev is None and self._clients_active_locked():
                     # a brand-new worker id arriving while clients are
                     # consuming: a mid-epoch LIVE JOIN — it is in the
                     # grant rotation and the re-issue serving set from
@@ -724,179 +1105,226 @@ class Dispatcher:
                     info.last_seen = now
                 return {"ok": True}
             if cmd == "next_split":
-                worker = str(req["worker"])
-                info = self._workers.get(worker)
-                if info is None or not info.alive:
-                    if info is not None and info.drained:
-                        # drain complete: tell the worker to exit instead
-                        # of re-attaching as a zombie
-                        return {"part": None, "drained": True}
-                    # unregistered/declared-dead workers get no splits —
-                    # a zombie must re-register before it can own parts
-                    return {"part": None, "register": True}
-                if info.state == DRAINING:
-                    # draining workers get NO new work; the poll doubles
-                    # as liveness while they serve out their parts
-                    info.last_seen = now
-                    return {"part": None, "draining": True}
-                if info.registered_gen != self.generation:
-                    # journal-restored worker that has not re-attached
-                    # this generation: its frame-store contents are
-                    # unknown until the register+reclaim handshake, and
-                    # a grant riding the SAME reply as the generation
-                    # bump would race the reclaim into a duplicate parse
-                    info.last_seen = now
-                    return {"part": None, "register": True}
-                info.last_seen = now
-                self._reap_stale_locked(now)
-                # speculative re-issues first: a flagged straggler part
-                # goes to the first polling worker that is NOT the stuck
-                # primary (journaled spec_grant; first part_done wins)
-                for _ in range(len(self._hedge_todo)):
-                    part = self._hedge_todo.popleft()
-                    if (part in self._completed or part in self._spec
-                            or part not in self._assigned):
-                        continue  # stale flag
-                    if self._assigned.get(part) == worker:
-                        self._hedge_todo.append(part)
-                        continue
-                    self._spec[part] = worker
-                    self._spec_times[part] = now
-                    self._journal_append({"op": "spec_grant",
-                                          "part": part, "worker": worker})
-                    _resilience.record_event("speculative_reissues")
-                    logger.warning("dispatcher: part %d speculatively "
-                                   "re-issued to worker %s (primary %s)",
-                                   part, worker, self._assigned.get(part))
-                    return {"part": part}
-                if not self._todo:
-                    return {"part": None}
-                part = self._todo.popleft()
-                self._assigned[part] = worker
-                self._grant_times[part] = now
-                self._journal_append({"op": "grant", "part": part,
-                                      "worker": worker})
-                logger.info("dispatcher: part %d -> worker %s", part, worker)
-                return {"part": part}
+                return self._next_split_locked(req, now)
             if cmd == "part_done":
-                worker = str(req["worker"])
-                part = int(req["part"])
-                primary = self._assigned.get(part)
-                spec = self._spec.get(part)
-                if (part not in self._completed
-                        and worker in (primary, spec)):
-                    # journaled completion: a restarted dispatcher keeps
-                    # the part done instead of re-queuing it as in-flight.
-                    # For a hedged part the FIRST completion wins; the
-                    # loser's later part_done is deduped right here.
-                    self._completed.add(part)
-                    # the latency sample measures the WINNER's own
-                    # grant->complete time (the spec grant stamp for a
-                    # speculative win) — never the stuck primary's age,
-                    # which exceeds the hedge threshold by construction
-                    # and would desensitize the median
-                    granted_at = self._grant_times.pop(part, None)
-                    if spec is not None and worker == spec:
-                        self._assigned[part] = worker
-                        granted_at = self._spec_times.get(part, granted_at)
-                        _resilience.record_event("speculative_wins")
-                        logger.info("dispatcher: speculative worker %s "
-                                    "won part %d over %s", worker, part,
-                                    primary)
-                    self._drop_spec_locked(part)
-                    self._journal_append({"op": "complete", "part": part,
-                                          "worker": worker})
-                    if granted_at is not None:
-                        self._latencies.append(max(0.0, now - granted_at))
-                elif part not in self._completed:
-                    # a completion for a part we had RE-QUEUED (its
-                    # grant didn't survive a dispatcher restart, or a
-                    # report_lost blamed a still-live worker): the
-                    # frames exist, so adopt it exactly as `reclaim`
-                    # would instead of letting the queue force a
-                    # duplicate parse (no latency sample — the grant
-                    # stamp died with the re-queue)
-                    info = self._workers.get(worker)
-                    if (info is not None and info.alive
-                            and part in self._todo):
-                        self._todo.remove(part)
-                        self._assigned[part] = worker
-                        self._completed.add(part)
-                        self._journal_append(
-                            {"op": "complete", "part": part,
-                             "worker": worker})
-                        logger.info("dispatcher: adopted completion of "
-                                    "re-queued part %d from worker %s",
-                                    part, worker)
-                return {"ok": True}
+                return self._part_done_locked(req, now)
             if cmd == "drain":
                 return self._drain_locked(req, now)
             if cmd == "handoff":
                 worker = str(req["worker"])
                 part = int(req["part"])
+                jname = str(req.get("job") or DEFAULT_JOB)
                 info = self._workers.get(worker)
                 if info is not None and info.state == DRAINING:
-                    info.handed_off.add(part)
+                    info.handed_off.add((jname, part))
                     self._maybe_finish_drain_locked(info)
                 return {"ok": True}
             if cmd == "reclaim":
                 return self._reclaim_locked(req)
             if cmd == "locate":
-                part = int(req["part"])
-                if not 0 <= part < self.num_parts:
-                    return {"error": f"part {part} out of range"}
-                self._clients_active = True  # a consumer is attached
-                self._reap_stale_locked(now)
-                owner = self._assigned.get(part)
-                info = self._workers.get(owner) if owner is not None else None
-                if info is None or not info.alive:
-                    if owner is not None:
-                        # the part stayed assigned to a departed drained
-                        # worker (handoff-confirmed — see
-                        # _finish_drain_locked) for exactly this moment:
-                        # a client still wants it, so NOW it re-queues
-                        self._requeue_locked(
-                            [part], owner, "located after its drained "
-                            "owner left")
-                    return {"wait": True}
-                resp = {"worker": info.worker, "host": info.host,
-                        "port": info.port}
-                if info.state == DRAINING:
-                    # the owner is leaving: clients should finish this
-                    # stream promptly and confirm with `handoff`
-                    resp["draining"] = True
-                have = req.get("have")
-                if have is not None and str(have) != info.worker:
-                    # the part moved off the worker the client last
-                    # used: the client takes this hint as confirmation
-                    # that a drain re-issue landed (drain_handoffs) —
-                    # no dead-socket timeout involved (docs/service.md)
-                    resp["moved"] = True
-                return resp
+                return self._locate_locked(req, now)
             if cmd == "report_lost":
                 self._mark_dead_locked(str(req["worker"]))
                 return {"ok": True}
             if cmd == "status":
+                default = self._default()
+                jobs = {
+                    name: {
+                        "uri": j.uri,
+                        "num_parts": j.num_parts,
+                        "share_sig": j.share_sig,
+                        "assigned": {str(p): w
+                                     for p, w in j.assigned.items()},
+                        "todo": list(j.todo),
+                        "completed": sorted(j.completed),
+                        "hedged": {str(p): w for p, w in j.spec.items()},
+                    } for name, j in self._jobs.items()}
                 return {
                     "workers": {w: {"host": i.host, "port": i.port,
                                     "alive": i.alive, "state": i.state}
                                 for w, i in self._workers.items()},
-                    "assigned": {str(p): w
-                                 for p, w in self._assigned.items()},
-                    "todo": list(self._todo),
-                    "completed": sorted(self._completed),
-                    "hedged": {str(p): w for p, w in self._spec.items()},
+                    # legacy one-dataset view: the default job's books
+                    "assigned": ({str(p): w for p, w
+                                  in default.assigned.items()}
+                                 if default else {}),
+                    "todo": list(default.todo) if default else [],
+                    "completed": (sorted(default.completed)
+                                  if default else []),
+                    "hedged": ({str(p): w for p, w in default.spec.items()}
+                               if default else {}),
+                    "jobs": jobs,
                     "generation": self.generation,
                 }
         return {"error": f"unknown command {cmd!r}"}
 
+    def _next_split_locked(self, req: dict, now: float) -> dict:
+        worker = str(req["worker"])
+        info = self._workers.get(worker)
+        if info is None or not info.alive:
+            if info is not None and info.drained:
+                # drain complete: tell the worker to exit instead of
+                # re-attaching as a zombie
+                return {"part": None, "drained": True}
+            # unregistered/declared-dead workers get no splits — a
+            # zombie must re-register before it can own parts
+            return {"part": None, "register": True}
+        if info.state == DRAINING:
+            # draining workers get NO new work; the poll doubles as
+            # liveness while they serve out their parts
+            info.last_seen = now
+            return {"part": None, "draining": True}
+        if info.registered_gen != self.generation:
+            # journal-restored worker that has not re-attached this
+            # generation: its frame-store contents are unknown until the
+            # register+reclaim handshake, and a grant riding the SAME
+            # reply as the generation bump would race the reclaim into a
+            # duplicate parse
+            info.last_seen = now
+            return {"part": None, "register": True}
+        info.last_seen = now
+        self._reap_stale_locked(now)
+        rotation = self._grant_rotation_locked()
+        # speculative re-issues first, any job: a flagged straggler part
+        # goes to the first polling worker that is NOT the stuck primary
+        # (journaled spec_grant; first part_done wins)
+        for job in rotation:
+            for _ in range(len(job.hedge_todo)):
+                part = job.hedge_todo.popleft()
+                if (part in job.completed or part in job.spec
+                        or part not in job.assigned):
+                    continue  # stale flag
+                if job.assigned.get(part) == worker:
+                    job.hedge_todo.append(part)
+                    continue
+                job.spec[part] = worker
+                job.spec_times[part] = now
+                self._journal_append(dict(
+                    {"op": "spec_grant", "part": part, "worker": worker},
+                    **self._job_tag(job)))
+                _resilience.record_event("speculative_reissues")
+                logger.warning(
+                    "dispatcher: job %s part %d speculatively re-issued "
+                    "to worker %s (primary %s)", job.job, part, worker,
+                    job.assigned.get(part))
+                return {"part": part, "job": job.job}
+        # fresh grants: round-robin across jobs with pending work, so N
+        # trainers' queues drain in parallel instead of job-major
+        for i, job in enumerate(rotation):
+            if not job.todo:
+                continue
+            part = job.todo.popleft()
+            job.assigned[part] = worker
+            job.grant_times[part] = now
+            self._journal_append(dict({"op": "grant", "part": part,
+                                       "worker": worker},
+                                      **self._job_tag(job)))
+            # advance the cursor PAST the granted job: the next grant
+            # starts at the following job in the rotation
+            self._rr = (self._rr + i + 1) % max(1, len(self._jobs))
+            logger.info("dispatcher: job %s part %d -> worker %s",
+                        job.job, part, worker)
+            return {"part": part, "job": job.job}
+        return {"part": None}
+
+    def _part_done_locked(self, req: dict, now: float) -> dict:
+        worker = str(req["worker"])
+        part = int(req["part"])
+        job = self._job_for(req)
+        if job is None:
+            return {"ok": True}  # completion for a job nobody knows
+        tag = self._job_tag(job)
+        primary = job.assigned.get(part)
+        spec = job.spec.get(part)
+        if part not in job.completed and worker in (primary, spec):
+            # journaled completion: a restarted dispatcher keeps the
+            # part done instead of re-queuing it as in-flight. For a
+            # hedged part the FIRST completion wins; the loser's later
+            # part_done is deduped right here.
+            job.completed.add(part)
+            # the latency sample measures the WINNER's own
+            # grant->complete time (the spec grant stamp for a
+            # speculative win) — never the stuck primary's age, which
+            # exceeds the hedge threshold by construction and would
+            # desensitize the median
+            granted_at = job.grant_times.pop(part, None)
+            if spec is not None and worker == spec:
+                job.assigned[part] = worker
+                granted_at = job.spec_times.get(part, granted_at)
+                _resilience.record_event("speculative_wins")
+                logger.info("dispatcher: speculative worker %s won "
+                            "job %s part %d over %s", worker, job.job,
+                            part, primary)
+            self._drop_spec_locked(job, part)
+            self._journal_append(dict({"op": "complete", "part": part,
+                                       "worker": worker}, **tag))
+            if granted_at is not None:
+                job.latencies.append(max(0.0, now - granted_at))
+        elif part not in job.completed:
+            # a completion for a part we had RE-QUEUED (its grant didn't
+            # survive a dispatcher restart, or a report_lost blamed a
+            # still-live worker): the frames exist, so adopt it exactly
+            # as `reclaim` would instead of letting the queue force a
+            # duplicate parse (no latency sample — the grant stamp died
+            # with the re-queue)
+            info = self._workers.get(worker)
+            if (info is not None and info.alive
+                    and part in job.todo):
+                job.todo.remove(part)
+                job.assigned[part] = worker
+                job.completed.add(part)
+                self._journal_append(dict(
+                    {"op": "complete", "part": part, "worker": worker},
+                    **tag))
+                logger.info("dispatcher: adopted completion of "
+                            "re-queued job %s part %d from worker %s",
+                            job.job, part, worker)
+        return {"ok": True}
+
+    def _locate_locked(self, req: dict, now: float) -> dict:
+        job = self._job_for(req)
+        if job is None:
+            return {"error": f"unknown job {req.get('job')!r} "
+                             f"(register_job first)"}
+        part = int(req["part"])
+        if not 0 <= part < job.num_parts:
+            return {"error": f"job {job.job}: part {part} out of range"}
+        job.clients_active = True  # a consumer is attached
+        self._reap_stale_locked(now)
+        owner = job.assigned.get(part)
+        info = self._workers.get(owner) if owner is not None else None
+        if info is None or not info.alive:
+            if owner is not None:
+                # the part stayed assigned to a departed drained worker
+                # (handoff-confirmed — see _finish_drain_locked) for
+                # exactly this moment: a client still wants it, so NOW
+                # it re-queues
+                self._requeue_locked(
+                    job, [part], owner, "located after its drained "
+                    "owner left")
+            return {"wait": True}
+        resp = {"worker": info.worker, "host": info.host,
+                "port": info.port}
+        if info.state == DRAINING:
+            # the owner is leaving: clients should finish this stream
+            # promptly and confirm with `handoff`
+            resp["draining"] = True
+        have = req.get("have")
+        if have is not None and str(have) != info.worker:
+            # the part moved off the worker the client last used: the
+            # client takes this hint as confirmation that a drain
+            # re-issue landed (drain_handoffs) — no dead-socket timeout
+            # involved (docs/service.md)
+            resp["moved"] = True
+        return resp
+
     def _drain_locked(self, req: dict, now: float) -> dict:
         """Begin (or report) a graceful drain: the worker leaves the
-        grant rotation immediately, its unstarted/in-flight parts
-        proactively re-issue at the front (hedged parts are inherited by
-        their speculative worker), and its frame-store-complete parts
-        keep serving until every one is ``handoff``-confirmed or the
-        drain deadline expires. Idempotent — repeats report state."""
+        grant rotation immediately, its unstarted/in-flight parts (every
+        job) proactively re-issue at the front (hedged parts are
+        inherited by their speculative worker), and its frame-store-
+        complete parts keep serving until every one is ``handoff``-
+        confirmed or the drain deadline expires. Idempotent — repeats
+        report state."""
         worker = str(req["worker"])
         info = self._workers.get(worker)
         if info is None or not info.alive:
@@ -927,24 +1355,29 @@ class Dispatcher:
             # (those keep serving out): failover starts now, not when
             # the worker's sockets die. A hedged part is inherited by
             # its speculative worker instead of re-queued.
-            pending = self._inherit_or_requeue_locked(
-                worker,
-                sorted(p for p, w in self._assigned.items()
-                       if w == worker and p not in self._completed),
-                "draining")
+            pending = 0
+            for job in self._jobs.values():
+                pending += len(self._inherit_or_requeue_locked(
+                    job, worker,
+                    sorted(p for p, w in job.assigned.items()
+                           if w == worker and p not in job.completed),
+                    "draining"))
             logger.warning(
                 "dispatcher: draining worker %s (deadline %.1fs, "
                 "%d unstarted parts re-issued, %d complete parts "
-                "serving out)", worker, deadline_s, len(pending),
-                sum(1 for p, w in self._assigned.items()
-                    if w == worker and p in self._completed))
+                "serving out)", worker, deadline_s, pending,
+                len(self._serving_locked(worker)))
             # nothing to serve out (preempted before any part
             # completed)? the drain is already done — exit within the
             # notice window instead of idling out the deadline
             self._maybe_finish_drain_locked(info)
-        serving = sorted(p for p, w in self._assigned.items()
-                         if w == worker and p in self._completed)
-        return {"ok": True, "serving": serving,
+        serving_jobs: Dict[str, List[int]] = {}
+        for jname, part in sorted(self._serving_locked(worker)):
+            serving_jobs.setdefault(jname, []).append(part)
+        return {"ok": True,
+                # legacy shape: the default job's serving parts
+                "serving": serving_jobs.get(DEFAULT_JOB, []),
+                "serving_jobs": serving_jobs,
                 "deadline_s": round(
                     max(0.0, (info.drain_deadline or now) - now), 3)}
 
@@ -953,41 +1386,66 @@ class Dispatcher:
         store still holds — instead of forcing a fleet-wide re-parse —
         and re-queue the journal-complete parts it no longer announces
         (its store lost them, e.g. dispatcher AND worker both died).
-        Parts owned by ANOTHER live worker are never stolen; parts
-        granted this generation and still mid-parse are left alone (the
-        announce lists complete parts only)."""
+        ``parts`` is a flat list (default job, the PR 12 wire shape) or
+        ``{job: [parts]}``; the reply's ``adopted`` mirrors the request
+        shape. Parts owned by ANOTHER live worker are never stolen;
+        parts granted this generation and still mid-parse are left alone
+        (the announce lists complete parts only)."""
         worker = str(req["worker"])
         info = self._workers.get(worker)
         if info is None or not info.alive:
             return {"error": f"reclaim from unregistered worker "
                              f"{worker!r} (register first)"}
-        held = {int(p) for p in (req.get("parts") or [])
-                if 0 <= int(p) < self.num_parts}
-        adopted: List[int] = []
-        for part in sorted(held):
-            owner = self._assigned.get(part)
-            if owner == worker:
-                if part not in self._completed:
-                    self._completed.add(part)
-                    self._journal_append({"op": "complete", "part": part,
-                                          "worker": worker})
-                adopted.append(part)
-            elif owner is None and part in self._todo:
-                self._todo.remove(part)
-                self._assigned[part] = worker
-                self._completed.add(part)
-                self._journal_append({"op": "reclaim", "part": part,
-                                      "worker": worker})
-                adopted.append(part)
-            # else: owned by another live worker — exactly-once wins
-        stale = [p for p, w in self._assigned.items()
-                 if w == worker and p in self._completed
-                 and p not in held]
-        self._requeue_locked(stale, worker, "reclaimed without")
+        raw = req.get("parts")
+        flat = not isinstance(raw, dict)
+        by_job: Dict[str, Set[int]] = (
+            {DEFAULT_JOB: {int(p) for p in (raw or [])}} if flat
+            else {str(j): {int(p) for p in (ps or [])}
+                  for j, ps in raw.items()})
+        adopted: Dict[str, List[int]] = {}
+        for jname, held in by_job.items():
+            job = self._jobs.get(jname)
+            if job is None:
+                continue
+            tag = self._job_tag(job)
+            held = {p for p in held if 0 <= p < job.num_parts}
+            got: List[int] = []
+            for part in sorted(held):
+                owner = job.assigned.get(part)
+                if owner == worker:
+                    if part not in job.completed:
+                        job.completed.add(part)
+                        self._journal_append(dict(
+                            {"op": "complete", "part": part,
+                             "worker": worker}, **tag))
+                    got.append(part)
+                elif owner is None and part in job.todo:
+                    job.todo.remove(part)
+                    job.assigned[part] = worker
+                    job.completed.add(part)
+                    self._journal_append(dict(
+                        {"op": "reclaim", "part": part,
+                         "worker": worker}, **tag))
+                    got.append(part)
+                # else: owned by another live worker — exactly-once wins
+            if got:
+                adopted[jname] = got
+        # journal-complete parts this incarnation no longer announces —
+        # ACROSS every job, so a worker that came back holding only job
+        # A's frames re-queues its stale job-B claims too
+        for job in self._jobs.values():
+            held = by_job.get(job.job, set())
+            stale = [p for p, w in job.assigned.items()
+                     if w == worker and p in job.completed
+                     and p not in held]
+            self._requeue_locked(job, stale, worker, "reclaimed without")
         if adopted:
             logger.info("dispatcher: worker %s reclaimed parts %s",
                         worker, adopted)
-        return {"ok": True, "adopted": adopted}
+        if flat:
+            return {"ok": True, "adopted": adopted.get(DEFAULT_JOB, [])}
+        return {"ok": True, "adopted": {j: ps
+                                        for j, ps in adopted.items()}}
 
     # ---------------- server loop ----------------
 
@@ -1139,7 +1597,23 @@ def request(address: str, req: dict, timeout: float = 10.0) -> dict:
             f"dispatcher {address}: busy (handler slots exhausted; "
             f"retry after backoff)")
     if "error" in resp:
-        from dmlc_tpu.utils.check import DMLCError
-
         raise DMLCError(f"dispatcher {address}: {resp['error']}")
     return resp
+
+
+def register_job(address: str, job: str, uri: str, num_parts: int,
+                 parser: Optional[dict] = None,
+                 plan: Optional[dict] = None,
+                 snapshot: Optional[dict] = None,
+                 timeout: float = 10.0) -> dict:
+    """Register ``job`` at a running dispatcher over the wire (the
+    trainer-side entry point of the multi-tenant service; docs/service.md
+    job registry). Idempotent for an identical spec; a conflicting spec
+    raises (job identity is immutable). Returns the registered spec —
+    including the resolved ``parser`` config, whose ``block_cache`` may
+    have been assigned by share-by-signature."""
+    return request(address, {
+        "cmd": "register_job", "job": str(job), "uri": uri,
+        "num_parts": int(num_parts), "parser": dict(parser or {}),
+        "plan": dict(plan or {}), "snapshot": dict(snapshot or {})},
+        timeout=timeout)

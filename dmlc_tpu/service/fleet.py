@@ -42,11 +42,13 @@ class LocalFleet:
                  plan: Optional[dict] = None,
                  snapshot: Optional[dict] = None,
                  autotune: Optional[bool] = None,
-                 journal_path: Optional[str] = None):
+                 journal_path: Optional[str] = None,
+                 share_dir: Optional[str] = None):
         self._dispatcher_args = dict(
             uri=uri, num_parts=num_parts, parser=parser,
             liveness_timeout=liveness_timeout, plan=plan,
-            snapshot=snapshot, journal_path=journal_path)
+            snapshot=snapshot, journal_path=journal_path,
+            share_dir=share_dir)
         self._worker_args = dict(poll_interval=poll_interval,
                                  heartbeat_interval=heartbeat_interval,
                                  autotune=autotune)
@@ -105,6 +107,39 @@ class LocalFleet:
     def address(self) -> str:
         """The dispatcher address clients connect to."""
         return self.dispatcher.address
+
+    def register_job(self, job: str, uri: str, num_parts: int,
+                     parser: Optional[dict] = None,
+                     plan: Optional[dict] = None,
+                     snapshot: Optional[dict] = None) -> dict:
+        """Register one more job at the running dispatcher
+        (docs/service.md multi-tenant service): the live workers pick it
+        up at their next grant — no fleet restart, no new fleet. With
+        ``share_dir`` set on the fleet, a job over an already-registered
+        corpus + config shares its published block caches by signature
+        (the corpus parses once fleet-wide)."""
+        return self.dispatcher.register_job(
+            job, uri, num_parts, parser=parser, plan=plan,
+            snapshot=snapshot)
+
+    def live_workers(self) -> List[ParseWorker]:
+        """Workers that are live CAPACITY: not killed/closed/drained,
+        and not mid-drain either — a draining worker serves out its
+        completed parts but takes no new grants, so counting it would
+        let the autoscaler drain a second worker below ``fleet_min``
+        (or phantom-re-drain the same one) while the first is still
+        exiting."""
+        return [w for w in self.workers
+                if w is not None and w.alive and not w.draining]
+
+    def autoscale(self, **kwargs) -> "FleetAutoscaler":
+        """Attach an input-wait-driven :class:`~dmlc_tpu.service.
+        autoscale.FleetAutoscaler` to this fleet (docs/service.md fleet
+        autoscaling). ``kwargs`` pass through (``source=``, bounds,
+        thresholds, ``start=True`` for the background tick thread)."""
+        from dmlc_tpu.service.autoscale import FleetAutoscaler
+
+        return FleetAutoscaler(self, **kwargs)
 
     def kill_worker(self, index: int) -> ParseWorker:
         """Crash-simulate one worker (see :meth:`ParseWorker.kill`)."""

@@ -1,18 +1,29 @@
-"""Data-service parse worker: claim splits, parse, stream frames.
+"""Data-service parse worker: claim splits across jobs, parse, stream.
 
 One worker of the disaggregated ingest fleet (arXiv:2210.14826 §3.2):
 it polls the :class:`~dmlc_tpu.service.dispatcher.Dispatcher` for
-partitions (first-come-first-served — a fast worker simply visits more
-splits), runs the **existing** parser stack on each
-(:func:`dmlc_tpu.data.parsers.create_parser` with the dispatcher-shipped
-config, optionally fronted by the parse-once
-:class:`~dmlc_tpu.data.parsers.BlockCacheIter` when the dispatcher
-config carries ``block_cache`` — a relaunched worker then re-serves its
-parts from the warm cache instead of re-parsing text), encodes every
-RowBlock into a wire frame at parse time
+partitions — **multiplexing every registered job from the one grant
+rotation**: each ``next_split`` grant names ``(job, part)``, the job's
+dataset spec is fetched lazily at first grant and cached, and the frame
+store is keyed per job, so one worker process serves N trainers' corpora
+side by side (docs/service.md multi-tenant service). Each granted part
+runs the **existing** parser stack
+(:func:`dmlc_tpu.data.parsers.create_parser` with the job's
+dispatcher-shipped config, optionally fronted by the parse-once
+:class:`~dmlc_tpu.data.parsers.BlockCacheIter` when the config carries
+``block_cache`` — a relaunched worker then re-serves its parts from the
+warm cache instead of re-parsing text, and a part of a job whose
+share-by-signature cache was ALREADY published by a sibling job serves
+warm without parsing at all: that fleet-wide parse-once is the
+cross-job sharing claim, counted as ``service_parts_shared`` vs
+``service_parts_parsed`` for actual parses), encodes every RowBlock
+into a wire frame at parse time
 (:func:`~dmlc_tpu.service.frame.encode_block_frame`, ``service_encode``
-spans), and serves ``stream``/``find``/``count`` requests from trainer
-clients over its own TCP listener (``service_send`` spans).
+spans), and serves job-qualified ``stream``/``find``/``count`` requests
+from trainer clients over its own TCP listener (``service_send``
+spans). Completed parts tick the job-labeled
+``service_job_parts`` registry counter, so the tracker pod table shows
+per-job parts served next to per-rank stages (docs/observability.md).
 
 Fleet bootstrap reuses the tracker layer: pass ``tracker=(uri, port)``
 and the worker fetches a stable rank from the rabit-protocol tracker
@@ -77,6 +88,7 @@ from typing import Dict, List, Optional, Tuple
 from dmlc_tpu.io import faults as _faults
 from dmlc_tpu.io import resilience as _resilience
 from dmlc_tpu.service import dispatcher as _dispatch
+from dmlc_tpu.service.dispatcher import DEFAULT_JOB
 from dmlc_tpu.service.frame import (
     annot_key,
     encode_block_frame,
@@ -84,6 +96,7 @@ from dmlc_tpu.service.frame import (
     encode_error_frame,
     send_frame,
 )
+from dmlc_tpu.utils import telemetry as _telemetry
 from dmlc_tpu.utils.check import DMLCError
 from dmlc_tpu.utils.timer import get_time
 
@@ -146,9 +159,20 @@ class ParseWorker:
         self._policy = _resilience.default_policy()
         self._gen: Optional[int] = None
         cfg = self._request({"cmd": "config"}, reattach=False)
-        self.uri = cfg["uri"]
-        self.num_parts = int(cfg["num_parts"])
+        # the default job's spec, kept as attributes for the historical
+        # one-dataset view (None/0/{} on a dispatcher born empty); jobs
+        # beyond the default are fetched lazily at first grant and
+        # cached in _job_cfgs (docs/service.md multi-tenant service)
+        self.uri = cfg.get("uri")
+        self.num_parts = int(cfg.get("num_parts") or 0)
         self._parser_cfg = dict(cfg.get("parser") or {})
+        self._job_cfgs: Dict[str, dict] = {}
+        if self.uri is not None:
+            self._job_cfgs[DEFAULT_JOB] = {
+                "uri": self.uri, "num_parts": self.num_parts,
+                "parser": self._parser_cfg,
+                "plan": dict(cfg.get("plan") or {}),
+                "snapshot": dict(cfg.get("snapshot") or {})}
         # per-host parse-tier self-tuning (docs/data.md autotune; the
         # tf.data-service motivation — a heterogeneous fleet cannot share
         # one static parse_workers): each completed part is a clean
@@ -203,11 +227,21 @@ class ParseWorker:
                 f"rank{self.rank}" if self.rank >= 0
                 else f"{self.host}:{self.port}")
             self._cond = threading.Condition()
-            self._store: Dict[int, _PartStore] = {}
-            # every part this worker ever parsed, in order — the
+            # frame stores are PER JOB: (job, part) -> _PartStore, so N
+            # multiplexed jobs' parts never collide (docs/service.md)
+            self._store: Dict[Tuple[str, int], _PartStore] = {}
+            # every part this worker ever processed, in order — the
             # no-re-parse evidence chaos tests assert on (a reclaimed
-            # part must appear exactly once across the fleet)
+            # part must appear exactly once across the fleet); the
+            # job-qualified twin rides parts_by_job
             self.parts_parsed: List[int] = []
+            self.parts_by_job: Dict[str, List[int]] = {}
+            # the cross-job sharing evidence: parts whose supply ran an
+            # actual text parse (cold) vs parts that resolved to an
+            # already-published share-by-signature block cache (warm —
+            # the parse was avoided fleet-wide; docs/store.md)
+            self.parts_cold: List[Tuple[str, int]] = []
+            self.parts_warm: List[Tuple[str, int]] = []
             # artifact-store pins held for parts this worker serves: a
             # block cache published while parsing a part stays pinned for
             # the worker's life, so a fleet-wide byte-budget squeeze can
@@ -286,20 +320,26 @@ class ParseWorker:
                       reattach=False)
 
     def _reclaim(self) -> None:
-        """Re-announce the fully-parsed parts still in the frame store
-        so a restarted dispatcher adopts them instead of re-issuing them
-        for a fleet-wide re-parse (counted as ``parts_reclaimed``). An
-        empty announce is still useful: it re-queues any stale parts the
-        dispatcher maps to this id whose frames this incarnation does
-        not hold."""
+        """Re-announce the fully-parsed parts still in the frame store —
+        per job — so a restarted dispatcher adopts them instead of
+        re-issuing them for a fleet-wide re-parse (counted as
+        ``parts_reclaimed``). An empty announce is still useful: it
+        re-queues any stale parts the dispatcher maps to this id whose
+        frames this incarnation does not hold."""
+        held: Dict[str, List[int]] = {}
         with self._cond:
-            held = sorted(p for p, s in self._store.items()
-                          if s.complete and s.error is None)
+            for (job, part), s in self._store.items():
+                if s.complete and s.error is None:
+                    held.setdefault(job, []).append(part)
+        for parts in held.values():
+            parts.sort()
         resp = self._request({"cmd": "reclaim", "worker": self.worker_id,
                               "parts": held}, reattach=False)
-        adopted = resp.get("adopted") or []
-        if adopted:
-            _resilience.record_event("parts_reclaimed", len(adopted))
+        adopted = resp.get("adopted") or {}
+        count = (sum(len(ps) for ps in adopted.values())
+                 if isinstance(adopted, dict) else len(adopted))
+        if count:
+            _resilience.record_event("parts_reclaimed", count)
             logger.info("worker %s: dispatcher adopted reclaimed parts %s",
                         self.worker_id, adopted)
 
@@ -484,10 +524,26 @@ class ParseWorker:
 
     # ---------------- parse side ----------------
 
-    def _build_parser(self, part: int):
+    def _job_cfg(self, job: str) -> dict:
+        """The dataset spec of ``job`` — the cached default/previously
+        granted specs, or one lazy ``config`` RPC for a job registered
+        after this worker booted (the multiplexing seam)."""
+        cfg = self._job_cfgs.get(job)
+        if cfg is None:
+            cfg = self._request({"cmd": "config", "job": job})
+            self._job_cfgs[job] = cfg = {
+                "uri": cfg.get("uri"),
+                "num_parts": int(cfg.get("num_parts") or 0),
+                "parser": dict(cfg.get("parser") or {}),
+                "plan": dict(cfg.get("plan") or {}),
+                "snapshot": dict(cfg.get("snapshot") or {})}
+        return cfg
+
+    def _build_parser(self, job: str, part: int):
         from dmlc_tpu.data.parsers import create_parser
 
-        kwargs = dict(self._parser_cfg)
+        cfg = self._job_cfg(job)
+        kwargs = dict(cfg["parser"])
         type_ = kwargs.pop("format", kwargs.pop("type_", "auto"))
         # plan knobs never reach the worker's parser (see __init__): the
         # frame store must be parse-order for exact-block failover resume
@@ -497,7 +553,8 @@ class ParseWorker:
         if self.tier_tuner is not None:
             # the self-tuned tier overrides the shipped static width
             kwargs["parse_workers"] = self.tier_tuner.workers
-        return create_parser(self.uri, part, self.num_parts, type_, **kwargs)
+        return create_parser(cfg["uri"], part, cfg["num_parts"], type_,
+                             **kwargs)
 
     def _retune_parse_tier(self, parser) -> None:
         """Feed the completed part's measured parallelism efficiency back
@@ -581,17 +638,39 @@ class ParseWorker:
             if part is None:
                 self._stop.wait(self.poll_interval)
                 continue
-            self._parse_part(int(part))
+            self._parse_part(str(resp.get("job") or DEFAULT_JOB),
+                             int(part))
 
-    def _parse_part(self, part: int) -> None:
+    def _parse_part(self, job: str, part: int) -> None:
         store = _PartStore()
+        # cache the job's spec BEFORE the store entry becomes visible: a
+        # client's snapshot-stream request can arrive the instant the
+        # dispatcher's locate names this worker, and the serve path
+        # reads the job's geometry from the cfg cache with no RPC — so
+        # the cache must be populated first. A failed fetch still
+        # publishes the store (with the error), so waiting clients
+        # relocate instead of timing out on a missing entry.
+        cfg_exc: Optional[BaseException] = None
+        try:
+            self._job_cfg(job)
+        except (OSError, DMLCError, ValueError) as exc:
+            cfg_exc = exc
         with self._cond:
-            self._store[part] = store
+            self._store[(job, part)] = store
             self.parts_parsed.append(part)
+            self.parts_by_job.setdefault(job, []).append(part)
             self._cond.notify_all()
         parser = None
+        warm = False
         try:
-            parser = self._build_parser(part)
+            if cfg_exc is not None:
+                raise cfg_exc
+            parser = self._build_parser(job, part)
+            # a part whose share-by-signature block cache was already
+            # published (by a sibling job over the same corpus, or by
+            # this worker's previous incarnation) serves WARM: the parse
+            # is avoided fleet-wide (docs/store.md share-by-signature)
+            warm = getattr(parser, "cache_state", "cold") == "warm"
             while True:
                 if self._stop.is_set():
                     return  # killed mid-parse: the part stays incomplete
@@ -620,8 +699,8 @@ class ParseWorker:
                     self._cond.notify_all()
         except Exception as exc:  # noqa: BLE001 - served to clients as ERROR
             store.error = f"{type(exc).__name__}: {exc}"
-            logger.warning("worker %s: parse of part %d failed: %s",
-                           self.worker_id, part, store.error)
+            logger.warning("worker %s: parse of job %s part %d failed: %s",
+                           self.worker_id, job, part, store.error)
         finally:
             if store.error is None:
                 # only CLEAN parts are measurement windows: a failed part
@@ -636,6 +715,20 @@ class ParseWorker:
             with self._cond:
                 store.complete = True
                 self._cond.notify_all()
+            if store.error is None:
+                # the sharing ledger: an actual parse vs a part resolved
+                # from an already-published shared artifact (the bench
+                # two-job leg's shared_parse_ratio reads these)
+                if warm:
+                    self.parts_warm.append((job, part))
+                    _resilience.record_event("service_parts_shared")
+                else:
+                    self.parts_cold.append((job, part))
+                    _resilience.record_event("service_parts_parsed")
+                # job-labeled parts-served tick for the tracker pod
+                # table (docs/observability.md per-job rows)
+                _telemetry.REGISTRY.counter(
+                    _telemetry.SERVICE_JOB_PARTS_METRIC, job=job).inc()
             if store.error is None and not self._stop.is_set():
                 # journal the completion at the dispatcher: a restarted
                 # control plane then keeps the part DONE instead of
@@ -645,11 +738,13 @@ class ParseWorker:
                 # the dispatcher restarted mid-parse)
                 try:
                     self._request({"cmd": "part_done", "part": part,
-                                   "worker": self.worker_id})
+                                   "worker": self.worker_id, "job": job})
                 except (OSError, DMLCError, ValueError):
                     pass
-        logger.info("worker %s: part %d parsed (%d blocks)",
-                    self.worker_id, part, len(store.frames))
+        logger.info("worker %s: job %s part %d %s (%d blocks)",
+                    self.worker_id, job, part,
+                    "served warm" if warm else "parsed",
+                    len(store.frames))
 
     def _pin_part_artifact(self, parser) -> None:
         """Hold the eviction pin on a part's published block cache for
@@ -704,16 +799,23 @@ class ParseWorker:
             threading.Thread(target=self._handle, args=(conn,),
                              daemon=True).start()
 
-    def _wait_store(self, part: int, timeout: float = 5.0):
-        """The store of a part whose grant may still be in flight (the
-        dispatcher answered ``locate`` the instant it assigned the part);
-        None when this worker does not serve it."""
-        if not 0 <= part < self.num_parts:
+    def _wait_store(self, job: str, part: int, timeout: float = 5.0):
+        """The store of a (job, part) whose grant may still be in flight
+        (the dispatcher answered ``locate`` the instant it assigned the
+        part); None when this worker does not serve it. Out-of-range
+        parts of a job whose spec is already cached reject instantly —
+        a burst of stale locates must not hold handler threads for the
+        full wait."""
+        if part < 0:
             return None
+        cfg = self._job_cfgs.get(job)
+        if cfg is not None and part >= int(cfg.get("num_parts") or 0):
+            return None
+        key = (job, part)
         with self._cond:
             ok = self._cond.wait_for(
-                lambda: part in self._store or self._dead, timeout=timeout)
-            return self._store.get(part) if ok else None
+                lambda: key in self._store or self._dead, timeout=timeout)
+            return self._store.get(key) if ok else None
 
     def _handle(self, conn: socket.socket) -> None:
         try:
@@ -722,20 +824,22 @@ class ParseWorker:
                 line = f.readline()
             req = json.loads(line) if line else {}
             cmd = req.get("cmd")
+            job = str(req.get("job") or DEFAULT_JOB)
             try:
                 part = int(req.get("part", -1))
             except (TypeError, ValueError):
                 part = -1  # "part": null etc — handlers answer with ERROR
             if cmd == "stream":
                 if req.get("snapshot"):
-                    self._serve_stream_snapshot(conn, part,
+                    self._serve_stream_snapshot(conn, job, part,
                                                 int(req.get("start", 0)))
                 else:
-                    self._serve_stream(conn, part, int(req.get("start", 0)))
+                    self._serve_stream(conn, job, part,
+                                       int(req.get("start", 0)))
             elif cmd == "find":
-                self._serve_find(conn, part, str(req.get("key", "")))
+                self._serve_find(conn, job, part, str(req.get("key", "")))
             elif cmd == "count":
-                self._serve_count(conn, part)
+                self._serve_count(conn, job, part)
             else:
                 send_frame(conn, encode_error_frame(
                     f"unknown request {cmd!r}"))
@@ -749,11 +853,12 @@ class ParseWorker:
             except OSError:
                 pass
 
-    def _serve_stream(self, conn, part: int, start: int) -> None:
-        store = self._wait_store(part)
+    def _serve_stream(self, conn, job: str, part: int, start: int) -> None:
+        store = self._wait_store(job, part)
         if store is None:
             send_frame(conn, encode_error_frame(
-                f"worker {self.worker_id} does not serve part {part}"))
+                f"worker {self.worker_id} does not serve job {job} "
+                f"part {part}"))
             return
         i = max(0, int(start))
         while True:
@@ -782,18 +887,18 @@ class ParseWorker:
             send_frame(conn, frame)  # the sendall runs outside the lock
             i += 1
 
-    def _pack_snapshot_frames(self, store: _PartStore) -> List[bytes]:
+    def _pack_snapshot_frames(self, store: _PartStore,
+                              geometry: dict) -> List[bytes]:
         """The part re-encoded as device-layout snapshot frames: decode
-        the stored CSR block frames, pack to the dispatcher's fixed
-        batch geometry, encode once, cache on the store (warm re-serves
-        pay nothing). Runs under no lock — only the cached-list publish
+        the stored CSR block frames, pack to the job's fixed batch
+        geometry, encode once, cache on the store (warm re-serves pay
+        nothing). Runs under no lock — only the cached-list publish
         does."""
         from dmlc_tpu.data.device import pack_dense_batches
         from dmlc_tpu.service.frame import (
             block_from_frame, decode_frame, encode_snapshot_frame,
         )
 
-        geometry = self.snapshot
         B = int(geometry["batch_size"])
         nc = int(geometry["num_col"])
         if geometry.get("x_dtype") == "bfloat16":
@@ -812,16 +917,22 @@ class ParseWorker:
                 "dense_packed", (packed,), rows=B, resume=resume))
         return frames
 
-    def _serve_stream_snapshot(self, conn, part: int, start: int) -> None:
-        """Stream a part as snapshot frames. Packing needs the whole
-        part (fixed batches span block boundaries), so this waits for
-        parse completion — the CSR stream stays the low-latency path;
-        snapshot frames trade first-byte latency for half the wire."""
-        store = self._wait_store(part)
-        if store is None or not self.snapshot:
+    def _serve_stream_snapshot(self, conn, job: str, part: int,
+                               start: int) -> None:
+        """Stream a part as snapshot frames (the geometry is the JOB's —
+        a bf16-wire trainer and a CSR trainer can share one fleet).
+        Packing needs the whole part (fixed batches span block
+        boundaries), so this waits for parse completion — the CSR stream
+        stays the low-latency path; snapshot frames trade first-byte
+        latency for half the wire."""
+        store = self._wait_store(job, part)
+        # a (job, part) in the store implies the job's cfg was fetched
+        # at grant time — the serve path never needs its own RPC
+        geometry = (self._job_cfgs.get(job) or {}).get("snapshot") or {}
+        if store is None or not geometry:
             send_frame(conn, encode_error_frame(
-                f"worker {self.worker_id} does not serve part {part} "
-                "as snapshot frames"))
+                f"worker {self.worker_id} does not serve job {job} "
+                f"part {part} as snapshot frames"))
             return
         with self._cond:
             self._cond.wait_for(lambda: store.complete or self._dead)
@@ -846,7 +957,7 @@ class ParseWorker:
                 store.snap_packing = True
         if frames is None:
             try:
-                packed = self._pack_snapshot_frames(store)
+                packed = self._pack_snapshot_frames(store, geometry)
             except Exception as exc:  # noqa: BLE001 - served as ERROR
                 with self._cond:
                     store.snap_packing = False
@@ -868,12 +979,12 @@ class ParseWorker:
         send_frame(conn, encode_end_frame(part, len(frames),
                                           draining=self._draining.is_set()))
 
-    def _serve_find(self, conn, part: int, key: str) -> None:
+    def _serve_find(self, conn, job: str, part: int, key: str) -> None:
         """Block index whose resume annotation matches ``key`` — the
         remote half of restoring a parser-chain checkpoint into a fresh
         service client. Scans incrementally so a match early in a part
         still being parsed answers without waiting for completion."""
-        store = self._wait_store(part)
+        store = self._wait_store(job, part)
         found = -1
         interrupted = error = None
         if store is not None:
@@ -898,8 +1009,8 @@ class ParseWorker:
             resp = {"block": found}
         conn.sendall(json.dumps(resp).encode() + b"\n")
 
-    def _serve_count(self, conn, part: int) -> None:
-        store = self._wait_store(part)
+    def _serve_count(self, conn, job: str, part: int) -> None:
+        store = self._wait_store(job, part)
         if store is None:
             conn.sendall(json.dumps(
                 {"error": f"part {part} not served"}).encode() + b"\n")
@@ -918,6 +1029,20 @@ class ParseWorker:
         conn.sendall(json.dumps(resp).encode() + b"\n")
 
     # ---------------- lifecycle ----------------
+
+    @property
+    def alive(self) -> bool:
+        """True while this worker serves: neither killed/closed nor
+        drained out."""
+        return not self._stop.is_set() and not self.drained
+
+    @property
+    def draining(self) -> bool:
+        """True once a graceful drain has begun: still serving its
+        frame-store-complete parts, but no longer grant-eligible — so
+        NOT live capacity (the autoscaler must not count or re-drain
+        it)."""
+        return self._draining.is_set()
 
     def _teardown(self) -> None:
         self._stop.set()

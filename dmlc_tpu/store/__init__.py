@@ -4,7 +4,13 @@ layout + crash-safe manifest, atomic publish with orphan GC, pin/drop
 refcounts, byte budgets with cost-aware eviction. See
 :mod:`dmlc_tpu.store.manager` and docs/store.md. The flock'd append-only
 JSONL substrate (:class:`~dmlc_tpu.store.journal.AppendJournal`) is
-shared with the data-service dispatcher's assignment journal."""
+shared with the data-service dispatcher's assignment journal, and
+:func:`signature_hash` doubles as the data service's cross-job
+share-by-signature key: the multi-tenant dispatcher digests each job's
+dataset identity with it to assign shared block-cache paths, so two
+jobs over the same corpus converge on the same published artifacts and
+the fleet parses that corpus exactly once (docs/store.md
+share-by-signature; docs/service.md multi-tenant service)."""
 
 from dmlc_tpu.store.journal import AppendJournal
 from dmlc_tpu.store.manager import (

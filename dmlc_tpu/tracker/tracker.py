@@ -418,6 +418,23 @@ class RabitTracker:
         with self._metrics_lock:
             return {r: dict(s) for r, s in self.metrics_by_rank.items()}
 
+    def pod_job_metrics(self) -> Dict[str, dict]:
+        """Fleet-wide per-job service breakdown, summed across ranks:
+        ``{job: {"input_wait_seconds", "parts"}}`` from the snapshots'
+        ``jobs`` sections (docs/observability.md per-job pod-table
+        rows). This is the aggregate input-starvation signal the fleet
+        autoscaler's tracker source reads (docs/service.md fleet
+        autoscaling)."""
+        out: Dict[str, dict] = {}
+        for snap in self.pod_metrics().values():
+            for job, rec in (snap.get("jobs") or {}).items():
+                tot = out.setdefault(str(job), {"input_wait_seconds": 0.0,
+                                                "parts": 0})
+                tot["input_wait_seconds"] += float(
+                    (rec or {}).get("input_wait_seconds", 0.0))
+                tot["parts"] += int((rec or {}).get("parts", 0))
+        return out
+
     def format_pod_table(self) -> str:
         """The merged per-rank × per-stage seconds table
         (telemetry.format_pod_table over the latest snapshots)."""

@@ -121,6 +121,29 @@ KNOB_TABLE: Dict[str, KnobSpec] = {
                 "completes and remaining parts re-issue (docs/service.md "
                 "elastic membership). Not an autotuned knob — the "
                 "deadline is the preemption notice window"),
+        KnobSpec(
+            "fleet_min", "DMLC_TPU_FLEET_MIN",
+            default=1, lo=1, hi=4096,
+            doc="fleet autoscaler floor: the worker count the fleet "
+                "never drains below (docs/service.md fleet autoscaling). "
+                "Not a DeviceIter-autotuned knob — it bounds the FLEET "
+                "controller, which moves worker count, not a pipeline "
+                "stage"),
+        KnobSpec(
+            "fleet_max", "DMLC_TPU_FLEET_MAX",
+            default=lambda: max(2, _cpus()), lo=1, hi=4096,
+            doc="fleet autoscaler ceiling: the worker count grow events "
+                "never exceed — the operator's capacity/cost cap "
+                "(docs/service.md fleet autoscaling)"),
+        KnobSpec(
+            "fleet_scale_interval", "DMLC_TPU_FLEET_SCALE_INTERVAL",
+            default=10, lo=1, hi=3600,
+            doc="seconds between fleet-autoscaler control ticks: each "
+                "tick aggregates per-job input_wait_seconds deltas from "
+                "the tracker pod table and may grow (live join) or "
+                "shrink (graceful drain) the fleet by ONE worker — "
+                "paired with hysteresis so decisions never flap "
+                "(docs/service.md fleet autoscaling)"),
     )
 }
 

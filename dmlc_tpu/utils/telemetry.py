@@ -87,6 +87,15 @@ AUTOTUNE_STEP_METRIC = "autotune_steps"
 # management, gauge labeled (root, tier) — evictions/rebuilds ride the
 # resilience counter like every other classified event (docs/store.md)
 STORE_BYTES_METRIC = "store_bytes"
+# multi-tenant data service (dmlc_tpu.service, docs/service.md): both
+# labeled by `job`. The wait counter is the CLIENT-side per-job input
+# starvation signal (every second a ServiceParser waits on the wire) the
+# fleet autoscaler aggregates from the tracker pod table; the parts
+# counter is the WORKER-side per-job parts-served tally. They ride
+# pod_snapshot()['jobs'] so the pod table shows a per-job breakdown next
+# to per-rank stages (docs/observability.md).
+SERVICE_JOB_WAIT_METRIC = "service_job_input_wait_seconds"
+SERVICE_JOB_PARTS_METRIC = "service_job_parts"
 
 
 # ---------------- pipeline scoping ----------------
@@ -569,10 +578,21 @@ def pod_snapshot() -> dict:
     if transfer:
         stages["transfer"] = stages.get("transfer", 0.0) + transfer
     events = REGISTRY.sum_by(RESILIENCE_METRIC, "event")
+    # per-job data-service breakdown (docs/service.md multi-tenant
+    # service): client-side input wait + worker-side parts served,
+    # keyed by job — the autoscaler's fleet-wide signal is the sum of
+    # these across ranks (additive key; schema stays v1 because old
+    # readers ignore it and every v1 field is unchanged)
+    job_waits = REGISTRY.sum_by(SERVICE_JOB_WAIT_METRIC, "job")
+    job_parts = REGISTRY.sum_by(SERVICE_JOB_PARTS_METRIC, "job")
+    jobs = {j: {"input_wait_seconds": round(job_waits.get(j, 0.0), 4),
+                "parts": int(round(job_parts.get(j, 0)))}
+            for j in sorted(set(job_waits) | set(job_parts)) if j}
     return {
         "telemetry_schema_version": SCHEMA_VERSION,
         "stages": {k: round(v, 4) for k, v in stages.items() if k},
         "resilience": {k: int(round(v)) for k, v in events.items() if k},
+        "jobs": jobs,
         # tiered artifact store (docs/store.md): this host's live bytes
         # under management + its eviction/rebuild tallies, so the pod
         # table shows which rank's disk the budget is squeezing
@@ -588,10 +608,23 @@ def pod_snapshot() -> dict:
     }
 
 
+def _format_jobs_cell(jobs: dict) -> str:
+    """One rank's per-job breakdown cell: ``job=wait<seconds>s/parts<n>``
+    per job (docs/observability.md per-job pod-table rows)."""
+    cells = []
+    for j in sorted(jobs):
+        rec = jobs[j] or {}
+        cells.append(f"{j}=wait{float(rec.get('input_wait_seconds', 0.0)):.3f}s"
+                     f"/parts{int(rec.get('parts', 0))}")
+    return " ".join(cells) if cells else "-"
+
+
 def format_pod_table(by_rank: Dict[int, dict]) -> str:
     """Merged per-rank × per-stage seconds table from worker snapshots
-    (what the tracker logs). Ranks whose snapshot carries a different
-    schema version are listed but not merged."""
+    (what the tracker logs), with a trailing per-job breakdown column
+    (job-labeled input wait + parts served — the fleet autoscaler's
+    operator-visible input signal). Ranks whose snapshot carries a
+    different schema version are listed but not merged."""
     stage_cols = list(STAGES)
     extras = sorted({s for snap in by_rank.values()
                      for s in (snap.get("stages") or {})
@@ -599,9 +632,10 @@ def format_pod_table(by_rank: Dict[int, dict]) -> str:
     stage_cols += extras
     width = max([5] + [len(s) for s in stage_cols])
     header = "rank  " + "  ".join(f"{s:>{width}}" for s in stage_cols) \
-        + "  resilience"
+        + "  resilience  jobs"
     lines = [header]
     totals = {s: 0.0 for s in stage_cols}
+    job_totals: Dict[str, Dict[str, float]] = {}
     for rank in sorted(by_rank):
         snap = by_rank[rank] or {}
         if snap.get("telemetry_schema_version") != SCHEMA_VERSION:
@@ -622,11 +656,21 @@ def format_pod_table(by_rank: Dict[int, dict]) -> str:
         store_bytes = (snap.get("store") or {}).get("store_bytes")
         if store_bytes:
             hot["store_bytes"] = int(store_bytes)
+        jobs = snap.get("jobs") or {}
+        for j, rec in jobs.items():
+            tot = job_totals.setdefault(j, {"input_wait_seconds": 0.0,
+                                            "parts": 0})
+            tot["input_wait_seconds"] += float(
+                (rec or {}).get("input_wait_seconds", 0.0))
+            tot["parts"] += int((rec or {}).get("parts", 0))
         lines.append(f"{rank:>4}  " + "  ".join(cells)
-                     + f"  {hot if hot else '-'}")
+                     + f"  {hot if hot else '-'}"
+                     + f"  {_format_jobs_cell(jobs)}")
     lines.append("-" * len(header))
     lines.append(" sum  " + "  ".join(
-        f"{totals[s]:>{width}.3f}" for s in stage_cols))
+        f"{totals[s]:>{width}.3f}" for s in stage_cols)
+        + (f"  jobs: {_format_jobs_cell(job_totals)}"
+           if job_totals else ""))
     return "\n".join(lines)
 
 

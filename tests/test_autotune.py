@@ -692,7 +692,7 @@ class TestParseTierTuner:
             worker = fleet.workers[0]
             deadline = time.time() + 10.0
             while time.time() < deadline:
-                store = worker._store.get(0)
+                store = worker._store.get(("default", 0))
                 if store is not None and store.complete:
                     break
                 time.sleep(0.05)

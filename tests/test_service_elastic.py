@@ -55,8 +55,9 @@ def test_background_reaper_requeues_silent_dead_worker():
 
         def reaped():
             with disp._lock:
+                default = disp._jobs[svc_dispatcher.DEFAULT_JOB]
                 return (disp._workers["a"].state == "dead"
-                        and list(disp._todo) == [0, 1])
+                        and list(default.todo) == [0, 1])
         _wait_for(reaped, timeout=5.0,
                   what="silent dead worker reaped by the tick thread")
     finally:

@@ -1,0 +1,572 @@
+"""Tier-1 suite for the multi-tenant data service (docs/service.md
+multi-tenant service): the N-job registry (``register_job`` RPC,
+immutable job identity, per-job config), fair round-robin grant
+rotation, job-scoped journal recovery (replay-exact across kill -9 for
+every registered job), the classified-fatal dataset-mismatch
+configuration error, cross-job artifact sharing by store signature (one
+corpus parses exactly once fleet-wide; pins protect the shared cache
+through a worker restart; eviction heals for every sharing job), the
+input-wait-driven fleet autoscaler (grow on starvation, graceful drain
+back, hysteresis, per-job fairness, validated knob bounds), and the
+per-job pod-table breakdown the autoscaler's signal is read from."""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import pytest
+
+from dmlc_tpu.io import resilience
+from dmlc_tpu.service import (
+    DEFAULT_JOB,
+    LocalFleet,
+    ServiceConfigError,
+    ServiceParser,
+)
+from dmlc_tpu.service import dispatcher as svc_dispatcher
+from dmlc_tpu.service.autoscale import GROW, HOLD, SHRINK
+from dmlc_tpu.utils import telemetry
+from dmlc_tpu.utils.check import DMLCError
+
+from tests.test_service import (  # noqa: F401  (corpus fixture)
+    NUM_PARTS,
+    PARSER_CFG,
+    _assert_blocks_equal,
+    _drain,
+    _local_blocks,
+    _write_corpus,
+    corpus,
+)
+from tests.test_service_recovery import _req, _wait_for  # noqa: F401
+
+# the second corpus (job "other"): different rows/seed so any cross-job
+# stream mixup fails byte comparison immediately
+OTHER_PARTS = 2
+
+
+def _write_other(tmp_path):
+    return _write_corpus(tmp_path / "other.libsvm", rows=3000, seed=7)
+
+
+# ---------------------------------------------------------------------------
+# job registry (RPC units)
+
+def test_register_job_rpc_config_and_status(corpus, tmp_path):
+    other = _write_other(tmp_path)
+    disp = svc_dispatcher.Dispatcher(corpus, NUM_PARTS,
+                                     parser=PARSER_CFG,
+                                     liveness_timeout=0)
+    try:
+        resp = svc_dispatcher.register_job(
+            disp.address, "other", other, OTHER_PARTS, parser=PARSER_CFG)
+        assert resp["ok"] and resp["job"] == "other"
+        assert resp["existing"] is False
+        # per-job config; the bare (legacy) config stays the default job
+        cfg = _req(disp, "config", job="other")
+        assert cfg["uri"] == other and cfg["num_parts"] == OTHER_PARTS
+        assert cfg["job"] == "other"
+        legacy = _req(disp, "config")
+        assert legacy["uri"] == corpus and "job" not in legacy
+        # unknown jobs are a loud error, not a silent default
+        with pytest.raises(DMLCError):
+            _req(disp, "config", job="ghost")
+        status = _req(disp, "status")
+        assert sorted(status["jobs"]) == [DEFAULT_JOB, "other"]
+        assert status["jobs"]["other"]["todo"] == list(range(OTHER_PARTS))
+        # legacy top-level assignment fields mirror the default job
+        assert status["todo"] == list(range(NUM_PARTS))
+        # idempotent re-registration of the identical spec
+        again = svc_dispatcher.register_job(
+            disp.address, "other", other, OTHER_PARTS, parser=PARSER_CFG)
+        assert again["ok"] and again["existing"] is True
+        # a conflicting spec is refused: job identity is immutable
+        with pytest.raises(DMLCError, match="immutable"):
+            svc_dispatcher.register_job(disp.address, "other", other,
+                                        OTHER_PARTS + 1,
+                                        parser=PARSER_CFG)
+    finally:
+        disp.close()
+
+
+def test_grant_rotation_round_robin_across_jobs(corpus, tmp_path):
+    """Per-job fairness: one polling worker alternates jobs instead of
+    draining the first job's queue job-major — a greedy many-part job
+    cannot drown a starved sibling."""
+    other = _write_other(tmp_path)
+    disp = svc_dispatcher.Dispatcher(corpus, 4, parser=PARSER_CFG,
+                                     liveness_timeout=0)
+    try:
+        disp.register_job("other", other, 4, parser=PARSER_CFG)
+        _req(disp, "register", worker="a", host="h", port=1)
+        grants = []
+        for _ in range(8):
+            resp = _req(disp, "next_split", worker="a")
+            grants.append((resp.get("job"), resp["part"]))
+        assert grants == [(DEFAULT_JOB, 0), ("other", 0),
+                          (DEFAULT_JOB, 1), ("other", 1),
+                          (DEFAULT_JOB, 2), ("other", 2),
+                          (DEFAULT_JOB, 3), ("other", 3)]
+        assert _req(disp, "next_split", worker="a")["part"] is None
+    finally:
+        disp.close()
+
+
+# ---------------------------------------------------------------------------
+# dataset-mismatch configuration error (satellite): classified FATAL
+
+def test_journal_dataset_mismatch_is_fatal_config_error(tmp_path):
+    jp = str(tmp_path / "disp.jsonl")
+    svc_dispatcher.Dispatcher("d.libsvm", 3, journal_path=jp,
+                              liveness_timeout=0).kill()
+    # legacy one-dataset journal vs a conflicting constructor
+    with pytest.raises(ServiceConfigError) as exc_info:
+        svc_dispatcher.Dispatcher("d.libsvm", 5, journal_path=jp,
+                                  liveness_timeout=0)
+    msg = str(exc_info.value)
+    assert jp in msg and "3" in msg and "5" in msg
+    assert "fresh journal" in msg  # actionable, names the way out
+    # NOT retryable: a journal/constructor disagreement cannot heal by
+    # re-attempting — the classifier must read it as fatal
+    assert resilience.classify(exc_info.value) == resilience.FATAL
+    # a constructor with no default dataset at all is the same class
+    with pytest.raises(ServiceConfigError):
+        svc_dispatcher.Dispatcher(journal_path=jp, liveness_timeout=0)
+
+
+def test_journal_restores_registered_jobs_and_rejects_conflicts(
+        corpus, tmp_path):
+    """The per-job journal twin: registered jobs replay with their full
+    spec across kill -9, an identical re-register is idempotent against
+    the restored state, and a conflicting one is refused."""
+    other = _write_other(tmp_path)
+    jp = str(tmp_path / "disp.jsonl")
+    disp = svc_dispatcher.Dispatcher(corpus, NUM_PARTS, parser=PARSER_CFG,
+                                     journal_path=jp, liveness_timeout=0)
+    disp.register_job("other", other, OTHER_PARTS, parser=PARSER_CFG)
+    _req(disp, "register", worker="a", host="h", port=1)
+    assert _req(disp, "next_split", worker="a")["part"] == 0  # default
+    resp = _req(disp, "next_split", worker="a")
+    assert (resp["job"], resp["part"]) == ("other", 0)
+    _req(disp, "part_done", worker="a", part=0, job="other")
+    disp.kill()
+
+    disp2 = svc_dispatcher.Dispatcher(corpus, NUM_PARTS,
+                                      parser=PARSER_CFG,
+                                      journal_path=jp, liveness_timeout=0)
+    try:
+        status = _req(disp2, "status")
+        assert sorted(status["jobs"]) == [DEFAULT_JOB, "other"]
+        jobs = status["jobs"]
+        assert jobs["other"]["uri"] == other
+        # job "other" part 0 journaled complete -> stays done; the
+        # default job's in-flight part 0 re-queued at the front
+        assert jobs["other"]["completed"] == [0]
+        assert jobs[DEFAULT_JOB]["completed"] == []
+        assert jobs[DEFAULT_JOB]["todo"][0] == 0
+        # the restored spec still enforces immutability
+        again = svc_dispatcher.register_job(
+            disp2.address, "other", other, OTHER_PARTS, parser=PARSER_CFG)
+        assert again["existing"] is True
+        with pytest.raises(DMLCError, match="immutable"):
+            svc_dispatcher.register_job(disp2.address, "other", other,
+                                        OTHER_PARTS + 3,
+                                        parser=PARSER_CFG)
+    finally:
+        disp2.close()
+
+
+# ---------------------------------------------------------------------------
+# cross-job artifact sharing by signature (satellite 3)
+
+def _drain_job(address, job, **kw):
+    sp = ServiceParser(address, job=job, **kw)
+    try:
+        return _drain(sp)
+    finally:
+        sp.close()
+
+
+def test_two_jobs_share_corpus_parsed_once_fleet_wide(corpus, tmp_path):
+    """The acceptance core: jobs A (default) and B over the SAME corpus
+    + job C over a different one, on one live fleet with
+    share-by-signature armed. A parses the corpus; B's parts resolve to
+    the published block caches (zero parses); C parses its own corpus.
+    Every stream is byte-identical to its single-job run and the
+    fleet-wide actual-parse ledger counts the shared corpus once."""
+    other = _write_other(tmp_path)
+    share = str(tmp_path / "share")
+    local_a = _local_blocks(corpus)
+    local_c = _local_blocks(other, OTHER_PARTS)
+    base = resilience.counters_snapshot()
+    fleet = LocalFleet(corpus, NUM_PARTS, num_workers=2,
+                       parser=PARSER_CFG, share_dir=share)
+    try:
+        got_a = _drain_job(fleet.address, DEFAULT_JOB)
+        _assert_blocks_equal(got_a, local_a)
+        # register B (same corpus+config -> same signature) AFTER A's
+        # epoch published the caches: B must not parse anything
+        resp = fleet.register_job("b", corpus, NUM_PARTS,
+                                  parser=PARSER_CFG)
+        assert resp["share_sig"], "share-by-signature did not arm"
+        assert resp["parser"]["block_cache"].startswith(share)
+        got_b = _drain_job(fleet.address, "b")
+        _assert_blocks_equal(got_b, local_a)  # byte-identical cross-job
+        fleet.register_job("c", other, OTHER_PARTS, parser=PARSER_CFG)
+        got_c = _drain_job(fleet.address, "c")
+        _assert_blocks_equal(got_c, local_c)
+        # fleet-wide parse ledger: A's parts + C's parts parsed, B's
+        # parts ALL served from the shared published artifacts
+        cold = sorted(jp for w in fleet.workers for jp in w.parts_cold)
+        warm = sorted(jp for w in fleet.workers for jp in w.parts_warm)
+        assert cold == sorted(
+            [(DEFAULT_JOB, p) for p in range(NUM_PARTS)]
+            + [("c", p) for p in range(OTHER_PARTS)])
+        assert warm == sorted(("b", p) for p in range(NUM_PARTS))
+        delta = resilience.counters_delta(base)
+        assert delta["service_parts_parsed"] == NUM_PARTS + OTHER_PARTS
+        assert delta["service_parts_shared"] == NUM_PARTS
+        assert delta["service_giveups"] == 0
+        # the shared artifacts live in share_dir under store management
+        shared = [n for n in os.listdir(share) if n.endswith(
+            tuple(f".part{p}" for p in range(NUM_PARTS)))]
+        assert shared, "no shared block caches published"
+    finally:
+        fleet.close()
+
+
+def test_shared_cache_pinned_through_mid_epoch_worker_restart(
+        corpus, tmp_path, monkeypatch):
+    """Store pins protect the shared cache: a starvation-level byte
+    budget armed over the published artifacts evicts nothing while the
+    serving workers' pins hold, a worker killed and replaced mid-epoch
+    of the SECOND job re-serves from the still-published cache
+    (byte-identical, zero re-parses) — and once every pin is gone the
+    SAME budget pass evicts the lot, proving the pins were the
+    protection."""
+    from dmlc_tpu.store import reset_stores, store_for
+
+    share = str(tmp_path / "share")
+    local = _local_blocks(corpus)
+    fleet = LocalFleet(corpus, NUM_PARTS, num_workers=2,
+                       parser=PARSER_CFG, share_dir=share)
+    cached = []
+    try:
+        _assert_blocks_equal(_drain_job(fleet.address, DEFAULT_JOB),
+                             local)
+        cached = sorted(n for n in os.listdir(share) if ".part" in n)
+        assert len(cached) == NUM_PARTS
+        # arm a 1-byte budget NOW and force a fresh store pass: the
+        # enforcement would evict every unpinned artifact — the live
+        # workers' pins are the only thing keeping the shared tier
+        monkeypatch.setenv("DMLC_TPU_STORE_BUDGET_BYTES", "1")
+        reset_stores()
+        st = store_for(os.path.join(share, cached[0]))
+        live = [e for e in st.entries() if not e["evicted"]]
+        assert sorted(e["path"] for e in live) == cached
+        assert all(e["pinned"] for e in live)
+        assert sorted(n for n in os.listdir(share)
+                      if ".part" in n) == cached
+        # mid-epoch restart of the SECOND job against the pinned cache
+        fleet.register_job("b", corpus, NUM_PARTS, parser=PARSER_CFG)
+        sp = ServiceParser(fleet.address, job="b")
+        got = [sp.next_block() for _ in range(3)]
+        fleet.kill_worker(0)
+        fleet.add_worker()
+        got.extend(_drain(sp))
+        sp.close()
+        _assert_blocks_equal(got, local)
+        # job b never parsed: every part resolved to the shared cache
+        cold_b = [jp for w in fleet.workers if w is not None
+                  for jp in w.parts_cold if jp[0] == "b"]
+        assert cold_b == []
+    finally:
+        fleet.close()
+    # counterfactual: the fleet is gone, every pin dropped — the same
+    # budget pass now evicts the shared caches
+    reset_stores()
+    store_for(os.path.join(share, cached[0]))
+    assert not [n for n in os.listdir(share) if ".part" in n]
+    reset_stores()  # do not leak the budget-armed store to later tests
+
+
+def test_shared_artifact_eviction_heals_for_all_jobs(corpus, tmp_path):
+    """Evicting a shared artifact is survivable for every sharing job:
+    the next fleet misses, ONE job's pass rebuilds (parses once), and
+    both jobs' streams stay byte-identical."""
+    from dmlc_tpu.store import reset_stores, store_for
+
+    share = str(tmp_path / "share")
+    local = _local_blocks(corpus)
+    fleet = LocalFleet(corpus, NUM_PARTS, num_workers=2,
+                       parser=PARSER_CFG, share_dir=share)
+    try:
+        _assert_blocks_equal(_drain_job(fleet.address, DEFAULT_JOB),
+                             local)
+    finally:
+        fleet.close()
+    # evict every shared artifact (store-managed removal)
+    for name in os.listdir(share):
+        if ".part" in name:
+            store_for(os.path.join(share, name)).discard(
+                os.path.join(share, name))
+    reset_stores()
+    base = resilience.counters_snapshot()
+    fleet = LocalFleet(corpus, NUM_PARTS, num_workers=2,
+                       parser=PARSER_CFG, share_dir=share)
+    try:
+        _assert_blocks_equal(_drain_job(fleet.address, DEFAULT_JOB),
+                             local)
+        # register b once the rebuild has re-published (the sequential
+        # case is the deterministic parse-once claim; a job registered
+        # DURING a sibling's cold pass may race it part-wise, with the
+        # store's unique staging converging on one artifact)
+        fleet.register_job("b", corpus, NUM_PARTS, parser=PARSER_CFG)
+        _assert_blocks_equal(_drain_job(fleet.address, "b"), local)
+        delta = resilience.counters_delta(base)
+        # the rebuild parsed the corpus exactly once; job b shared it
+        assert delta["service_parts_parsed"] == NUM_PARTS
+        assert delta["service_parts_shared"] == NUM_PARTS
+    finally:
+        fleet.close()
+        reset_stores()
+
+
+# ---------------------------------------------------------------------------
+# fleet autoscaler (tentpole: input-wait-driven grow/drain)
+
+def test_autoscaler_grows_on_starvation_then_drains_back(corpus):
+    """The control acceptance: sustained per-job input wait grows the
+    fleet by live join; a sustained idle signal drains the added worker
+    gracefully back to the floor — with hysteresis (priming tick,
+    consecutive-tick streaks) and zero service_giveups."""
+    base = resilience.counters_snapshot()
+    fleet = LocalFleet(corpus, NUM_PARTS, num_workers=1,
+                       parser=PARSER_CFG)
+    waits = {"default": 0.0}
+    try:
+        scaler = fleet.autoscale(source=lambda: dict(waits),
+                                 min_workers=1, max_workers=2,
+                                 interval=1.0, up_ticks=2, down_ticks=2,
+                                 cooldown_ticks=0, start=False)
+        t = 0.0
+        assert scaler.step(now=t)["action"] == HOLD  # priming
+        for expect in (HOLD, GROW):  # 2 consecutive starved ticks
+            t += 1.0
+            waits["default"] += 1.0  # fully input-bound window
+            assert scaler.step(now=t)["action"] == expect
+        assert len(fleet.live_workers()) == 2
+        # at fleet_max: further starvation holds instead of flapping up
+        for _ in range(3):
+            t += 1.0
+            waits["default"] += 1.0
+            assert scaler.step(now=t)["action"] == HOLD
+        # idle: drains the ADDED worker back to the floor
+        for expect in (HOLD, SHRINK):
+            t += 1.0
+            assert scaler.step(now=t)["action"] == expect
+        _wait_for(lambda: len(fleet.live_workers()) == 1,
+                  what="autoscaler drain to complete")
+        # at fleet_min: more idle ticks hold
+        for _ in range(3):
+            t += 1.0
+            assert scaler.step(now=t)["action"] == HOLD
+        assert len(fleet.live_workers()) == 1
+        snap = scaler.snapshot()
+        assert snap["scale_ups"] == 1 and snap["scale_downs"] == 1
+        # the epoch still streams clean after the elasticity exercise
+        _assert_blocks_equal(_drain_job(fleet.address, DEFAULT_JOB),
+                             _local_blocks(corpus))
+        delta = resilience.counters_delta(base)
+        assert delta["fleet_scale_ups"] == 1
+        assert delta["fleet_scale_downs"] == 1
+        assert delta["service_giveups"] == 0
+    finally:
+        fleet.close()
+
+
+def test_autoscaler_fairness_starved_job_not_averaged_away(corpus):
+    """Per-job fairness: the decision signal is the MAX over jobs — one
+    starved job grows the fleet even when its siblings are idle (a mean
+    would read 0.33 here and never trigger)."""
+    fleet = LocalFleet(corpus, NUM_PARTS, num_workers=1,
+                       parser=PARSER_CFG)
+    waits = {"a": 0.0, "b": 0.0, "c": 0.0}
+    try:
+        scaler = fleet.autoscale(source=lambda: dict(waits),
+                                 min_workers=1, max_workers=3,
+                                 interval=1.0, grow_frac=0.5,
+                                 up_ticks=1, cooldown_ticks=0,
+                                 start=False)
+        t = 0.0
+        scaler.step(now=t)  # priming
+        t += 1.0
+        waits["a"] += 1.0  # only job a starves; b and c idle
+        rec = scaler.step(now=t)
+        assert rec["action"] == GROW
+        assert rec["wait_fracs"]["a"] == 1.0
+        assert len(fleet.live_workers()) == 2
+    finally:
+        fleet.close()
+
+
+def test_autoscaler_knob_validation(corpus, monkeypatch):
+    fleet = LocalFleet(corpus, NUM_PARTS, num_workers=1,
+                       parser=PARSER_CFG)
+    try:
+        # inverted bounds are a loud config error, not silent clamping
+        with pytest.raises(DMLCError, match="FLEET_MIN"):
+            fleet.autoscale(source=dict, min_workers=5, max_workers=2)
+        # garbage env values fail at the read site (knob-table row)
+        monkeypatch.setenv("DMLC_TPU_FLEET_MIN", "0")
+        with pytest.raises(DMLCError):
+            fleet.autoscale(source=dict)
+        monkeypatch.delenv("DMLC_TPU_FLEET_MIN")
+        monkeypatch.setenv("DMLC_TPU_FLEET_SCALE_INTERVAL", "soon")
+        with pytest.raises(DMLCError):
+            fleet.autoscale(source=dict)
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# per-job pod-table breakdown (satellite 2)
+
+def test_pod_snapshot_and_table_carry_per_job_breakdown():
+    telemetry.REGISTRY.counter(telemetry.SERVICE_JOB_WAIT_METRIC,
+                               job="jt-a").inc(1.25)
+    telemetry.REGISTRY.counter(telemetry.SERVICE_JOB_PARTS_METRIC,
+                               job="jt-a").inc(3)
+    telemetry.REGISTRY.counter(telemetry.SERVICE_JOB_PARTS_METRIC,
+                               job="jt-b").inc(2)
+    snap = telemetry.pod_snapshot()
+    assert snap["jobs"]["jt-a"]["input_wait_seconds"] >= 1.25
+    assert snap["jobs"]["jt-a"]["parts"] >= 3
+    assert snap["jobs"]["jt-b"]["parts"] >= 2
+    table = telemetry.format_pod_table({0: snap})
+    assert "jobs" in table.splitlines()[0]
+    assert "jt-a=wait" in table and "/parts" in table
+
+
+def test_tracker_pod_job_metrics_aggregates_across_ranks():
+    from dmlc_tpu.tracker.tracker import RabitTracker
+
+    trk = RabitTracker.__new__(RabitTracker)  # no sockets: metrics only
+    trk._metrics_lock = threading.Lock()
+    trk.metrics_by_rank = {
+        0: {"jobs": {"a": {"input_wait_seconds": 1.5, "parts": 2}}},
+        1: {"jobs": {"a": {"input_wait_seconds": 0.5, "parts": 1},
+                     "b": {"input_wait_seconds": 2.0, "parts": 4}}},
+    }
+    agg = trk.pod_job_metrics()
+    assert agg["a"] == {"input_wait_seconds": 2.0, "parts": 3}
+    assert agg["b"] == {"input_wait_seconds": 2.0, "parts": 4}
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: kill -9 recovery with three live jobs + job-bound states
+
+def test_dispatcher_kill9_mid_epoch_recovers_all_jobs(corpus, tmp_path):
+    """The acceptance chaos run: three jobs (two sharing a corpus, one
+    on its own) streaming mid-epoch, dispatcher kill -9, journal-exact
+    restart on the same address — every stream rides through
+    byte-identically and the recovered registry still knows all three
+    jobs."""
+    other = _write_other(tmp_path)
+    jp = str(tmp_path / "disp.jsonl")
+    share = str(tmp_path / "share")
+    local_a = _local_blocks(corpus)
+    local_c = _local_blocks(other, OTHER_PARTS)
+    fleet = LocalFleet(corpus, NUM_PARTS, num_workers=2,
+                       parser=PARSER_CFG, poll_interval=0.02,
+                       heartbeat_interval=0.1, liveness_timeout=5.0,
+                       journal_path=jp, share_dir=share)
+    clients = []
+    try:
+        fleet.register_job("b", corpus, NUM_PARTS, parser=PARSER_CFG)
+        fleet.register_job("c", other, OTHER_PARTS, parser=PARSER_CFG)
+        got = {}
+        for job, want in ((DEFAULT_JOB, local_a), ("b", local_a),
+                          ("c", local_c)):
+            sp = ServiceParser(fleet.address, job=job)
+            clients.append((job, sp, want))
+            got[job] = [sp.next_block() for _ in range(2)]  # mid-epoch
+        fleet.kill_dispatcher()
+        fleet.restart_dispatcher()
+        for job, sp, want in clients:
+            got[job].extend(_drain(sp))
+            _assert_blocks_equal(got[job], want)
+        status = _req(fleet.dispatcher, "status")
+        assert sorted(status["jobs"]) == ["b", "c", DEFAULT_JOB]
+        assert status["jobs"]["c"]["completed"] == list(
+            range(OTHER_PARTS))
+    finally:
+        for _, sp, _ in clients:
+            sp.close()
+        fleet.close()
+
+
+def test_job_bound_checkpoint_restores_and_cross_job_fails(corpus,
+                                                           tmp_path):
+    other = _write_other(tmp_path)
+    share = str(tmp_path / "share")
+    local_c = _local_blocks(other, OTHER_PARTS)
+    fleet = LocalFleet(corpus, NUM_PARTS, num_workers=2,
+                       parser=PARSER_CFG, share_dir=share)
+    try:
+        fleet.register_job("c", other, OTHER_PARTS, parser=PARSER_CFG)
+        sp = ServiceParser(fleet.address, job="c")
+        got = [sp.next_block() for _ in range(3)]
+        state = sp.state_dict()
+        assert state["job"] == "c"
+        sp.close()
+        # restore into a fresh client bound to the SAME job
+        sp2 = ServiceParser(fleet.address, job="c")
+        sp2.load_state(state)
+        got.extend(_drain(sp2))
+        sp2.close()
+        _assert_blocks_equal(got, local_c)
+        # a client bound to ANOTHER job must refuse the state loudly
+        spa = ServiceParser(fleet.address, job=DEFAULT_JOB)
+        with pytest.raises(DMLCError, match="bound to job"):
+            spa.load_state(state)
+        spa.close()
+        # legacy job-less service states restore into the DEFAULT job
+        # only: a default-bound client accepts them, a job-bound client
+        # refuses (they were written against the default job — silently
+        # applying the cursor to another job's order serves wrong data)
+        spb = ServiceParser(fleet.address)
+        spb.load_state({"kind": "service", "part": 0, "block": 0,
+                        "blocks": 0})
+        assert spb.next_block() is not None
+        spb.close()
+        spc = ServiceParser(fleet.address, job="c")
+        with pytest.raises(DMLCError, match="bound to job"):
+            spc.load_state({"kind": "service", "part": 0, "block": 0,
+                            "blocks": 0})
+        spc.close()
+    finally:
+        fleet.close()
+
+
+def test_worker_multiplexes_jobs_with_per_job_stores(corpus, tmp_path):
+    """One worker serves N jobs side by side: per-(job, part) frame
+    stores never collide even when two jobs cover the same corpus and
+    part indices."""
+    other = _write_other(tmp_path)
+    fleet = LocalFleet(corpus, NUM_PARTS, num_workers=1,
+                       parser=PARSER_CFG)
+    try:
+        fleet.register_job("c", other, OTHER_PARTS, parser=PARSER_CFG)
+        _assert_blocks_equal(_drain_job(fleet.address, DEFAULT_JOB),
+                             _local_blocks(corpus))
+        _assert_blocks_equal(_drain_job(fleet.address, "c"),
+                             _local_blocks(other, OTHER_PARTS))
+        worker = fleet.workers[0]
+        keys = sorted(worker._store)
+        assert keys == sorted(
+            [(DEFAULT_JOB, p) for p in range(NUM_PARTS)]
+            + [("c", p) for p in range(OTHER_PARTS)])
+        assert sorted(worker.parts_by_job) == ["c", DEFAULT_JOB]
+    finally:
+        fleet.close()
