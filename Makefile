@@ -190,6 +190,26 @@ bench-smoke:
 	    assert fse == 0, \
 	        f'fleet_scale_events {fse} != 0: the autoscaler flapped on a ' \
 	        'clean smoke run'; \
+	    wblocks = line.get('service_wire_blocks'); \
+	    assert wblocks, \
+	        'service_wire_blocks missing (wire v2 leg did not run)'; \
+	    assert line.get('service_pipeline_depth'), \
+	        'service_pipeline_depth missing'; \
+	    assert line.get('service_wire_gbps'), 'service_wire_gbps missing'; \
+	    wratio = line.get('service_wire_compression_ratio'); \
+	    assert wratio is not None and wratio <= 1.0, \
+	        f'service_wire_compression_ratio {wratio} missing or > 1.0 ' \
+	        '(the per-dtype break-even check shipped an inflating codec)'; \
+	    wspd = line.get('service_wire_pipelined_speedup'); \
+	    assert wspd is not None and wspd >= 0.85, \
+	        f'service_wire_pipelined_speedup {wspd} < 0.85: the pipelined ' \
+	        'schedule lost to one-request-per-frame beyond measurement ' \
+	        'noise (loopback RTT is microseconds, so the smoke gate is a ' \
+	        'no-regression floor; the window must never cost throughput)'; \
+	    wfp = line.get('service_wire_fastpath'); \
+	    assert wfp == wblocks, \
+	        f'service_wire_fastpath {wfp} != {wblocks}: the co-located ' \
+	        'client did not serve every block off the mmap fast path'; \
 	    assert line.get('autotune_enabled') is True, \
 	        'autotune_enabled missing (autotune leg did not run)'; \
 	    assert line.get('autotune_steps') is not None, \
@@ -243,6 +263,10 @@ bench-smoke:
 	    print('bench-smoke: multi-tenant OK:', line['service_jobs'], \
 	          'jobs, shared_parse_ratio', spr, ',', fse, \
 	          'fleet scale events'); \
+	    print('bench-smoke: wire v2 OK:', line['service_wire_gbps'], \
+	          'gbps at depth', line['service_pipeline_depth'], \
+	          ', pipelined x', wspd, ', compression', wratio, \
+	          ', fastpath', wfp, '/', wblocks, 'blocks'); \
 	    print('bench-smoke: autotune OK:', line['autotune_steps'], \
 	          'steps,', line.get('autotune_adjustments'), \
 	          'adjustments, converged', line.get('autotune_converged'), \

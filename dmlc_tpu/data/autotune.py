@@ -72,6 +72,14 @@ STAGE_KNOB: Dict[str, str] = {
     "dispatch": "prefetch",
 }
 
+# per-stage fallback when the primary knob is not registered on this
+# pipeline: a service-fed pipeline has no local parse fan-out, so its
+# read stage (frame recv waits — see ServiceParser.stage_seconds) climbs
+# the client's pipelined fetch window instead (docs/service.md Wire v2)
+STAGE_KNOB_FALLBACK: Dict[str, str] = {
+    "read": "service_pipeline_depth",
+}
+
 # busy-attribution stages the controller ranks when picking a move
 # (transfer deliberately absent: it has no host-side knob — it IS the
 # convergence target)
@@ -284,7 +292,8 @@ class AutoTuner:
         for stage_busy, stage in ranked:
             if stage_busy <= 0.0:
                 break
-            knob = self.knobs.get(STAGE_KNOB.get(stage, ""))
+            knob = (self.knobs.get(STAGE_KNOB.get(stage, ""))
+                    or self.knobs.get(STAGE_KNOB_FALLBACK.get(stage, "")))
             if knob is None:
                 continue
             # >= so a knob blocked at step S with hold H stays held for

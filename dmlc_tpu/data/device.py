@@ -959,6 +959,15 @@ class DeviceIter:
                 lambda: int(self._snap_read_workers
                             or _knobs.resolve("snapshot_read_workers")),
                 self._apply_snapshot_read_workers))
+        if callable(getattr(self.source, "resize_pipeline_depth", None)):
+            # service-fed pipeline: the read stage's relief knob is the
+            # client's pipelined fetch window (STAGE_KNOB_FALLBACK —
+            # there is no local parse fan-out to widen)
+            knobs.append(_autotune.Knob(
+                "service_pipeline_depth",
+                lambda: int(getattr(self.source, "pipeline_depth", 0)
+                            or _knobs.resolve("service_pipeline_depth")),
+                self.source.resize_pipeline_depth))
         return knobs
 
     def _apply_prefetch(self, n: int) -> bool:

@@ -1410,6 +1410,12 @@ class BlockCacheIter(Parser):
                 self._heal_corruption()
                 return self._next_cold()
             block = RowBlock.from_segments(segments, hold=reader.hold)
+            # span export: the block's contiguous cache span rides along
+            # so downstream single-materialization consumers (cache tee,
+            # service wire encode) reuse the mmap bytes with zero
+            # re-encode — the reader stays open for the block's lifetime
+            # via hold, which pins the same mmap
+            block.encoded = reader.block_encoded(i)
             annot = reader.resume(i)
             if annot is not None:
                 block.resume_state = annot

@@ -442,6 +442,26 @@ class BlockCacheReader:
             segments = {k: np.array(v) for k, v in segments.items()}
         return segments
 
+    def block_encoded(self, i: int):
+        """Block ``i``'s contiguous segment span as an
+        :class:`~dmlc_tpu.data.batch_parser.EncodedSegments` view over
+        the mmap — ZERO-COPY span export. A parse worker serving a warm
+        cache hands this straight to the wire encoder (the frame payload
+        IS the cache span, no per-array ``tobytes`` re-buffering) and a
+        vectored send ships the mmap pages themselves. The view aliases
+        the mmap via ``hold``; keep the reader open while it lives."""
+        from dmlc_tpu.data.batch_parser import EncodedSegments
+
+        entry = self._blocks[i]
+        pos, end = int(entry["pos"]), int(entry["end"])
+        span = memoryview(self._mm)[pos:end]
+        arrays = {name: (dt, int(off) - pos, int(nb))
+                  for name, (dt, off, nb) in entry["arrays"].items()}
+        return EncodedSegments(
+            data=span, arrays=arrays, crc=int(entry["crc"]),
+            rows=int(entry["rows"]),
+            num_col=self.num_col, hold=self._mm)
+
     def close(self) -> None:
         # the eviction pin drops first, unconditionally — even when
         # exported views keep the mmap alive (an unlinked-but-mapped file

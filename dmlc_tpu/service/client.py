@@ -66,6 +66,7 @@ to ``find`` the annotation in their frame stores — the service analog of
 
 from __future__ import annotations
 
+import os
 import socket
 import json
 import threading
@@ -77,12 +78,15 @@ from dmlc_tpu.io import faults as _faults
 from dmlc_tpu.io import resilience as _resilience
 from dmlc_tpu.service import dispatcher as _dispatch
 from dmlc_tpu.service.dispatcher import DEFAULT_JOB
+from dmlc_tpu.utils import knobs as _knobs
 from dmlc_tpu.utils import telemetry as _telemetry
 from dmlc_tpu.service.frame import (
     KIND_BLOCK,
     KIND_END,
     KIND_ERROR,
+    KIND_HELLO,
     KIND_SNAPSHOT,
+    WIRE_CODECS,
     ServiceFrameError,
     annot_key,
     block_from_frame,
@@ -183,6 +187,30 @@ class ServiceParser(Parser):
         self._recv_seconds = 0.0
         self._decode_seconds = 0.0
         self._last_annot: Optional[dict] = None
+        # ---- wire v2 session state (docs/service.md Wire v2) ----
+        # negotiated PER STREAM at open: the client always offers v2 and
+        # peeks the first frame — a HELLO means a v2 worker (pipelined
+        # newline-JSON fetches, negotiated codec, fast-path offer); any
+        # other frame means a v1 worker already pushing, and the peeked
+        # frame is stashed so nothing on the wire is lost
+        self._pipeline_depth = _knobs.resolve("service_pipeline_depth")
+        # what this client OFFERS at stream open (the negotiated result
+        # lands in _wire per stream): 2 everywhere, pinned to 1 only as
+        # an operational escape hatch / for the compat test matrix
+        self._offer_wire = 2
+        self._wire = 1
+        self._codec: Optional[str] = None
+        self._pending: Optional[tuple] = None
+        self._inflight = 0          # v2 fetches issued, reply not read
+        self._next_fetch = 0        # v2: next block index to fetch
+        self._blocks_total: Optional[int] = None  # from HELLO, if complete
+        self._fp_reader = None      # co-located mmap fast-path reader
+        self._fp_skip = False       # fast path failed: TCP for this part
+        self._fastpath_blocks = 0   # blocks served off the mmap, no TCP
+        # a finished part's drained, healthy v2 socket parked for reuse:
+        # (socket, owner) — adopted by _ensure_stream when the next part
+        # locates at the same worker, closed otherwise
+        self._held: Optional[tuple] = None
 
     # ---------------- control plane ----------------
 
@@ -234,9 +262,34 @@ class ServiceParser(Parser):
         # flight: once the stream is dropped (END, epoch reset) a later
         # fault must not report this — by then healthy — worker lost
         self._pending_owner = None
+        # v2 session state is per-stream: a reconnect re-negotiates and
+        # re-issues the in-flight window from the exact (part, block)
+        # cursor — nothing outstanding survives the old socket
+        self._wire = 1
+        self._codec = None
+        self._pending = None
+        self._inflight = 0
+        self._next_fetch = 0
+        self._blocks_total = None
         if sock is not None:
             try:
                 sock.close()
+            except OSError:
+                pass
+
+    def _close_fastpath(self) -> None:
+        reader, self._fp_reader = self._fp_reader, None
+        if reader is not None:
+            try:
+                reader.close()
+            except OSError:
+                pass
+
+    def _drop_held(self) -> None:
+        held, self._held = self._held, None
+        if held is not None:
+            try:
+                held[0].close()
             except OSError:
                 pass
 
@@ -276,17 +329,59 @@ class ServiceParser(Parser):
         self._pending_owner = str(owner["worker"])
         # the worker_rpc fault-plan seam: chaos plans break client->
         # worker data-plane connects deterministically (docs/resilience.md)
+        # — it fires per part-stream whether the transport reconnects or
+        # reuses, so chaos plans see the same schedule either way
         _faults.maybe_fail(
             "worker_rpc", f"{owner['worker']} stream part {self._part}")
+        held, self._held = self._held, None
+        if held is not None:
+            if held[1] == str(owner["worker"]):
+                # connection reuse (docs/service.md Wire v2): the next
+                # part located at the worker whose drained v2 stream we
+                # parked — adopt it; the first fetch line names the new
+                # (job, part) and re-targets the stream server-side.
+                # No HELLO on a re-target: ENDs close the part, and the
+                # fast path waits for the next fresh handshake.
+                self._sock, self._owner = held
+                self._wire = 2
+                self._blocks_total = None
+                self._next_fetch = self._pos
+                self._inflight = 0
+                self._pending = None
+                self._failover_from = None
+                return self._sock
+            try:
+                held[0].close()
+            except OSError:
+                pass
         sock = socket.create_connection(
             (owner["host"], int(owner["port"])),
             timeout=self._connect_timeout)
-        sock.settimeout(self._stream_timeout)
-        req = {"cmd": "stream", "part": self._part, "start": self._pos,
-               "job": self.job}
-        if self.snapshot:
-            req["snapshot"] = True
-        sock.sendall(json.dumps(req).encode() + b"\n")
+        try:
+            sock.settimeout(self._stream_timeout)
+            req = {"cmd": "stream", "part": self._part, "start": self._pos,
+                   "job": self.job}
+            offer_v2 = not self.snapshot and self._offer_wire >= 2
+            if self.snapshot:
+                # snapshot streams stay on the v1 push plane: packed
+                # batches are already the minimal wire form
+                req["snapshot"] = True
+            elif offer_v2:
+                # offer wire v2 (docs/service.md Wire v2): a v1 worker
+                # ignores the unknown keys and pushes v1 frames — the
+                # handshake peek below detects which peer answered
+                req["wire"] = 2
+                req["accept"] = sorted(WIRE_CODECS)
+                req["host"] = socket.gethostname()
+            sock.sendall(json.dumps(req).encode() + b"\n")
+            if offer_v2:
+                self._handshake(sock)
+        except BaseException:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
         self._sock = sock
         self._owner = str(owner["worker"])
         if self._failover_from is not None:
@@ -296,6 +391,59 @@ class ServiceParser(Parser):
                 _resilience.record_event("service_failovers")
             self._failover_from = None
         return sock
+
+    def _handshake(self, sock: socket.socket) -> None:
+        """Peek the first frame of a fresh stream. KIND_HELLO: a v2
+        worker — record the negotiated codec / shipped block count, arm
+        the pipelined fetch cursor at the exact resume position, and take
+        a co-located fast-path offer when one rides the HELLO. Anything
+        else: a v1 worker already pushing from ``start`` — stash the
+        peeked frame so the delivery loop consumes it first."""
+        kind, meta, payload = recv_frame(sock)
+        if kind != KIND_HELLO:
+            self._wire = 1
+            self._pending = (kind, meta, payload)
+            return
+        self._wire = 2
+        self._codec = meta.get("codec")
+        total = meta.get("blocks")
+        self._blocks_total = None if total is None else int(total)
+        self._next_fetch = self._pos
+        self._inflight = 0
+        if not self._fp_skip:
+            self._open_fastpath(meta.get("fastpath"))
+
+    def _open_fastpath(self, offer) -> None:
+        """Map a co-located worker's published block-cache artifact and
+        serve the part off the mmap, skipping TCP entirely. The reader
+        pins the artifact against byte-budget eviction for as long as it
+        is open (docs/store.md), and the blocks it yields are the same
+        cache spans the worker would have framed — byte-identical arrays
+        AND resume annotations. Any mismatch falls back to TCP."""
+        if not isinstance(offer, dict):
+            return
+        path = str(offer.get("path") or "")
+        if not path or not os.path.exists(path):
+            return
+        from dmlc_tpu.io.block_cache import BlockCacheReader
+
+        try:
+            reader = BlockCacheReader(path)
+        except (DMLCError, OSError, ValueError):
+            return  # unreadable / torn artifact: TCP serves the part
+        blocks = offer.get("blocks")
+        if (blocks is not None and int(blocks) != reader.num_blocks) or (
+                self._blocks_total is not None
+                and self._blocks_total != reader.num_blocks):
+            # the artifact on disk disagrees with what the worker serves:
+            # trust the wire, not the map
+            try:
+                reader.close()
+            except OSError:
+                pass
+            return
+        self._close_fastpath()
+        self._fp_reader = reader
 
     def _on_stream_fault(self, exc: BaseException) -> None:
         """One broken stream: count it, tell the dispatcher, back off.
@@ -329,14 +477,156 @@ class ServiceParser(Parser):
                 f"{self._policy.max_attempts}): {exc}") from exc
         self._policy.sleep(self._policy.backoff(used))
 
+    # ---------------- wire v2 engine ----------------
+
+    def _recv_stream(self, sock: socket.socket) -> tuple:
+        """One frame off the stream. v1: the worker pushes — just read
+        (the handshake's peeked frame first). v2: top the pipelined fetch
+        window up to ``service_pipeline_depth`` outstanding requests,
+        then read — the worker answers FIFO, so RTT and per-block turn
+        around hide behind the in-flight window."""
+        if self._pending is not None:
+            frame, self._pending = self._pending, None
+            return frame
+        if self._wire >= 2:
+            self._fill_window(sock)
+            frame = recv_frame(sock)
+            self._inflight -= 1
+            return frame
+        return recv_frame(sock)
+
+    def _fill_window(self, sock: socket.socket) -> None:
+        """Issue fetch lines until ``service_pipeline_depth`` are in
+        flight. With the part's block count known (HELLO on a complete
+        part) the window stops one PAST the last block, so the final
+        fetch elicits the END that closes the part; with the count
+        unknown (mid-parse part, re-targeted stream) the window runs
+        optimistically and every past-end fetch is answered by an END."""
+        depth = max(1, int(self._pipeline_depth))
+        lim = None if self._blocks_total is None else self._blocks_total + 1
+        while self._inflight < depth:
+            if lim is not None and self._next_fetch >= lim:
+                break
+            sock.sendall(json.dumps(
+                {"block": self._next_fetch, "part": self._part,
+                 "job": self.job}).encode() + b"\n")
+            self._next_fetch += 1
+            self._inflight += 1
+
+    def _hold_stream(self) -> None:
+        """Close out a finished part's v2 stream for reuse: drain the
+        window's trailing ENDs (FIFO — every in-flight fetch past the
+        end got one) and park the healthy socket; ``_ensure_stream``
+        adopts it when the next part locates at the same worker. Any
+        surprise on the drain just drops the socket — reuse is an
+        optimization, never a correctness hinge."""
+        sock, owner = self._sock, self._owner
+        clean = self._wire >= 2 and sock is not None and owner is not None
+        while clean and self._inflight > 0:
+            try:
+                kind, _meta, _payload = recv_frame(sock)
+            except (ConnectionError, OSError, ServiceFrameError):
+                clean = False
+                break
+            self._inflight -= 1
+            if kind != KIND_END:
+                clean = False
+        if clean:
+            self._sock = None  # detach so _drop_stream cannot close it
+            self._drop_stream()
+            self._drop_held()
+            self._held = (sock, owner)
+        else:
+            self._drop_stream()
+
+    def _fastpath_next(self, t0: float) -> Optional[RowBlock]:
+        """One block off the co-located mmap (docs/service.md Wire v2
+        fast path): the same cache span / resume annotation the worker
+        would have framed, with zero wire bytes. Returns None when the
+        part is finished (cursor advanced, reader closed — its eviction
+        pin drops with it) or when the map failed mid-part (falls back
+        to TCP at the exact block cursor)."""
+        reader = self._fp_reader
+        if self._pos >= reader.num_blocks:
+            self._close_fastpath()
+            self._part += 1
+            self._pos = 0
+            self._last_located = None
+            self._drain_move_from = None
+            self._fp_skip = False
+            return None
+        i = self._pos
+        t1 = get_time()
+        try:
+            segments = reader.load_segments(i)
+            block = RowBlock.from_segments(segments, hold=reader.hold)
+            block.encoded = reader.block_encoded(i)
+            annot = reader.resume(i)
+            nbytes = reader.block_nbytes(i)
+        except (DMLCError, OSError, ValueError):
+            # torn/evicted/corrupt map mid-part: the wire is the source
+            # of truth — resume over TCP at this exact block
+            self._close_fastpath()
+            self._fp_skip = True
+            return None
+        if annot is not None:
+            block.resume_state = annot
+        dt = get_time() - t0
+        self._recv_seconds += dt
+        self._wait_metric.inc(dt)
+        self._decode_seconds += get_time() - t1
+        self._bytes += nbytes
+        self._pos += 1
+        self._delivered += 1
+        self._fastpath_blocks += 1
+        self._stream_failures = 0
+        self._soft_retry_owner = None
+        self._drain_moves = 0
+        self._last_annot = annot
+        return block
+
+    def resize_pipeline_depth(self, depth: int) -> bool:
+        """Autotuner seam (docs/data.md feedback controller): the read
+        stage climbs ``service_pipeline_depth`` through this, the same
+        duck-typed contract as ``resize_prefetch``. Takes effect at the
+        next window fill — an oversized in-flight window simply drains
+        down. Returns False when nothing changed."""
+        depth = int(depth)
+        if depth < 1 or depth == self._pipeline_depth:
+            return False
+        self._pipeline_depth = depth
+        return True
+
+    @property
+    def pipeline_depth(self) -> int:
+        return self._pipeline_depth
+
+    @property
+    def fastpath_blocks(self) -> int:
+        """Blocks served off the co-located mmap fast path (the bench's
+        ``service_wire_fastpath``) — only the client can count these:
+        the worker just sees its stream close."""
+        return self._fastpath_blocks
+
     # ---------------- Parser contract ----------------
 
     def next_block(self) -> Optional[RowBlock]:
         while self._part < self.num_parts:
             t0 = get_time()
             try:
-                sock = self._ensure_stream()
-                kind, meta, payload = recv_frame(sock)
+                if self._fp_reader is None:
+                    self._ensure_stream()
+                if self._fp_reader is not None:
+                    # co-located fast path: the part serves off the mmap;
+                    # the handshake socket is released (the worker's
+                    # fetch-read returns EOF and the handler exits)
+                    self._drop_stream()
+                    block = self._fastpath_next(t0)
+                    if block is None:
+                        continue  # part done / fell back: loop re-aims
+                    return block
+                sock = self._sock
+                kind, meta, payload = self._recv_stream(sock)
             except (ConnectionError, OSError,
                     ServiceFrameError, ServiceUnavailableError) as exc:
                 # torn dispatcher replies arrive as ConnectionError —
@@ -402,13 +692,17 @@ class ServiceParser(Parser):
                 if meta.get("draining"):
                     # the part was served out by a DRAINING worker:
                     # confirm the handoff so the drain can complete
-                    # before its deadline instead of waiting it out
+                    # before its deadline instead of waiting it out —
+                    # and never park its socket (the worker is leaving)
                     self._confirm_handoff(self._part, self._owner)
-                self._drop_stream()
+                    self._drop_stream()
+                else:
+                    self._hold_stream()
                 self._part += 1
                 self._pos = 0
                 self._last_located = None
                 self._drain_move_from = None
+                self._fp_skip = False
                 continue
             if kind == KIND_ERROR and meta.get("draining"):
                 # GRACEFUL drain notice: the worker is leaving and the
@@ -451,6 +745,9 @@ class ServiceParser(Parser):
 
     def before_first(self) -> None:
         self._drop_stream()
+        self._close_fastpath()
+        self._drop_held()
+        self._fp_skip = False
         self._part = 0
         self._pos = 0
         self._delivered = 0
@@ -534,6 +831,9 @@ class ServiceParser(Parser):
 
     def load_state(self, state: dict) -> None:
         self._drop_stream()
+        self._close_fastpath()
+        self._drop_held()
+        self._fp_skip = False
         self._stream_failures = 0
         self._failover_from = None
         self._soft_retry_owner = None
@@ -620,3 +920,5 @@ class ServiceParser(Parser):
     def close(self) -> None:
         self._closed.set()
         self._drop_stream()
+        self._close_fastpath()
+        self._drop_held()

@@ -305,10 +305,14 @@ class _JobState:
         self.hedge_todo: Deque[int] = deque()
 
     def spec_dict(self) -> dict:
-        """The wire-shape dataset spec (`config` reply sans job key)."""
+        """The wire-shape dataset spec (`config` reply sans job key).
+        ``wire`` advertises the fleet's newest data-plane protocol
+        (docs/service.md Wire v2) — informational: the binding
+        negotiation happens per stream at open, so mixed fleets and old
+        peers interoperate regardless of what this says."""
         return {"uri": self.uri, "num_parts": self.num_parts,
                 "parser": self.parser, "plan": self.plan,
-                "snapshot": self.snapshot}
+                "snapshot": self.snapshot, "wire": 2}
 
 
 class Dispatcher:

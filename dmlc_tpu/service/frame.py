@@ -31,6 +31,16 @@ Integrity: the trailing crc covers meta + payload; a mismatch (torn
 write, flaky link) raises :class:`ServiceFrameError`, which classifies
 retryable — the client re-requests the block index from the dispatcher's
 current owner instead of delivering corrupt data.
+
+Wire v2 (pinned by ``tests/data/service_frame_v2.golden``) keeps the
+header/crc layout with version byte 2 and adds: ``HELLO`` stream-open
+replies (negotiated codec + co-located fast-path offer), per-segment
+compression (meta gains ``codec``/``raw_len`` and a ``wire`` map;
+``arrays`` keeps the RAW layout so :func:`decode_frame` rebuilds the
+byte-identical v1 payload), and pipelined block fetches
+(docs/service.md "Wire v2"). The crc does not cover the header, so the
+v2-identity encoding of a stored v1 frame is the same body bytes with
+only the version byte rewritten (:func:`reframe_v2`).
 """
 
 from __future__ import annotations
@@ -52,6 +62,11 @@ from dmlc_tpu.utils.timer import get_time
 
 FRAME_MAGIC = b"DSRV"
 FRAME_VERSION = 1
+# wire v2: same header/crc layout, version byte 2. Adds HELLO frames
+# (stream-open negotiation), per-segment compression (meta carries a
+# "wire" map; "arrays" keeps the RAW layout so decode rebuilds the
+# byte-identical v1 payload), and pipelined fetch (docs/service.md).
+FRAME_VERSION_2 = 2
 
 KIND_BLOCK = 1
 KIND_END = 2
@@ -60,6 +75,9 @@ KIND_ERROR = 3
 # segment encoding): the worker ships post-convert packed batches — bf16
 # halves the wire bytes vs the f32 CSR block frames (docs/service.md)
 KIND_SNAPSHOT = 4
+# v2 stream-open reply: negotiated codec, part block count, and (when
+# worker and client are co-located) the mmap fast-path cache offer
+KIND_HELLO = 5
 
 _HEADER_FMT = "<4sBB2xIQ"  # magic, version, kind, meta_len, payload_len
 HEADER_LEN = struct.calcsize(_HEADER_FMT)
@@ -69,6 +87,108 @@ _CRC_LEN = struct.calcsize(_CRC_FMT)
 # frames above this are refused at decode: a corrupt length prefix must
 # not make the client try to allocate terabytes (1 GiB >> any real block)
 MAX_FRAME_BYTES = 1 << 30
+
+
+# ---------------- wire v2 compression codecs ----------------
+#
+# Registry of per-segment codecs: name -> (compress, decompress). zlib
+# ships with CPython so it is always present; zstd/lz4 register only
+# when their modules exist (no hard dependency — negotiation falls back
+# through the preference order, and identity is always the floor).
+
+def _zlib_compress(buf) -> bytes:
+    return zlib.compress(bytes(buf), 6)
+
+
+def _zlib_decompress(buf, raw_len: int) -> bytes:
+    out = zlib.decompress(bytes(buf))
+    if len(out) != raw_len:
+        raise ServiceFrameError(
+            f"service frame: segment inflates to {len(out)}B != {raw_len}B")
+    return out
+
+
+WIRE_CODECS = {"zlib": (_zlib_compress, _zlib_decompress)}
+
+try:  # optional: python-zstandard
+    import zstandard as _zstd
+
+    def _zstd_compress(buf) -> bytes:
+        return _zstd.ZstdCompressor(level=3).compress(bytes(buf))
+
+    def _zstd_decompress(buf, raw_len: int) -> bytes:
+        out = _zstd.ZstdDecompressor().decompress(
+            bytes(buf), max_output_size=raw_len)
+        if len(out) != raw_len:
+            raise ServiceFrameError(
+                f"service frame: segment inflates to {len(out)}B "
+                f"!= {raw_len}B")
+        return out
+
+    WIRE_CODECS["zstd"] = (_zstd_compress, _zstd_decompress)
+except ImportError:  # pragma: no cover - environment-dependent
+    pass
+
+try:  # optional: python-lz4
+    import lz4.frame as _lz4
+
+    def _lz4_compress(buf) -> bytes:
+        return _lz4.compress(bytes(buf))
+
+    def _lz4_decompress(buf, raw_len: int) -> bytes:
+        out = _lz4.decompress(bytes(buf))
+        if len(out) != raw_len:
+            raise ServiceFrameError(
+                f"service frame: segment inflates to {len(out)}B "
+                f"!= {raw_len}B")
+        return out
+
+    WIRE_CODECS["lz4"] = (_lz4_compress, _lz4_decompress)
+except ImportError:  # pragma: no cover - environment-dependent
+    pass
+
+# negotiation preference, best ratio/speed first among what both ends
+# have; identity (None) is the implicit floor when nothing intersects
+WIRE_CODEC_PREFERENCE = ("zstd", "lz4", "zlib")
+
+# break-even table per segment dtype kind, derived from measured ratios
+# on libsvm corpora (docs/service.md): delta-friendly integer segments
+# (offset/index/qid/field) compress 2-5x, float value/label/weight
+# segments are near-incompressible noise — attempting them burns CPU to
+# ship ~100% of the bytes. A compressed segment is kept only when it
+# actually beats _KEEP_RATIO, so the table is an *attempt* filter, not a
+# correctness gate. Decisions are static per dtype so frames stay
+# deterministic (the v2 golden byte-pin depends on it); the measured
+# ratios per dtype are exported live via wire_dtype_ratios().
+_COMPRESS_DTYPE_KINDS = ("i", "u")  # np dtype kind chars: int / uint
+_KEEP_RATIO = 0.9
+_MIN_COMPRESS_BYTES = 64
+
+# measured compression ledger per dtype: dtype_str -> [raw_bytes, wire_bytes]
+_DTYPE_RATIOS: dict = {}
+
+
+def wire_dtype_ratios() -> dict:
+    """Measured per-dtype compression ratios (wire/raw) accumulated by
+    every v2 encode in this process — the live break-even table."""
+    return {dt: (wire / raw if raw else 1.0)
+            for dt, (raw, wire) in sorted(_DTYPE_RATIOS.items())}
+
+
+def _dtype_compressible(dtype_str: str) -> bool:
+    # segment dtype strings are numpy ``.str`` form ("<i8", "<u8",
+    # "<f4"); strip the byte-order prefix and test the kind char
+    kind = str(dtype_str).lstrip("<>|=")[:1]
+    return kind in _COMPRESS_DTYPE_KINDS
+
+
+def negotiate_codec(accept) -> Optional[str]:
+    """Pick the preferred codec both ends support, or None (identity)."""
+    offered = {str(a) for a in (accept or ())}
+    for name in WIRE_CODEC_PREFERENCE:
+        if name in offered and name in WIRE_CODECS:
+            return name
+    return None
 
 
 class ServiceFrameError(DMLCError):
@@ -83,13 +203,21 @@ class ServiceFrameError(DMLCError):
         self.__cause__ = ConnectionError(msg)
 
 
-def _pack(kind: int, meta: dict, payload: bytes = b"") -> bytes:
+def _pack(kind: int, meta: dict, payload: bytes = b"",
+          version: int = FRAME_VERSION) -> bytes:
     meta_raw = json.dumps(meta, sort_keys=True,
                           separators=(",", ":")).encode()
     crc = zlib.crc32(payload, zlib.crc32(meta_raw)) & 0xFFFFFFFF
-    header = struct.pack(_HEADER_FMT, FRAME_MAGIC, FRAME_VERSION, kind,
+    header = struct.pack(_HEADER_FMT, FRAME_MAGIC, version, kind,
                          len(meta_raw), len(payload))
     return b"".join((header, meta_raw, payload, struct.pack(_CRC_FMT, crc)))
+
+
+def encode_hello_frame(meta: dict) -> bytes:
+    """V2 stream-open reply: ``{"wire": 2, "codec": <name|None>,
+    "blocks": <known part total|None>}`` plus an optional ``"fastpath"``
+    offer (``{"path", "blocks"}``) when the peer is co-located."""
+    return _pack(KIND_HELLO, meta, version=FRAME_VERSION_2)
 
 
 def encode_block_frame(block: RowBlock,
@@ -129,6 +257,94 @@ def encode_block_frame(block: RowBlock,
     _telemetry.record_span("service_encode", t0, get_time() - t0,
                            rows=rows)
     return out
+
+
+def reframe_v2(frame) -> Tuple[bytes, memoryview]:
+    """A stored v1 frame as v2-identity send buffers, zero-copy.
+
+    The crc trails meta+payload and does not cover the header, so the v2
+    identity encoding of a v1 frame is the same bytes with only the
+    header's version byte rewritten: return a fresh 20-byte header plus
+    a memoryview of the original body for a vectored send.
+    """
+    view = memoryview(frame)
+    magic, _, kind, meta_len, payload_len = struct.unpack_from(
+        _HEADER_FMT, view)
+    header = struct.pack(_HEADER_FMT, magic, FRAME_VERSION_2, kind,
+                         meta_len, payload_len)
+    return header, view[HEADER_LEN:]
+
+
+def encode_block_frame_v2(meta: dict, payload,
+                          codec: str) -> Optional[bytes]:
+    """Re-encode a decoded v1 BLOCK frame with per-segment compression.
+
+    ``meta["arrays"]`` keeps the RAW segment layout; a ``"wire"`` map
+    (name -> [wire_offset, wire_len, compressed_flag]) plus ``"codec"``
+    and ``"raw_len"`` describe the on-wire payload, so decode rebuilds
+    the byte-identical raw payload (alignment gaps are zeros on both
+    sides). Only break-even-eligible dtypes are attempted and a
+    compressed segment is kept only when it beats ``_KEEP_RATIO``;
+    returns None when nothing compressed (caller ships identity).
+    """
+    compress = WIRE_CODECS[codec][0]
+    view = memoryview(payload)
+    wire: dict = {}
+    chunks = []
+    woff = 0
+    compressed_any = False
+    for name, (dt, off, nb) in sorted(meta["arrays"].items(),
+                                      key=lambda kv: kv[1][1]):
+        off, nb = int(off), int(nb)
+        seg = view[off:off + nb]
+        raw_tot, wire_tot = _DTYPE_RATIOS.setdefault(str(dt), [0, 0])
+        if _dtype_compressible(dt) and nb >= _MIN_COMPRESS_BYTES:
+            comp = compress(seg)
+            if len(comp) < nb * _KEEP_RATIO:
+                wire[name] = [woff, len(comp), 1]
+                chunks.append(comp)
+                woff += len(comp)
+                _DTYPE_RATIOS[str(dt)] = [raw_tot + nb,
+                                          wire_tot + len(comp)]
+                compressed_any = True
+                continue
+        wire[name] = [woff, nb, 0]
+        chunks.append(bytes(seg))
+        woff += nb
+        _DTYPE_RATIOS[str(dt)] = [raw_tot + nb, wire_tot + nb]
+    if not compressed_any:
+        return None
+    out_meta = dict(meta)
+    out_meta["codec"] = codec
+    out_meta["wire"] = wire
+    out_meta["raw_len"] = len(view)
+    return _pack(KIND_BLOCK, out_meta, b"".join(chunks),
+                 version=FRAME_VERSION_2)
+
+
+def _inflate_payload(meta: dict, payload) -> memoryview:
+    """Rebuild the raw v1 payload from a compressed v2 payload; the
+    result is byte-identical to what the v1 wire would have carried
+    (alignment gaps restore as zeros in the fresh buffer)."""
+    codec = meta.get("codec")
+    if codec not in WIRE_CODECS:
+        raise ServiceFrameError(f"service frame: unknown codec {codec!r}")
+    decompress = WIRE_CODECS[codec][1]
+    arrays = meta["arrays"]
+    raw = bytearray(int(meta["raw_len"]))
+    view = memoryview(payload)
+    for name, (woff, wlen, enc) in meta["wire"].items():
+        try:
+            _, off, nb = arrays[name]
+        except KeyError as exc:
+            raise ServiceFrameError(
+                f"service frame: wire segment {name!r} not in arrays"
+            ) from exc
+        off, nb = int(off), int(nb)
+        chunk = view[int(woff):int(woff) + int(wlen)]
+        raw[off:off + nb] = (decompress(chunk, nb) if enc
+                             else chunk)
+    return memoryview(raw)
 
 
 def encode_snapshot_frame(kind: str, arrays, rows: int,
@@ -216,30 +432,38 @@ def encode_error_frame(message: str, draining: bool = False) -> bytes:
     return _pack(KIND_ERROR, meta)
 
 
-def decode_frame(data: bytes) -> Tuple[int, dict, bytes]:
+def decode_frame(data) -> Tuple[int, dict, bytes]:
     """Split one raw frame into ``(kind, meta, payload)``; verifies magic,
-    version, and the trailing crc."""
+    version, and the trailing crc. Accepts ``bytes``, ``bytearray`` or a
+    ``memoryview`` (the recv path hands in its preallocated buffer —
+    no ``header + rest`` concat copy). A compressed v2 payload is
+    inflated here, so callers always see the raw v1 segment bytes."""
+    data = memoryview(data)
     if len(data) < HEADER_LEN + _CRC_LEN:
         raise ServiceFrameError(f"service frame truncated ({len(data)}B)")
-    magic, version, kind, meta_len, payload_len = struct.unpack(
-        _HEADER_FMT, data[:HEADER_LEN])
+    magic, version, kind, meta_len, payload_len = struct.unpack_from(
+        _HEADER_FMT, data)
     if magic != FRAME_MAGIC:
         raise ServiceFrameError(f"service frame: bad magic {magic!r}")
-    if version != FRAME_VERSION:
+    if version not in (FRAME_VERSION, FRAME_VERSION_2):
         raise ServiceFrameError(
-            f"service frame: version {version} != {FRAME_VERSION}")
+            f"service frame: version {version} not in "
+            f"({FRAME_VERSION}, {FRAME_VERSION_2})")
     end = HEADER_LEN + meta_len + payload_len
     if end + _CRC_LEN != len(data):
         raise ServiceFrameError("service frame: length mismatch")
     meta_raw = data[HEADER_LEN:HEADER_LEN + meta_len]
     payload = data[HEADER_LEN + meta_len:end]
-    (crc,) = struct.unpack(_CRC_FMT, data[end:end + _CRC_LEN])
+    (crc,) = struct.unpack_from(_CRC_FMT, data, end)
     if zlib.crc32(payload, zlib.crc32(meta_raw)) & 0xFFFFFFFF != crc:
         raise ServiceFrameError("service frame: crc mismatch")
     try:
-        meta = json.loads(meta_raw)
+        meta = json.loads(bytes(meta_raw))
     except ValueError as exc:
         raise ServiceFrameError(f"service frame: bad meta: {exc}") from exc
+    if version == FRAME_VERSION_2 and isinstance(meta, dict) \
+            and isinstance(meta.get("wire"), dict):
+        payload = _inflate_payload(meta, payload)
     return kind, meta, payload
 
 
@@ -260,18 +484,24 @@ def block_from_frame(meta: dict, payload: bytes) -> RowBlock:
 
 # ---------------- socket plumbing ----------------
 
-def recvall(sock, nbytes: int) -> bytes:
-    """Read exactly ``nbytes``; a peer hangup mid-message raises
-    ConnectionError (retryable — the client fails over)."""
-    chunks = []
+def recvall_into(sock, buf: memoryview) -> None:
+    """Fill ``buf`` exactly via ``recv_into``; a peer hangup mid-message
+    raises ConnectionError (retryable — the client fails over)."""
     nread = 0
+    nbytes = buf.nbytes
     while nread < nbytes:
-        chunk = sock.recv(min(nbytes - nread, 1 << 20))
-        if not chunk:
+        got = sock.recv_into(buf[nread:], min(nbytes - nread, 1 << 20))
+        if not got:
             raise ConnectionError("service: peer closed mid-frame")
-        nread += len(chunk)
-        chunks.append(chunk)
-    return b"".join(chunks)
+        nread += got
+
+
+def recvall(sock, nbytes: int) -> bytearray:
+    """Read exactly ``nbytes`` into one preallocated buffer (no
+    chunk-list join; the quadratic-ish copying is gone)."""
+    buf = bytearray(nbytes)
+    recvall_into(sock, memoryview(buf))
+    return buf
 
 
 def send_frame(sock, frame: bytes) -> None:
@@ -282,20 +512,54 @@ def send_frame(sock, frame: bytes) -> None:
                            nbytes=len(frame))
 
 
+def send_frame_vectored(sock, buffers) -> int:
+    """Ship one frame given as scatter buffers — the worker's v2 send
+    path hands the mmap'd payload span straight to ``sendmsg`` instead
+    of re-buffering it next to the header. Falls back to per-buffer
+    ``sendall`` on sockets without ``sendmsg``. Returns bytes sent."""
+    t0 = get_time()
+    views = [memoryview(b).cast("B") for b in buffers if len(b)]
+    total = sum(v.nbytes for v in views)
+    if hasattr(sock, "sendmsg"):
+        while views:
+            sent = sock.sendmsg(views)
+            while sent:
+                if views[0].nbytes <= sent:
+                    sent -= views[0].nbytes
+                    views.pop(0)
+                else:
+                    views[0] = views[0][sent:]
+                    sent = 0
+    else:  # pragma: no cover - sendmsg exists on all posix pythons
+        for v in views:
+            sock.sendall(v)
+    _telemetry.record_span("service_send", t0, get_time() - t0,
+                           nbytes=total)
+    return total
+
+
 def recv_frame(sock) -> Tuple[int, dict, bytes]:
     """Read one frame off the socket (``service_recv`` span covers the
-    wire wait; decode is spanned separately by :func:`block_from_frame`)."""
+    wire wait; decode is spanned separately by :func:`block_from_frame`).
+
+    The frame lands in ONE preallocated buffer: the 20-byte header is
+    read first (to size the allocation), copied in, and the body is
+    ``recv_into`` the remainder — no ``header + rest`` concat copy."""
     t0 = get_time()
     header = recvall(sock, HEADER_LEN)
     magic, version, kind, meta_len, payload_len = struct.unpack(
-        _HEADER_FMT, header)
-    if magic != FRAME_MAGIC or version != FRAME_VERSION:
+        _HEADER_FMT, bytes(header))
+    if magic != FRAME_MAGIC or version not in (FRAME_VERSION,
+                                               FRAME_VERSION_2):
         raise ServiceFrameError(
             f"service frame: bad header (magic {magic!r} version {version})")
     if meta_len + payload_len > MAX_FRAME_BYTES:
         raise ServiceFrameError(
             f"service frame: implausible length {meta_len + payload_len}")
-    rest = recvall(sock, meta_len + payload_len + _CRC_LEN)
+    body_len = meta_len + payload_len + _CRC_LEN
+    frame = bytearray(HEADER_LEN + body_len)
+    frame[:HEADER_LEN] = header
+    recvall_into(sock, memoryview(frame)[HEADER_LEN:])
     _telemetry.record_span("service_recv", t0, get_time() - t0,
-                           nbytes=HEADER_LEN + len(rest))
-    return decode_frame(header + rest)
+                           nbytes=len(frame))
+    return decode_frame(frame)

@@ -90,11 +90,18 @@ from dmlc_tpu.io import resilience as _resilience
 from dmlc_tpu.service import dispatcher as _dispatch
 from dmlc_tpu.service.dispatcher import DEFAULT_JOB
 from dmlc_tpu.service.frame import (
+    WIRE_CODECS,
     annot_key,
+    decode_frame,
     encode_block_frame,
+    encode_block_frame_v2,
     encode_end_frame,
     encode_error_frame,
+    encode_hello_frame,
+    negotiate_codec,
+    reframe_v2,
     send_frame,
+    send_frame_vectored,
 )
 from dmlc_tpu.utils import telemetry as _telemetry
 from dmlc_tpu.utils.check import DMLCError
@@ -114,7 +121,7 @@ class _PartStore:
     dispatcher's ``snapshot`` geometry decides shape and dtype)."""
 
     __slots__ = ("frames", "keys", "complete", "error", "snap_frames",
-                 "snap_packing")
+                 "snap_packing", "cache_path", "wire_cache")
 
     def __init__(self):
         self.frames: List[bytes] = []
@@ -123,6 +130,14 @@ class _PartStore:
         self.error: Optional[str] = None
         self.snap_frames: Optional[List[bytes]] = None
         self.snap_packing = False  # one serve thread holds the pack claim
+        # the part's published block-cache path (set at parse end when it
+        # exists): the v2 HELLO offers it to co-located clients as the
+        # mmap fast path (docs/service.md Wire v2)
+        self.cache_path: Optional[str] = None
+        # lazily compressed v2 frames per negotiated codec: codec ->
+        # {block: frame-or-None} (None = measured incompressible, ship
+        # identity) — compressed once, re-served to every v2 client
+        self.wire_cache: Dict[str, Dict[int, Optional[bytes]]] = {}
 
 
 class ParseWorker:
@@ -662,6 +677,7 @@ class ParseWorker:
             self._cond.notify_all()
         parser = None
         warm = False
+        release_claim = None
         try:
             if cfg_exc is not None:
                 raise cfg_exc
@@ -671,6 +687,13 @@ class ParseWorker:
             # this worker's previous incarnation) serves WARM: the parse
             # is avoided fleet-wide (docs/store.md share-by-signature)
             warm = getattr(parser, "cache_state", "cold") == "warm"
+            if not warm:
+                # single-claim the cold build fleet-wide: a sibling
+                # worker mid-cold-pass over the same store signature
+                # (a job registered DURING the pass) must not trigger a
+                # duplicate parse — wait for its publish instead
+                parser, warm, release_claim = self._claim_cold_build(
+                    job, part, parser)
             while True:
                 if self._stop.is_set():
                     return  # killed mid-parse: the part stays incomplete
@@ -710,9 +733,20 @@ class ParseWorker:
                 self._retune_parse_tier(parser)
             if store.error is None:
                 self._pin_part_artifact(parser)
+            cache_path = getattr(parser, "cache_file", None)
             if parser is not None:
                 parser.close()
+            if release_claim is not None:
+                # belt and braces: a clean cold pass already dissolved
+                # the claim via its publish; an errored one must not
+                # strand it (the waiting sibling would burn its bound)
+                release_claim()
             with self._cond:
+                if (store.error is None and cache_path
+                        and os.path.exists(cache_path)):
+                    # the published artifact this part serves from — the
+                    # v2 HELLO's co-located mmap fast-path offer
+                    store.cache_path = cache_path
                 store.complete = True
                 self._cond.notify_all()
             if store.error is None:
@@ -745,6 +779,63 @@ class ParseWorker:
                     self.worker_id, job, part,
                     "served warm" if warm else "parsed",
                     len(store.frames))
+
+    def _claim_cold_build(self, job: str, part: int, parser):
+        """Fleet-wide single-claim of a cold cache build (docs/store.md
+        single-claim builds): claim the part's final cache path through
+        the PR 11 manifest before parsing. When a DIFFERENT live owner
+        already holds the claim, bounded-wait for its publish (the claim
+        dissolves with it), rebuild the parser, and serve warm — the
+        duplicate cold pass never runs. On timeout / builder death the
+        cold pass proceeds anyway (stage_path + atomic rename converge
+        on one artifact). Returns ``(parser, warm, release_fn)``."""
+        path = getattr(parser, "cache_file", None)
+        if not path:
+            return parser, False, None
+        owner = f"{os.getpid()}:{self.worker_id}"
+        try:
+            from dmlc_tpu.store import store_for
+
+            store = store_for(path)
+        except Exception:  # noqa: BLE001 - claiming must never fail parse
+            return parser, False, None
+
+        def release():
+            try:
+                store.release(path, owner)
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                pass
+
+        try:
+            if store.claim(path, owner):
+                return parser, False, release
+        except Exception:  # noqa: BLE001
+            return parser, False, None
+        _resilience.record_event("service_parse_claim_waits")
+        logger.info("worker %s: job %s part %d cold build claimed by %s; "
+                    "waiting for its publish", self.worker_id, job, part,
+                    store.claimant(path))
+        deadline = get_time() + 30.0
+        while (get_time() < deadline and not self._stop.is_set()
+               and not self._draining.is_set()):
+            try:
+                if store.claimant(path) is None:
+                    break  # published (or the builder died)
+            except Exception:  # noqa: BLE001
+                break
+            self._stop.wait(0.05)
+        parser.close()
+        parser = self._build_parser(job, part)
+        if getattr(parser, "cache_state", "cold") == "warm":
+            return parser, True, None
+        # builder died or timed out without publishing: take the claim
+        # and run the cold pass ourselves
+        try:
+            if store.claim(path, owner):
+                return parser, False, release
+        except Exception:  # noqa: BLE001
+            pass
+        return parser, False, None
 
     def _pin_part_artifact(self, parser) -> None:
         """Hold the eviction pin on a part's published block cache for
@@ -820,29 +911,41 @@ class ParseWorker:
     def _handle(self, conn: socket.socket) -> None:
         try:
             conn.settimeout(60.0)
+            # the request file stays open for the connection's life: a
+            # wire-v2 stream keeps reading pipelined fetch lines off it
+            # (v1 requests still carry exactly one line)
             with conn.makefile("rb") as f:
                 line = f.readline()
-            req = json.loads(line) if line else {}
-            cmd = req.get("cmd")
-            job = str(req.get("job") or DEFAULT_JOB)
-            try:
-                part = int(req.get("part", -1))
-            except (TypeError, ValueError):
-                part = -1  # "part": null etc — handlers answer with ERROR
-            if cmd == "stream":
-                if req.get("snapshot"):
-                    self._serve_stream_snapshot(conn, job, part,
-                                                int(req.get("start", 0)))
+                req = json.loads(line) if line else {}
+                cmd = req.get("cmd")
+                job = str(req.get("job") or DEFAULT_JOB)
+                try:
+                    part = int(req.get("part", -1))
+                except (TypeError, ValueError):
+                    part = -1  # "part": null etc — handlers answer ERROR
+                try:
+                    wire = int(req.get("wire") or 1)
+                except (TypeError, ValueError):
+                    wire = 1
+                if cmd == "stream":
+                    if req.get("snapshot"):
+                        self._serve_stream_snapshot(
+                            conn, job, part, int(req.get("start", 0)))
+                    elif wire >= 2:
+                        self._serve_stream_v2(
+                            conn, f, job, part, req.get("accept"),
+                            str(req.get("host") or ""))
+                    else:
+                        self._serve_stream(conn, job, part,
+                                           int(req.get("start", 0)))
+                elif cmd == "find":
+                    self._serve_find(conn, job, part,
+                                     str(req.get("key", "")))
+                elif cmd == "count":
+                    self._serve_count(conn, job, part)
                 else:
-                    self._serve_stream(conn, job, part,
-                                       int(req.get("start", 0)))
-            elif cmd == "find":
-                self._serve_find(conn, job, part, str(req.get("key", "")))
-            elif cmd == "count":
-                self._serve_count(conn, job, part)
-            else:
-                send_frame(conn, encode_error_frame(
-                    f"unknown request {cmd!r}"))
+                    send_frame(conn, encode_error_frame(
+                        f"unknown request {cmd!r}"))
         except (OSError, ValueError):
             pass  # client went away / garbage request: nothing to serve
         finally:
@@ -886,6 +989,132 @@ class ParseWorker:
                     return
             send_frame(conn, frame)  # the sendall runs outside the lock
             i += 1
+
+    # ---------------- wire v2 serve side ----------------
+
+    def _negotiate_codec(self, accept) -> Optional[str]:
+        """The worker's half of stream-open codec negotiation: the
+        operator's mode gates what this end will do, the client's
+        ``accept`` list gates what the peer can undo. None = identity."""
+        from dmlc_tpu.utils import knobs as _knobs
+
+        mode = _knobs.wire_compression()
+        if mode == "off":
+            return None
+        offered = {str(a) for a in (accept or ())}
+        if mode == "auto":
+            return negotiate_codec(offered)
+        return mode if (mode in WIRE_CODECS and mode in offered) else None
+
+    def _send_block_v2(self, conn, store: _PartStore, i: int,
+                       frame: bytes, codec: Optional[str]) -> int:
+        """Ship stored v1 frame ``i`` as a v2 frame; returns on-wire
+        bytes. With a codec, the compressed form is built once per
+        (codec, block) and cached on the store (None = measured
+        incompressible — ship identity). The identity path rewrites only
+        the header's version byte and hands the stored body to a
+        vectored send untouched (:func:`reframe_v2`)."""
+        if codec is not None:
+            cache = store.wire_cache.setdefault(codec, {})
+            v2 = cache.get(i, False)
+            if v2 is False:
+                _, meta, payload = decode_frame(frame)
+                v2 = encode_block_frame_v2(meta, payload, codec)
+                cache[i] = v2
+            if v2 is not None:
+                send_frame(conn, v2)
+                return len(v2)
+        header, body = reframe_v2(frame)
+        return send_frame_vectored(conn, (header, body))
+
+    def _serve_stream_v2(self, conn, rfile, job: str, part: int,
+                         accept, client_host: str) -> None:
+        """The v2 data plane: reply HELLO (negotiated codec, block count,
+        co-located fast-path offer), then serve newline-JSON ``fetch``
+        requests FIFO off the same socket — the client keeps
+        ``service_pipeline_depth`` fetches in flight so RTT hides behind
+        the outstanding window. A fetch past the end of a complete part
+        answers END (every in-flight fetch gets one, so the client can
+        drain its window); a fetch naming the next part on the same
+        connection re-targets the stream (connection reuse when the
+        located owner is unchanged). Every served data byte ticks the
+        compression ledger (``service_wire_bytes_raw/sent``)."""
+        store = self._wait_store(job, part)
+        if store is None:
+            send_frame(conn, encode_error_frame(
+                f"worker {self.worker_id} does not serve job {job} "
+                f"part {part}"))
+            return
+        codec = self._negotiate_codec(accept)
+        hello: dict = {"wire": 2, "codec": codec}
+        with self._cond:
+            complete = store.complete and store.error is None
+            blocks = len(store.frames) if complete else None
+            cache_path = store.cache_path
+        if blocks is not None:
+            hello["blocks"] = blocks
+        if (client_host and client_host == socket.gethostname()
+                and complete and cache_path
+                and os.path.exists(cache_path)):
+            # co-located peer + published store-pinned cache: offer the
+            # mmap fast path — the client maps the artifact directly and
+            # skips TCP for the part (pin/byte-identity semantics ride
+            # the BlockCacheReader it opens; docs/service.md Wire v2)
+            hello["fastpath"] = {"path": cache_path, "blocks": blocks}
+        send_frame(conn, encode_hello_frame(hello))
+        raw_ctr = _telemetry.REGISTRY.counter(
+            _telemetry.SERVICE_WIRE_RAW_METRIC, job=job)
+        sent_ctr = _telemetry.REGISTRY.counter(
+            _telemetry.SERVICE_WIRE_SENT_METRIC, job=job)
+        while True:
+            line = rfile.readline()
+            if not line:
+                return  # client closed (done, or the fast path took over)
+            freq = json.loads(line)
+            try:
+                i = int(freq.get("block", -1))
+                p = int(freq.get("part", part))
+            except (TypeError, ValueError):
+                send_frame(conn, encode_error_frame(
+                    f"bad fetch request {line!r}"))
+                return
+            j = str(freq.get("job") or job)
+            if (j, p) != (job, part):
+                # connection reuse: the stream re-targets the next part
+                # this worker serves without a reconnect
+                job, part = j, p
+                store = self._wait_store(job, part)
+                if store is None:
+                    send_frame(conn, encode_error_frame(
+                        f"worker {self.worker_id} does not serve job "
+                        f"{job} part {part}"))
+                    return
+                raw_ctr = _telemetry.REGISTRY.counter(
+                    _telemetry.SERVICE_WIRE_RAW_METRIC, job=job)
+                sent_ctr = _telemetry.REGISTRY.counter(
+                    _telemetry.SERVICE_WIRE_SENT_METRIC, job=job)
+            with self._cond:
+                self._cond.wait_for(
+                    lambda: i < len(store.frames) or store.complete
+                    or self._dead)
+                if self._dead:
+                    return  # crash simulation: drop mid-stream
+                if i < len(store.frames):
+                    frame = store.frames[i]
+                elif store.error is not None:
+                    send_frame(conn, encode_error_frame(
+                        store.error, draining=self._draining.is_set()))
+                    return
+                else:
+                    # fetch past the end: END — and keep reading, the
+                    # client's remaining in-flight fetches need theirs
+                    send_frame(conn, encode_end_frame(
+                        part, len(store.frames),
+                        draining=self._draining.is_set()))
+                    continue
+            sent = self._send_block_v2(conn, store, i, frame, codec)
+            raw_ctr.inc(len(frame))
+            sent_ctr.inc(sent)
 
     def _pack_snapshot_frames(self, store: _PartStore,
                               geometry: dict) -> List[bytes]:

@@ -185,6 +185,9 @@ class ArtifactStore:
         # torn-tail skip, atomic rewrite) — store.journal.AppendJournal
         self._journal = AppendJournal(self._manifest,
                                       lock_path=self._lock_path)
+        # live cold-build claims (docs/store.md single-claim builds):
+        # name -> {"owner", "pid"}; refreshed by every replay
+        self._claims: Dict[str, dict] = {}
         with self._locked():
             self._gc_orphans_locked()
             state = self._replay_locked()
@@ -217,6 +220,7 @@ class ArtifactStore:
         rm) are dropped without a tombstone."""
         lines = self._read_lines_locked()
         entries: Dict[str, _Entry] = {}
+        claims: Dict[str, dict] = {}
         for seq, raw in enumerate(lines):
             try:
                 ev = json.loads(raw)
@@ -236,6 +240,9 @@ class ArtifactStore:
                 if prev is not None:
                     e.pins = prev.pins  # pins survive a republish
                 entries[name] = e
+                # a publish completes whatever cold build claimed the
+                # path — the claim dissolves with the artifact live
+                claims.pop(name, None)
             elif op == "pin":
                 e = entries.get(name)
                 if e is not None:
@@ -261,16 +268,28 @@ class ArtifactStore:
                 # heal): no tombstone — the rebuild it triggers is not
                 # an eviction casualty
                 entries.pop(name, None)
+                claims.pop(name, None)
             elif op == "rebuild":
                 e = entries.get(name)
                 if e is not None and e.evicted:
                     entries.pop(name, None)
+            elif op == "claim":
+                claims[name] = {"owner": str(ev.get("owner", "")),
+                                "pid": int(ev.get("pid", 0) or 0)}
+            elif op == "release":
+                cur = claims.get(name)
+                if cur is not None and cur["owner"] == ev.get("owner"):
+                    claims.pop(name, None)
         for name, e in list(entries.items()):
             e.pins = {pid: n for pid, n in e.pins.items()
                       if n > 0 and _pid_alive(pid)}
             if not e.evicted and not os.path.exists(
                     os.path.join(self.root, name)):
                 del entries[name]
+        # a claim whose holder's pid died is dropped — a crashed cold
+        # builder must never wedge the fleet behind a stranded claim
+        self._claims = {name: c for name, c in claims.items()
+                        if _pid_alive(c["pid"])}
         self._maybe_compact_locked(entries, len(lines))
         return entries
 
@@ -289,6 +308,11 @@ class ArtifactStore:
                 for pid, n in e.pins.items():
                     for _ in range(n):
                         yield {"op": "pin", "path": e.name, "pid": pid}
+            # live claims survive compaction (emitted after publishes so
+            # the publish-clears-claim replay rule cannot eat them)
+            for name, c in self._claims.items():
+                yield {"op": "claim", "path": name,
+                       "owner": c["owner"], "pid": c["pid"]}
 
         self._journal.rewrite(live_events())
         # replayed seqs are now compacted-file line numbers; entries keep
@@ -472,6 +496,48 @@ class ArtifactStore:
         except OSError:
             return
         self._replay_locked()
+
+    def claim(self, path: str, owner: str) -> bool:
+        """Single-claim the cold build of ``path`` fleet-wide.
+
+        Returns True when ``owner`` now holds (or already held) the
+        build claim; False when a DIFFERENT live owner does — the caller
+        should wait for that builder's publish instead of running a
+        duplicate cold pass (docs/service.md parse-once). The claim is
+        journaled (crash-safe, cross-process via the manifest flock) and
+        dissolves on the path's publish, an explicit :meth:`release`, or
+        the claimant pid dying."""
+        name = self._name(path)
+        with self._locked():
+            self._replay_locked()
+            cur = self._claims.get(name)
+            if cur is not None and cur["owner"] != owner:
+                return False
+            if cur is None:
+                self._append_locked(
+                    {"op": "claim", "path": name, "owner": str(owner),
+                     "pid": os.getpid()}, sync=True)
+                self._claims[name] = {"owner": str(owner),
+                                      "pid": os.getpid()}
+            return True
+
+    def release(self, path: str, owner: str) -> None:
+        """Release ``owner``'s build claim on ``path`` (no-op when not
+        held — a publish already dissolved it)."""
+        name = self._name(path)
+        with self._locked():
+            self._append_locked({"op": "release", "path": name,
+                                 "owner": str(owner)})
+            if self._claims.get(name, {}).get("owner") == str(owner):
+                self._claims.pop(name, None)
+            self._compact_if_bloated_locked()
+
+    def claimant(self, path: str) -> Optional[str]:
+        """The live owner token of ``path``'s build claim, or None."""
+        with self._locked():
+            self._replay_locked()
+            cur = self._claims.get(self._name(path))
+            return cur["owner"] if cur is not None else None
 
     def discard(self, path: str) -> None:
         """Deliberate removal (stale signature, corruption heal): delete

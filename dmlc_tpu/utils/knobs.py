@@ -136,6 +136,15 @@ KNOB_TABLE: Dict[str, KnobSpec] = {
                 "never exceed — the operator's capacity/cost cap "
                 "(docs/service.md fleet autoscaling)"),
         KnobSpec(
+            "service_pipeline_depth", "DMLC_TPU_SERVICE_PIPELINE_DEPTH",
+            default=4, lo=1, hi=64,
+            doc="wire v2 pipelined block requests a service client keeps "
+                "in flight per stream — RTT hides behind the outstanding "
+                "window; depth 1 degenerates to the v1 one-request-per-"
+                "frame cadence (docs/service.md Wire v2). Autotuned: the "
+                "controller maps the read stage to it when the source is "
+                "a service stream"),
+        KnobSpec(
             "fleet_scale_interval", "DMLC_TPU_FLEET_SCALE_INTERVAL",
             default=10, lo=1, hi=3600,
             doc="seconds between fleet-autoscaler control ticks: each "
@@ -275,6 +284,35 @@ def parse_engine(explicit: Optional[str] = None) -> str:
           f"parse engine {raw!r}: must be one of {PARSE_ENGINES} "
           f"(DMLC_TPU_PARSE_ENGINE / create_parser(engine=...) / "
           f"?engine= URI arg — docs/data.md engine-selection table)")
+    return value
+
+
+WIRE_COMPRESSION_MODES = ("auto", "off", "zlib", "zstd", "lz4")
+
+
+def wire_compression(explicit: Optional[str] = None) -> str:
+    """The wire v2 per-segment compression selector (docs/service.md
+    Wire v2): explicit argument > ``DMLC_TPU_WIRE_COMPRESSION`` env >
+    ``auto``. Values:
+
+    - ``auto``: offer every codec this process has (preference order
+      zstd > lz4 > zlib) and let stream-open negotiation pick;
+    - ``off``: identity only — never offer or accept a codec;
+    - ``zlib`` / ``zstd`` / ``lz4``: offer exactly that codec (a codec
+      whose module is missing falls back to identity at negotiation,
+      never crashes — no hard dependency).
+
+    Not an autotuned knob — codec choice is negotiated per stream, not a
+    value the controller may move; it lives here so the knob lint gate
+    covers the env read and a typo'd mode fails the run loudly."""
+    raw = (explicit if explicit is not None
+           else os.environ.get("DMLC_TPU_WIRE_COMPRESSION", "").strip()
+           or "auto")
+    value = str(raw).strip().lower()
+    check(value in WIRE_COMPRESSION_MODES,
+          f"wire compression {raw!r}: must be one of "
+          f"{WIRE_COMPRESSION_MODES} (DMLC_TPU_WIRE_COMPRESSION — "
+          f"docs/service.md Wire v2)")
     return value
 
 

@@ -96,6 +96,12 @@ STORE_BYTES_METRIC = "store_bytes"
 # to per-rank stages (docs/observability.md).
 SERVICE_JOB_WAIT_METRIC = "service_job_input_wait_seconds"
 SERVICE_JOB_PARTS_METRIC = "service_job_parts"
+# wire v2 compression ledger (dmlc_tpu.service.frame, docs/service.md
+# Wire v2): raw vs on-wire bytes for every served data frame, labeled by
+# `job` — sent/raw is the live compression ratio the pod table and bench
+# report; identity transports tick both equally so the ratio reads 1.0
+SERVICE_WIRE_RAW_METRIC = "service_wire_bytes_raw"
+SERVICE_WIRE_SENT_METRIC = "service_wire_bytes_sent"
 
 
 # ---------------- pipeline scoping ----------------

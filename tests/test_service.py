@@ -188,7 +188,7 @@ def test_dispatcher_fcfs_exactly_once_and_reissue(tmp_path):
         # journal-less dispatcher's whole life — no restart can recover)
         assert cfg == {"uri": "dummy.libsvm", "num_parts": 4,
                        "parser": {"format": "libsvm"}, "plan": {},
-                       "snapshot": {}, "gen": 1}
+                       "snapshot": {}, "wire": 2, "gen": 1}
         # unregistered workers get no splits
         resp = svc_dispatcher.request(addr, {"cmd": "next_split",
                                              "worker": "ghost"})

@@ -332,6 +332,99 @@ def test_shared_artifact_eviction_heals_for_all_jobs(corpus, tmp_path):
         reset_stores()
 
 
+def _straggling_fleet(corpus, num_parts, share, straggle):
+    """1 dispatcher + 2 hand-built straggle-slowed workers (LocalFleet
+    has no per-worker chaos knobs) with share-by-signature armed."""
+    from dmlc_tpu.service import ParseWorker
+
+    disp = svc_dispatcher.Dispatcher(corpus, num_parts,
+                                     parser=PARSER_CFG,
+                                     share_dir=share)
+    workers = [ParseWorker(disp.address, poll_interval=0.02,
+                           heartbeat_interval=0.1,
+                           straggle_seconds=straggle)
+               for _ in range(2)]
+    return disp, workers
+
+
+def test_cold_build_claim_wait_serves_warm(corpus, tmp_path):
+    """The deterministic single-claim race: job B registers while job
+    A's ONLY part is mid-cold-pass on one straggle-slowed worker, so
+    the idle worker's grant of (B, 0) lands on the in-progress build.
+    The claim through the store manifest denies the duplicate pass: the
+    racing worker waits for A's publish and serves warm — exactly one
+    actual parse (service_parts_parsed == 1), exactly one recorded
+    claim wait, both streams byte-identical."""
+    share = str(tmp_path / "share")
+    local = _local_blocks(corpus, 1)
+    base = resilience.counters_snapshot()
+    disp, workers = _straggling_fleet(corpus, 1, share, straggle=0.3)
+    try:
+        sp_a = ServiceParser(disp.address)
+        first = sp_a.next_block()  # A's cold pass is underway NOW
+        assert first is not None
+        svc_dispatcher.register_job(disp.address, "b", corpus, 1,
+                                    parser=PARSER_CFG)
+        got_b = _drain_job(disp.address, "b")
+        got_a = [first] + _drain(sp_a)
+        sp_a.close()
+        _assert_blocks_equal(got_a, local)
+        _assert_blocks_equal(got_b, local)
+        delta = resilience.counters_delta(base)
+        assert delta["service_parts_parsed"] == 1
+        assert delta["service_parts_shared"] == 1
+        assert delta["service_parse_claim_waits"] == 1
+        assert delta["service_giveups"] == 0
+    finally:
+        for w in workers:
+            w.close()
+        disp.close()
+
+
+def test_racing_cold_pass_parses_once_fleet_wide(corpus, tmp_path):
+    """The PR 15 residual closed (docs/store.md single-claim builds):
+    a job registered DURING a sibling's cold pass over the same store
+    signature races it part-wise across the fleet — and HOWEVER the
+    grants interleave, each part's cold build runs exactly once
+    (service_parts_parsed pinned exact), the other job's copy resolves
+    to the published artifact, and both concurrent streams stay
+    byte-identical to local parsing."""
+    share = str(tmp_path / "share")
+    local = _local_blocks(corpus)
+    base = resilience.counters_snapshot()
+    disp, workers = _straggling_fleet(corpus, NUM_PARTS, share,
+                                      straggle=0.05)
+    try:
+        sp_a = ServiceParser(disp.address)
+        first = sp_a.next_block()  # A's cold pass is underway NOW
+        assert first is not None
+        svc_dispatcher.register_job(disp.address, "b", corpus,
+                                    NUM_PARTS, parser=PARSER_CFG)
+        out: dict = {}
+
+        def drain_b():
+            out["b"] = _drain_job(disp.address, "b")
+
+        t = threading.Thread(target=drain_b, daemon=True)
+        t.start()
+        got_a = [first] + _drain(sp_a)
+        sp_a.close()
+        t.join(timeout=60.0)
+        assert not t.is_alive(), "job b's racing drain hung"
+        _assert_blocks_equal(got_a, local)
+        _assert_blocks_equal(out["b"], local)
+        delta = resilience.counters_delta(base)
+        # the exact pin: N parts over one shared signature -> N actual
+        # parses fleet-wide, the other job's N all resolve shared
+        assert delta["service_parts_parsed"] == NUM_PARTS
+        assert delta["service_parts_shared"] == NUM_PARTS
+        assert delta["service_giveups"] == 0
+    finally:
+        for w in workers:
+            w.close()
+        disp.close()
+
+
 # ---------------------------------------------------------------------------
 # fleet autoscaler (tentpole: input-wait-driven grow/drain)
 

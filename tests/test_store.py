@@ -621,3 +621,68 @@ class TestLintStoreGate:
              REPO],
             capture_output=True, text=True, timeout=120)
         assert proc.returncode == 0, proc.stderr
+
+
+class TestClaims:
+    """Single-claim cold builds (docs/service.md parse-once): the store
+    journals a fleet-wide build claim per artifact path, dissolved by
+    the path's publish, an explicit release, or the claimant dying."""
+
+    def test_claim_idempotent_same_owner_denied_other(self, tmp_path):
+        path = str(tmp_path / "c.bc")
+        store = store_for(path)
+        assert store.claim(path, "w1") is True
+        assert store.claim(path, "w1") is True
+        assert store.claim(path, "w2") is False
+        assert store.claimant(path) == "w1"
+
+    def test_publish_dissolves_claim(self, tmp_path):
+        path = tmp_path / "c.bc"
+        store = store_for(str(path))
+        assert store.claim(str(path), "builder") is True
+        _mk_block_cache(path)
+        assert store.claimant(str(path)) is None
+        # the artifact is live; a newcomer may claim a rebuild
+        assert store.claim(str(path), "other") is True
+
+    def test_release_dissolves_claim(self, tmp_path):
+        path = str(tmp_path / "c.bc")
+        store = store_for(path)
+        assert store.claim(path, "w1") is True
+        store.release(path, "w1")
+        assert store.claimant(path) is None
+        # releasing an unheld claim is a no-op
+        store.release(path, "w1")
+        assert store.claim(path, "w2") is True
+        # a non-holder's release does not steal the claim
+        store.release(path, "w1")
+        assert store.claimant(path) == "w2"
+
+    def test_claim_survives_store_reopen(self, tmp_path):
+        path = str(tmp_path / "c.bc")
+        store_for(path).claim(path, "w1")
+        reset_stores()
+        fresh = store_for(path)
+        assert fresh.claimant(path) == "w1"
+        assert fresh.claim(path, "w2") is False
+
+    def test_dead_claimant_is_dropped_on_replay(self, tmp_path):
+        path = str(tmp_path / "c.bc")
+        store = store_for(path)
+        assert store.claim(path, "gone") is True
+        manifest = os.path.join(str(tmp_path), ".dmlc_store",
+                                store_mgr.MANIFEST_NAME)
+        lines = []
+        with open(manifest) as fh:
+            for line in fh:
+                ev = json.loads(line)
+                if ev.get("op") == "claim":
+                    # forge a claimant pid that cannot be alive
+                    ev["pid"] = 2 ** 22 + 1
+                lines.append(json.dumps(ev) + "\n")
+        with open(manifest, "w") as fh:
+            fh.writelines(lines)
+        reset_stores()
+        fresh = store_for(path)
+        assert fresh.claimant(path) is None
+        assert fresh.claim(path, "w2") is True
