@@ -36,7 +36,11 @@
 #                     (autotune_enabled/autotune_steps/
 #                     autotune_final_config — the feedback controller
 #                     climbs a starved config and emits the chosen knobs
-#                     as reusable env), the tiered artifact store
+#                     as reusable env),
+#                     the production-QoS leg (service_qos_* — two-class
+#                     contention: the critical tenant's warm wait frac
+#                     under its SLO, the batch tenant throttled >= 1
+#                     with zero giveups), the tiered artifact store
 #                     (store_bytes/store_evictions/
 #                     store_rebuilds_after_eviction — every cache and
 #                     snapshot the legs publish is store-managed), and
@@ -210,6 +214,28 @@ bench-smoke:
 	    assert wfp == wblocks, \
 	        f'service_wire_fastpath {wfp} != {wblocks}: the co-located ' \
 	        'client did not serve every block off the mmap fast path'; \
+	    assert line.get('service_qos_jobs') == 2, \
+	        'service_qos_jobs missing (production-QoS leg did not run)'; \
+	    qthr = line.get('service_qos_throttles'); \
+	    assert qthr is not None and qthr >= 1, \
+	        f'service_qos_throttles {qthr}: admission control never shed ' \
+	        'the saturating batch tenant (expected >= 1 retryable ' \
+	        'throttled replies under the fleet ceiling)'; \
+	    assert line.get('service_qos_admission_waits') is not None, \
+	        'service_qos_admission_waits missing'; \
+	    qgu = line.get('service_qos_giveups'); \
+	    assert qgu == 0, \
+	        f'service_qos_giveups {qgu} != 0: a throttled tenant burned ' \
+	        'its failure budget — overload must degrade to bounded ' \
+	        'queueing, never to give-up'; \
+	    qwf = line.get('service_qos_critical_wait_frac'); \
+	    qslo = line.get('service_qos_critical_slo'); \
+	    assert qwf is not None and qslo and qwf < qslo, \
+	        f'critical tenant wait frac {qwf} not under its SLO {qslo} ' \
+	        'despite priority + admission budgets'; \
+	    assert line.get('service_qos_batch_blocks'), \
+	        'service_qos_batch_blocks missing/zero (the throttled batch ' \
+	        'tenant never drained its epoch)'; \
 	    assert line.get('autotune_enabled') is True, \
 	        'autotune_enabled missing (autotune leg did not run)'; \
 	    assert line.get('autotune_steps') is not None, \
@@ -267,6 +293,10 @@ bench-smoke:
 	          'gbps at depth', line['service_pipeline_depth'], \
 	          ', pipelined x', wspd, ', compression', wratio, \
 	          ', fastpath', wfp, '/', wblocks, 'blocks'); \
+	    print('bench-smoke: production QoS OK: critical wait frac', qwf, \
+	          'under slo', qslo, ',', qthr, 'batch throttles,', \
+	          line['service_qos_admission_waits'], 'admission waits,', \
+	          qgu, 'giveups'); \
 	    print('bench-smoke: autotune OK:', line['autotune_steps'], \
 	          'steps,', line.get('autotune_adjustments'), \
 	          'adjustments, converged', line.get('autotune_converged'), \

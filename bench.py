@@ -833,6 +833,118 @@ def service_wire_leg(path: str, size_mb: float, workers: int = 2):
     }
 
 
+def service_qos_leg(path: str, size_mb: float, workers: int = 2):
+    """Production-QoS leg (``--service`` / ISSUE 17, docs/service.md
+    Production QoS): two-class contention on one fleet. A
+    latency-critical tenant (priority 1, weight 2, ``slo_wait_frac``)
+    and a batch tenant (priority 0, ``max_inflight=1``) read the same
+    corpus while ``DMLC_TPU_QOS_MAX_INFLIGHT`` caps the fleet's
+    concurrent parses at the worker count. The critical job's cold
+    epoch saturates the admission ceiling, so the batch tenant's
+    locates shed with retryable ``throttled`` replies
+    (``service_qos_throttles`` — gated ``>= 1`` by ``make
+    bench-smoke``) that the client backs off on
+    (``service_qos_admission_waits``) WITHOUT ever burning toward a
+    give-up (``service_qos_giveups`` gated ``== 0``). Both tenants
+    drain their full epochs — overload degrades to bounded queueing,
+    never to failure.
+
+    ``service_qos_critical_wait_frac`` is the critical job's WARM-epoch
+    input-wait fraction (client wait seconds / epoch wall) measured
+    while the batch tenant is still cold-parsing beside it, with a
+    small per-block consume pause modeling a trainer's step cadence —
+    the same job-labeled signal the SLO-driven autoscaler steers on.
+    Gated ``< service_qos_critical_slo`` by ``make bench-smoke``: the
+    priority band + admission budget must keep the critical tenant
+    under its declared SLO despite the saturating sibling."""
+    import threading as _threading
+
+    from dmlc_tpu.io import resilience as _resilience
+    from dmlc_tpu.service import LocalFleet, ServiceParser
+    from dmlc_tpu.utils import telemetry as _telemetry
+
+    num_parts = max(4, workers * 2)
+    cfg = {"format": "libsvm", "chunk_bytes": CHUNK_BYTES}
+    slo = 0.5
+    res_base = _resilience.counters_snapshot()
+    # born-empty fleet: both tenants are explicit registrations, so the
+    # default job cannot skew the grant rotation under test
+    fleet = LocalFleet(None, 0, num_workers=workers, parser=cfg)
+    os.environ["DMLC_TPU_QOS_MAX_INFLIGHT"] = str(workers)
+    batch_blocks = [0]
+    batch_errs: list = []
+
+    def _drain_batch():
+        sp = ServiceParser(fleet.address, job="qos-batch")
+        try:
+            while sp.next_block() is not None:
+                batch_blocks[0] += 1
+        except BaseException as exc:  # noqa: BLE001 - re-raised below
+            batch_errs.append(exc)
+        finally:
+            sp.close()
+
+    try:
+        fleet.register_job("qos-critical", path, num_parts, parser=cfg,
+                           priority=1, weight=2, slo_wait_frac=slo)
+        fleet.register_job("qos-batch", path, num_parts, parser=cfg,
+                           max_inflight=1)
+        # critical cold epoch first: its grants preempt and saturate the
+        # ceiling, so the batch thread's locates shed deterministically
+        crit = ServiceParser(fleet.address, job="qos-critical")
+        batch_thread = _threading.Thread(target=_drain_batch, daemon=True)
+        crit_blocks = 0
+        try:
+            batch_thread.start()
+            while crit.next_block() is not None:
+                crit_blocks += 1
+        finally:
+            crit.close()
+        # warm critical epoch, timed: every part is parsed and served
+        # off the workers' stores, so the wait frac is steady-state
+        # input starvation, not the cold build
+        wait_c = _telemetry.REGISTRY.counter(
+            _telemetry.SERVICE_JOB_WAIT_METRIC, job="qos-critical")
+        crit = ServiceParser(fleet.address, job="qos-critical")
+        warm_blocks = 0
+        try:
+            wait0 = wait_c.value
+            t0 = time.monotonic()
+            while crit.next_block() is not None:
+                warm_blocks += 1
+                time.sleep(0.02)  # the trainer's consume cadence
+            warm_dt = time.monotonic() - t0
+            crit_wait = wait_c.value - wait0
+        finally:
+            crit.close()
+        batch_thread.join(timeout=600.0)
+        if batch_errs:
+            raise batch_errs[0]
+        if batch_thread.is_alive():
+            raise RuntimeError("qos leg: batch tenant never drained")
+    finally:
+        os.environ.pop("DMLC_TPU_QOS_MAX_INFLIGHT", None)
+        fleet.close()
+    res = _resilience.counters_delta(res_base)
+    wait_frac = crit_wait / max(warm_dt, 1e-9)
+    log(f"bench: service qos leg: critical {crit_blocks} cold + "
+        f"{warm_blocks} warm blocks (wait frac {wait_frac:.3f} vs slo "
+        f"{slo}), batch {batch_blocks[0]} blocks through "
+        f"{res['service_throttles']} throttles / "
+        f"{res['service_admission_waits']} admission waits, "
+        f"{res['service_giveups']} giveups")
+    return {
+        "service_qos_jobs": 2,
+        "service_qos_critical_slo": slo,
+        "service_qos_critical_wait_frac": round(wait_frac, 4),
+        "service_qos_critical_blocks": warm_blocks,
+        "service_qos_batch_blocks": batch_blocks[0],
+        "service_qos_throttles": res["service_throttles"],
+        "service_qos_admission_waits": res["service_admission_waits"],
+        "service_qos_giveups": res["service_giveups"],
+    }
+
+
 def autotune_leg(path: str, size_mb: float, max_epochs: int = 5):
     """Offline controller convergence (``--autotune`` / ISSUE 10): run
     the ingest pipeline with the feedback controller armed at a
@@ -1212,6 +1324,12 @@ def run_child() -> None:
             line.update(service_wire_leg(path, size_mb))
         except Exception as exc:  # noqa: BLE001 - the headline must still print
             log(f"bench: service wire leg failed: {exc}")
+        # production-QoS leg (docs/service.md Production QoS): two-class
+        # contention — critical tenant under SLO, batch tenant throttled
+        try:
+            line.update(service_qos_leg(path, size_mb))
+        except Exception as exc:  # noqa: BLE001 - the headline must still print
+            log(f"bench: service qos leg failed: {exc}")
     # online-autotuner convergence leg (docs/data.md autotune): the
     # controller climbs a starved config until gap_stage == transfer and
     # the chosen knobs ride the JSON line as reusable env — emitted when
@@ -1434,6 +1552,13 @@ def main() -> int:
                           "service_wire_pipelined_speedup",
                           "service_wire_compression_ratio",
                           "service_wire_fastpath",
+                          "service_qos_jobs", "service_qos_critical_slo",
+                          "service_qos_critical_wait_frac",
+                          "service_qos_critical_blocks",
+                          "service_qos_batch_blocks",
+                          "service_qos_throttles",
+                          "service_qos_admission_waits",
+                          "service_qos_giveups",
                           "autotune_enabled", "autotune_steps",
                           "autotune_adjustments", "autotune_converged",
                           "autotune_gap_stage", "autotune_final_config",

@@ -36,7 +36,8 @@ the span tracer and its invalidation/corruption events go through
   ``DMLC_TPU_HEDGE_FACTOR``, ``DMLC_TPU_DRAIN_DEADLINE``,
   ``DMLC_TPU_PARSE_ENGINE``, ``DMLC_TPU_FLEET*``,
   ``DMLC_TPU_SERVICE_PIPELINE_DEPTH``,
-  ``DMLC_TPU_WIRE_COMPRESSION``) — every
+  ``DMLC_TPU_WIRE_COMPRESSION``, ``DMLC_TPU_QOS*``,
+  ``DMLC_TPU_CLAIM_WAIT_DEADLINE``) — every
   pipeline tunable must be a row in the
   autotune knob table (``dmlc_tpu/utils/knobs.py``, read via
   ``knobs.resolve``) so the feedback controller knows its bounds and the
@@ -77,7 +78,8 @@ _KNOB_PATTERN = (
                r"DMLC_TPU_(?:[A-Z0-9_]*_WORKERS|PREFETCH|CONVERT_AHEAD|"
                r"AUTOTUNE[A-Z0-9_]*|STORE[A-Z0-9_]*|HEDGE_FACTOR|"
                r"DRAIN_DEADLINE|PARSE_ENGINE|FLEET[A-Z0-9_]*|"
-               r"SERVICE_PIPELINE_DEPTH|WIRE_COMPRESSION)['\"]"),
+               r"SERVICE_PIPELINE_DEPTH|WIRE_COMPRESSION|"
+               r"QOS[A-Z0-9_]*|CLAIM_WAIT_DEADLINE)['\"]"),
     "ad-hoc tunable env read — register the knob in "
     "dmlc_tpu/utils/knobs.py (KNOB_TABLE / a validated accessor like "
     "store_budget_bytes) and read it through that module")

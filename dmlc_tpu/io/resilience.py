@@ -235,6 +235,19 @@ class _Counters:
                   sustained per-job input wait / gracefully drained
                   under sustained idleness (docs/service.md fleet
                   autoscaling) — both zero on a clean bench run
+    ``service_throttles``
+                  locate requests the dispatcher shed with a retryable
+                  ``throttled`` reply because admission control had the
+                  job over its ``max_inflight`` budget or the fleet over
+                  the ``DMLC_TPU_QOS_MAX_INFLIGHT`` ceiling
+                  (docs/service.md Production QoS) — bounded queueing,
+                  not failure: a throttled epoch still completes
+                  byte-identically
+    ``service_admission_waits``
+                  client-side backoff sleeps taken on those throttled
+                  replies (shared RetryPolicy schedule; each throttle
+                  resets the locate deadline, so a deliberately-queued
+                  batch tenant never burns toward ``service_giveups``)
     """
 
     _KEYS = ("attempts", "retries", "resumes", "giveups", "fatal",
@@ -247,7 +260,8 @@ class _Counters:
              "worker_drains", "drain_handoffs", "preemption_notices",
              "speculative_reissues", "speculative_wins", "worker_joins",
              "service_parts_parsed", "service_parts_shared",
-             "fleet_scale_ups", "fleet_scale_downs")
+             "fleet_scale_ups", "fleet_scale_downs",
+             "service_throttles", "service_admission_waits")
 
     def bump(self, key: str, n: int = 1) -> None:
         record_event(key, n)

@@ -22,12 +22,15 @@ fleet keeps up).
 **Control law** (one decision per tick, docs/service.md fleet
 autoscaling):
 
-- *per-job fairness*: the decision signal is the **max** wait fraction
-  over jobs, never the mean — a starved job cannot be drowned by a
-  greedy (or idle) sibling averaging it away; and because the
-  dispatcher's grant rotation is round-robin across jobs, capacity
-  added for the starved job actually reaches it.
-- *grow*: ``starved_frac > grow_frac`` for ``up_ticks`` CONSECUTIVE
+- *per-job SLO fairness*: each job's wait fraction is measured against
+  its OWN target — ``register_job(slo_wait_frac=)`` when declared,
+  ``grow_frac`` otherwise — never a mean, so a starved job cannot be
+  drowned by a greedy (or idle) sibling averaging it away. Among jobs
+  over target, the **highest-priority** one drives the decision
+  (docs/service.md Production QoS); because the dispatcher's grant
+  scheduling serves higher bands first, capacity added for that job
+  actually reaches it.
+- *grow*: some job over its target for ``up_ticks`` CONSECUTIVE
   ticks and the live fleet is under ``DMLC_TPU_FLEET_MAX`` -> one
   worker live-joins (``LocalFleet.add_worker()``, the PR 13 join path),
   counted as ``fleet_scale_ups``.
@@ -87,6 +90,7 @@ class FleetAutoscaler:
     def __init__(self, fleet,
                  source: Optional[Callable[[], Dict[str, float]]] = None,
                  tracker=None,
+                 qos_source: Optional[Callable[[], Dict[str, dict]]] = None,
                  min_workers: Optional[int] = None,
                  max_workers: Optional[int] = None,
                  interval: Optional[float] = None,
@@ -110,6 +114,14 @@ class FleetAutoscaler:
                 return {job: rec.get("input_wait_seconds", 0.0)
                         for job, rec in trk.pod_job_metrics().items()}
         self._source = source
+        # the jobs' QoS classes ({job: {priority, slo_wait_frac, ...}},
+        # docs/service.md Production QoS): defaults to the fleet's
+        # dispatcher view so registered SLOs steer the controller with
+        # zero wiring; a fleet/stub without one degrades to the
+        # pre-QoS max-over-jobs law
+        if qos_source is None:
+            qos_source = getattr(fleet, "job_qos", None)
+        self._qos_source = qos_source
         self.min_workers = _knobs.resolve("fleet_min", min_workers)
         self.max_workers = _knobs.resolve("fleet_max", max_workers)
         check(self.min_workers <= self.max_workers,
@@ -187,19 +199,41 @@ class FleetAutoscaler:
             fracs[job] = min(1.0, delta / window)
         self._last, self._last_t = waits, now
         self.ticks += 1
-        # per-job fairness: the decision signal is the WORST-OFF job
-        starved_frac = max(fracs.values(), default=0.0)
-        starved_job = max(fracs, key=fracs.get) if fracs else None
+        # SLO-aware per-job fairness (docs/service.md Production QoS):
+        # each job is measured against its OWN input-wait target
+        # (register_job(slo_wait_frac=), default grow_frac), and among
+        # the over-target jobs the HIGHEST-PRIORITY one drives the
+        # decision (ties broken by relative overage) — capacity grows
+        # for the latency-critical tenant breaching its SLO, never for
+        # whichever batch job happens to wait hardest. Without QoS
+        # specs every target is grow_frac and this degenerates to the
+        # historical max-over-jobs law.
+        qos = self._job_qos()
+
+        def target(job: str) -> float:
+            slo = (qos.get(job) or {}).get("slo_wait_frac")
+            return float(slo) if slo else self.grow_frac
+
+        over = [j for j, f in fracs.items() if f > target(j)]
+        if over:
+            starved_job = max(
+                over,
+                key=lambda j: (int((qos.get(j) or {}).get("priority", 0)),
+                               fracs[j] / target(j)))
+        else:
+            starved_job = max(fracs, key=fracs.get) if fracs else None
+        starved_frac = fracs.get(starved_job, 0.0) if starved_job \
+            else 0.0
         if self._cooldown > 0:
             self._cooldown -= 1
             self._starved_streak = 0
             self._idle_streak = 0
             return self._record(HOLD, fracs,
                                 f"cooldown ({self._cooldown} left)")
-        if starved_frac > self.grow_frac:
+        if over:
             self._starved_streak += 1
             self._idle_streak = 0
-        elif fracs and starved_frac < self.shrink_frac:
+        elif fracs and max(fracs.values()) < self.shrink_frac:
             self._idle_streak += 1
             self._starved_streak = 0
         else:
@@ -212,7 +246,9 @@ class FleetAutoscaler:
                     HOLD, fracs, f"starved (job {starved_job} at "
                     f"{starved_frac:.2f}) but at fleet_max "
                     f"{self.max_workers}")
-            return self._grow(fracs, starved_job, starved_frac, live)
+            return self._grow(fracs, starved_job, starved_frac,
+                              target(starved_job) if starved_job
+                              else self.grow_frac, live)
         if self._idle_streak >= self.down_ticks:
             if live <= self.min_workers:
                 return self._record(
@@ -221,8 +257,21 @@ class FleetAutoscaler:
             return self._shrink(fracs, live)
         return self._record(HOLD, fracs, "within hysteresis band")
 
+    def _job_qos(self) -> Dict[str, dict]:
+        """The jobs' QoS classes from the configured source; a source
+        hiccup (dispatcher mid-restart) degrades to no-QoS for the tick
+        — the controller never dies on its input."""
+        if self._qos_source is None:
+            return {}
+        try:
+            return {str(j): dict(q or {})
+                    for j, q in (self._qos_source() or {}).items()}
+        except Exception as exc:  # noqa: BLE001
+            logger.warning("fleet autoscaler: qos source failed: %s", exc)
+            return {}
+
     def _grow(self, fracs: dict, job: Optional[str], frac: float,
-              live: int) -> dict:
+              tgt: float, live: int) -> dict:
         worker = self.fleet.add_worker()
         self._added.append(worker)
         self.scale_ups += 1
@@ -230,9 +279,9 @@ class FleetAutoscaler:
         self._cooldown = self.cooldown_ticks
         _resilience.record_event("fleet_scale_ups")
         logger.warning(
-            "fleet autoscaler: job %s input-wait frac %.2f > %.2f — "
-            "grew fleet %d -> %d (worker %s live-joined)", job, frac,
-            self.grow_frac, live, live + 1, worker.worker_id)
+            "fleet autoscaler: job %s input-wait frac %.2f > target "
+            "%.2f — grew fleet %d -> %d (worker %s live-joined)", job,
+            frac, tgt, live, live + 1, worker.worker_id)
         return self._record(GROW, fracs,
                             f"job {job} wait frac {frac:.2f}",
                             worker=worker.worker_id)

@@ -157,6 +157,15 @@ class ServiceParser(Parser):
         # Checkpoints stay (part, batch) 'service' states: the packed
         # batches carry no parser-chain annotations to match against.
         self.snapshot = dict(cfg.get("snapshot") or {})
+        # the dispatcher-declared QoS class (docs/service.md Production
+        # QoS): priority/weight shape this job's grant share, an SLO
+        # target is republished as a job-labeled gauge so the pod table
+        # shows the job's wait beside the contract the autoscaler holds
+        self.qos = dict(cfg.get("qos") or {})
+        if self.qos.get("slo_wait_frac"):
+            _telemetry.REGISTRY.gauge(
+                _telemetry.SERVICE_JOB_SLO_METRIC,
+                job=self.job).set(float(self.qos["slo_wait_frac"]))
         self._part = 0
         self._pos = 0          # next block index within the current part
         self._delivered = 0    # blocks delivered this epoch (all parts)
@@ -296,8 +305,14 @@ class ServiceParser(Parser):
     def _locate_owner(self) -> dict:
         """Poll the dispatcher until the current part has a live owner.
         Bounded by the policy's attempt timeout — a fleet with no live
-        worker must surface, not spin forever."""
+        worker must surface, not spin forever. A ``throttled`` reply
+        (admission control shedding this job's grants — docs/service.md
+        Production QoS) is NOT a dead fleet: back off on the shared
+        RetryPolicy's schedule and extend the deadline, so a
+        deliberately-queued batch tenant never burns toward a give-up
+        while the fleet is healthy."""
         deadline = get_time() + self._policy.attempt_timeout
+        throttles = 0
         while not self._closed.is_set():
             req = {"cmd": "locate", "part": self._part, "job": self.job}
             if self._last_located is not None:
@@ -306,6 +321,13 @@ class ServiceParser(Parser):
                 # failover happens here — not on a dead socket's timeout
                 req["have"] = self._last_located
             resp = self._control(req)
+            if resp.get("throttled"):
+                _resilience.record_event("service_admission_waits")
+                pause = self._policy.backoff(throttles)
+                throttles += 1
+                deadline = get_time() + self._policy.attempt_timeout
+                self._closed.wait(pause)
+                continue
             if not resp.get("wait"):
                 return resp
             if get_time() >= deadline:

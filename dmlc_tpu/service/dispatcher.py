@@ -158,7 +158,7 @@ import socket
 import statistics
 import threading
 from collections import deque
-from typing import Deque, Dict, List, Optional, Set, Tuple
+from typing import Deque, Dict, List, Optional, Set, Tuple, Union
 
 from dmlc_tpu.io import faults as _faults
 from dmlc_tpu.io import resilience as _resilience
@@ -255,13 +255,17 @@ class _JobState:
     __slots__ = ("job", "uri", "num_parts", "parser", "plan", "snapshot",
                  "share_sig", "todo", "assigned", "completed",
                  "clients_active", "grant_times", "latencies", "spec",
-                 "spec_times", "hedge_todo")
+                 "spec_times", "hedge_todo", "priority", "weight",
+                 "slo_wait_frac", "max_inflight", "deficit")
 
     def __init__(self, job: str, uri: str, num_parts: int,
                  parser: Optional[dict] = None,
                  plan: Optional[dict] = None,
                  snapshot: Optional[dict] = None,
-                 share_sig: Optional[str] = None):
+                 share_sig: Optional[str] = None,
+                 priority: int = 0, weight: int = 1,
+                 slo_wait_frac: Optional[float] = None,
+                 max_inflight: Optional[int] = None):
         self.job = str(job)
         self.uri = uri
         self.num_parts = int(num_parts)
@@ -303,6 +307,49 @@ class _JobState:
         # parts flagged for speculative re-issue, awaiting a poll from a
         # worker that is not the stuck primary
         self.hedge_todo: Deque[int] = deque()
+        # --- QoS class (docs/service.md Production QoS) ---
+        # priority band: higher bands fully preempt lower ones in the
+        # grant rotation; weight shapes the deficit-round-robin share
+        # WITHIN a band; slo_wait_frac is the job's input-wait SLO target
+        # the autoscaler steers toward; max_inflight bounds this job's
+        # granted-not-completed parts (admission control). All four are
+        # part of the immutable job identity and journal with the spec.
+        self.priority = int(priority)
+        self.weight = int(weight)
+        self.slo_wait_frac = (None if slo_wait_frac is None
+                              else float(slo_wait_frac))
+        self.max_inflight = (None if max_inflight is None
+                             else int(max_inflight))
+        # DRR running credit: replenished by `weight` when the band's
+        # eligible set runs dry, spent 1.0 per grant. Scheduler state,
+        # not identity — rebuilt implicitly across restarts (grants
+        # already journal; credit restarts at 0 for everyone, which
+        # preserves relative shares).
+        self.deficit = 0.0
+
+    def qos_dict(self) -> dict:
+        """The job's QoS class as a wire/journal sub-dict (only the
+        non-default knobs — the default job's flat PR 12 shape stays
+        byte-compatible when nothing was asked for)."""
+        qos: dict = {"priority": self.priority, "weight": self.weight}
+        if self.slo_wait_frac is not None:
+            qos["slo_wait_frac"] = self.slo_wait_frac
+        if self.max_inflight is not None:
+            qos["max_inflight"] = self.max_inflight
+        return qos
+
+    def inflight(self) -> int:
+        """Granted-not-completed parts charged to this job's admission
+        budget (primary grants only — a hedge duplicates work already
+        admitted, it is not a new admission)."""
+        return len(self.grant_times)
+
+    def default_qos(self) -> bool:
+        """True when no QoS knob was asked for — such jobs keep the
+        pre-QoS wire/journal shape byte-compatible."""
+        return (self.priority == 0 and self.weight == 1
+                and self.slo_wait_frac is None
+                and self.max_inflight is None)
 
     def spec_dict(self) -> dict:
         """The wire-shape dataset spec (`config` reply sans job key).
@@ -310,9 +357,12 @@ class _JobState:
         (docs/service.md Wire v2) — informational: the binding
         negotiation happens per stream at open, so mixed fleets and old
         peers interoperate regardless of what this says."""
-        return {"uri": self.uri, "num_parts": self.num_parts,
+        spec = {"uri": self.uri, "num_parts": self.num_parts,
                 "parser": self.parser, "plan": self.plan,
                 "snapshot": self.snapshot, "wire": 2}
+        if not self.default_qos():
+            spec["qos"] = self.qos_dict()
+        return spec
 
 
 class Dispatcher:
@@ -463,12 +513,20 @@ class Dispatcher:
         with self._lock:
             return list(self._jobs)
 
+    def job_qos(self) -> Dict[str, dict]:
+        """Every registered job's QoS class ({job: {priority, weight
+        [, slo_wait_frac][, max_inflight]}}) — the FleetAutoscaler's
+        SLO/priority input (docs/service.md Production QoS)."""
+        with self._lock:
+            return {name: j.qos_dict() for name, j in self._jobs.items()}
+
     # ---------------- job registry ----------------
 
     def _make_job(self, job: str, uri: str, num_parts: int,
                   parser: Optional[dict], plan: Optional[dict],
                   snapshot: Optional[dict],
-                  share_sig: Optional[str] = None) -> _JobState:
+                  share_sig: Optional[str] = None,
+                  qos: Optional[dict] = None) -> _JobState:
         """Build a _JobState, resolving the share-by-signature block
         cache when armed: a job without its own ``block_cache`` gets one
         keyed by its dataset identity, so identical jobs converge on the
@@ -479,21 +537,86 @@ class Dispatcher:
                 {"uri": uri, "num_parts": int(num_parts), "parser": cfg})
             cfg["block_cache"] = os.path.join(self.share_dir,
                                               f"svc-{share_sig}.bc")
+        qos = dict(qos or {})
         return _JobState(job, uri, num_parts, cfg, plan, snapshot,
-                         share_sig=share_sig)
+                         share_sig=share_sig,
+                         priority=qos.get("priority", 0),
+                         weight=qos.get("weight", 1),
+                         slo_wait_frac=qos.get("slo_wait_frac"),
+                         max_inflight=qos.get("max_inflight"))
+
+    @staticmethod
+    def _validate_qos(job: str, req: dict) -> Union[dict, str]:
+        """Normalize the QoS knobs of a registration request into a qos
+        sub-dict, or return an error string. Loud validation: a typo'd
+        class must fail the registration, not silently round-robin."""
+        qos = dict(req.get("qos") or {})
+        for key in ("priority", "weight", "slo_wait_frac", "max_inflight"):
+            if req.get(key) is not None:
+                qos[key] = req[key]
+        try:
+            priority = int(qos.get("priority", 0))
+        except (TypeError, ValueError):
+            return (f"register_job {job!r}: priority "
+                    f"{qos.get('priority')!r} is not an integer")
+        if priority < 0:
+            return (f"register_job {job!r}: priority {priority} must be "
+                    f">= 0 (higher bands preempt lower)")
+        try:
+            weight = int(qos.get("weight", 1))
+        except (TypeError, ValueError):
+            return (f"register_job {job!r}: weight "
+                    f"{qos.get('weight')!r} is not an integer")
+        if weight < 1:
+            return (f"register_job {job!r}: weight {weight} must be >= 1 "
+                    f"(the DRR share within the priority band)")
+        out = {"priority": priority, "weight": weight}
+        if qos.get("slo_wait_frac") is not None:
+            try:
+                slo = float(qos["slo_wait_frac"])
+            except (TypeError, ValueError):
+                return (f"register_job {job!r}: slo_wait_frac "
+                        f"{qos.get('slo_wait_frac')!r} is not a number")
+            if not (0.0 < slo <= 1.0):
+                return (f"register_job {job!r}: slo_wait_frac {slo} must "
+                        f"be in (0, 1] — the input-wait fraction the "
+                        f"autoscaler keeps the job under")
+            out["slo_wait_frac"] = slo
+        if qos.get("max_inflight") is not None:
+            try:
+                max_inflight = int(qos["max_inflight"])
+            except (TypeError, ValueError):
+                return (f"register_job {job!r}: max_inflight "
+                        f"{qos.get('max_inflight')!r} is not an integer")
+            if max_inflight < 1:
+                return (f"register_job {job!r}: max_inflight "
+                        f"{max_inflight} must be >= 1 (admission budget "
+                        f"of granted-not-completed parts)")
+            out["max_inflight"] = max_inflight
+        return out
 
     def register_job(self, job: str, uri: str, num_parts: int,
                      parser: Optional[dict] = None,
                      plan: Optional[dict] = None,
-                     snapshot: Optional[dict] = None) -> dict:
+                     snapshot: Optional[dict] = None,
+                     priority: Optional[int] = None,
+                     weight: Optional[int] = None,
+                     slo_wait_frac: Optional[float] = None,
+                     max_inflight: Optional[int] = None) -> dict:
         """In-process job registration (the RPC's twin — LocalFleet and
         tests use it directly). Returns the registered spec reply;
         raises :class:`ServiceConfigError` when ``job`` exists with a
-        conflicting spec (job identity is immutable)."""
+        conflicting spec (job identity is immutable). ``priority`` /
+        ``weight`` / ``slo_wait_frac`` / ``max_inflight`` are the job's
+        QoS class (docs/service.md Production QoS) — part of the
+        immutable identity."""
         with self._lock:
             resp = self._register_job_locked({
                 "job": job, "uri": uri, "num_parts": num_parts,
-                "parser": parser, "plan": plan, "snapshot": snapshot})
+                "parser": parser, "plan": plan, "snapshot": snapshot,
+                "priority": priority, "weight": weight,
+                "slo_wait_frac": slo_wait_frac,
+                "max_inflight": max_inflight})
         if "error" in resp:
             raise ServiceConfigError(resp["error"])
         return resp
@@ -514,17 +637,22 @@ class Dispatcher:
         if num_parts < 1:
             return {"error": f"register_job {job!r}: num_parts "
                              f"{num_parts} must be >= 1"}
+        qos = self._validate_qos(job, req)
+        if isinstance(qos, str):
+            return {"error": qos}
         state = self._make_job(job, str(uri), num_parts,
                                dict(req.get("parser") or {}),
                                dict(req.get("plan") or {}),
-                               dict(req.get("snapshot") or {}))
+                               dict(req.get("snapshot") or {}),
+                               qos=qos)
         prev = self._jobs.get(job)
         if prev is not None:
             if (prev.uri == state.uri
                     and prev.num_parts == state.num_parts
                     and prev.parser == state.parser
                     and prev.plan == state.plan
-                    and prev.snapshot == state.snapshot):
+                    and prev.snapshot == state.snapshot
+                    and prev.qos_dict() == state.qos_dict()):
                 # idempotent re-registration (a trainer restarting its
                 # client re-binds to the live job state)
                 return dict(prev.spec_dict(), job=job, ok=True,
@@ -532,9 +660,11 @@ class Dispatcher:
             return {"error":
                     f"register_job {job!r}: job already registered with "
                     f"a different spec (have uri={prev.uri!r} "
-                    f"num_parts={prev.num_parts} parser={prev.parser}; "
+                    f"num_parts={prev.num_parts} parser={prev.parser} "
+                    f"qos={prev.qos_dict()}; "
                     f"got uri={state.uri!r} num_parts={state.num_parts} "
-                    f"parser={state.parser}) — job identity is "
+                    f"parser={state.parser} qos={state.qos_dict()}) — "
+                    f"job identity is "
                     f"immutable; register the new dataset under a new "
                     f"job name"}
         self._jobs[job] = state
@@ -559,7 +689,7 @@ class Dispatcher:
         return {"op": "dataset", "job": state.job, "uri": state.uri,
                 "num_parts": state.num_parts, "parser": state.parser,
                 "plan": state.plan, "snapshot": state.snapshot,
-                "share_sig": state.share_sig}
+                "share_sig": state.share_sig, "qos": state.qos_dict()}
 
     # ---------------- journal + replay ----------------
 
@@ -608,11 +738,15 @@ class Dispatcher:
                     f"fresh journal to start over")
             return
         prev = self._jobs.get(name)
+        qos = dict(ev.get("qos") or {})
         restored = _JobState(
             name, ev.get("uri"), int(ev.get("num_parts", 0) or 0),
             dict(ev.get("parser") or {}), dict(ev.get("plan") or {}),
             dict(ev.get("snapshot") or {}),
-            share_sig=ev.get("share_sig"))
+            share_sig=ev.get("share_sig"),
+            priority=qos.get("priority", 0), weight=qos.get("weight", 1),
+            slo_wait_frac=qos.get("slo_wait_frac"),
+            max_inflight=qos.get("max_inflight"))
         if prev is None:
             self._jobs[name] = restored
             return
@@ -1041,16 +1175,45 @@ class Dispatcher:
         """The job a request addresses (absent field = default job)."""
         return self._jobs.get(str(req.get("job") or DEFAULT_JOB))
 
+    def _bands_locked(self) -> List[List[_JobState]]:
+        """Jobs grouped into priority bands, highest band first, each
+        band rotated from the round-robin cursor (docs/service.md
+        Production QoS): a higher band fully preempts lower ones in the
+        grant order; rotation within a band is what DRR credits shape."""
+        bands: Dict[int, List[_JobState]] = {}
+        for j in self._jobs.values():
+            bands.setdefault(j.priority, []).append(j)
+        out = []
+        for prio in sorted(bands, reverse=True):
+            band = bands[prio]
+            k = self._rr % len(band)
+            out.append(band[k:] + band[:k])
+        return out
+
     def _grant_rotation_locked(self) -> List[_JobState]:
-        """The job visitation order for the NEXT grant: round-robin from
-        the rotation cursor, so every job with pending work gets a turn
-        before any job gets a second one — a greedy many-part job cannot
-        drown a starved one (docs/service.md grant fairness)."""
-        order = list(self._jobs.values())
-        if not order:
-            return []
-        k = self._rr % len(order)
-        return order[k:] + order[:k]
+        """The flat job visitation order (priority bands descending,
+        round-robin within each band) — the hedge scan and fairness
+        probes walk this, so a latency-critical job's straggler re-issues
+        ahead of a batch job's fresh work."""
+        return [j for band in self._bands_locked() for j in band]
+
+    def _fleet_inflight_locked(self) -> int:
+        """Granted-not-completed parts across every job — what the
+        fleet-wide admission ceiling bounds."""
+        return sum(j.inflight() for j in self._jobs.values())
+
+    def _admission_locked(self, job: _JobState) -> bool:
+        """True when `job` may be granted one more part: under its own
+        max_inflight budget AND the fleet under the
+        DMLC_TPU_QOS_MAX_INFLIGHT ceiling. Hedge re-issues bypass this —
+        they duplicate work already admitted."""
+        if (job.max_inflight is not None
+                and job.inflight() >= job.max_inflight):
+            return False
+        ceiling = _knobs.qos_max_inflight()
+        if ceiling is not None and self._fleet_inflight_locked() >= ceiling:
+            return False
+        return True
 
     def _dispatch_cmd(self, req: dict) -> dict:
         cmd = req.get("cmd")
@@ -1142,6 +1305,8 @@ class Dispatcher:
                         "todo": list(j.todo),
                         "completed": sorted(j.completed),
                         "hedged": {str(p): w for p, w in j.spec.items()},
+                        "qos": j.qos_dict(),
+                        "inflight": j.inflight(),
                     } for name, j in self._jobs.items()}
                 return {
                     "workers": {w: {"host": i.host, "port": i.port,
@@ -1211,23 +1376,38 @@ class Dispatcher:
                     "to worker %s (primary %s)", job.job, part, worker,
                     job.assigned.get(part))
                 return {"part": part, "job": job.job}
-        # fresh grants: round-robin across jobs with pending work, so N
-        # trainers' queues drain in parallel instead of job-major
-        for i, job in enumerate(rotation):
-            if not job.todo:
+        # fresh grants: deficit round-robin within the highest priority
+        # band that has admissible work (docs/service.md Production QoS).
+        # Higher bands fully preempt lower ones; within a band each job
+        # spends one credit per grant and the band replenishes by weight
+        # when every eligible credit runs dry — so weight 2 jobs draw
+        # twice the grants of weight 1 siblings, and equal-weight jobs
+        # keep the historical strict alternation. Over-budget jobs
+        # (admission control) are simply not eligible this poll.
+        for band in self._bands_locked():
+            eligible = [j for j in band
+                        if j.todo and self._admission_locked(j)]
+            if not eligible:
                 continue
-            part = job.todo.popleft()
-            job.assigned[part] = worker
-            job.grant_times[part] = now
-            self._journal_append(dict({"op": "grant", "part": part,
-                                       "worker": worker},
-                                      **self._job_tag(job)))
-            # advance the cursor PAST the granted job: the next grant
-            # starts at the following job in the rotation
-            self._rr = (self._rr + i + 1) % max(1, len(self._jobs))
-            logger.info("dispatcher: job %s part %d -> worker %s",
-                        job.job, part, worker)
-            return {"part": part, "job": job.job}
+            if all(j.deficit < 1.0 for j in eligible):
+                for j in eligible:
+                    j.deficit = min(j.deficit + j.weight, float(j.weight))
+            for i, job in enumerate(eligible):
+                if job.deficit < 1.0:
+                    continue
+                job.deficit -= 1.0
+                part = job.todo.popleft()
+                job.assigned[part] = worker
+                job.grant_times[part] = now
+                self._journal_append(dict({"op": "grant", "part": part,
+                                           "worker": worker},
+                                          **self._job_tag(job)))
+                # advance the cursor PAST the granted job: the next
+                # grant's band rotation starts at the following job
+                self._rr = (self._rr + band.index(job) + 1) % (1 << 30)
+                logger.info("dispatcher: job %s part %d -> worker %s",
+                            job.job, part, worker)
+                return {"part": part, "job": job.job}
         return {"part": None}
 
     def _part_done_locked(self, req: dict, now: float) -> dict:
@@ -1305,6 +1485,15 @@ class Dispatcher:
                 self._requeue_locked(
                     job, [part], owner, "located after its drained "
                     "owner left")
+            if not self._admission_locked(job):
+                # the part is ungranted BECAUSE admission control is
+                # shedding this job's grants (its own budget or the
+                # fleet ceiling): tell the client to back off with a
+                # retryable throttle instead of a hot wait-poll —
+                # overload degrades to bounded queueing, never a
+                # give-up (docs/service.md Production QoS)
+                _resilience.record_event("service_throttles")
+                return {"throttled": True}
             return {"wait": True}
         resp = {"worker": info.worker, "host": info.host,
                 "port": info.port}
@@ -1609,15 +1798,26 @@ def register_job(address: str, job: str, uri: str, num_parts: int,
                  parser: Optional[dict] = None,
                  plan: Optional[dict] = None,
                  snapshot: Optional[dict] = None,
+                 priority: Optional[int] = None,
+                 weight: Optional[int] = None,
+                 slo_wait_frac: Optional[float] = None,
+                 max_inflight: Optional[int] = None,
                  timeout: float = 10.0) -> dict:
     """Register ``job`` at a running dispatcher over the wire (the
     trainer-side entry point of the multi-tenant service; docs/service.md
     job registry). Idempotent for an identical spec; a conflicting spec
     raises (job identity is immutable). Returns the registered spec —
     including the resolved ``parser`` config, whose ``block_cache`` may
-    have been assigned by share-by-signature."""
-    return request(address, {
-        "cmd": "register_job", "job": str(job), "uri": uri,
-        "num_parts": int(num_parts), "parser": dict(parser or {}),
-        "plan": dict(plan or {}), "snapshot": dict(snapshot or {})},
-        timeout=timeout)
+    have been assigned by share-by-signature. ``priority`` / ``weight`` /
+    ``slo_wait_frac`` / ``max_inflight`` declare the job's QoS class
+    (docs/service.md Production QoS); the keys ride the wire only when
+    set, so old dispatchers keep accepting default-class registrations."""
+    req = {"cmd": "register_job", "job": str(job), "uri": uri,
+           "num_parts": int(num_parts), "parser": dict(parser or {}),
+           "plan": dict(plan or {}), "snapshot": dict(snapshot or {})}
+    for key, value in (("priority", priority), ("weight", weight),
+                       ("slo_wait_frac", slo_wait_frac),
+                       ("max_inflight", max_inflight)):
+        if value is not None:
+            req[key] = value
+    return request(address, req, timeout=timeout)

@@ -111,16 +111,28 @@ class LocalFleet:
     def register_job(self, job: str, uri: str, num_parts: int,
                      parser: Optional[dict] = None,
                      plan: Optional[dict] = None,
-                     snapshot: Optional[dict] = None) -> dict:
+                     snapshot: Optional[dict] = None,
+                     priority: Optional[int] = None,
+                     weight: Optional[int] = None,
+                     slo_wait_frac: Optional[float] = None,
+                     max_inflight: Optional[int] = None) -> dict:
         """Register one more job at the running dispatcher
         (docs/service.md multi-tenant service): the live workers pick it
         up at their next grant — no fleet restart, no new fleet. With
         ``share_dir`` set on the fleet, a job over an already-registered
         corpus + config shares its published block caches by signature
-        (the corpus parses once fleet-wide)."""
+        (the corpus parses once fleet-wide). ``priority`` / ``weight`` /
+        ``slo_wait_frac`` / ``max_inflight`` declare the job's QoS class
+        (docs/service.md Production QoS)."""
         return self.dispatcher.register_job(
             job, uri, num_parts, parser=parser, plan=plan,
-            snapshot=snapshot)
+            snapshot=snapshot, priority=priority, weight=weight,
+            slo_wait_frac=slo_wait_frac, max_inflight=max_inflight)
+
+    def job_qos(self):
+        """The registered jobs' QoS classes ({job: {priority, weight,
+        ...}}) — the FleetAutoscaler's default SLO/priority source."""
+        return self.dispatcher.job_qos()
 
     def live_workers(self) -> List[ParseWorker]:
         """Workers that are live CAPACITY: not killed/closed/drained,

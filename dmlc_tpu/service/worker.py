@@ -103,11 +103,54 @@ from dmlc_tpu.service.frame import (
     send_frame,
     send_frame_vectored,
 )
+from dmlc_tpu.store.manager import publish_owner
+from dmlc_tpu.utils import knobs as _knobs
 from dmlc_tpu.utils import telemetry as _telemetry
 from dmlc_tpu.utils.check import DMLCError
 from dmlc_tpu.utils.timer import get_time
 
 logger = logging.getLogger("dmlc_tpu.service")
+
+
+# the shared packed-snapshot container (docs/service.md snapshot
+# sharing): the DMLCSN01 store tier's on-disk home for a part's packed
+# snapshot frames — magic, frame count, then length-prefixed wire
+# frames. Deliberately trivial: the frames ARE the wire encoding
+# (dmlc_tpu.service.frame), so a load is a read + split, no re-pack.
+_SNAP_SHARE_MAGIC = b"DMLCSN01"
+
+
+def _encode_snap_container(frames: List[bytes]) -> bytes:
+    import struct
+
+    out = [_SNAP_SHARE_MAGIC, struct.pack("<I", len(frames))]
+    for fr in frames:
+        out.append(struct.pack("<Q", len(fr)))
+        out.append(fr)
+    return b"".join(out)
+
+
+def _decode_snap_container(data: bytes) -> Optional[List[bytes]]:
+    """The container's frames, or None on any shape violation — a
+    corrupt/foreign file must fall back to a local pack, never crash
+    the serve."""
+    import struct
+
+    if len(data) < 12 or data[:8] != _SNAP_SHARE_MAGIC:
+        return None
+    (count,) = struct.unpack_from("<I", data, 8)
+    off = 12
+    frames: List[bytes] = []
+    for _ in range(count):
+        if off + 8 > len(data):
+            return None
+        (ln,) = struct.unpack_from("<Q", data, off)
+        off += 8
+        if off + ln > len(data):
+            return None
+        frames.append(data[off:off + ln])
+        off += ln
+    return frames if off == len(data) else None
 
 
 class _PartStore:
@@ -657,6 +700,14 @@ class ParseWorker:
                              int(part))
 
     def _parse_part(self, job: str, part: int) -> None:
+        # the whole parse — however deep the block-cache/chunk-cache
+        # machinery publishes — runs in the job's publish-owner scope,
+        # so every artifact lands in the manifest with its owning-job
+        # ledger entry (docs/store.md per-job budgets)
+        with publish_owner(job):
+            self._parse_part_owned(job, part)
+
+    def _parse_part_owned(self, job: str, part: int) -> None:
         store = _PartStore()
         # cache the job's spec BEFORE the store entry becomes visible: a
         # client's snapshot-stream request can arrive the instant the
@@ -815,7 +866,7 @@ class ParseWorker:
         logger.info("worker %s: job %s part %d cold build claimed by %s; "
                     "waiting for its publish", self.worker_id, job, part,
                     store.claimant(path))
-        deadline = get_time() + 30.0
+        deadline = get_time() + float(_knobs.resolve("claim_wait_deadline"))
         while (get_time() < deadline and not self._stop.is_set()
                and not self._draining.is_set()):
             try:
@@ -1185,15 +1236,29 @@ class ParseWorker:
             if frames is None:
                 store.snap_packing = True
         if frames is None:
-            try:
-                packed = self._pack_snapshot_frames(store, geometry)
-            except Exception as exc:  # noqa: BLE001 - served as ERROR
-                with self._cond:
-                    store.snap_packing = False
-                    self._cond.notify_all()
-                send_frame(conn, encode_error_frame(
-                    f"snapshot packing failed: {exc}"))
-                return
+            # cross-job snapshot sharing (docs/service.md snapshot
+            # sharing): a sibling job with the SAME geometry over the
+            # same corpus signature — or a previous incarnation — may
+            # already have published this pack to the DMLCSN01 store
+            # tier; load + pin it instead of re-packing
+            packed = self._load_shared_snapshot(store, geometry)
+            if packed is not None:
+                _resilience.record_event("service_parts_shared")
+                logger.info("worker %s: job %s part %d snapshot served "
+                            "from shared artifact", self.worker_id, job,
+                            part)
+            else:
+                try:
+                    packed = self._pack_snapshot_frames(store, geometry)
+                except Exception as exc:  # noqa: BLE001 - served as ERROR
+                    with self._cond:
+                        store.snap_packing = False
+                        self._cond.notify_all()
+                    send_frame(conn, encode_error_frame(
+                        f"snapshot packing failed: {exc}"))
+                    return
+                self._publish_shared_snapshot(store, geometry, packed,
+                                              job)
             with self._cond:
                 store.snap_frames = packed
                 store.snap_packing = False
@@ -1207,6 +1272,74 @@ class ParseWorker:
         # the dispatcher, same as the CSR path (docs/service.md)
         send_frame(conn, encode_end_frame(part, len(frames),
                                           draining=self._draining.is_set()))
+
+    def _snap_share_path(self, store: _PartStore,
+                         geometry: dict) -> Optional[str]:
+        """The shared on-disk home of this part's packed snapshot
+        frames: the part's published (share-by-signature) block-cache
+        path + a geometry digest. Sibling jobs over the same corpus
+        signature with the same geometry resolve the SAME path, so the
+        pack happens once fleet-wide; a job with a private cache still
+        shares with its own later incarnations. None when the part has
+        no published cache (nothing durable to key on)."""
+        cache_path = store.cache_path
+        if not cache_path or not geometry:
+            return None
+        from dmlc_tpu.store import signature_hash
+
+        return f"{cache_path}.g{signature_hash(geometry)}.snap"
+
+    def _load_shared_snapshot(self, store: _PartStore,
+                              geometry: dict) -> Optional[List[bytes]]:
+        """A previously-published shared snapshot pack for this part +
+        geometry, pinned against either tenant's eviction pressure; None
+        on miss/corruption (the caller packs locally)."""
+        path = self._snap_share_path(store, geometry)
+        if not path or not os.path.exists(path):
+            return None
+        try:
+            with open(path, "rb") as f:
+                frames = _decode_snap_container(f.read())
+        except OSError:
+            return None
+        if frames is None:
+            return None
+        try:
+            from dmlc_tpu.store import store_for
+
+            store_for(path).pin(path)
+            self._artifact_pins.append(path)
+        except Exception:  # noqa: BLE001 - a pin failure must never
+            pass           # fail the serve; the artifact stays evictable
+        return frames
+
+    def _publish_shared_snapshot(self, store: _PartStore, geometry: dict,
+                                 frames: List[bytes], job: str) -> None:
+        """Publish this part's packed snapshot frames to the DMLCSN01
+        store tier (atomic stage + rename — concurrent packers converge
+        on one artifact) and pin it for this worker's life. Best-effort:
+        a store failure costs only the sharing, never the stream."""
+        path = self._snap_share_path(store, geometry)
+        if not path:
+            return
+        try:
+            from dmlc_tpu.store import store_for
+
+            st = store_for(path)
+            tmp = st.stage_path(path)
+            with open(tmp, "wb") as f:
+                f.write(_encode_snap_container(frames))
+            st.publish_file(
+                tmp, path, "snapshot",
+                signature={"cache": os.path.basename(store.cache_path),
+                           "geometry": geometry},
+                job=job)
+            st.pin(path)
+            self._artifact_pins.append(path)
+        except Exception as exc:  # noqa: BLE001 - sharing is an
+            # optimization; the local pack already serves this client
+            logger.warning("worker %s: shared snapshot publish of %s "
+                           "failed: %s", self.worker_id, path, exc)
 
     def _serve_find(self, conn, job: str, part: int, key: str) -> None:
         """Block index whose resume annotation matches ``key`` — the

@@ -22,7 +22,9 @@ from dmlc_tpu.store.manager import (
     TIER_COST,
     TIERS,
     ArtifactStore,
+    current_publish_owner,
     note_missing,
+    publish_owner,
     reset_stores,
     signature_hash,
     store_counters,
@@ -34,6 +36,7 @@ __all__ = [
     "AppendJournal",
     "ArtifactStore", "COMPACT_BYTES", "COMPACT_LINES", "MAGIC_TIERS",
     "MANIFEST_NAME", "STORE_DIRNAME", "TIER_COST", "TIERS",
-    "note_missing", "reset_stores", "signature_hash", "store_counters",
+    "current_publish_owner", "note_missing", "publish_owner",
+    "reset_stores", "signature_hash", "store_counters",
     "store_for", "tier_for_magic",
 ]

@@ -64,6 +64,7 @@ and :func:`~dmlc_tpu.utils.telemetry.pod_snapshot`. See docs/store.md.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import itertools
 import json
@@ -113,6 +114,33 @@ MAGIC_TIERS = {
 
 _stage_seq = itertools.count(1)
 
+# the active publish owner (a service job name), thread-local: service
+# workers wrap a part's whole parse in publish_owner(job) so every
+# artifact the parse publishes — however deep in the block-cache /
+# chunk-cache machinery the write happens — lands in the manifest with
+# its owning-job ledger entry (docs/store.md per-job budgets)
+_owner_tls = threading.local()
+
+
+@contextlib.contextmanager
+def publish_owner(job: Optional[str]):
+    """Attribute every publish on this thread to ``job`` for the scope
+    (nested scopes restore the outer owner). The owner rides the
+    manifest's publish events, so per-tenant budget eviction can filter
+    candidates by owning job."""
+    prev = getattr(_owner_tls, "job", None)
+    _owner_tls.job = str(job) if job else None
+    try:
+        yield
+    finally:
+        _owner_tls.job = prev
+
+
+def current_publish_owner() -> Optional[str]:
+    """The thread's active publish-owner job, or None (unowned — only
+    the fleet-wide budget applies to such artifacts)."""
+    return getattr(_owner_tls, "job", None)
+
 
 def tier_for_magic(magic: bytes) -> str:
     """The tier a container magic publishes under."""
@@ -149,10 +177,12 @@ def _pid_alive(pid: int) -> bool:
 class _Entry:
     """Replayed live state of one artifact."""
 
-    __slots__ = ("name", "tier", "bytes", "sig", "seq", "pins", "evicted")
+    __slots__ = ("name", "tier", "bytes", "sig", "seq", "pins", "evicted",
+                 "job")
 
     def __init__(self, name: str, tier: str, nbytes: int,
-                 sig: Optional[str], seq: int):
+                 sig: Optional[str], seq: int,
+                 job: Optional[str] = None):
         self.name = name
         self.tier = tier
         self.bytes = int(nbytes)
@@ -160,6 +190,7 @@ class _Entry:
         self.seq = seq          # last event seq — the LRU clock
         self.pins: Dict[int, int] = {}   # pid -> refcount
         self.evicted = False    # tombstone: evicted, rebuild not yet seen
+        self.job = job          # owning-job ledger (per-tenant budgets)
 
     def pinned(self) -> bool:
         return any(n > 0 and _pid_alive(pid)
@@ -235,7 +266,7 @@ class ArtifactStore:
                 if tier not in TIER_COST:
                     continue
                 e = _Entry(name, tier, int(ev.get("bytes", 0) or 0),
-                           ev.get("sig"), seq)
+                           ev.get("sig"), seq, job=ev.get("job"))
                 prev = entries.get(name)
                 if prev is not None:
                     e.pins = prev.pins  # pins survive a republish
@@ -300,9 +331,15 @@ class ArtifactStore:
 
         def live_events():
             for e in sorted(entries.values(), key=lambda e: e.seq):
-                yield {"op": "publish", "path": e.name, "tier": e.tier,
+                pub = {"op": "publish", "path": e.name, "tier": e.tier,
                        "bytes": e.bytes, "sig": e.sig,
                        "cost": TIER_COST[e.tier]}
+                if e.job:
+                    # the owning-job ledger survives compaction — a
+                    # per-tenant budget squeeze after a compaction must
+                    # still know whose artifact is whose
+                    pub["job"] = e.job
+                yield pub
                 if e.evicted:
                     yield {"op": "evict", "path": e.name}
                 for pid, n in e.pins.items():
@@ -392,18 +429,36 @@ class ArtifactStore:
 
     def _enforce_budget_locked(self, state: Dict[str, _Entry],
                                protect: Optional[str] = None) -> None:
+        # per-tenant pass FIRST (docs/store.md per-job budgets): a job
+        # over DMLC_TPU_STORE_JOB_BUDGET_BYTES sheds ITS OWN artifacts,
+        # so the offender is bounded before its pressure ever reaches
+        # the fleet-wide pass — one tenant's cold builds can never evict
+        # a sibling's warm set through the shared budget
+        job_budget = _knobs.store_job_budget_bytes()
+        if job_budget is not None:
+            by_job: Dict[str, List[_Entry]] = {}
+            for e in state.values():
+                if not e.evicted and e.job:
+                    by_job.setdefault(e.job, []).append(e)
+            for owned in by_job.values():
+                self._evict_over_locked(owned, job_budget, protect)
         budget = _knobs.store_budget_bytes()
-        if budget is None:
-            return
-        live = [e for e in state.values() if not e.evicted]
-        total = sum(e.bytes for e in live)
-        # cheapest-to-rebuild first (tier cost ascending), LRU within a
-        # tier (event seq ascending)
-        for victim in sorted(live, key=lambda e: (TIER_COST[e.tier],
-                                                  e.seq)):
+        if budget is not None:
+            live = [e for e in state.values() if not e.evicted]
+            self._evict_over_locked(live, budget, protect)
+
+    def _evict_over_locked(self, candidates: List[_Entry], budget: int,
+                           protect: Optional[str]) -> None:
+        """Evict from ``candidates`` until their live bytes fit
+        ``budget``: cheapest-to-rebuild first (tier cost ascending), LRU
+        within a tier (event seq ascending)."""
+        total = sum(e.bytes for e in candidates if not e.evicted)
+        for victim in sorted(candidates, key=lambda e: (TIER_COST[e.tier],
+                                                        e.seq)):
             if total <= budget:
                 break
-            if victim.name == protect or victim.pinned():
+            if victim.evicted or victim.name == protect \
+                    or victim.pinned():
                 # the just-published artifact and every pinned one are
                 # exempt — with nothing else to evict the store may sit
                 # over budget until a pin drops (docs/store.md)
@@ -438,13 +493,18 @@ class ArtifactStore:
         return f"{final_path}.{os.getpid()}.{next(_stage_seq)}.tmp"
 
     def publish_file(self, tmp_path: str, final_path: str, tier: str,
-                     signature=None, fobj=None) -> None:
+                     signature=None, fobj=None,
+                     job: Optional[str] = None) -> None:
         """The one publish path: fsync the staged bytes, atomically
         rename into place, journal the publish, enforce the byte budget.
         ``fobj`` is the still-open staging file when the caller has one
-        (saves a reopen); it is closed here either way."""
+        (saves a reopen); it is closed here either way. ``job`` records
+        the owning tenant in the manifest ledger (per-job budgets);
+        defaults to the thread's :func:`publish_owner` scope."""
         check(tier in TIER_COST,
               f"store: unknown tier {tier!r}; managed tiers: {TIERS}")
+        if job is None:
+            job = current_publish_owner()
         if fobj is not None and not fobj.closed:
             # fsync BEFORE the atomic rename: without it a crash in the
             # window can publish a complete-looking artifact whose bytes
@@ -459,11 +519,12 @@ class ArtifactStore:
         with self._locked():
             os.replace(tmp_path, final_path)
             nbytes = os.path.getsize(final_path)
-            self._append_locked(
-                {"op": "publish", "path": name, "tier": tier,
-                 "bytes": nbytes, "sig": signature_hash(signature),
-                 "cost": TIER_COST[tier], "pid": os.getpid()},
-                sync=True)
+            pub = {"op": "publish", "path": name, "tier": tier,
+                   "bytes": nbytes, "sig": signature_hash(signature),
+                   "cost": TIER_COST[tier], "pid": os.getpid()}
+            if job:
+                pub["job"] = str(job)
+            self._append_locked(pub, sync=True)
             state = self._replay_locked()
             self._enforce_budget_locked(state, protect=name)
             self._set_gauges_locked(state)
@@ -576,7 +637,7 @@ class ArtifactStore:
             state = self._replay_locked()
         return [{"path": e.name, "tier": e.tier, "bytes": e.bytes,
                  "sig": e.sig, "pinned": e.pinned(),
-                 "evicted": e.evicted}
+                 "evicted": e.evicted, "job": e.job}
                 for e in sorted(state.values(), key=lambda e: e.seq)]
 
     def total_bytes(self) -> int:

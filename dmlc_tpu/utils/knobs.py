@@ -145,6 +145,15 @@ KNOB_TABLE: Dict[str, KnobSpec] = {
                 "controller maps the read stage to it when the source is "
                 "a service stream"),
         KnobSpec(
+            "claim_wait_deadline", "DMLC_TPU_CLAIM_WAIT_DEADLINE",
+            default=30, lo=1, hi=86400,
+            doc="seconds a service worker waits on a sibling's cold-build "
+                "claim before giving up the wait and building the part "
+                "itself (docs/service.md single-claim cold builds). Not "
+                "an autotuned knob — the deadline is the operator's "
+                "duplicate-work-vs-latency tradeoff under claim-holder "
+                "failure"),
+        KnobSpec(
             "fleet_scale_interval", "DMLC_TPU_FLEET_SCALE_INTERVAL",
             default=10, lo=1, hi=3600,
             doc="seconds between fleet-autoscaler control ticks: each "
@@ -238,6 +247,49 @@ def store_budget_bytes(explicit: Optional[int] = None) -> Optional[int]:
     if not raw:
         return None
     return _parse_positive_int(raw, "DMLC_TPU_STORE_BUDGET_BYTES")
+
+
+def store_job_budget_bytes(explicit: Optional[int] = None) -> Optional[int]:
+    """Per-tenant artifact-store byte budget (docs/store.md per-job
+    budgets): explicit argument > ``DMLC_TPU_STORE_JOB_BUDGET_BYTES``
+    env (validated loudly: integer >= 1) > None (no per-job cap — only
+    the fleet-wide ``DMLC_TPU_STORE_BUDGET_BYTES`` applies). Layered on
+    the PR 11 eviction pass: a job over its budget sheds ITS OWN
+    cheapest unpinned artifacts first, so one tenant's cold builds can
+    never evict a sibling's warm set. Not an autotune knob — isolation
+    budgets are the operator's tenancy contract."""
+    if explicit is not None:
+        value = int(explicit)
+        check(value >= 1,
+              f"store_job_budget_bytes={value}: must be >= 1 (omit the "
+              f"budget entirely for uncapped tenants)")
+        return value
+    raw = os.environ.get("DMLC_TPU_STORE_JOB_BUDGET_BYTES", "").strip()
+    if not raw:
+        return None
+    return _parse_positive_int(raw, "DMLC_TPU_STORE_JOB_BUDGET_BYTES")
+
+
+def qos_max_inflight(explicit: Optional[int] = None) -> Optional[int]:
+    """Fleet-wide parts-in-flight ceiling for the data service
+    (docs/service.md Production QoS): explicit argument >
+    ``DMLC_TPU_QOS_MAX_INFLIGHT`` env (validated loudly: integer >= 1) >
+    None (no ceiling — the historical grant-whatever-workers-ask
+    behavior). When the sum of granted-not-completed parts across every
+    job reaches the ceiling, the dispatcher sheds further grants and
+    locate replies turn ``{"throttled": true}`` — overload degrades to
+    bounded queueing instead of fleet collapse. Not an autotune knob —
+    the ceiling is the operator's overload contract."""
+    if explicit is not None:
+        value = int(explicit)
+        check(value >= 1,
+              f"qos_max_inflight={value}: must be >= 1 (omit the ceiling "
+              f"entirely for unbounded admission)")
+        return value
+    raw = os.environ.get("DMLC_TPU_QOS_MAX_INFLIGHT", "").strip()
+    if not raw:
+        return None
+    return _parse_positive_int(raw, "DMLC_TPU_QOS_MAX_INFLIGHT")
 
 
 def store_gc_age_seconds(explicit: Optional[int] = None) -> int:

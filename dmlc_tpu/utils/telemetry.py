@@ -96,6 +96,11 @@ STORE_BYTES_METRIC = "store_bytes"
 # to per-rank stages (docs/observability.md).
 SERVICE_JOB_WAIT_METRIC = "service_job_input_wait_seconds"
 SERVICE_JOB_PARTS_METRIC = "service_job_parts"
+# per-job input-wait SLO target (register_job(slo_wait_frac=),
+# docs/service.md Production QoS): a job-labeled gauge each
+# ServiceParser publishes from its config reply, so the pod table shows
+# every job's wait NEXT TO the target the autoscaler steers it under
+SERVICE_JOB_SLO_METRIC = "service_job_slo_wait_frac"
 # wire v2 compression ledger (dmlc_tpu.service.frame, docs/service.md
 # Wire v2): raw vs on-wire bytes for every served data frame, labeled by
 # `job` — sent/raw is the live compression ratio the pod table and bench
@@ -594,6 +599,12 @@ def pod_snapshot() -> dict:
     jobs = {j: {"input_wait_seconds": round(job_waits.get(j, 0.0), 4),
                 "parts": int(round(job_parts.get(j, 0)))}
             for j in sorted(set(job_waits) | set(job_parts)) if j}
+    # SLO targets ride beside the wait they bound (docs/service.md
+    # Production QoS) — a gauge, identical across a job's ranks, so the
+    # pod table can show wait-vs-target per job at a glance
+    for j, slo in REGISTRY.sum_by(SERVICE_JOB_SLO_METRIC, "job").items():
+        if j and slo and j in jobs:
+            jobs[j]["slo_wait_frac"] = round(slo, 4)
     return {
         "telemetry_schema_version": SCHEMA_VERSION,
         "stages": {k: round(v, 4) for k, v in stages.items() if k},
@@ -620,8 +631,13 @@ def _format_jobs_cell(jobs: dict) -> str:
     cells = []
     for j in sorted(jobs):
         rec = jobs[j] or {}
-        cells.append(f"{j}=wait{float(rec.get('input_wait_seconds', 0.0)):.3f}s"
-                     f"/parts{int(rec.get('parts', 0))}")
+        cell = (f"{j}=wait{float(rec.get('input_wait_seconds', 0.0)):.3f}s"
+                f"/parts{int(rec.get('parts', 0))}")
+        if rec.get("slo_wait_frac"):
+            # the job's input-wait SLO target next to its wait — the
+            # at-a-glance "is the autoscaler holding the contract" cell
+            cell += f"/slo{float(rec['slo_wait_frac']):.2f}"
+        cells.append(cell)
     return " ".join(cells) if cells else "-"
 
 
@@ -669,6 +685,13 @@ def format_pod_table(by_rank: Dict[int, dict]) -> str:
             tot["input_wait_seconds"] += float(
                 (rec or {}).get("input_wait_seconds", 0.0))
             tot["parts"] += int((rec or {}).get("parts", 0))
+            slo = (rec or {}).get("slo_wait_frac")
+            if slo:
+                # a target, not a tally: identical across ranks, so the
+                # sum row carries it through max, never addition
+                tot["slo_wait_frac"] = max(float(slo),
+                                           float(tot.get("slo_wait_frac",
+                                                         0.0)))
         lines.append(f"{rank:>4}  " + "  ".join(cells)
                      + f"  {hot if hot else '-'}"
                      + f"  {_format_jobs_cell(jobs)}")

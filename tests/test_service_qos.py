@@ -1,0 +1,641 @@
+"""Tier-1 suite for production QoS on the multi-tenant data service
+(docs/service.md Production QoS): priority/weight classes (validated at
+registration, deficit-round-robin within a band, higher bands preempt,
+journal-exact replay across kill -9 + compaction), admission control
+(per-job ``max_inflight`` budgets + the fleet-wide
+``DMLC_TPU_QOS_MAX_INFLIGHT`` ceiling, retryable ``throttled`` locate
+replies the client backs off on without ever burning toward a give-up),
+per-tenant store budgets (``DMLC_TPU_STORE_JOB_BUDGET_BYTES`` — an
+over-budget tenant sheds ITS OWN unpinned artifacts, never a sibling's
+warm set), SLO-driven autoscaling (``register_job(slo_wait_frac=)``
+steers the grow decision toward the most-starved highest-priority job),
+cross-job snapshot sharing through the ``DMLCSN01`` store tier, and the
+process-level acceptance run — a saturating batch tenant beside a
+latency-critical one: the critical epoch stays byte-identical with its
+input-wait fraction under the declared SLO, the batch tenant is
+throttled (``service_throttles``) with zero ``service_giveups``, the
+QoS classes replay exactly across dispatcher kill -9, and a budget
+squeeze never evicts the sibling's pinned warm set."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.io import resilience
+from dmlc_tpu.service import (
+    DEFAULT_JOB,
+    LocalFleet,
+    ParseWorker,
+    ServiceConfigError,
+    ServiceParser,
+)
+from dmlc_tpu.service import dispatcher as svc_dispatcher
+from dmlc_tpu.service.autoscale import GROW, HOLD
+from dmlc_tpu.store import reset_stores, store_for
+from dmlc_tpu.utils import knobs, telemetry
+from dmlc_tpu.utils.check import DMLCError
+
+from tests.test_service import (  # noqa: F401  (corpus fixture)
+    NUM_PARTS,
+    PARSER_CFG,
+    _assert_blocks_equal,
+    _drain,
+    _local_blocks,
+    _write_corpus,
+    corpus,
+)
+from tests.test_service_multitenant import (  # noqa: F401
+    OTHER_PARTS,
+    _drain_job,
+    _write_other,
+)
+from tests.test_service_recovery import _req  # noqa: F401
+
+
+# ---------------------------------------------------------------------------
+# QoS classes: validation, config echo, immutable identity
+
+
+def test_register_job_qos_validation_and_echo(corpus):
+    disp = svc_dispatcher.Dispatcher(corpus, NUM_PARTS, parser=PARSER_CFG,
+                                     liveness_timeout=0)
+    try:
+        # loud validation: a typo'd class fails the registration
+        for bad, match in ((dict(priority=-1), "priority"),
+                           (dict(weight=0), "weight"),
+                           (dict(slo_wait_frac=1.5), "slo_wait_frac"),
+                           (dict(slo_wait_frac=0.0), "slo_wait_frac"),
+                           (dict(max_inflight=0), "max_inflight")):
+            with pytest.raises(ServiceConfigError, match=match):
+                disp.register_job("bad", corpus, NUM_PARTS,
+                                  parser=PARSER_CFG, **bad)
+        # non-numeric knobs over the RPC are the same loud error
+        with pytest.raises(DMLCError, match="priority"):
+            _req(disp, "register_job", job="bad", uri=corpus,
+                 num_parts=NUM_PARTS, parser=PARSER_CFG, priority="high")
+        assert "bad" not in disp.jobs
+        # a declared class echoes through the registered spec...
+        resp = disp.register_job("crit", corpus, NUM_PARTS,
+                                 parser=PARSER_CFG, priority=2, weight=3,
+                                 slo_wait_frac=0.25, max_inflight=4)
+        assert resp["qos"] == {"priority": 2, "weight": 3,
+                              "slo_wait_frac": 0.25, "max_inflight": 4}
+        # ...and the autoscaler's job_qos view
+        qos = disp.job_qos()
+        assert qos["crit"] == {"priority": 2, "weight": 3,
+                               "slo_wait_frac": 0.25, "max_inflight": 4}
+        # a job that asked for nothing keeps the default class and the
+        # pre-QoS config wire shape (no qos key at all)
+        assert qos[DEFAULT_JOB] == {"priority": 0, "weight": 1}
+        assert "qos" not in _req(disp, "config")
+        # the class is part of the immutable job identity
+        again = disp.register_job("crit", corpus, NUM_PARTS,
+                                  parser=PARSER_CFG, priority=2, weight=3,
+                                  slo_wait_frac=0.25, max_inflight=4)
+        assert again["existing"] is True
+        with pytest.raises(ServiceConfigError, match="immutable"):
+            disp.register_job("crit", corpus, NUM_PARTS,
+                              parser=PARSER_CFG, priority=1)
+    finally:
+        disp.close()
+
+
+def test_weighted_drr_grant_shares_within_band(corpus):
+    """Deficit round-robin: a weight-2 job draws exactly twice the
+    grants of its weight-1 sibling in every replenish cycle — weighted
+    fairness, not starvation and not strict alternation."""
+    disp = svc_dispatcher.Dispatcher(liveness_timeout=0)  # born empty
+    try:
+        disp.register_job("heavy", corpus, 6, parser=PARSER_CFG,
+                          weight=2)
+        disp.register_job("light", corpus, 3, parser=PARSER_CFG)
+        _req(disp, "register", worker="a", host="h", port=1)
+        grants = []
+        for _ in range(9):
+            resp = _req(disp, "next_split", worker="a")
+            grants.append(resp["job"])
+        # every 3-grant window splits 2:1 — the DRR credit cycle
+        for i in (3, 6, 9):
+            assert grants[:i].count("heavy") == 2 * (i // 3)
+            assert grants[:i].count("light") == i // 3
+        assert _req(disp, "next_split", worker="a")["part"] is None
+    finally:
+        disp.close()
+
+
+def test_priority_band_preempts_lower(corpus):
+    """A higher priority band fully preempts lower ones: once the
+    critical job registers, every grant is its until its queue drains —
+    the batch job resumes only afterwards."""
+    disp = svc_dispatcher.Dispatcher(liveness_timeout=0)
+    try:
+        disp.register_job("batch", corpus, 2, parser=PARSER_CFG)
+        _req(disp, "register", worker="a", host="h", port=1)
+        first = _req(disp, "next_split", worker="a")
+        assert (first["job"], first["part"]) == ("batch", 0)
+        disp.register_job("crit", corpus, 2, parser=PARSER_CFG,
+                          priority=1)
+        order = []
+        for _ in range(3):
+            resp = _req(disp, "next_split", worker="a")
+            order.append((resp["job"], resp["part"]))
+        assert order == [("crit", 0), ("crit", 1), ("batch", 1)]
+    finally:
+        disp.close()
+
+
+def test_qos_replays_across_kill9_and_compaction(corpus, tmp_path):
+    """The journal twin: priority/weight/SLO/budget replay exactly
+    across dispatcher kill -9, survive journal compaction, and the
+    restored class still enforces immutable identity."""
+    other = _write_other(tmp_path)
+    jp = str(tmp_path / "disp.jsonl")
+    disp = svc_dispatcher.Dispatcher(corpus, NUM_PARTS, parser=PARSER_CFG,
+                                     journal_path=jp, liveness_timeout=0)
+    disp.register_job("crit", corpus, NUM_PARTS, parser=PARSER_CFG,
+                      priority=2, weight=3, slo_wait_frac=0.5,
+                      max_inflight=2)
+    disp.register_job("batch", other, OTHER_PARTS, parser=PARSER_CFG)
+    want = disp.job_qos()
+    assert want["crit"] == {"priority": 2, "weight": 3,
+                            "slo_wait_frac": 0.5, "max_inflight": 2}
+    assert want["batch"] == {"priority": 0, "weight": 1}
+    # some assignment traffic so compaction has state to fold
+    _req(disp, "register", worker="a", host="h", port=1)
+    g = _req(disp, "next_split", worker="a")
+    _req(disp, "part_done", worker="a", part=g["part"], job=g["job"])
+    disp.kill()
+    # restart forces compaction (tiny threshold): the rewritten journal
+    # must carry the QoS classes forward
+    disp2 = svc_dispatcher.Dispatcher(corpus, NUM_PARTS,
+                                      parser=PARSER_CFG, journal_path=jp,
+                                      liveness_timeout=0,
+                                      journal_compact_lines=1)
+    assert disp2.job_qos() == want
+    with pytest.raises(DMLCError, match="immutable"):
+        svc_dispatcher.register_job(disp2.address, "crit", corpus,
+                                    NUM_PARTS, parser=PARSER_CFG,
+                                    priority=1)
+    disp2.kill()
+    # a third boot replays the COMPACTED form identically
+    disp3 = svc_dispatcher.Dispatcher(corpus, NUM_PARTS,
+                                      parser=PARSER_CFG, journal_path=jp,
+                                      liveness_timeout=0)
+    try:
+        assert disp3.job_qos() == want
+    finally:
+        disp3.close()
+
+
+# ---------------------------------------------------------------------------
+# admission control: budgets, the fleet ceiling, throttled locates
+
+
+def test_per_job_inflight_budget_throttles_and_heals(corpus):
+    """max_inflight bounds granted-not-completed parts: the over-budget
+    job is simply not eligible, its ungranted parts locate as a
+    retryable ``throttled`` reply, and a completion heals admission."""
+    base = resilience.counters_snapshot()
+    disp = svc_dispatcher.Dispatcher(liveness_timeout=0)
+    try:
+        disp.register_job("j", corpus, 2, parser=PARSER_CFG,
+                          max_inflight=1)
+        _req(disp, "register", worker="a", host="h", port=1)
+        assert _req(disp, "next_split", worker="a")["part"] == 0
+        # at budget: no second grant, and the ungranted part's locate is
+        # a shed — not a wait, not an error
+        assert _req(disp, "next_split", worker="a")["part"] is None
+        shed = _req(disp, "locate", part=1, job="j")
+        assert shed["throttled"] is True
+        assert "worker" not in shed and "wait" not in shed
+        # the GRANTED part still locates its owner (serving continues)
+        assert _req(disp, "locate", part=0, job="j")["worker"] == "a"
+        # completion frees the budget: the grant and locate both heal
+        _req(disp, "part_done", worker="a", part=0, job="j")
+        assert _req(disp, "next_split", worker="a")["part"] == 1
+        assert _req(disp, "locate", part=1, job="j")["worker"] == "a"
+        delta = resilience.counters_delta(base)
+        assert delta["service_throttles"] == 1
+    finally:
+        disp.close()
+
+
+def test_fleet_ceiling_sheds_across_jobs(corpus, tmp_path, monkeypatch):
+    """DMLC_TPU_QOS_MAX_INFLIGHT bounds the SUM of in-flight parts over
+    every job: with the fleet saturated by one tenant, a sibling's
+    locate sheds with ``throttled`` until capacity frees."""
+    monkeypatch.setenv("DMLC_TPU_QOS_MAX_INFLIGHT", "1")
+    other = _write_other(tmp_path)
+    base = resilience.counters_snapshot()
+    disp = svc_dispatcher.Dispatcher(liveness_timeout=0)
+    try:
+        disp.register_job("a", corpus, 1, parser=PARSER_CFG)
+        disp.register_job("b", other, 1, parser=PARSER_CFG)
+        _req(disp, "register", worker="w", host="h", port=1)
+        assert _req(disp, "next_split", worker="w")["job"] == "a"
+        # fleet at ceiling: job b gets neither grants nor a hot wait
+        assert _req(disp, "next_split", worker="w")["part"] is None
+        assert _req(disp, "locate", part=0, job="b")["throttled"] is True
+        _req(disp, "part_done", worker="w", part=0, job="a")
+        assert _req(disp, "next_split", worker="w")["job"] == "b"
+        assert _req(disp, "locate", part=0, job="b")["worker"] == "w"
+        assert resilience.counters_delta(base)["service_throttles"] == 1
+    finally:
+        disp.close()
+
+
+def test_throttled_tenant_backs_off_heals_byte_identical(
+        corpus, tmp_path, monkeypatch):
+    """End to end under a saturating ceiling: the batch tenant's locates
+    shed while the priority tenant cold-parses the whole fleet, the
+    client backs off on the shared RetryPolicy (``service_admission_waits``
+    with its deadline reset — never a give-up), a checkpoint taken
+    before the throttled window restores cleanly through it, and both
+    streams land byte-identical."""
+    monkeypatch.setenv("DMLC_TPU_QOS_MAX_INFLIGHT", "1")
+    other = _write_other(tmp_path)
+    local_crit = _local_blocks(corpus)
+    local_batch = _local_blocks(other, OTHER_PARTS)
+    base = resilience.counters_snapshot()
+    disp = svc_dispatcher.Dispatcher(liveness_timeout=10.0)
+    workers = [ParseWorker(disp.address, poll_interval=0.02,
+                           heartbeat_interval=0.1,
+                           straggle_seconds=0.05)
+               for _ in range(2)]
+    try:
+        svc_dispatcher.register_job(disp.address, "crit", corpus,
+                                    NUM_PARTS, parser=PARSER_CFG,
+                                    priority=1, weight=2)
+        svc_dispatcher.register_job(disp.address, "batch", other,
+                                    OTHER_PARTS, parser=PARSER_CFG,
+                                    max_inflight=1)
+        # checkpoint/restore across the throttled window: the state is
+        # taken before the overload, the restored client's first locate
+        # lands inside it
+        sp0 = ServiceParser(disp.address, job="batch")
+        state = sp0.state_dict()
+        sp0.close()
+        out = {}
+
+        def drain_batch():
+            sp = ServiceParser(disp.address, job="batch")
+            try:
+                sp.load_state(state)
+                out["batch"] = _drain(sp)
+            finally:
+                sp.close()
+
+        t = threading.Thread(target=drain_batch, daemon=True)
+        t.start()
+        out["crit"] = _drain_job(disp.address, "crit")
+        t.join(timeout=120.0)
+        assert not t.is_alive(), "throttled batch tenant hung"
+        _assert_blocks_equal(out["crit"], local_crit)
+        _assert_blocks_equal(out["batch"], local_batch)
+        delta = resilience.counters_delta(base)
+        # sheds happened and the client treated every one as retryable
+        assert delta["service_throttles"] >= 1
+        assert delta["service_admission_waits"] >= 1
+        assert delta["service_giveups"] == 0
+    finally:
+        for w in workers:
+            w.close()
+        disp.close()
+
+
+# ---------------------------------------------------------------------------
+# knob rows + the lint gate (satellite: claim-wait deadline, QoS env)
+
+
+def test_claim_wait_and_qos_knob_validation(monkeypatch):
+    assert knobs.resolve("claim_wait_deadline") == 30  # table default
+    monkeypatch.setenv("DMLC_TPU_CLAIM_WAIT_DEADLINE", "5")
+    assert knobs.resolve("claim_wait_deadline") == 5
+    for bad in ("0", "-1", "soon"):
+        monkeypatch.setenv("DMLC_TPU_CLAIM_WAIT_DEADLINE", bad)
+        with pytest.raises(DMLCError):
+            knobs.resolve("claim_wait_deadline")
+    # the admission ceiling: unset means unbounded, garbage is loud
+    monkeypatch.delenv("DMLC_TPU_QOS_MAX_INFLIGHT", raising=False)
+    assert knobs.qos_max_inflight() is None
+    assert knobs.qos_max_inflight(3) == 3
+    with pytest.raises(DMLCError):
+        knobs.qos_max_inflight(0)
+    for bad in ("0", "lots"):
+        monkeypatch.setenv("DMLC_TPU_QOS_MAX_INFLIGHT", bad)
+        with pytest.raises(DMLCError):
+            knobs.qos_max_inflight()
+    # the per-tenant store budget rides the same validated read path
+    monkeypatch.delenv("DMLC_TPU_STORE_JOB_BUDGET_BYTES", raising=False)
+    assert knobs.store_job_budget_bytes() is None
+    monkeypatch.setenv("DMLC_TPU_STORE_JOB_BUDGET_BYTES", "-3")
+    with pytest.raises(DMLCError):
+        knobs.store_job_budget_bytes()
+
+
+def test_lint_gate_rejects_adhoc_qos_env_reads():
+    """The lint-metrics knob pattern covers the QoS family: an ad-hoc
+    env read of the ceiling/budget/deadline knobs anywhere outside the
+    knob table is an offender."""
+    import importlib
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bin"))
+    try:
+        scan = importlib.import_module("lint_metrics").scan_source
+    finally:
+        sys.path.pop(0)
+    for snippet in (
+            'x = os.environ.get("DMLC_TPU_QOS_MAX_INFLIGHT")',
+            "x = os.environ['DMLC_TPU_CLAIM_WAIT_DEADLINE']",
+            'x = os.getenv("DMLC_TPU_STORE_JOB_BUDGET_BYTES")'):
+        assert scan(snippet), snippet
+    assert not scan("x = _knobs.qos_max_inflight()")
+    assert not scan('y = _knobs.resolve("claim_wait_deadline")')
+
+
+# ---------------------------------------------------------------------------
+# per-tenant store budgets: the offender sheds its own, pins hold
+
+
+def test_store_job_budget_isolates_tenants(tmp_path, monkeypatch):
+    """DMLC_TPU_STORE_JOB_BUDGET_BYTES groups eviction candidates by the
+    manifest's owning-job ledger: the tenant over ITS budget sheds its
+    own oldest unpinned artifact, while the sibling's strictly OLDER
+    unpinned artifact — which a global pass would have taken first — is
+    untouched; pinned entries are exempt even from their own tenant."""
+    reset_stores()
+
+    def publish(name, job):
+        path = str(tmp_path / name)
+        st = store_for(path)
+        tmp = st.stage_path(path)
+        with open(tmp, "wb") as f:
+            f.write(b"DMLCBC01" + b"\0" * 4096)
+        st.publish_file(tmp, path, "block_cache",
+                        signature={"n": name}, job=job)
+        return path
+
+    size = 8 + 4096
+    monkeypatch.setenv("DMLC_TPU_STORE_JOB_BUDGET_BYTES",
+                       str(2 * size + size // 2))  # two artifacts/tenant
+    try:
+        a1 = publish("a1.bc", "crit")
+        store_for(a1).pin(a1)
+        a2 = publish("a2.bc", "crit")  # crit at 2 artifacts: under budget
+        publish("b1.bc", "batch")
+        publish("b2.bc", "batch")
+        publish("b3.bc", "batch")
+        # batch's squeeze (3 artifacts > budget) evicts batch's own
+        # oldest (b1) — NOT crit's a2, which is older and unpinned and
+        # would be the victim of a global LRU pass
+        entries = {e["path"]: e for e in store_for(a1).entries()}
+        assert entries["b1.bc"]["evicted"]
+        assert not entries["b2.bc"]["evicted"]
+        assert not entries["b3.bc"]["evicted"]
+        assert not entries["a1.bc"]["evicted"]
+        assert not entries["a2.bc"]["evicted"]
+        assert not os.path.exists(tmp_path / "b1.bc")
+        # open-time enforcement replays the same ledger: nothing new falls
+        reset_stores()
+        entries = {e["path"]: e for e in store_for(a1).entries()}
+        assert [n for n, e in sorted(entries.items()) if e["evicted"]] \
+            == ["b1.bc"]
+        # a starvation-level squeeze takes every unpinned artifact but
+        # may never break a pin — even the pinning tenant's own
+        monkeypatch.setenv("DMLC_TPU_STORE_JOB_BUDGET_BYTES", "1")
+        reset_stores()
+        entries = {e["path"]: e for e in store_for(a1).entries()}
+        assert not entries["a1.bc"]["evicted"]  # pinned: exempt
+        for name in ("a2.bc", "b2.bc", "b3.bc"):
+            assert entries[name]["evicted"], name
+    finally:
+        reset_stores()
+
+
+# ---------------------------------------------------------------------------
+# SLO-driven autoscaling: capacity follows the starved PRIORITY job
+
+
+def test_autoscaler_targets_starved_priority_job(corpus):
+    """register_job(slo_wait_frac=) becomes the job's own grow target
+    (not the global grow_frac), and among over-target jobs the
+    highest-priority one drives the decision — even when a batch sibling
+    waits harder in absolute and relative terms."""
+    fleet = LocalFleet(corpus, NUM_PARTS, num_workers=1,
+                       parser=PARSER_CFG)
+    waits = {"crit": 0.0, "batch": 0.0}
+    try:
+        fleet.register_job("crit", corpus, NUM_PARTS, parser=PARSER_CFG,
+                           priority=2, slo_wait_frac=0.3)
+        fleet.register_job("batch", corpus, NUM_PARTS,
+                           parser=PARSER_CFG)
+        assert fleet.job_qos()["crit"]["slo_wait_frac"] == 0.3
+        scaler = fleet.autoscale(source=lambda: dict(waits),
+                                 min_workers=1, max_workers=4,
+                                 interval=1.0, grow_frac=0.5,
+                                 up_ticks=1, cooldown_ticks=0,
+                                 start=False)
+        t = 0.0
+        assert scaler.step(now=t)["action"] == HOLD  # priming
+        # crit at 0.4 breaches ITS 0.3 SLO while batch at 0.45 is under
+        # the default 0.5 target: the SLO, not the raw max, decides
+        t += 1.0
+        waits["crit"] += 0.4
+        waits["batch"] += 0.45
+        rec = scaler.step(now=t)
+        assert rec["action"] == GROW and "crit" in rec["why"]
+        # both over target: priority outranks the larger overage
+        t += 1.0
+        waits["crit"] += 0.4
+        waits["batch"] += 0.9
+        rec = scaler.step(now=t)
+        assert rec["action"] == GROW and "crit" in rec["why"]
+        assert len(fleet.live_workers()) == 3
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# cross-job snapshot sharing (DMLCSN01 store tier)
+
+
+def test_snap_container_roundtrip_and_corruption():
+    from dmlc_tpu.service.worker import (
+        _decode_snap_container,
+        _encode_snap_container,
+    )
+
+    frames = [b"abc", b"", b"x" * 1000]
+    data = _encode_snap_container(frames)
+    assert data[:8] == b"DMLCSN01"
+    assert _decode_snap_container(data) == frames
+    assert _encode_snap_container([]) and _decode_snap_container(
+        _encode_snap_container([])) == []
+    # any shape violation is a miss (the caller re-packs), never a crash
+    assert _decode_snap_container(data[:-1]) is None
+    assert _decode_snap_container(data + b"\0") is None
+    assert _decode_snap_container(b"NOPE0000" + data[8:]) is None
+    assert _decode_snap_container(b"") is None
+
+
+def test_snapshot_pack_shared_across_jobs(corpus, tmp_path):
+    """Two jobs over the same corpus signature and geometry converge on
+    one published DMLCSN01 pack per part: job A packs + publishes, job
+    B's parts resolve shared (blocks AND snapshot packs), the artifacts
+    are pinned in the share-dir store, and both packed streams are
+    identical."""
+    share = str(tmp_path / "share")
+    geom = {"batch_size": 32, "num_col": 6, "x_dtype": "float32"}
+    base = resilience.counters_snapshot()
+    fleet = LocalFleet(None, 0, num_workers=1, parser=PARSER_CFG,
+                       share_dir=share)
+    try:
+        fleet.register_job("a", corpus, NUM_PARTS, parser=PARSER_CFG,
+                           snapshot=geom)
+        got_a = _drain_job(fleet.address, "a")
+        assert got_a and all(b.packed and len(b) == 32 for b in got_a)
+        snaps = [n for n in os.listdir(share) if n.endswith(".snap")]
+        assert len(snaps) == NUM_PARTS
+        # the packs are store-managed and pinned for the worker's life
+        for name in snaps:
+            path = os.path.join(share, name)
+            entry = next(e for e in store_for(path).entries()
+                         if e["path"] == name)
+            assert entry["tier"] == "snapshot" and entry["pinned"]
+        fleet.register_job("b", corpus, NUM_PARTS, parser=PARSER_CFG,
+                           snapshot=geom)
+        got_b = _drain_job(fleet.address, "b")
+        assert len(got_b) == len(got_a)
+        for x, y in zip(got_a, got_b):
+            np.testing.assert_array_equal(x.x, y.x)
+            np.testing.assert_array_equal(x.label, y.label)
+        delta = resilience.counters_delta(base)
+        # the corpus parsed once fleet-wide; job b resolved every part
+        # shared TWICE over — the block cache and the snapshot pack
+        assert delta["service_parts_parsed"] == NUM_PARTS
+        assert delta["service_parts_shared"] == 2 * NUM_PARTS
+        assert delta["service_giveups"] == 0
+    finally:
+        fleet.close()
+        reset_stores()
+
+
+# ---------------------------------------------------------------------------
+# ACCEPTANCE: production QoS under saturation + chaos
+
+
+def test_acceptance_production_qos_chaos(corpus, tmp_path, monkeypatch):
+    """The PR's acceptance run (docs/service.md Production QoS): a
+    saturating batch tenant rides beside a latency-critical one under a
+    fleet ceiling of 1. The critical job's epochs stay byte-identical
+    and its WARM epoch's input-wait fraction lands under its declared
+    SLO; the batch tenant is throttled at least once and gives up zero
+    times; the QoS classes replay exactly across a dispatcher kill -9
+    mid-epoch; and a per-tenant budget squeeze evicts only the batch
+    tenant's unpinned scratch — never the pinned warm set."""
+    other = _write_other(tmp_path)
+    jp = str(tmp_path / "disp.jsonl")
+    share = str(tmp_path / "share")
+    local_crit = _local_blocks(corpus)
+    local_batch = _local_blocks(other, OTHER_PARTS)
+    monkeypatch.setenv("DMLC_TPU_QOS_MAX_INFLIGHT", "1")
+    base = resilience.counters_snapshot()
+    # hand-built fleet: straggle-slowed workers keep the critical cold
+    # pass on the wire long enough that the batch tenant's locates land
+    # inside the saturated window (LocalFleet has no per-worker chaos
+    # knobs, and the restart is the manual same-address journal replay)
+    disp_kw = dict(liveness_timeout=5.0, journal_path=jp,
+                   share_dir=share)
+    disp = svc_dispatcher.Dispatcher(**disp_kw)
+    workers = [ParseWorker(disp.address, poll_interval=0.02,
+                           heartbeat_interval=0.1,
+                           straggle_seconds=0.05)
+               for _ in range(2)]
+    try:
+        disp.register_job("crit", corpus, NUM_PARTS, parser=PARSER_CFG,
+                          priority=1, weight=2, slo_wait_frac=0.6)
+        disp.register_job("batch", other, OTHER_PARTS,
+                          parser=PARSER_CFG, max_inflight=1)
+        want_qos = disp.job_qos()
+        out = {}
+
+        def drain_batch():
+            out["batch"] = _drain_job(disp.address, "batch")
+
+        t = threading.Thread(target=drain_batch, daemon=True)
+        t.start()
+        # cold epoch: the priority band keeps every grant the critical
+        # job's while its queue lasts; the batch tenant sheds meanwhile
+        out["cold"] = _drain_job(disp.address, "crit")
+        _assert_blocks_equal(out["cold"], local_crit)
+        # warm epoch, timed at a trainer-step consume cadence: the wait
+        # fraction must land under the job's declared SLO
+        wait_c = telemetry.REGISTRY.counter(
+            telemetry.SERVICE_JOB_WAIT_METRIC, job="crit")
+        w0, t0 = wait_c.value, time.time()
+        sp = ServiceParser(disp.address, job="crit")
+        warm = []
+        while (b := sp.next_block()) is not None:
+            warm.append(b)
+            time.sleep(0.02)
+        sp.close()
+        wait_frac = (wait_c.value - w0) / max(time.time() - t0, 1e-9)
+        _assert_blocks_equal(warm, local_crit)
+        assert wait_frac < 0.6, f"warm wait frac {wait_frac:.3f} over SLO"
+        t.join(timeout=120.0)
+        assert not t.is_alive(), "throttled batch tenant hung"
+        _assert_blocks_equal(out["batch"], local_batch)
+        delta = resilience.counters_delta(base)
+        assert delta["service_throttles"] >= 1
+        assert delta["service_admission_waits"] >= 1
+        assert delta["service_giveups"] == 0
+        # chaos: kill -9 mid-epoch — the journal replays the classes and
+        # the stream rides through byte-identically
+        sp = ServiceParser(disp.address, job="crit")
+        got = [sp.next_block(), sp.next_block()]
+        host, port = disp.host, disp.port
+        disp.kill()
+        disp = svc_dispatcher.Dispatcher(host=host, port=port, **disp_kw)
+        assert disp.job_qos() == want_qos
+        got.extend(_drain(sp))
+        sp.close()
+        _assert_blocks_equal(got, local_crit)
+        # budget squeeze: a batch-owned unpinned scratch artifact beside
+        # the live workers' pinned warm set; with a 1-byte per-tenant
+        # budget the squeeze takes ONLY the scratch
+        synth = os.path.join(share, "batch-scratch.bc")
+        st = store_for(synth)
+        tmp = st.stage_path(synth)
+        with open(tmp, "wb") as f:
+            f.write(b"DMLCBC01" + b"\0" * 4096)
+        st.publish_file(tmp, synth, "block_cache",
+                        signature={"scratch": True}, job="batch")
+        pinned_before = sorted(
+            e["path"] for e in store_for(synth).entries()
+            if e["pinned"] and not e["evicted"])
+        assert pinned_before, "no pinned warm set to protect"
+        monkeypatch.setenv("DMLC_TPU_STORE_JOB_BUDGET_BYTES", "1")
+        reset_stores()  # fresh open runs the enforcement pass
+        entries = {e["path"]: e for e in store_for(synth).entries()}
+        assert entries["batch-scratch.bc"]["evicted"]
+        for name in pinned_before:
+            assert not entries[name]["evicted"], name
+        monkeypatch.delenv("DMLC_TPU_STORE_JOB_BUDGET_BYTES")
+        reset_stores()
+        # the squeeze cost the critical tenant nothing
+        _assert_blocks_equal(_drain_job(disp.address, "crit"),
+                             local_crit)
+        assert resilience.counters_delta(base)["service_giveups"] == 0
+    finally:
+        for w in workers:
+            w.close()
+        disp.close()
+        reset_stores()
