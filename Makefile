@@ -165,6 +165,20 @@ bench-smoke:
 	    conv = line.get('snapshot_warm_convert_seconds'); \
 	    assert conv is not None and conv <= 0.05, \
 	        f'snapshot warm convert busy {conv}s != ~0 (convert not bypassed)'; \
+	    dd = line.get('device_decode_mb_per_sec'); \
+	    assert dd, 'device_decode_mb_per_sec missing (device-decode leg did not run)'; \
+	    ddspd = line.get('device_decode_vs_snapshot_speedup'); \
+	    ddbytes = line.get('device_decode_transfer_bytes'); \
+	    ddconv = line.get('device_decode_convert_seconds'); \
+	    ddbk = line.get('device_decode_backend'); \
+	    assert ddspd and ddbytes and ddconv is not None and ddbk, \
+	        'device_decode speedup/transfer_bytes/convert_seconds/backend missing'; \
+	    assert ddconv <= 0.05, \
+	        f'device-decode warm convert busy {ddconv}s != ~0 (host decode crept back)'; \
+	    assert ddbk == 'cpu' or ddspd >= 1.0, \
+	        f'device_decode_vs_snapshot_speedup {ddspd} < 1.0 on accelerator ' \
+	        f'backend {ddbk}; on the CPU backend device decode runs on the ' \
+	        'same silicon as host decode, so only presence is gated'; \
 	    assert line.get('service_workers') == 2, \
 	        'service_workers missing (service leg did not run)'; \
 	    assert line.get('service_mb_per_sec'), \
@@ -282,6 +296,9 @@ bench-smoke:
 	          line['snapshot_vs_cache_speedup'], 'over cache warm,', \
 	          'bf16 bytes ratio', line['snapshot_wire_bytes_ratio'], \
 	          ', warm convert', conv, 's'); \
+	    print('bench-smoke: device decode OK:', dd, 'MB/s warm, x', ddspd, \
+	          'vs host-decode,', ddbytes, 'span bytes on', ddbk, \
+	          'backend, convert', ddconv, 's'); \
 	    print('bench-smoke: data service OK:', \
 	          line['service_mb_per_sec'], 'MB/s with', \
 	          line['service_workers'], 'workers, vs-local x', \

@@ -59,9 +59,10 @@ def log(msg: str) -> None:
 # canonical stage order for the ingest attribution table (VERDICT r5 weak
 # #4: name the unaccounted share of pipeline bound, per-stage).
 # snapshot_read = warm device-native snapshot supply (mmap + crc of
-# post-convert batches, docs/data.md snapshot section)
+# post-convert batches, docs/data.md snapshot section); device_decode =
+# on-device span decode dispatch (docs/data.md three-tier decode table)
 STAGE_ORDER = ("read", "cache_read", "snapshot_read", "parse", "convert",
-               "dispatch", "transfer")
+               "dispatch", "device_decode", "transfer")
 
 
 def attribution_line(stats: dict, extra_transfer: float = 0.0) -> dict:

@@ -46,6 +46,16 @@ the span tracer and its invalidation/corruption events go through
   per-site parses in parsers.py/snapshot.py/device.py were consolidated
   by the autotuner PR).
 
+A second gate guards the warm snapshot serve path: the device-decode PR
+moved every per-batch byte decode onto two sanctioned homes —
+``dmlc_tpu/io/block_cache.py`` (the host mmap views) and
+``dmlc_tpu/ops/device_decode.py`` (the HBM span decode + the
+widen/dequant dtype path). ``dmlc_tpu/io/snapshot.py`` and
+``dmlc_tpu/data/device.py`` sit ON the warm serve path but must not
+decode bytes themselves, so any ``np.frombuffer(`` or ``.astype(``
+appearing there FAILS — that is host per-batch decode creeping back
+into the path whose whole point is that the span ships verbatim.
+
 Exit status: 0 clean, 1 with offenders listed as ``path:line``.
 """
 
@@ -64,6 +74,20 @@ ALLOWED = {
 # the knob table is the ONE sanctioned reader of tunable env variables
 KNOB_TABLE_MODULE = Path("dmlc_tpu") / "utils" / "knobs.py"
 
+# the two sanctioned byte-decode homes (module docstring): host views in
+# block_cache, HBM decode + the widen/dequant dtype path in device_decode
+DECODE_MODULES = {
+    Path("dmlc_tpu") / "io" / "block_cache.py",
+    Path("dmlc_tpu") / "ops" / "device_decode.py",
+}
+
+# warm-snapshot serve path modules that must stay decode-free: they route
+# spans, they do not decode them
+DECODE_SCOPE = {
+    Path("dmlc_tpu") / "io" / "snapshot.py",
+    Path("dmlc_tpu") / "data" / "device.py",
+}
+
 _PATTERNS = (
     (re.compile(r"\bCOUNTERS\.bump\s*\("),
      "direct COUNTERS.bump — use resilience.record_event / a registry "
@@ -79,10 +103,22 @@ _KNOB_PATTERN = (
                r"AUTOTUNE[A-Z0-9_]*|STORE[A-Z0-9_]*|HEDGE_FACTOR|"
                r"DRAIN_DEADLINE|PARSE_ENGINE|FLEET[A-Z0-9_]*|"
                r"SERVICE_PIPELINE_DEPTH|WIRE_COMPRESSION|"
-               r"QOS[A-Z0-9_]*|CLAIM_WAIT_DEADLINE)['\"]"),
+               r"QOS[A-Z0-9_]*|CLAIM_WAIT_DEADLINE|"
+               r"DEVICE_DECODE[A-Z0-9_]*)['\"]"),
     "ad-hoc tunable env read — register the knob in "
     "dmlc_tpu/utils/knobs.py (KNOB_TABLE / a validated accessor like "
     "store_budget_bytes) and read it through that module")
+
+_DECODE_PATTERNS = (
+    (re.compile(r"\bnp\.frombuffer\s*\("),
+     "host np.frombuffer on the warm snapshot serve path — per-batch "
+     "byte decode belongs in io/block_cache.py (host views) or "
+     "ops/device_decode.py (HBM span decode)"),
+    (re.compile(r"\.astype\s*\("),
+     "host dtype convert on the warm snapshot serve path — widening/"
+     "dequant belongs in ops/device_decode.py (the sanctioned device "
+     "dtype path)"),
+)
 
 
 def scan_source(text: str,
@@ -102,6 +138,20 @@ def scan_source(text: str,
     return offenders
 
 
+def scan_decode(text: str) -> List[Tuple[int, str]]:
+    """The warm-serve decode gate (module docstring): (line, reason) for
+    each per-batch host decode site in a DECODE_SCOPE module."""
+    offenders: List[Tuple[int, str]] = []
+    for i, line in enumerate(text.splitlines()):
+        stripped = line.lstrip()
+        if stripped.startswith("#"):
+            continue
+        for pattern, reason in _DECODE_PATTERNS:
+            if pattern.search(line):
+                offenders.append((i + 1, reason))
+    return offenders
+
+
 def main(argv: List[str]) -> int:
     root = Path(argv[1]) if len(argv) > 1 else \
         Path(__file__).resolve().parent.parent
@@ -110,11 +160,15 @@ def main(argv: List[str]) -> int:
         rel = path.relative_to(root)
         if rel in ALLOWED:
             continue
+        text = path.read_text(encoding="utf-8")
         for lineno, reason in scan_source(
-                path.read_text(encoding="utf-8"),
-                knob_gate=rel != KNOB_TABLE_MODULE):
+                text, knob_gate=rel != KNOB_TABLE_MODULE):
             print(f"{rel}:{lineno}: {reason}", file=sys.stderr)
             bad += 1
+        if rel in DECODE_SCOPE:
+            for lineno, reason in scan_decode(text):
+                print(f"{rel}:{lineno}: {reason}", file=sys.stderr)
+                bad += 1
     if bad:
         print(f"lint-metrics: {bad} ad-hoc bookkeeping site(s) found",
               file=sys.stderr)

@@ -70,6 +70,9 @@ STAGE_KNOB: Dict[str, str] = {
     "snapshot_read": "snapshot_read_workers",
     "convert": "convert_ahead",
     "dispatch": "prefetch",
+    # device-decode busy is jit dispatch riding the transfer queue: a
+    # deeper device_put lookahead overlaps it, same as dispatch
+    "device_decode": "prefetch",
 }
 
 # per-stage fallback when the primary knob is not registered on this
@@ -84,7 +87,7 @@ STAGE_KNOB_FALLBACK: Dict[str, str] = {
 # (transfer deliberately absent: it has no host-side knob — it IS the
 # convergence target)
 SUPPLY_STAGES = ("read", "cache_read", "snapshot_read", "parse",
-                 "convert", "dispatch")
+                 "convert", "dispatch", "device_decode")
 
 
 class Knob:

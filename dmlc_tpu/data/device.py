@@ -44,6 +44,7 @@ from dmlc_tpu.io import block_cache as _block_cache
 from dmlc_tpu.io import resilience as _resilience
 from dmlc_tpu.io import snapshot as _snapshot
 from dmlc_tpu.io.threaded_iter import OrderedWorkerPool, ThreadedIter
+from dmlc_tpu.ops import device_decode as _device_decode
 from dmlc_tpu.ops.sparse import (
     EllBatch, block_to_bcoo_host, block_to_dense, block_to_ell,
 )
@@ -98,7 +99,7 @@ def _require_bf16_exact(packed_col, src, what: str) -> None:
     float32 source values: raise when the cast lost precision. Shared by
     the local convert-pool pack and the service worker's snapshot-frame
     pack, so no bf16 path can silently corrupt labels/weights."""
-    if not np.array_equal(packed_col.astype(np.float32),
+    if not np.array_equal(np.asarray(packed_col, dtype=np.float32),
                           np.asarray(src, dtype=np.float32)):
         raise DMLCError(
             f"bfloat16 aux packing: this batch's {what}s are not "
@@ -136,18 +137,6 @@ def pack_dense_batches(blocks, batch_size: int, num_col: int,
             _require_bf16_exact(packed[:, nc], y, "label")
             _require_bf16_exact(packed[:, nc + 1], w, "weight")
         yield packed, getattr(block, "resume_state", None)
-
-
-def _dequant_q8_impl(q, scale):
-    """Device-side int8 -> float32 dequantization of a quantized snapshot
-    batch: one fused multiply per element (VPU noise next to the 4x
-    host->HBM byte saving the int8 wire buys)."""
-    import jax.numpy as jnp
-
-    return q.astype(jnp.float32) * scale
-
-
-_dequant_q8 = jax.jit(_dequant_q8_impl)
 
 
 _RING_FREE = object()  # sentinel: slot never attached / explicitly released
@@ -317,11 +306,11 @@ class PackedDenseBatch:
 
     @property
     def y(self):
-        return self.packed[:, self.num_col].astype(jax.numpy.float32)
+        return _device_decode.widen_f32(self.packed[:, self.num_col])
 
     @property
     def w(self):
-        return self.packed[:, self.num_col + 1].astype(jax.numpy.float32)
+        return _device_decode.widen_f32(self.packed[:, self.num_col + 1])
 
     def __iter__(self):
         return iter((self.x, self.y, self.w))
@@ -411,9 +400,9 @@ class DeviceIter:
       3. this object: ``device_put`` issued ``prefetch`` batches ahead.
 
     ``stats()['stages']`` decomposes consumer wall time into named costs
-    (read / parse / convert / dispatch / transfer) — see the module
-    docstring; ``stats()['stage_busy']`` carries the raw per-stage busy
-    counters the attribution is derived from.
+    (read / parse / convert / dispatch / device_decode / transfer) — see
+    the module docstring; ``stats()['stage_busy']`` carries the raw
+    per-stage busy counters the attribution is derived from.
     """
 
     def __init__(
@@ -445,6 +434,7 @@ class DeviceIter:
         snapshot_quant: Optional[str] = None,
         snapshot_shuffle_seed: Optional[int] = None,
         snapshot_read_workers: Optional[int] = None,
+        device_decode: Optional[bool] = None,
         autotune: Optional[bool] = None,
         autotune_interval: Optional[int] = None,
     ):
@@ -600,6 +590,13 @@ class DeviceIter:
             None if snapshot is None
             else _knobs.resolve("snapshot_read_workers",
                                 snapshot_read_workers))
+        # ---- device-decode tier (docs/data.md three-tier decode) ----
+        # armed, warm snapshot epochs (and service snapshot spans)
+        # device_put each batch's raw container span VERBATIM and decode
+        # in HBM (ops/device_decode) — zero per-batch host numpy decode;
+        # host convert busy reads 0 and a 'device_decode' stage appears
+        self.device_decode = _knobs.device_decode(device_decode)
+        self.device_decode_bytes = 0  # verbatim span bytes transferred
         self._snap_epoch = 0    # advances per reset() while snapshot armed
         self._snap_pos0 = 0     # warm start position (mid-epoch restore)
         self._snap_reader = None
@@ -674,11 +671,13 @@ class DeviceIter:
         # books (docs/observability.md).
         self._busy = StageMeter("read", "cache_read", "snapshot_read",
                                 "parse", "convert", "dispatch",
+                                "device_decode",
                                 metric=_telemetry.STAGE_BUSY_METRIC,
                                 scope=self.pipeline_label)
         # consumer-wall attribution (the partition stats() reports)
         self._attr = StageMeter("read", "cache_read", "snapshot_read",
-                                "parse", "convert", "dispatch", "transfer",
+                                "parse", "convert", "dispatch",
+                                "device_decode", "transfer",
                                 metric=_telemetry.STAGE_WALL_METRIC,
                                 scope=self.pipeline_label)
         self._transfer_samples = 0
@@ -860,7 +859,7 @@ class DeviceIter:
             reader, order=order, start=start,
             read_workers=self._snap_read_workers,
             on_read=lambda dt: self._add_busy("snapshot_read", dt),
-            annotate=self._trace)
+            annotate=self._trace, raw=self.device_decode)
         return _SnapshotFeed(feed, start=start, plan_annot=plan_annot)
 
     def _invalidate_snapshot(self) -> None:
@@ -1223,7 +1222,18 @@ class DeviceIter:
                 # work — the whole (x|label|weight) batch is ONE array
                 emitted += B
                 annot = self._push_annot(emitted)
-                yield ("dense_ready", block.x, annot)
+                span = getattr(block, "device_span", None)
+                if (span is not None and self.device_decode
+                        and self.snapshot_path is None):
+                    # wire-v2/fast-path snapshot frame: the service client
+                    # kept the frame's verbatim payload bytes + layout —
+                    # ship the raw span and decode in HBM instead of
+                    # device_put'ing the host-decoded view. (With a local
+                    # snapshot tee armed the host arrays are still needed
+                    # by the shadow writer, so keep the decoded route.)
+                    yield ("span_ready", span, annot)
+                else:
+                    yield ("dense_ready", block.x, annot)
                 continue
             if (isinstance(block, DenseBlock) and block.packed
                     and not parts and len(block) < B):
@@ -1284,6 +1294,12 @@ class DeviceIter:
                 kind = item[0]
                 if kind == "dense_ready":
                     return ("dense_packed", item[1]), None, item[2]
+                if kind == "span_ready":
+                    # (raw u8 payload, layout, stored kind) from the
+                    # service client — already device-decodable, no host
+                    # conversion at all
+                    raw, layout, skind = item[1]
+                    return ("device_span", raw, layout, skind), None, item[2]
                 if kind == "dense_parts":
                     hb, bufs = self._pack_dense_parts(item[1])
                     return hb, bufs, item[2]
@@ -1471,12 +1487,17 @@ class DeviceIter:
         # optional tracing hook (SURVEY.md §5.1): annotate transfers so they
         # are attributable in a jax.profiler / Perfetto trace
         t0 = get_time()
+        dd0 = self._busy.seconds()["device_decode"]
         try:
             with _telemetry.profiler_annotation("dmlc_tpu.device_put",
                                                 self._trace):
                 out = self._put_inner(host_batch)
         finally:
+            # the device_span branch meters its decode dispatch as its own
+            # 'device_decode' stage NESTED in this window — subtract it so
+            # the busy meters stay disjoint (attribution partitions wall)
             dt = get_time() - t0
+            dt -= self._busy.seconds()["device_decode"] - dd0
             self._add_busy("dispatch", dt)
             _telemetry.record_span("dispatch", t0, dt)
         if ring_bufs is not None and self._ring is not None:
@@ -1489,6 +1510,8 @@ class DeviceIter:
 
     def _put_inner(self, host_batch):
         kind = host_batch[0]
+        if kind == "device_span":
+            return self._put_device_span(host_batch)
         if kind == "dense_packed":
             xp = host_batch[1]
             self.bytes_to_device += xp.nbytes
@@ -1504,7 +1527,8 @@ class DeviceIter:
             out = (jax.device_put([q, scale], self.device)
                    if self.device is not None
                    else jax.device_put([q, scale]))
-            return PackedDenseBatch(_dequant_q8(*out), self.num_col)
+            return PackedDenseBatch(_device_decode.dequant_q8(*out),
+                                    self.num_col)
         if kind == "bcoo_csr":
             from jax.experimental import sparse as jsparse
 
@@ -1557,6 +1581,35 @@ class DeviceIter:
         if kind == "ell":
             return EllBatch(*out)
         return out  # (x, y, w)
+
+    def _put_device_span(self, host_batch):
+        """The third warm tier (``device_decode=True``): the snapshot
+        batch's verbatim container bytes crossed the pipeline as ONE
+        contiguous u8 span — ship it as-is and decode in HBM
+        (``ops/device_decode``). Zero per-batch host numpy work; the
+        decode dispatch is metered as its own 'device_decode' stage
+        (disjoint from 'dispatch' — see :meth:`_put`)."""
+        _, span, layout, snap_kind = host_batch
+        self.bytes_to_device += span.nbytes
+        self.device_decode_bytes += span.nbytes
+        d = (jax.device_put(span, self.device)
+             if self.device is not None else jax.device_put(span))
+        t0 = get_time()
+        try:
+            segs = _device_decode.decode_span(d, layout)
+            out = [segs[name] for name, *_ in layout]
+            if snap_kind == "dense_packed":
+                return PackedDenseBatch(out[0], self.num_col)
+            if snap_kind == "dense_packed_q8":
+                return PackedDenseBatch(
+                    _device_decode.dequant_q8(out[0], out[1]), self.num_col)
+            if snap_kind == "ell":
+                return EllBatch(*out)
+            return tuple(out)  # "dense": (x, y, w)
+        finally:
+            dt = get_time() - t0
+            self._add_busy("device_decode", dt)
+            _telemetry.record_span("device_decode", t0, dt)
 
     def _maybe_restart_pipeline(self, exc: BaseException) -> bool:
         """Bounded consumer-side recovery from a retryable pipeline error.
@@ -1645,8 +1698,9 @@ class DeviceIter:
         """
         busy1 = self._busy.seconds()
         d_disp = busy1["dispatch"] - busy0["dispatch"]
+        d_decode = busy1["device_decode"] - busy0["device_decode"]
         consumer_put = self.batch_size is not None
-        window = (t1 - t0) - (d_disp if consumer_put else 0.0)
+        window = (t1 - t0) - ((d_disp + d_decode) if consumer_put else 0.0)
         weights = {k: busy1[k] - busy0[k]
                    for k in ("read", "cache_read", "snapshot_read",
                              "parse", "convert")}
@@ -1654,6 +1708,7 @@ class DeviceIter:
             # natural-block mode dispatches on the producer thread: its put
             # time is part of what the consumer waited on
             weights["dispatch"] = d_disp
+            weights["device_decode"] = d_decode
         wsum = sum(weights.values())
         if wsum > 0 and window > 0:
             scale = min(1.0, window / wsum)
@@ -1661,7 +1716,12 @@ class DeviceIter:
                 if v > 0:
                     self._attr.add(k, v * scale)
         if consumer_put:
+            # measured directly on this thread (not pipeline-blocked time):
+            # charged unscaled, like dispatch — the device_decode share is
+            # the jit dispatch of the on-device span decode
             self._attr.add("dispatch", d_disp)
+            if d_decode > 0:
+                self._attr.add("device_decode", d_decode)
 
     def __next__(self):
         # every consumer-side step runs under this pipeline's telemetry
@@ -1938,8 +1998,9 @@ class DeviceIter:
         """Throughput counters + per-stage wall attribution.
 
         ``stages`` partitions consumer wall (``wall_seconds``, first pull
-        to latest delivery) into read / cache_read / parse / convert /
-        dispatch / transfer; by construction their sum never exceeds wall, and the
+        to latest delivery) into read / cache_read / snapshot_read / parse
+        / convert / dispatch / device_decode / transfer; by construction
+        their sum never exceeds wall, and the
         difference is unattributed consumer time ('other': the caller's
         own compute between pulls, e.g. a training step). ``stage_busy``
         carries the raw per-thread busy counters the attribution is
@@ -2002,6 +2063,12 @@ class DeviceIter:
             "snapshot_epoch": (self._snap_epoch
                                if self.snapshot_path is not None
                                else None),
+            # third warm tier (docs/data.md three-tier decode table): is
+            # device-side span decode armed, and how many verbatim
+            # container bytes crossed as raw u8 spans (decoded in HBM —
+            # each such batch does ZERO per-batch host numpy decode)
+            "device_decode": self.device_decode,
+            "device_decode_bytes": self.device_decode_bytes,
             # the epoch planner's identity when the source serves a
             # shuffle-native / pod-sharded cache: the seed and epoch every
             # delivered byte is a function of, None with no plan armed

@@ -210,7 +210,8 @@ class DenseBlock:
     analog (its parsers always build CSR RowBlocks, src/data/row_block.h).
     """
 
-    __slots__ = ("x", "label", "weight", "hold", "resume_state", "packed")
+    __slots__ = ("x", "label", "weight", "hold", "resume_state", "packed",
+                 "device_span")
 
     def __init__(self, x: np.ndarray, label: np.ndarray,
                  weight: Optional[np.ndarray] = None, hold=None,
@@ -224,6 +225,11 @@ class DenseBlock:
         self.hold = hold
         self.packed = packed
         self.resume_state = None  # parser position just after this block
+        # optional (service snapshot frames): the block's verbatim
+        # container bytes + span layout + stored kind, for a
+        # device_decode=True DeviceIter to decode in HBM instead of
+        # shipping the host-decoded views (ops/device_decode)
+        self.device_span = None
 
     def __len__(self) -> int:
         return len(self.label)

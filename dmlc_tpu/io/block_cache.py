@@ -173,6 +173,26 @@ def read_segments(buf, arrays: Dict[str, list]) -> Dict[str, np.ndarray]:
     return out
 
 
+def span_layout(arrays: Dict[str, list], shapes=None, base: int = 0):
+    """A batch's footer/frame ``arrays`` (+ optional ``shapes``) mapping
+    as a hashable span layout: ``((name, dtype_str, rel_offset, nbytes,
+    shape), ...)`` with offsets rebased to ``base`` (the batch's ``pos``
+    for an on-disk container span, 0 for a wire-frame payload). The
+    compile-time constant :func:`dmlc_tpu.ops.device_decode.decode_span`
+    slices and bitcasts a verbatim-transferred u8 span by — built here
+    (jax-free, beside the footer schema it reads) so snapshot readers
+    and service frame decoders share one definition."""
+    entries = []
+    for name, (dtype_str, off, nbytes) in arrays.items():
+        shape = (shapes or {}).get(name)
+        dt = _segment_dtype(dtype_str)
+        shape = (tuple(int(d) for d in shape) if shape is not None
+                 else (int(nbytes) // dt.itemsize,))
+        entries.append((str(name), str(dtype_str), int(off) - int(base),
+                        int(nbytes), shape))
+    return tuple(entries)
+
+
 def finish_container(f, tmp_path: str, path: str, footer: dict,
                      magic: bytes) -> None:
     """The shared publish tail: write the crc'd JSON ``footer`` + tail

@@ -87,14 +87,17 @@ def quantize_int8(arr) -> Tuple[np.ndarray, np.ndarray]:
     returns ``(q8, scale)`` with ``scale`` float32 per column
     (``absmax / 127``; zero columns get scale 1.0 so dequant is exact
     zeros). The device dequantizes with one fused multiply
-    (``q.astype(f32) * scale``) — the opt-in that quarters snapshot
-    bytes for value ranges that tolerate 8-bit precision."""
-    a = np.asarray(arr, dtype=np.float32)
-    check(a.ndim == 2, "quantize_int8: expected a 2-D [rows, cols] batch")
-    scale = np.abs(a).max(axis=0) / 127.0
-    scale[scale == 0.0] = 1.0
-    q = np.clip(np.rint(a / scale), -127, 127).astype(np.int8)
-    return q, scale.astype(np.float32)
+    (``dequant_q8``) — the opt-in that quarters snapshot bytes for value
+    ranges that tolerate 8-bit precision.
+
+    Thin wrapper: the implementation lives in
+    :mod:`dmlc_tpu.ops.device_decode` (the single sanctioned device-side
+    dtype path, quantize and dequant audited as one pair). Imported
+    lazily — this module must stay importable without jax (the service
+    frame codec's no-jax contract)."""
+    from dmlc_tpu.ops.device_decode import quantize_int8 as _impl
+
+    return _impl(arr)
 
 
 class SnapshotWriter:
@@ -299,6 +302,35 @@ class SnapshotReader:
             out.append(arr)
         return (entry["kind"], *out)
 
+    def batch_span(self, i: int, copy: bool = False) -> tuple:
+        """Batch ``i`` as its raw container bytes: ``(kind, span,
+        layout)`` with ``span`` the verbatim ``[pos, end)`` u8 view over
+        the mmap and ``layout`` the hashable segment map
+        (:func:`~dmlc_tpu.io.block_cache.span_layout`, offsets rebased
+        to the span) — the device-decode tier's input: the consumer
+        ``device_put``s the span untouched (one contiguous transfer)
+        and :func:`dmlc_tpu.ops.device_decode.decode_span` slices and
+        bitcasts it in HBM. No per-segment host views are built.
+
+        crc + fault semantics match :meth:`load_batch`; ``copy=True``
+        materializes the span (plan-ordered warm epochs — same
+        attribution discipline as ``load_batch``)."""
+        faults.maybe_fail("snapshot_read", self.path)
+        entry = self._batches[i]
+        pos, end = int(entry["pos"]), int(entry["end"])
+        if self.verify:
+            with memoryview(self._mm)[pos:end] as mv:
+                ok = zlib.crc32(mv) & 0xFFFFFFFF == int(entry["crc"])
+            if not ok:
+                raise CacheCorruptionError(
+                    f"snapshot {self.path}: crc mismatch on batch {i}")
+        span = np.asarray(memoryview(self._mm)[pos:end])
+        if copy:
+            span = np.array(span)
+        layout = self._bc.span_layout(entry["arrays"],
+                                      entry.get("shapes"), base=pos)
+        return entry["kind"], span, layout
+
     def close(self) -> None:
         # the eviction pin drops first, unconditionally (see the
         # block-cache reader: an unlinked-but-mapped file keeps serving)
@@ -362,19 +394,27 @@ class SnapshotIter:
     with ``host_batch = (kind, *arrays)``, or None at end of epoch. Each
     read is timed into a ``snapshot_read`` span and reported through the
     ``on_read`` callback (the consumer's stage-busy meter).
+
+    ``raw=True`` is the device-decode feed: ``host_batch`` becomes
+    ``("device_span", span, layout, kind)`` — the batch's verbatim
+    container bytes (:meth:`SnapshotReader.batch_span`) instead of
+    decoded host views, for consumers that transfer the span untouched
+    and decode in HBM. Resume annotations, ordering, and timing are
+    identical, so checkpoint states restore across the two modes.
     """
 
     def __init__(self, reader: SnapshotReader,
                  order: Optional[np.ndarray] = None, start: int = 0,
                  read_workers: Optional[int] = None,
                  on_read: Optional[Callable[[float], None]] = None,
-                 annotate: bool = False):
+                 annotate: bool = False, raw: bool = False):
         from dmlc_tpu.io.threaded_iter import OrderedWorkerPool
 
         self.reader = reader
         self._order = order
         self._on_read = on_read
         self._annotate = annotate
+        self._raw = raw
         n = reader.num_batches if order is None else len(order)
         workers = _knobs.resolve("snapshot_read_workers", read_workers)
         self._pool = OrderedWorkerPool(
@@ -403,7 +443,12 @@ class SnapshotIter:
                 # permuted serves materialize HERE, inside the timed
                 # region, so out-of-order page faults are attributed to
                 # snapshot_read and never leak into dispatch/transfer
-                batch = reader.load_batch(i, copy=self._order is not None)
+                copy = self._order is not None
+                if self._raw:
+                    kind, span, layout = reader.batch_span(i, copy=copy)
+                    batch = ("device_span", span, layout, kind)
+                else:
+                    batch = reader.load_batch(i, copy=copy)
         finally:
             dt = get_time() - t0
             _telemetry.record_span("snapshot_read", t0, dt)

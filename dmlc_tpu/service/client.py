@@ -688,6 +688,19 @@ class ServiceParser(Parser):
                 nc = int(self.snapshot["num_col"])
                 block = DenseBlock(xp, xp[:, nc], xp[:, nc + 1],
                                    hold=payload, packed=True)
+                # the frame payload IS the device-decodable span (same
+                # write_segments bytes as an on-disk snapshot batch, meta
+                # offsets payload-relative): keep it + its layout beside
+                # the host views so a device_decode=True DeviceIter can
+                # ship the raw bytes and decode in HBM (ops/device_decode)
+                import numpy as _np
+
+                from dmlc_tpu.io.block_cache import span_layout
+                block.device_span = (
+                    _np.frombuffer(payload, dtype=_np.uint8),
+                    span_layout(meta["arrays"], meta.get("shapes"),
+                                base=0),
+                    bkind)
                 resume = meta.get("resume")
                 if resume is not None:
                     block.resume_state = resume

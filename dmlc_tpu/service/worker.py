@@ -1173,7 +1173,14 @@ class ParseWorker:
         the stored CSR block frames, pack to the job's fixed batch
         geometry, encode once, cache on the store (warm re-serves pay
         nothing). Runs under no lock — only the cached-list publish
-        does."""
+        does.
+
+        Contract: a snapshot frame's payload IS the device-decodable
+        span — the same ``write_segments`` bytes as an on-disk snapshot
+        batch, with meta array offsets payload-relative (base 0) — so a
+        ``device_decode=True`` client ships the payload verbatim to HBM
+        and decodes it there (``ops/device_decode``). Any change to the
+        frame encoding must preserve that byte-level identity."""
         from dmlc_tpu.data.device import pack_dense_batches
         from dmlc_tpu.service.frame import (
             block_from_frame, decode_frame, encode_snapshot_frame,
@@ -1204,7 +1211,9 @@ class ParseWorker:
         Packing needs the whole part (fixed batches span block
         boundaries), so this waits for parse completion — the CSR stream
         stays the low-latency path; snapshot frames trade first-byte
-        latency for half the wire."""
+        latency for half the wire. Each frame's payload doubles as the
+        client's device-decodable span (see
+        :meth:`_pack_snapshot_frames`)."""
         store = self._wait_store(job, part)
         # a (job, part) in the store implies the job's cfg was fetched
         # at grant time — the serve path never needs its own RPC

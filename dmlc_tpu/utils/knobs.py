@@ -377,6 +377,23 @@ def autotune_enabled(explicit: Optional[bool] = None) -> bool:
     return os.environ.get("DMLC_TPU_AUTOTUNE", "").strip() == "1"
 
 
+def device_decode(explicit: Optional[bool] = None) -> bool:
+    """The device-decode tier switch (docs/data.md three-tier decode
+    table): an explicit argument (``DeviceIter(device_decode=...)``)
+    wins; otherwise ``DMLC_TPU_DEVICE_DECODE=1`` arms it (any other
+    value — or unset — leaves the warm path on host snapshot views, the
+    historical behavior). Armed, a snapshot-warm epoch ``device_put``s
+    each batch's raw container span verbatim and decodes it in HBM
+    (:mod:`dmlc_tpu.ops.device_decode`) — zero per-batch host numpy
+    decode. Not an autotuned knob — the controller maps the
+    ``device_decode`` stage onto ``prefetch`` (deeper transfer
+    lookahead), it never flips the tier itself; registered here so the
+    knob lint gate covers the env read."""
+    if explicit is not None:
+        return bool(explicit)
+    return os.environ.get("DMLC_TPU_DEVICE_DECODE", "").strip() == "1"
+
+
 def autotune_interval(explicit: Optional[int] = None) -> int:
     """Mid-epoch controller pacing: run a tuning step every N delivered
     batches (0 = epoch boundaries only, the default). Explicit argument

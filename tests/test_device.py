@@ -382,6 +382,55 @@ def test_pallas_ell_matvec_candidate_band_parity(D, K):
                                rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("K,block_b", [(1, 32), (7, 64), (96, 32)])
+def test_pallas_ell_matvec_interpret_edge_widths(K, block_b):
+    """Interpret-mode parity OFF the candidate band: K=1 (degenerate
+    single-slot rows), K=7 (non-power-of-2), K=96 (wider than any bench
+    shape), at small block_b tiles — the grid-K kernel must be exact at
+    widths the auto-router never picks, so a future band change can't
+    silently step onto untested math."""
+    from dmlc_tpu.ops import ell_matvec
+    from dmlc_tpu.ops.pallas_sparse import ell_matvec_pallas
+    from dmlc_tpu.ops.sparse import EllBatch
+
+    rng = np.random.default_rng(K)
+    B, D = 128, 384
+    idx = rng.integers(0, D, size=(B, K)).astype(np.int32)
+    val = rng.normal(size=(B, K)).astype(np.float32)
+    w = jnp.asarray(rng.normal(size=D).astype(np.float32))
+    ell = EllBatch(jnp.asarray(idx), jnp.asarray(val),
+                   jnp.zeros(B), jnp.ones(B))
+    want = ell_matvec(w, ell)
+    got = ell_matvec_pallas(w, ell.indices, ell.values,
+                            block_b=block_b, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_ell_matvec_interpret_duplicate_and_padded_slots():
+    """ELL rows routinely repeat a column (hash collisions) or pad the
+    tail with value 0.0 — the kernel's gather+multiply must accumulate
+    duplicates and ignore padding exactly like the XLA reference."""
+    from dmlc_tpu.ops import ell_matvec
+    from dmlc_tpu.ops.pallas_sparse import ell_matvec_pallas
+    from dmlc_tpu.ops.sparse import EllBatch
+
+    rng = np.random.default_rng(42)
+    B, K, D = 64, 8, 256
+    idx = rng.integers(0, D, size=(B, K)).astype(np.int32)
+    idx[:, 1] = idx[:, 0]          # every row: one duplicated column
+    val = rng.normal(size=(B, K)).astype(np.float32)
+    val[:, K // 2:] = 0.0          # and a zero-padded tail
+    w = jnp.asarray(rng.normal(size=D).astype(np.float32))
+    ell = EllBatch(jnp.asarray(idx), jnp.asarray(val),
+                   jnp.zeros(B), jnp.ones(B))
+    want = ell_matvec(w, ell)
+    got = ell_matvec_pallas(w, ell.indices, ell.values,
+                            block_b=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_pallas_tile_pick_lane_aligned():
     """Compiled-mode tiles must be multiples of 128 (Mosaic lane minimum,
     advisor r3): _pick_block_b returns only {256, 128, 0}, and the raw
@@ -1203,7 +1252,8 @@ def test_device_iter_stage_attribution_partitions_wall(tmp_path, layout):
     it.close()
     assert n == 8
     assert set(s["stages"]) == {"read", "cache_read", "snapshot_read",
-                                "parse", "convert", "dispatch", "transfer"}
+                                "parse", "convert", "dispatch",
+                                "device_decode", "transfer"}
     assert s["cache_state"] is None  # no block cache armed on this source
     assert all(v >= 0.0 for v in s["stages"].values())
     assert s["wall_seconds"] > 0.0
