@@ -266,16 +266,37 @@ bench-smoke:
 	        'store_evictions missing'; \
 	    assert line.get('store_rebuilds_after_eviction') is not None, \
 	        'store_rebuilds_after_eviction missing'; \
-	    assert line.get('telemetry_schema_version') == 1, \
+	    assert line.get('telemetry_schema_version') == 2, \
 	        'telemetry_schema_version missing/mismatched'; \
 	    assert line.get('trace_spans'), 'trace_spans missing/zero'; \
 	    sc = line.get('trace_span_counts') or {}; \
 	    missing_s = [s for s in ('read', 'parse', 'convert', 'dispatch', \
 	        'cache_read') if not sc.get(s)]; \
 	    assert not missing_s, f'span counts missing stages: {missing_s}'; \
+	    tov = line.get('trace_overhead_pct'); \
+	    assert tov is not None and tov < 5.0, \
+	        f'trace_overhead_pct {tov} missing or >= 5: trace propagation ' \
+	        'must stay cheap enough to leave on'; \
+	    xp = line.get('trace_spans_crossproc'); \
+	    assert xp is not None and xp >= 1, \
+	        f'trace_spans_crossproc {xp}: no (job, part) trace linked the ' \
+	        'worker-side encode/send to the client-side recv/decode'; \
+	    assert line.get('trace_timeline_events'), \
+	        'trace_timeline_events missing/zero (merged pod timeline empty)'; \
+	    pm = line.get('prometheus_metrics'); \
+	    assert pm, \
+	        f'prometheus_metrics {pm}: render_prometheus did not round-trip ' \
+	        'through the text-format parser'; \
+	    assert line.get('decisions_total') is not None, \
+	        'decisions_total missing (decision ledger absent)'; \
 	    print('bench-smoke: telemetry OK: schema', \
 	          line['telemetry_schema_version'], 'spans', \
 	          line['trace_spans'], sc); \
+	    print('bench-smoke: observability OK: trace overhead', tov, \
+	          'pct,', xp, 'cross-process trace(s),', \
+	          line['trace_timeline_events'], 'timeline events,', pm, \
+	          'prometheus metrics,', line['decisions_total'], \
+	          'decisions'); \
 	    print('bench-smoke: attribution OK:', \
 	          {k: a[k] for k in sorted(a)}); \
 	    print('bench-smoke: parse scaling OK:', curve, \

@@ -729,6 +729,31 @@ def service_leg(path: str, size_mb: float, workers: int = 2):
         while client.next_block() is not None:
             sblocks += 1
         service_dt = time.monotonic() - t0
+        # merged pod timeline + cross-process trace count (docs/
+        # observability.md Distributed tracing): export ONE Chrome/
+        # Perfetto JSON for the whole fleet (kept when
+        # DMLC_BENCH_TRACE_PATH names a destination), and count the
+        # (job, part) traces whose spans link the worker-side
+        # encode/send to the client-side recv/decode — the one-trace-
+        # per-part acceptance signal bench-smoke gates >= 1
+        keep = os.environ.get("DMLC_BENCH_TRACE_PATH", "")
+        trace_path = keep or os.path.join(
+            tempfile.gettempdir(), f"dmlc-bench-trace-{os.getpid()}.json")
+        timeline_events = fleet.dump_trace(trace_path)
+        if not keep:
+            try:
+                os.remove(trace_path)
+            except OSError:
+                pass
+        worker_side = {"service_parse", "service_encode", "service_send"}
+        client_side = {"service_recv", "service_decode"}
+        by_tid: dict = {}
+        for s in _telemetry.spans_snapshot():
+            t = s.get("trace_id")
+            if t:
+                by_tid.setdefault(t, set()).add(s["name"])
+        crossproc = sum(1 for names in by_tid.values()
+                        if names & worker_side and names & client_side)
     finally:
         if client is not None:
             client.close()
@@ -739,7 +764,8 @@ def service_leg(path: str, size_mb: float, workers: int = 2):
         f"serial {size_mb/local_dt:.1f} MB/s -> speedup "
         f"x{local_dt/service_dt:.2f} (control plane: "
         f"{res['dispatcher_restarts']} restarts, "
-        f"{res['control_plane_retries']} retries)")
+        f"{res['control_plane_retries']} retries; {crossproc} cross-"
+        f"process trace(s), {timeline_events} timeline events)")
     # ---- two-job multi-tenant leg (docstring): same corpus, two jobs,
     # share-by-signature, knob-paced autoscaler attached for the ride
     tenant = "tenant-b"
@@ -803,6 +829,8 @@ def service_leg(path: str, size_mb: float, workers: int = 2):
         "service_jobs": 2,
         "shared_parse_ratio": round(shared_ratio, 3),
         "fleet_scale_events": scale_events,
+        "trace_spans_crossproc": crossproc,
+        "trace_timeline_events": timeline_events,
     }
 
 
@@ -1115,6 +1143,45 @@ def autotune_leg(path: str, size_mb: float, max_epochs: int = 5):
         }
     finally:
         it.close()
+
+
+def trace_overhead_leg(path: str, size_mb: float, reps: int = 3):
+    """Trace-propagation tax (docs/observability.md Distributed
+    tracing): a warm parse-epoch pair — trace context armed (a live
+    trace installed, every span stamped) against propagation forced off
+    — interleaved, best-of-``reps`` each. ``trace_overhead_pct`` is the
+    relative cost of the armed leg; ``make bench-smoke`` gates it < 5%
+    (the observability plane must be cheap enough to leave on). Best-of
+    because scheduler noise and page-cache drift only ever ADD time;
+    interleaved so drift lands on both legs equally."""
+    from dmlc_tpu.data import create_parser
+    from dmlc_tpu.utils import telemetry as _telemetry
+
+    def _epoch() -> float:
+        t0 = time.monotonic()
+        parser = create_parser(path, 0, 1, "libsvm",
+                               chunk_bytes=CHUNK_BYTES)
+        while parser.next_block() is not None:
+            pass
+        parser.close()
+        return time.monotonic() - t0
+
+    _epoch()  # both legs must measure warm page-cache supply
+    on = off = float("inf")
+    try:
+        for _ in range(max(1, int(reps))):
+            _telemetry.set_trace_propagation(True)
+            with _telemetry.trace(_telemetry.new_trace_id(),
+                                  _telemetry.new_span_id()):
+                on = min(on, _epoch())
+            _telemetry.set_trace_propagation(False)
+            off = min(off, _epoch())
+    finally:
+        _telemetry.set_trace_propagation(None)
+    pct = (on - off) / off * 100.0 if off > 0 else 0.0
+    log(f"bench: trace overhead: traced {size_mb/on:.1f} MB/s vs "
+        f"untraced {size_mb/off:.1f} MB/s -> {pct:+.2f}%")
+    return {"trace_overhead_pct": round(pct, 2)}
 
 
 def device_floor_mbps(x_dtype: str = "float32"):
@@ -1477,6 +1544,13 @@ def run_child() -> None:
             f"eviction")
     except Exception as exc:  # noqa: BLE001 - the headline must still print
         log(f"bench: store counters failed: {exc}")
+    # trace-propagation overhead guard (docs/observability.md): warm
+    # epoch pair, context armed vs forced off — make bench-smoke gates
+    # trace_overhead_pct < 5 so the plane stays cheap enough to leave on
+    try:
+        line.update(trace_overhead_leg(path, size_mb))
+    except Exception as exc:  # noqa: BLE001 - the headline must still print
+        log(f"bench: trace overhead leg failed: {exc}")
     # always-on telemetry contract (docs/observability.md): the schema
     # version + per-stage span counts ride the JSON line, proving the span
     # tracer covered the whole measurement (make bench-smoke gates these)
@@ -1486,6 +1560,17 @@ def run_child() -> None:
     counts = _telemetry.span_counts()
     line["trace_spans"] = int(sum(counts.values()))
     line["trace_span_counts"] = {k: int(v) for k, v in sorted(counts.items())}
+    # Prometheus exposition self-check: the render must round-trip
+    # through the text-format parser (what a real scraper does), and the
+    # decision ledger's lifetime count rides along — both gated
+    try:
+        prom = _telemetry.render_prometheus()
+        line["prometheus_metrics"] = len(_telemetry.parse_prometheus_text(
+            prom))
+    except Exception as exc:  # noqa: BLE001 - the headline must still print
+        log(f"bench: prometheus render failed: {exc}")
+        line["prometheus_metrics"] = None
+    line["decisions_total"] = _telemetry.decisions_total()
     print(json.dumps(line))
 
 
@@ -1687,7 +1772,9 @@ def main() -> int:
                           "autotune_gap_stage", "autotune_final_config",
                           "autotune_mb_per_sec", "input_wait_seconds",
                           "telemetry_schema_version", "trace_spans",
-                          "trace_span_counts"):
+                          "trace_span_counts", "trace_overhead_pct",
+                          "trace_spans_crossproc", "trace_timeline_events",
+                          "prometheus_metrics", "decisions_total"):
                     if parsed.get(k) is not None:
                         line[f"cpu_backend_{k}"] = parsed[k]
                 line["cpu_backend_note"] = (

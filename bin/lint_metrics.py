@@ -37,7 +37,7 @@ the span tracer and its invalidation/corruption events go through
   ``DMLC_TPU_PARSE_ENGINE``, ``DMLC_TPU_FLEET*``,
   ``DMLC_TPU_SERVICE_PIPELINE_DEPTH``,
   ``DMLC_TPU_WIRE_COMPRESSION``, ``DMLC_TPU_QOS*``,
-  ``DMLC_TPU_CLAIM_WAIT_DEADLINE``) — every
+  ``DMLC_TPU_CLAIM_WAIT_DEADLINE``, ``DMLC_TPU_METRICS*``) — every
   pipeline tunable must be a row in the
   autotune knob table (``dmlc_tpu/utils/knobs.py``, read via
   ``knobs.resolve``) so the feedback controller knows its bounds and the
@@ -55,6 +55,13 @@ widen/dequant dtype path). ``dmlc_tpu/io/snapshot.py`` and
 decode bytes themselves, so any ``np.frombuffer(`` or ``.astype(``
 appearing there FAILS — that is host per-batch decode creeping back
 into the path whose whole point is that the span ships verbatim.
+
+A third gate guards service control-RPC observability: every ``cmd ==
+"..."`` handler arm in ``dmlc_tpu/service/dispatcher.py`` and
+``dmlc_tpu/service/worker.py`` must be covered by a
+``record_span("service_rpc", ...)`` site in the same module — control
+traffic that never hits the span tracer is invisible in merged pod
+timelines (docs/observability.md Distributed tracing).
 
 Exit status: 0 clean, 1 with offenders listed as ``path:line``.
 """
@@ -88,6 +95,19 @@ DECODE_SCOPE = {
     Path("dmlc_tpu") / "data" / "device.py",
 }
 
+# service control-plane modules whose RPC dispatch must be covered by the
+# span tracer (docs/observability.md Distributed tracing): every
+# ``cmd == "..."`` handler arm must sit under a ``service_rpc`` span so
+# control traffic is visible in merged pod timelines — a handler added
+# outside the span-wrapped dispatch is un-traceable control flow
+RPC_MODULES = {
+    Path("dmlc_tpu") / "service" / "dispatcher.py",
+    Path("dmlc_tpu") / "service" / "worker.py",
+}
+
+_RPC_HANDLER = re.compile(r"\bcmd\s*==\s*['\"](\w+)['\"]")
+_RPC_SPAN = re.compile(r"record_span\(\s*['\"]service_rpc['\"]")
+
 _PATTERNS = (
     (re.compile(r"\bCOUNTERS\.bump\s*\("),
      "direct COUNTERS.bump — use resilience.record_event / a registry "
@@ -104,7 +124,7 @@ _KNOB_PATTERN = (
                r"DRAIN_DEADLINE|PARSE_ENGINE|FLEET[A-Z0-9_]*|"
                r"SERVICE_PIPELINE_DEPTH|WIRE_COMPRESSION|"
                r"QOS[A-Z0-9_]*|CLAIM_WAIT_DEADLINE|"
-               r"DEVICE_DECODE[A-Z0-9_]*)['\"]"),
+               r"DEVICE_DECODE[A-Z0-9_]*|METRICS[A-Z0-9_]*)['\"]"),
     "ad-hoc tunable env read — register the knob in "
     "dmlc_tpu/utils/knobs.py (KNOB_TABLE / a validated accessor like "
     "store_budget_bytes) and read it through that module")
@@ -152,6 +172,28 @@ def scan_decode(text: str) -> List[Tuple[int, str]]:
     return offenders
 
 
+def scan_rpc_spans(text: str) -> List[Tuple[int, str]]:
+    """The RPC-coverage gate (module docstring): in an RPC_MODULES file,
+    every ``cmd == "..."`` handler arm requires a ``service_rpc``
+    span-recording site in the same module — without one, every handler
+    line is an offender (the whole dispatch runs untraced)."""
+    if _RPC_SPAN.search(text):
+        return []
+    offenders: List[Tuple[int, str]] = []
+    for i, line in enumerate(text.splitlines()):
+        stripped = line.lstrip()
+        if stripped.startswith("#"):
+            continue
+        m = _RPC_HANDLER.search(line)
+        if m:
+            offenders.append(
+                (i + 1, f"RPC handler {m.group(1)!r} without a "
+                        "record_span('service_rpc', ...) site in this "
+                        "module — control RPCs must be span-traced "
+                        "(docs/observability.md)"))
+    return offenders
+
+
 def main(argv: List[str]) -> int:
     root = Path(argv[1]) if len(argv) > 1 else \
         Path(__file__).resolve().parent.parent
@@ -167,6 +209,10 @@ def main(argv: List[str]) -> int:
             bad += 1
         if rel in DECODE_SCOPE:
             for lineno, reason in scan_decode(text):
+                print(f"{rel}:{lineno}: {reason}", file=sys.stderr)
+                bad += 1
+        if rel in RPC_MODULES:
+            for lineno, reason in scan_rpc_spans(text):
                 print(f"{rel}:{lineno}: {reason}", file=sys.stderr)
                 bad += 1
     if bad:

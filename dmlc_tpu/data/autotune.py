@@ -89,6 +89,13 @@ STAGE_KNOB_FALLBACK: Dict[str, str] = {
 SUPPLY_STAGES = ("read", "cache_read", "snapshot_read", "parse",
                  "convert", "dispatch", "device_decode")
 
+# controller actions that land on the audit ledger (docs/observability.md
+# Decision ledger): the actual control moves and anomaly holds. The
+# per-window bookkeeping actions (skip/hold/steady) stay in the local
+# history only — they would flood the ledger with no-ops.
+_LEDGER_ACTIONS = frozenset(
+    ("grow", "revert", "revert_failed", "cooldown", "bound"))
+
 
 class Knob:
     """One live-resizable pipeline control.
@@ -192,6 +199,14 @@ class AutoTuner:
         if len(self.history) > self.max_history:
             del self.history[: len(self.history) - self.max_history]
         self._last_gap = decision.get("gap_stage", self._last_gap)
+        if decision["action"] in _LEDGER_ACTIONS:
+            _telemetry.record_decision(
+                "autotune", decision["action"],
+                trigger={k: decision[k]
+                         for k in ("knob", "from", "to", "gap_stage",
+                                   "input_wait_frac") if k in decision},
+                outcome=decision.get("rationale"),
+                pipeline=self.scope or "", step=self._step_no)
         return decision
 
     def step(self, window: dict) -> dict:
@@ -399,6 +414,12 @@ class ParseTierTuner:
             else round(float(efficiency), 4),
             "rationale": why,
         })
+        if new != w:
+            _telemetry.record_decision(
+                "parse_tier_tuner", "grow" if new > w else "shrink",
+                trigger={"efficiency": round(float(efficiency), 4),
+                         "workers": w},
+                outcome=why, next_workers=new)
         if len(self.history) > self.max_history:
             del self.history[: len(self.history) - self.max_history]
         self.workers = new

@@ -695,6 +695,11 @@ class DeviceIter:
         self._last_resume: Optional[dict] = None
         self._drop_rows = 0                # rows to drop after a seek-restore
         self._suppress_before_first = False
+        # last trace context seen on a source block (service clients stamp
+        # block.trace_ctx from the grant's wire context) — links the
+        # dispatch span into the (job, part) trace even though rebatching
+        # and the convert pool detach the device_put from the block object
+        self._last_trace_ctx: Optional[tuple] = None
         # ---- fault tolerance (docs/resilience.md) ----
         # stream-level retries/resumes happen below, in the filesystems; a
         # retryable error that ESCAPES them (budget exhausted, producer
@@ -1102,6 +1107,9 @@ class DeviceIter:
             self._add_busy("parse", dt - read - cache_read)
             if blk is None:
                 return
+            ctx = getattr(blk, "trace_ctx", None)
+            if ctx is not None:
+                self._last_trace_ctx = ctx
             yield blk
 
     def _tracked_blocks(self) -> Iterator[RowBlock]:
@@ -1499,7 +1507,15 @@ class DeviceIter:
             dt = get_time() - t0
             dt -= self._busy.seconds()["device_decode"] - dd0
             self._add_busy("dispatch", dt)
-            _telemetry.record_span("dispatch", t0, dt)
+            ctx = self._last_trace_ctx
+            if ctx is not None:
+                # device_put joins the (job, part) trace the source block
+                # carried — the timeline shows grant -> parse -> recv ->
+                # decode -> dispatch as one causal chain
+                _telemetry.record_span("dispatch", t0, dt,
+                                       trace_id=ctx[0], parent_id=ctx[1])
+            else:
+                _telemetry.record_span("dispatch", t0, dt)
         if ring_bufs is not None and self._ring is not None:
             # tie the staging slot to ALL device arrays of the batch: the
             # slot frees only when the consumer has dropped every one of

@@ -211,7 +211,7 @@ class DenseBlock:
     """
 
     __slots__ = ("x", "label", "weight", "hold", "resume_state", "packed",
-                 "device_span")
+                 "device_span", "trace_ctx")
 
     def __init__(self, x: np.ndarray, label: np.ndarray,
                  weight: Optional[np.ndarray] = None, hold=None,
@@ -230,6 +230,9 @@ class DenseBlock:
         # device_decode=True DeviceIter to decode in HBM instead of
         # shipping the host-decoded views (ops/device_decode)
         self.device_span = None
+        # optional (service clients): the (trace_id, span_id) context of
+        # the grant that produced this block (docs/observability.md)
+        self.trace_ctx = None
 
     def __len__(self) -> int:
         return len(self.label)
@@ -256,7 +259,8 @@ class CooBlock:
     """
 
     __slots__ = ("coords", "values", "label", "weight", "n_rows", "nnz",
-                 "num_col", "hold", "resume_state", "row_ptr")
+                 "num_col", "hold", "resume_state", "row_ptr",
+                 "trace_ctx")
 
     def __init__(self, coords: np.ndarray, values: Optional[np.ndarray],
                  label: np.ndarray, weight: np.ndarray, n_rows: int,
@@ -274,6 +278,7 @@ class CooBlock:
         self.num_col = num_col
         self.hold = hold
         self.resume_state = None
+        self.trace_ctx = None
 
     @property
     def shape(self):

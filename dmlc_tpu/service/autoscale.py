@@ -61,6 +61,7 @@ from typing import Callable, Dict, List, Optional
 
 from dmlc_tpu.io import resilience as _resilience
 from dmlc_tpu.utils import knobs as _knobs
+from dmlc_tpu.utils import telemetry as _telemetry
 from dmlc_tpu.utils.check import check
 from dmlc_tpu.utils.timer import get_time
 
@@ -199,6 +200,11 @@ class FleetAutoscaler:
             fracs[job] = min(1.0, delta / window)
         self._last, self._last_t = waits, now
         self.ticks += 1
+        # one time-series sample per control tick: the bounded
+        # metrics-history ring is what answers "what did input_wait
+        # look like when the autoscaler grew" after the fact
+        # (docs/observability.md Prometheus exposition)
+        _telemetry.sample_metrics_history()
         # SLO-aware per-job fairness (docs/service.md Production QoS):
         # each job is measured against its OWN input-wait target
         # (register_job(slo_wait_frac=), default grow_frac), and among
@@ -317,6 +323,15 @@ class FleetAutoscaler:
                "why": why}
         if worker is not None:
             rec["worker"] = worker
+        if action != HOLD:
+            # scale events land on the audit ledger (HOLD ticks stay in
+            # the local history only — one decision event per actual
+            # control action, docs/observability.md Decision ledger)
+            _telemetry.record_decision(
+                "autoscaler", action,
+                trigger={"wait_fracs": rec["wait_fracs"],
+                         "fleet_size": rec["fleet_size"]},
+                outcome=why, worker=worker)
         self.history.append(rec)
         if len(self.history) > HISTORY_LIMIT:
             del self.history[:len(self.history) - HISTORY_LIMIT]

@@ -89,6 +89,7 @@ from dmlc_tpu.service.frame import (
     WIRE_CODECS,
     ServiceFrameError,
     annot_key,
+    attach_trace,
     block_from_frame,
     recv_frame,
     snapshot_from_frame,
@@ -191,6 +192,12 @@ class ServiceParser(Parser):
         self._last_located: Optional[str] = None
         self._drain_move_from: Optional[str] = None
         self._drain_moves = 0
+        # the CURRENT part's trace context — the grant trace the
+        # dispatcher hands back on `locate`, re-offered to the worker on
+        # the stream request and scoped around this client's recv/decode
+        # so one (job, part) renders as one causal trace across all
+        # three processes (docs/observability.md Distributed tracing)
+        self._trace_ctx: Optional[tuple] = None
         self._stream_failures = 0
         self._bytes = 0
         self._recv_seconds = 0.0
@@ -349,6 +356,8 @@ class ServiceParser(Parser):
             self._drain_move_from = None
         self._last_located = str(owner["worker"])
         self._pending_owner = str(owner["worker"])
+        self._trace_ctx = _telemetry.trace_context_from_wire(
+            owner.get("trace"))
         # the worker_rpc fault-plan seam: chaos plans break client->
         # worker data-plane connects deterministically (docs/resilience.md)
         # — it fires per part-stream whether the transport reconnects or
@@ -383,6 +392,10 @@ class ServiceParser(Parser):
             sock.settimeout(self._stream_timeout)
             req = {"cmd": "stream", "part": self._part, "start": self._pos,
                    "job": self.job}
+            # re-offer the part's grant trace to the worker (optional
+            # key — old workers ignore it): its service_send spans then
+            # join the same trace this client's recv/decode record under
+            attach_trace(req, self._trace_ctx)
             offer_v2 = not self.snapshot and self._offer_wire >= 2
             if self.snapshot:
                 # snapshot streams stay on the v1 push plane: packed
@@ -499,6 +512,14 @@ class ServiceParser(Parser):
                 f"{self._policy.max_attempts}): {exc}") from exc
         self._policy.sleep(self._policy.backoff(used))
 
+    def _trace_scope(self):
+        """The current part's trace context as a span scope: recv/decode
+        spans recorded inside inherit the grant's trace id (or none when
+        propagation is off / the dispatcher predates tracing)."""
+        ctx = self._trace_ctx
+        return _telemetry.trace(ctx[0] if ctx else None,
+                                ctx[1] if ctx else "")
+
     # ---------------- wire v2 engine ----------------
 
     def _recv_stream(self, sock: socket.socket) -> tuple:
@@ -593,6 +614,8 @@ class ServiceParser(Parser):
             return None
         if annot is not None:
             block.resume_state = annot
+        if self._trace_ctx is not None:
+            block.trace_ctx = self._trace_ctx
         dt = get_time() - t0
         self._recv_seconds += dt
         self._wait_metric.inc(dt)
@@ -648,7 +671,8 @@ class ServiceParser(Parser):
                         continue  # part done / fell back: loop re-aims
                     return block
                 sock = self._sock
-                kind, meta, payload = self._recv_stream(sock)
+                with self._trace_scope():
+                    kind, meta, payload = self._recv_stream(sock)
             except (ConnectionError, OSError,
                     ServiceFrameError, ServiceUnavailableError) as exc:
                 # torn dispatcher replies arrive as ConnectionError —
@@ -664,7 +688,8 @@ class ServiceParser(Parser):
             self._wait_metric.inc(dt)
             if kind == KIND_BLOCK:
                 t1 = get_time()
-                block = block_from_frame(meta, payload)
+                with self._trace_scope():
+                    block = block_from_frame(meta, payload)
                 self._decode_seconds += get_time() - t1
                 self._bytes += len(payload)
                 self._pos += 1
@@ -673,13 +698,19 @@ class ServiceParser(Parser):
                 self._soft_retry_owner = None
                 self._drain_moves = 0
                 self._last_annot = meta.get("resume")
+                if self._trace_ctx is not None:
+                    # ride the trace to the device dispatch: DeviceIter's
+                    # dispatch span picks this up whatever thread it runs
+                    # on (docs/observability.md)
+                    block.trace_ctx = self._trace_ctx
                 return block
             if kind == KIND_SNAPSHOT:
                 # device-layout packed batch: decode to a packed
                 # DenseBlock (zero-copy views over the payload) —
                 # DeviceIter serves it through the dense_ready fast path
                 t1 = get_time()
-                bkind, *arrays = snapshot_from_frame(meta, payload)
+                with self._trace_scope():
+                    bkind, *arrays = snapshot_from_frame(meta, payload)
                 if bkind != "dense_packed":
                     self._on_stream_fault(DMLCError(
                         f"unsupported snapshot frame kind {bkind!r}"))
@@ -712,6 +743,8 @@ class ServiceParser(Parser):
                 self._soft_retry_owner = None
                 self._drain_moves = 0
                 self._last_annot = resume
+                if self._trace_ctx is not None:
+                    block.trace_ctx = self._trace_ctx
                 return block
             if kind == KIND_END:
                 total = meta.get("blocks")
@@ -793,6 +826,7 @@ class ServiceParser(Parser):
         self._last_located = None
         self._drain_move_from = None
         self._drain_moves = 0
+        self._trace_ctx = None
 
     # ---------------- checkpoint / resume ----------------
 

@@ -166,10 +166,37 @@ from dmlc_tpu.store import journal as _journal_mod
 from dmlc_tpu.store.journal import AppendJournal
 from dmlc_tpu.store.manager import signature_hash
 from dmlc_tpu.utils import knobs as _knobs
+from dmlc_tpu.utils import telemetry as _telemetry
 from dmlc_tpu.utils.check import DMLCError, check
 from dmlc_tpu.utils.timer import get_time
 
 logger = logging.getLogger("dmlc_tpu.service")
+
+# per-address clock-offset estimates (peer monotonic clock minus ours),
+# fed by every `request()` round trip whose reply carries a `now` stamp:
+# offset = peer_now - (t_send + t_recv) / 2, EWMA-smoothed so one
+# GC-paused round trip cannot skew a whole timeline. Consumed by
+# LocalFleet.dump_trace to place every peer's spans on ONE clock
+# (docs/observability.md Distributed tracing).
+_CLOCK_OFFSETS: Dict[str, float] = {}
+_CLOCK_OFFSETS_LOCK = threading.Lock()
+_CLOCK_OFFSET_ALPHA = 0.3
+
+
+def _note_clock_offset(address: str, offset: float) -> None:
+    with _CLOCK_OFFSETS_LOCK:
+        prev = _CLOCK_OFFSETS.get(address)
+        _CLOCK_OFFSETS[address] = (
+            offset if prev is None
+            else prev + _CLOCK_OFFSET_ALPHA * (offset - prev))
+
+
+def peer_clock_offset(address: str) -> Optional[float]:
+    """Latest clock-offset estimate (seconds to ADD to ``address``'s
+    monotonic timestamps to land on this process's clock), or None when
+    no stamped reply from that address has been seen yet."""
+    with _CLOCK_OFFSETS_LOCK:
+        return _CLOCK_OFFSETS.get(address)
 
 # the job the one-dataset constructor/protocol of PR 7-14 maps onto:
 # requests without a `job` field, journal events without one, and the
@@ -256,7 +283,7 @@ class _JobState:
                  "share_sig", "todo", "assigned", "completed",
                  "clients_active", "grant_times", "latencies", "spec",
                  "spec_times", "hedge_todo", "priority", "weight",
-                 "slo_wait_frac", "max_inflight", "deficit")
+                 "slo_wait_frac", "max_inflight", "deficit", "traces")
 
     def __init__(self, job: str, uri: str, num_parts: int,
                  parser: Optional[dict] = None,
@@ -326,6 +353,12 @@ class _JobState:
         # already journal; credit restarts at 0 for everyone, which
         # preserves relative shares).
         self.deficit = 0.0
+        # part -> (trace_id, root span_id): the trace each in-flight
+        # part's grant opened. Grant replies and locate replies hand the
+        # SAME context to the worker and the client, so one (job, part)
+        # is one trace from next_split to device_put. Observability
+        # state, not identity — never journaled, dies with a restart.
+        self.traces: Dict[int, Tuple[str, str]] = {}
 
     def qos_dict(self) -> dict:
         """The job's QoS class as a wire/journal sub-dict (only the
@@ -1037,6 +1070,10 @@ class Dispatcher:
             return
         info.state = DEAD
         self._journal_append({"op": "dead", "worker": worker})
+        self._decision_locked(
+            "mark_dead",
+            {"last_seen_s": round(get_time() - info.last_seen, 3)},
+            "worker declared dead; its parts re-issue", worker=worker)
         self._release_worker_parts_locked(worker, "lost")
 
     def _reap_stale_locked(self, now: float) -> None:
@@ -1071,6 +1108,10 @@ class Dispatcher:
                     info.worker, why)
         info.state = DEAD
         self._journal_append({"op": "dead", "worker": info.worker})
+        self._decision_locked(
+            "drain_complete",
+            {"handed_off": len(info.handed_off)}, why,
+            worker=info.worker)
         self._drop_worker_specs_locked(info.worker)
         for job in self._jobs.values():
             keep = {p for (j, p) in info.handed_off
@@ -1146,6 +1187,14 @@ class Dispatcher:
                            for w in self._workers.values()):
                     continue  # nobody to hedge onto
                 job.hedge_todo.append(part)
+                self._decision_locked(
+                    "hedge",
+                    {"part": part, "age_s": round(age, 3),
+                     "threshold_s": round(threshold, 3),
+                     "median_s": round(
+                         statistics.median(job.latencies), 3)},
+                    f"part {part} on {owner} flagged for "
+                    f"speculative re-issue", job=job.job)
                 logger.warning(
                     "dispatcher: job %s part %d on worker %s stuck "
                     "%.2fs (> %.2fs = %dx job median); flagging for "
@@ -1165,10 +1214,24 @@ class Dispatcher:
     # ---------------- request handlers ----------------
 
     def _handle(self, req: dict) -> dict:
-        resp = self._dispatch_cmd(req)
+        t0 = get_time()
+        # adopt the caller's trace context (optional `trace` wire key,
+        # docs/service.md) for the duration of this command, so the
+        # service_rpc span — and anything the handler records — links
+        # into the caller's trace
+        ctx = _telemetry.trace_context_from_wire(req.get("trace"))
+        with _telemetry.trace(ctx[0] if ctx else None,
+                              ctx[1] if ctx else ""):
+            resp = self._dispatch_cmd(req)
+            _telemetry.record_span("service_rpc", t0, get_time() - t0,
+                                   cmd=str(req.get("cmd") or ""))
         # the monotonic generation token: peers detect a restart at
         # their next control exchange and re-register/revalidate
         resp["gen"] = self.generation
+        # monotonic clock stamp: `request()` pairs it with its own
+        # send/receive midpoint to estimate this process's clock offset
+        # (merged pod timelines, docs/observability.md)
+        resp["now"] = round(get_time(), 6)
         return resp
 
     def _job_for(self, req: dict) -> Optional[_JobState]:
@@ -1263,6 +1326,10 @@ class Dispatcher:
                     # this very reply
                     self._journal_append({"op": "join", "worker": worker})
                     _resilience.record_event("worker_joins")
+                    self._decision_locked(
+                        "live_join", None,
+                        f"worker {worker} joined mid-epoch",
+                        worker=worker)
                     logger.info("dispatcher: worker %s joined the live "
                                 "fleet", worker)
                 return {"ok": True}
@@ -1293,6 +1360,21 @@ class Dispatcher:
             if cmd == "report_lost":
                 self._mark_dead_locked(str(req["worker"]))
                 return {"ok": True}
+            if cmd == "trace_dump":
+                # this process's span rings + decisions, with a clock
+                # stamp — LocalFleet.dump_trace merges these into ONE
+                # pod timeline (docs/observability.md)
+                return {"snapshot":
+                        _telemetry.component_snapshot("dispatcher")}
+            if cmd == "metrics_text":
+                return {"text": _telemetry.render_prometheus(),
+                        "content_type":
+                            "text/plain; version=0.0.4; charset=utf-8"}
+            if cmd == "decisions":
+                comp = req.get("component")
+                return {"decisions": _telemetry.decisions_snapshot(
+                            str(comp) if comp else None),
+                        "total": _telemetry.decisions_total()}
             if cmd == "status":
                 default = self._default()
                 jobs = {
@@ -1325,6 +1407,42 @@ class Dispatcher:
                     "generation": self.generation,
                 }
         return {"error": f"unknown command {cmd!r}"}
+
+    def _decision_locked(self, action: str, trigger: Optional[dict],
+                         outcome: Optional[str], **extra) -> None:
+        """Record one dispatcher control decision: audit-ledger event
+        (+ ``decision_events`` counter) and a ``decision`` journal line
+        so post-mortems survive the process. Replay skips unknown ops,
+        so old dispatchers reading a new journal are unaffected; journal
+        compaction drops decision lines (they are observability, not
+        assignment state). Never fsync'd — a lost tail decision must not
+        cost the control plane a disk flush."""
+        event = _telemetry.record_decision("dispatcher", action,
+                                           trigger=trigger,
+                                           outcome=outcome, **extra)
+        self._journal_append(dict({"op": "decision"}, **event),
+                             sync=False)
+
+    def _grant_trace_locked(self, job: _JobState, part: int,
+                            worker: str, now: float,
+                            name: str) -> Optional[dict]:
+        """Open (or re-join) the part's trace at grant time and return
+        its wire context for the reply. The grant is the trace ROOT: one
+        (job, part) = one trace id, and the root span id is what worker
+        and client spans parent under. A hedge re-grant re-joins the
+        primary grant's trace so both attempts render as one causal
+        timeline."""
+        if not _telemetry.trace_propagation_enabled():
+            return None
+        ctx = job.traces.get(part)
+        if ctx is None:
+            ctx = (_telemetry.new_trace_id(), _telemetry.new_span_id())
+            job.traces[part] = ctx
+        tid, sid = ctx
+        _telemetry.record_span(name, now, get_time() - now,
+                               trace_id=tid, span_id=sid,
+                               job=job.job, part=part, worker=worker)
+        return {"tid": tid, "sid": sid}
 
     def _next_split_locked(self, req: dict, now: float) -> dict:
         worker = str(req["worker"])
@@ -1371,11 +1489,23 @@ class Dispatcher:
                     {"op": "spec_grant", "part": part, "worker": worker},
                     **self._job_tag(job)))
                 _resilience.record_event("speculative_reissues")
+                age = now - job.grant_times.get(part, now)
+                self._decision_locked(
+                    "spec_grant",
+                    {"part": part, "age_s": round(age, 3),
+                     "samples": len(job.latencies)},
+                    f"re-issued to {worker} (primary "
+                    f"{job.assigned.get(part)})", job=job.job)
                 logger.warning(
                     "dispatcher: job %s part %d speculatively re-issued "
                     "to worker %s (primary %s)", job.job, part, worker,
                     job.assigned.get(part))
-                return {"part": part, "job": job.job}
+                resp = {"part": part, "job": job.job}
+                wire = self._grant_trace_locked(job, part, worker, now,
+                                                "service_spec_grant")
+                if wire is not None:
+                    resp["trace"] = wire
+                return resp
         # fresh grants: deficit round-robin within the highest priority
         # band that has admissible work (docs/service.md Production QoS).
         # Higher bands fully preempt lower ones; within a band each job
@@ -1407,7 +1537,12 @@ class Dispatcher:
                 self._rr = (self._rr + band.index(job) + 1) % (1 << 30)
                 logger.info("dispatcher: job %s part %d -> worker %s",
                             job.job, part, worker)
-                return {"part": part, "job": job.job}
+                resp = {"part": part, "job": job.job}
+                wire = self._grant_trace_locked(job, part, worker, now,
+                                                "service_grant")
+                if wire is not None:
+                    resp["trace"] = wire
+                return resp
         return {"part": None}
 
     def _part_done_locked(self, req: dict, now: float) -> dict:
@@ -1435,6 +1570,10 @@ class Dispatcher:
                 job.assigned[part] = worker
                 granted_at = job.spec_times.get(part, granted_at)
                 _resilience.record_event("speculative_wins")
+                self._decision_locked(
+                    "spec_win", {"part": part},
+                    f"speculative worker {worker} won over {primary}",
+                    job=job.job)
                 logger.info("dispatcher: speculative worker %s won "
                             "job %s part %d over %s", worker, job.job,
                             part, primary)
@@ -1493,10 +1632,23 @@ class Dispatcher:
                 # overload degrades to bounded queueing, never a
                 # give-up (docs/service.md Production QoS)
                 _resilience.record_event("service_throttles")
+                self._decision_locked(
+                    "throttle",
+                    {"part": part, "inflight": job.inflight(),
+                     "fleet_inflight": self._fleet_inflight_locked(),
+                     "max_inflight": job.max_inflight},
+                    "client told to back off", job=job.job)
                 return {"throttled": True}
             return {"wait": True}
         resp = {"worker": info.worker, "host": info.host,
                 "port": info.port}
+        ctx = job.traces.get(part)
+        wire = (_telemetry.trace_context_wire(ctx)
+                if ctx is not None else None)
+        if wire is not None:
+            # the part's grant trace: the client's recv/decode/dispatch
+            # spans join the same causal chain the grant opened
+            resp["trace"] = wire
         if info.state == DRAINING:
             # the owner is leaving: clients should finish this stream
             # promptly and confirm with `handoff`
@@ -1542,6 +1694,10 @@ class Dispatcher:
             info.handed_off = set()
             self._journal_append({"op": "drain", "worker": worker})
             _resilience.record_event("worker_drains")
+            self._decision_locked(
+                "drain", {"deadline_s": round(deadline_s, 3)},
+                f"worker {worker} leaving the grant rotation",
+                worker=worker)
             # speculative grants the drainer held die with the drain
             self._drop_worker_specs_locked(worker)
             # proactive re-issue of everything NOT frame-store-complete
@@ -1767,13 +1923,22 @@ def request(address: str, req: dict, timeout: float = 10.0) -> dict:
     the classification at call sites. The ``dispatch_rpc`` fault-plan op
     fires on every round trip (docs/resilience.md grammar)."""
     _faults.maybe_fail("dispatch_rpc", f"{address} {req.get('cmd', '')}")
+    if "trace" not in req:
+        # propagate the caller's trace context (optional key — old
+        # dispatchers ignore it); copy-on-write so retries and callers
+        # that reuse request dicts are unaffected
+        wire = _telemetry.trace_context_wire()
+        if wire is not None:
+            req = dict(req, trace=wire)
     host, _, port = address.rpartition(":")
+    t0 = get_time()
     with socket.create_connection((host, int(port)), timeout=timeout) as s:
         s.settimeout(timeout)
         with s.makefile("rwb") as f:
             f.write(json.dumps(req).encode() + b"\n")
             f.flush()
             line = f.readline()
+    t1 = get_time()
     if not line:
         raise ConnectionError(f"dispatcher {address}: empty reply "
                               f"(died mid-response)")
@@ -1789,6 +1954,13 @@ def request(address: str, req: dict, timeout: float = 10.0) -> dict:
         raise ConnectionError(
             f"dispatcher {address}: busy (handler slots exhausted; "
             f"retry after backoff)")
+    now = resp.get("now")
+    if isinstance(now, (int, float)):
+        # clock-offset estimate from the round-trip midpoint: the peer
+        # stamped `now` roughly halfway between our send and receive,
+        # so ADDING (t0+t1)/2 − now to a peer timestamp lands it on
+        # this process's clock (docs/observability.md)
+        _note_clock_offset(address, (t0 + t1) / 2.0 - float(now))
     if "error" in resp:
         raise DMLCError(f"dispatcher {address}: {resp['error']}")
     return resp

@@ -153,6 +153,77 @@ class LocalFleet:
 
         return FleetAutoscaler(self, **kwargs)
 
+    def _pull_trace_snapshots(self) -> List[dict]:
+        """One ``trace_dump`` round trip per live component — dispatcher
+        over the control plane, each worker over its data listener —
+        each snapshot tagged with a clock offset estimated from the RPC
+        request/reply midpoint (docs/observability.md Distributed
+        tracing). A peer that cannot answer is skipped, never fatal."""
+        import json
+        import socket as _socket
+
+        from dmlc_tpu.service import dispatcher as _dispatch
+        from dmlc_tpu.utils.timer import get_time
+
+        peers: List[dict] = []
+
+        def note(snap, t0: float, t1: float) -> None:
+            if not isinstance(snap, dict):
+                return
+            now = snap.get("now")
+            offset = ((t0 + t1) / 2.0 - float(now)
+                      if isinstance(now, (int, float)) else 0.0)
+            peers.append(dict(snap, clock_offset_s=round(offset, 6)))
+
+        try:
+            t0 = get_time()
+            resp = _dispatch.request(self.address, {"cmd": "trace_dump"})
+            note(resp.get("snapshot"), t0, get_time())
+        except Exception:  # noqa: BLE001 - a dead dispatcher still dumps
+            pass           # the workers' half of the timeline
+        for w in self.workers:
+            if w is None or not w.alive:
+                continue
+            try:
+                t0 = get_time()
+                with _socket.create_connection((w.host, w.port),
+                                               timeout=10.0) as s:
+                    s.settimeout(10.0)
+                    with s.makefile("rwb") as f:
+                        f.write(json.dumps(
+                            {"cmd": "trace_dump"}).encode() + b"\n")
+                        f.flush()
+                        line = f.readline()
+                note(json.loads(line).get("snapshot") if line else None,
+                     t0, get_time())
+            except (OSError, ValueError):
+                continue
+        return peers
+
+    def dump_trace(self, path: str) -> int:
+        """Pull every component's span rings + decision ledgers over the
+        ``trace_dump`` RPC and export ONE merged Chrome/Perfetto JSON at
+        ``path`` (open in ui.perfetto.dev; docs/observability.md). Each
+        genuinely remote peer gets its own timeline row with its clock
+        offset applied; co-located peers (a LocalFleet is one process,
+        so dispatcher and workers share one span-ring set) collapse to a
+        single row instead of duplicating every span N times. Returns
+        the number of span events written."""
+        from dmlc_tpu.utils import telemetry as _telemetry
+
+        unique: List[dict] = []
+        by_pid: dict = {}
+        for peer in self._pull_trace_snapshots():
+            pid = peer.get("pid")
+            prior = by_pid.get(pid)
+            if pid is not None and prior is not None:
+                prior["peer"] = f"{prior['peer']}+{peer.get('peer')}"
+                continue
+            if pid is not None:
+                by_pid[pid] = peer
+            unique.append(peer)
+        return _telemetry.export_pod_trace(path, unique)
+
     def kill_worker(self, index: int) -> ParseWorker:
         """Crash-simulate one worker (see :meth:`ParseWorker.kill`)."""
         w = self.workers[index]

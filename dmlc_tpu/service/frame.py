@@ -88,6 +88,31 @@ _CRC_LEN = struct.calcsize(_CRC_FMT)
 # not make the client try to allocate terabytes (1 GiB >> any real block)
 MAX_FRAME_BYTES = 1 << 30
 
+# optional trace-context key on JSON request lines (docs/service.md
+# Distributed tracing): control RPCs and v1/v2 stream-open / block-fetch
+# requests may carry ``{"trace": {"tid", "sid"}}``. Peers that predate
+# tracing ignore unknown JSON keys, and no FRAME bytes change, so the
+# v1/v2 wire goldens stay byte-pinned.
+TRACE_KEY = "trace"
+
+
+def attach_trace(req: dict, ctx=None) -> dict:
+    """Attach a trace context (default: this thread's) to a JSON request
+    dict under :data:`TRACE_KEY` — only when propagation is enabled and
+    a context exists, so untraced requests stay byte-identical to the
+    historical wire. Returns ``req`` for chaining."""
+    wire = _telemetry.trace_context_wire(ctx)
+    if wire is not None:
+        req[TRACE_KEY] = wire
+    return req
+
+
+def extract_trace(req: dict):
+    """The ``(trace_id, span_id)`` context a request line carries, or
+    None — malformed/absent keys never fail the request."""
+    return _telemetry.trace_context_from_wire(
+        req.get(TRACE_KEY) if isinstance(req, dict) else None)
+
 
 # ---------------- wire v2 compression codecs ----------------
 #

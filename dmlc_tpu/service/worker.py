@@ -697,15 +697,29 @@ class ParseWorker:
                 self._stop.wait(self.poll_interval)
                 continue
             self._parse_part(str(resp.get("job") or DEFAULT_JOB),
-                             int(part))
+                             int(part),
+                             _telemetry.trace_context_from_wire(
+                                 resp.get("trace")))
 
-    def _parse_part(self, job: str, part: int) -> None:
+    def _parse_part(self, job: str, part: int,
+                    ctx: Optional[Tuple[str, str]] = None) -> None:
         # the whole parse — however deep the block-cache/chunk-cache
         # machinery publishes — runs in the job's publish-owner scope,
         # so every artifact lands in the manifest with its owning-job
-        # ledger entry (docs/store.md per-job budgets)
-        with publish_owner(job):
-            self._parse_part_owned(job, part)
+        # ledger entry (docs/store.md per-job budgets). The grant's
+        # trace context (optional `trace` key on the next_split reply)
+        # scopes the parse: every service_encode span recorded inside
+        # inherits the grant's trace id, parented under the grant span —
+        # one (job, part) is one trace (docs/observability.md).
+        with publish_owner(job), _telemetry.trace(
+                ctx[0] if ctx else None, ctx[1] if ctx else ""):
+            t0 = get_time()
+            try:
+                self._parse_part_owned(job, part)
+            finally:
+                _telemetry.record_span("service_parse", t0,
+                                       get_time() - t0, job=job,
+                                       part=part)
 
     def _parse_part_owned(self, job: str, part: int) -> None:
         store = _PartStore()
@@ -978,25 +992,54 @@ class ParseWorker:
                     wire = int(req.get("wire") or 1)
                 except (TypeError, ValueError):
                     wire = 1
-                if cmd == "stream":
-                    if req.get("snapshot"):
-                        self._serve_stream_snapshot(
-                            conn, job, part, int(req.get("start", 0)))
-                    elif wire >= 2:
-                        self._serve_stream_v2(
-                            conn, f, job, part, req.get("accept"),
-                            str(req.get("host") or ""))
+                # adopt the requester's trace context (optional `trace`
+                # key — the part's grant trace, handed to the client by
+                # `locate`): every service_send span this stream records
+                # joins the same causal chain as the grant and parse
+                ctx = _telemetry.trace_context_from_wire(req.get("trace"))
+                t0 = get_time()
+                with _telemetry.trace(ctx[0] if ctx else None,
+                                      ctx[1] if ctx else ""):
+                    if cmd == "stream":
+                        if req.get("snapshot"):
+                            self._serve_stream_snapshot(
+                                conn, job, part, int(req.get("start", 0)))
+                        elif wire >= 2:
+                            self._serve_stream_v2(
+                                conn, f, job, part, req.get("accept"),
+                                str(req.get("host") or ""))
+                        else:
+                            self._serve_stream(conn, job, part,
+                                               int(req.get("start", 0)))
+                    elif cmd == "find":
+                        self._serve_find(conn, job, part,
+                                         str(req.get("key", "")))
+                    elif cmd == "count":
+                        self._serve_count(conn, job, part)
+                    elif cmd == "trace_dump":
+                        # the worker half of the merged pod timeline
+                        # (docs/observability.md): span rings +
+                        # decisions + a clock stamp, one JSON line
+                        conn.sendall(json.dumps(
+                            {"snapshot": _telemetry.component_snapshot(
+                                self.worker_id)}).encode() + b"\n")
+                    elif cmd == "metrics_text":
+                        conn.sendall(json.dumps(
+                            {"text": _telemetry.render_prometheus(),
+                             "content_type": "text/plain; version=0.0.4;"
+                                             " charset=utf-8"}
+                        ).encode() + b"\n")
+                    elif cmd == "decisions":
+                        conn.sendall(json.dumps(
+                            {"decisions": _telemetry.decisions_snapshot(),
+                             "total": _telemetry.decisions_total()}
+                        ).encode() + b"\n")
                     else:
-                        self._serve_stream(conn, job, part,
-                                           int(req.get("start", 0)))
-                elif cmd == "find":
-                    self._serve_find(conn, job, part,
-                                     str(req.get("key", "")))
-                elif cmd == "count":
-                    self._serve_count(conn, job, part)
-                else:
-                    send_frame(conn, encode_error_frame(
-                        f"unknown request {cmd!r}"))
+                        send_frame(conn, encode_error_frame(
+                            f"unknown request {cmd!r}"))
+                    _telemetry.record_span(
+                        "service_rpc", t0, get_time() - t0,
+                        cmd=str(cmd or ""))
         except (OSError, ValueError):
             pass  # client went away / garbage request: nothing to serve
         finally:

@@ -472,6 +472,15 @@ class ArtifactStore:
             victim.evicted = True
             total -= victim.bytes
             _resilience.record_event("store_evictions")
+            _telemetry.record_decision(
+                "store", "evict",
+                trigger={"over_bytes": int(total + victim.bytes - budget),
+                         "budget_bytes": int(budget),
+                         "tier": victim.tier,
+                         "bytes": int(victim.bytes)},
+                outcome=f"evicted {victim.name} "
+                        f"(tier {victim.tier}, seq {victim.seq})",
+                root=self.root, job=victim.job or "")
 
     def _set_gauges_locked(self, state: Dict[str, _Entry]) -> None:
         per_tier = {tier: 0 for tier in TIERS}

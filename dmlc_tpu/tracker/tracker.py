@@ -435,6 +435,17 @@ class RabitTracker:
                 tot["parts"] += int((rec or {}).get("parts", 0))
         return out
 
+    def pod_decisions(self) -> Dict[str, int]:
+        """Fleet-wide control-decision counts summed across ranks:
+        ``{"component.action": count}`` from the snapshots' ``decisions``
+        sections (docs/observability.md Decision ledger) — one line of
+        who-did-what for the whole pod without pulling every ledger."""
+        out: Dict[str, int] = {}
+        for snap in self.pod_metrics().values():
+            for key, n in (snap.get("decisions") or {}).items():
+                out[str(key)] = out.get(str(key), 0) + int(n)
+        return out
+
     def format_pod_table(self) -> str:
         """The merged per-rank × per-stage seconds table
         (telemetry.format_pod_table over the latest snapshots)."""

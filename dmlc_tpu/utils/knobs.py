@@ -154,6 +154,26 @@ KNOB_TABLE: Dict[str, KnobSpec] = {
                 "duplicate-work-vs-latency tradeoff under claim-holder "
                 "failure"),
         KnobSpec(
+            "metrics_history", "DMLC_TPU_METRICS_HISTORY",
+            default=256, lo=1, hi=65536,
+            doc="samples retained in the bounded metrics time-series "
+                "ring behind the exposition gauges "
+                "(telemetry.sample_metrics_history — the fleet "
+                "autoscaler records one per control tick, so 'what did "
+                "input_wait look like when the fleet grew' is "
+                "answerable post hoc; docs/observability.md Prometheus "
+                "exposition). Not an autotuned knob — it sizes a "
+                "diagnostic buffer, not a pipeline stage"),
+        KnobSpec(
+            "metrics_max_pipelines", "DMLC_TPU_METRICS_MAX_PIPELINES",
+            default=512, lo=8, hi=1048576,
+            doc="distinct per-pipeline metric scopes the registry "
+                "retains before the least-recently-touched scope is "
+                "retired with its counters folded into process totals "
+                "— the registry twin of DMLC_TPU_TRACE_MAX_RINGS "
+                "(docs/observability.md). Not an autotuned knob — it "
+                "bounds bookkeeping, not throughput"),
+        KnobSpec(
             "fleet_scale_interval", "DMLC_TPU_FLEET_SCALE_INTERVAL",
             default=10, lo=1, hi=3600,
             doc="seconds between fleet-autoscaler control ticks: each "

@@ -186,6 +186,9 @@ def test_dispatcher_fcfs_exactly_once_and_reissue(tmp_path):
         cfg = svc_dispatcher.request(addr, {"cmd": "config"})
         # every response carries the monotonic generation token (1 for a
         # journal-less dispatcher's whole life — no restart can recover)
+        # and a monotonic clock stamp (the peer-clock-offset estimate
+        # behind merged pod timelines, docs/observability.md)
+        assert isinstance(cfg.pop("now"), float)
         assert cfg == {"uri": "dummy.libsvm", "num_parts": 4,
                        "parser": {"format": "libsvm"}, "plan": {},
                        "snapshot": {}, "wire": 2, "gen": 1}

@@ -322,6 +322,32 @@ class TestBudget:
         assert d["store_evictions"] == 1
         assert store.total_bytes() <= total - 1
 
+    def test_eviction_reaches_decision_ledger(self, tmp_path,
+                                              monkeypatch):
+        """ISSUE 19: every budget eviction is one audit-ledger event
+        carrying the squeeze that fired it (docs/observability.md
+        Decision ledger)."""
+        telemetry.reset_decisions()
+        snap = _mk_snapshot(tmp_path / "s.snap")
+        bc = _mk_block_cache(tmp_path / "a.bc")
+        store = store_for(bc)
+        total = store.total_bytes()
+        monkeypatch.setenv("DMLC_TPU_STORE_BUDGET_BYTES",
+                           str(total - 1))
+        reset_stores()
+        store_for(bc)  # open-time enforcement: one eviction
+        assert not os.path.exists(snap)
+        events = telemetry.decisions_snapshot("store")
+        assert len(events) == 1
+        ev = events[0]
+        assert ev["action"] == "evict"
+        assert ev["trigger"]["budget_bytes"] == total - 1
+        assert ev["trigger"]["tier"] == "snapshot"
+        assert ev["trigger"]["bytes"] > 0
+        assert "s.snap" in ev["outcome"]
+        assert telemetry.decision_counts()["store.evict"] == 1
+        telemetry.reset_decisions()
+
     def test_lru_within_tier(self, tmp_path, monkeypatch):
         s_old = _mk_snapshot(tmp_path / "old.snap", tag="o")
         s_new = _mk_snapshot(tmp_path / "new.snap", tag="n")
