@@ -17,7 +17,9 @@
 #                     cold_epoch_mb_per_sec/cache_state), the chunk-batch
 #                     cold-parse leg (native_batch_parse_mb_per_sec +
 #                     batch_vs_stream_parse_speedup >= 1.0 when the native
-#                     kernel engaged (batch_parse_simd_level >= 0) — the
+#                     kernel engaged (batch_parse_simd_level >= 0) AND the
+#                     host has cores to fan onto (os.cpu_count() > 1;
+#                     single-core hosts gate field presence only) — the
 #                     native-batch engine's cold cache build vs the
 #                     stream+re-encode path), the shuffle-native plan leg
 #                     (shuffled_warm_epoch_mb_per_sec/shuffle_overhead_pct
@@ -43,7 +45,13 @@
 #                     with zero giveups), the tiered artifact store
 #                     (store_bytes/store_evictions/
 #                     store_rebuilds_after_eviction — every cache and
-#                     snapshot the legs publish is store-managed), and
+#                     snapshot the legs publish is store-managed), the
+#                     pod-scale training leg (als_rows_per_sec/
+#                     als_step_seconds/als_input_wait_frac/
+#                     als_overlap_frac — ALX-style sharded ALS warm-fed
+#                     by the pod-sharded cache; the als_input_wait_frac
+#                     < 0.2 compute-bound bar is judged on accelerator,
+#                     the CPU host gates structure only), and
 #                     the telemetry contract (telemetry_schema_version +
 #                     per-stage span counts)
 #   make fuzz         mutation fuzz of every native parse C-ABI entry point
@@ -118,7 +126,7 @@ bench-smoke:
 	DMLC_BENCH_PLATFORM=cpu DMLC_BENCH_MB=8 DMLC_BENCH_REPS=1 \
 	DMLC_BENCH_ATTEMPTS=1 DMLC_BENCH_TIMEOUT=600 \
 	    $(PYTHON) bench.py --service --autotune > .bench_smoke.json
-	$(PYTHON) -c "import json; \
+	$(PYTHON) -c "import json, os; \
 	    line = json.load(open('.bench_smoke.json')); \
 	    a = line.get('attribution') or {}; \
 	    missing = [k for k in ('read', 'parse', 'convert', 'dispatch', \
@@ -141,10 +149,12 @@ bench-smoke:
 	    simd = line.get('batch_parse_simd_level'); \
 	    assert bvs is not None and simd is not None, \
 	        'batch_vs_stream_parse_speedup/batch_parse_simd_level missing'; \
-	    assert simd < 0 or bvs >= 1.0, \
+	    assert simd < 0 or (os.cpu_count() or 1) <= 1 or bvs >= 1.0, \
 	        f'batch_vs_stream_parse_speedup {bvs} < 1.0 (simd {simd}); on a ' \
 	        'toolchain-less host (simd -1) both legs run the Python engine ' \
-	        'and the ratio is noise, so only presence is gated'; \
+	        'and the ratio is noise, and on a single-core host the batch ' \
+	        'fan-out has no cores to fan onto — in both cases only presence ' \
+	        'is gated (the >1.5x bar is judged on multi-core hardware)'; \
 	    assert line.get('warm_vs_cold_speedup'), \
 	        'warm_vs_cold_speedup missing'; \
 	    assert line.get('cache_state') == 'warm', \
@@ -260,6 +270,16 @@ bench-smoke:
 	        f'autotune_final_config incomplete: {acfg}'; \
 	    assert line.get('input_wait_seconds') is not None, \
 	        'input_wait_seconds missing'; \
+	    alsr = line.get('als_rows_per_sec'); \
+	    assert alsr, 'als_rows_per_sec missing (als train leg did not run)'; \
+	    assert line.get('als_step_seconds'), 'als_step_seconds missing'; \
+	    alsw = line.get('als_input_wait_frac'); \
+	    assert alsw is not None, 'als_input_wait_frac missing'; \
+	    also = line.get('als_overlap_frac'); \
+	    assert also is not None, 'als_overlap_frac missing'; \
+	    assert line.get('als_cache_state') == 'warm', \
+	        f\"als_cache_state {line.get('als_cache_state')!r} != 'warm' \" \
+	        '(the training loop was not warm-fed)'; \
 	    assert line.get('store_bytes'), \
 	        'store_bytes missing/zero (artifacts not store-managed)'; \
 	    assert line.get('store_evictions') is not None, \
@@ -342,7 +362,10 @@ bench-smoke:
 	    print('bench-smoke: artifact store OK:', line['store_bytes'], \
 	          'managed bytes,', line['store_evictions'], 'evictions,', \
 	          line['store_rebuilds_after_eviction'], \
-	          'rebuilds after eviction')"
+	          'rebuilds after eviction'); \
+	    print('bench-smoke: als training OK:', alsr, 'rows/s warm-fed,', \
+	          'step', line['als_step_seconds'], 's, input wait frac', \
+	          alsw, '(< 0.2 is the TPU-return bar), overlap', also)"
 
 parse-bench:
 	mkdir -p native/build

@@ -9,7 +9,9 @@ second-order factorization machine the libfm format exists to feed
 (models/fm.py).
 """
 
+from dmlc_tpu.models.als import AlsLearner, AlsParams
 from dmlc_tpu.models.fm import FMLearner, FMParams
 from dmlc_tpu.models.linear import LinearLearner, LinearParams
 
-__all__ = ["FMLearner", "FMParams", "LinearLearner", "LinearParams"]
+__all__ = ["AlsLearner", "AlsParams", "FMLearner", "FMParams",
+           "LinearLearner", "LinearParams"]

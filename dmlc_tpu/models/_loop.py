@@ -11,6 +11,25 @@ Learners provide ``self._step(params, opt_state, batch)``,
 derives the jitted on-device metric from those (replicated scalar outputs,
 so results are addressable on every process). ``self.params`` /
 ``self.opt_state`` / ``self.mesh`` attributes are assumed.
+
+Two loop-wide contracts live here so every learner inherits them:
+
+* **Donated step buffers.** :meth:`TrainLoopMixin._jit_step` compiles the
+  update with ``donate_argnums=(0, 1)`` — the ``(params, opt_state)``
+  input buffers are handed back to XLA so the outputs reuse their HBM
+  instead of doubling peak parameter memory. The compiled callable is
+  stamped with ``_donate_argnums`` so tests can pin the contract
+  structurally (the CPU backend accepts but ignores donation, so
+  ``is_deleted``-style checks would not hold under tier-1).
+
+* **No per-step host sync.** The loop never forces a device→host transfer
+  inside the epoch: losses and metric partials accumulate as device
+  scalars and cross to the host once per epoch through
+  :func:`host_scalar`, the loop's single sanctioned sync point. (The only
+  other device→host traffic during an epoch is DeviceIter's sampled
+  transfer sideband, which the loop does not control.) Keeping the epoch
+  free of blocking syncs is what lets dispatch run ahead of the ingest
+  pipeline and hide input latency.
 """
 
 from __future__ import annotations
@@ -20,7 +39,40 @@ from typing import Tuple
 from dmlc_tpu.utils.timer import get_time
 
 
+def host_scalar(x) -> float:
+    """Bring one device scalar to the host — the loop's sanctioned sync.
+
+    Every device→host conversion the training loop performs funnels
+    through here (once per epoch for the loss, twice per accuracy pass),
+    so a regression test can monkeypatch this single name and count
+    blocking syncs instead of auditing call sites.
+    """
+    return float(x)
+
+
 class TrainLoopMixin:
+    def _jit_step(self, step_fn, params_sh=None, batch_sh=None,
+                  opt_sh=None, loss_sh=None):
+        """Compile ``step_fn(params, opt_state, batch) -> (params,
+        opt_state, loss)`` under the loop's donation contract.
+
+        ``donate_argnums=(0, 1)`` donates the ``(params, opt_state)``
+        input buffers: XLA aliases them to the outputs, making the step an
+        in-place update rather than a 2x-peak-memory copy. When
+        ``params_sh`` is given the mesh placement is pinned explicitly
+        (``opt_sh``/``loss_sh`` pass through, ``None`` meaning "infer").
+        """
+        import jax
+
+        if params_sh is None:
+            fn = jax.jit(step_fn, donate_argnums=(0, 1))
+        else:
+            fn = jax.jit(step_fn, donate_argnums=(0, 1),
+                         in_shardings=(params_sh, opt_sh, batch_sh),
+                         out_shardings=(params_sh, opt_sh, loss_sh))
+        fn._donate_argnums = (0, 1)
+        return fn
+
     def _build_accuracy(self):
         """Jitted (correct_weighted, total_weight) over one batch; the
         reduction stays ON DEVICE so mesh-global batches spanning processes
@@ -39,23 +91,33 @@ class TrainLoopMixin:
         rep = NamedSharding(self.mesh, P())
         return jax.jit(acc_fn, out_shardings=(rep, rep))
 
-    def step(self, batch) -> float:
+    def step(self, batch):
+        """One jitted update. Returns the loss as a DEVICE scalar — no
+        host sync here; convert with :func:`host_scalar` when a float is
+        actually needed."""
         self.params, self.opt_state, loss = self._step(
             self.params, self.opt_state, batch)
         return loss
 
     def fit_epoch(self, device_iter, max_steps=None) -> Tuple[float, int]:
         """One pass over a DeviceIter; returns (mean loss, batches).
-        ``max_steps`` is the SPMD step-count cap (module docstring)."""
-        total, n = 0.0, 0
+        ``max_steps`` is the SPMD step-count cap (module docstring).
+
+        The per-step losses accumulate on device; the single
+        :func:`host_scalar` call at the end of the pass is the epoch's
+        only blocking device→host sync.
+        """
+        total, n = None, 0
         for batch in device_iter:
             loss = self.step(batch)
-            total += float(loss)
+            total = loss if total is None else total + loss
             n += 1
             if max_steps is not None and n >= max_steps:
                 break
         device_iter.reset()
-        return (total / max(n, 1)), n
+        if n == 0:
+            return 0.0, 0
+        return host_scalar(total) / n, n
 
     def fit(self, device_iter, epochs: int = 1, log_fn=None,
             steps_per_epoch=None):
@@ -68,15 +130,19 @@ class TrainLoopMixin:
 
     def accuracy(self, device_iter, max_steps=None) -> float:
         """Weighted accuracy over one pass, reduced ON DEVICE (replicated
-        scalars — pod-safe); ``max_steps`` as in :meth:`fit_epoch`."""
-        correct, total = 0.0, 0.0
+        scalars — pod-safe); ``max_steps`` as in :meth:`fit_epoch`. The
+        partials stay on device; the two :func:`host_scalar` calls at the
+        end are the pass's only syncs."""
+        correct, total = None, None
         n = 0
         for batch in device_iter:
             c, t = self._accuracy(self.params, batch)
-            correct += float(c)
-            total += float(t)
+            correct = c if correct is None else correct + c
+            total = t if total is None else total + t
             n += 1
             if max_steps is not None and n >= max_steps:
                 break
         device_iter.reset()
-        return correct / max(total, 1.0)
+        if n == 0:
+            return 0.0
+        return host_scalar(correct) / max(host_scalar(total), 1.0)

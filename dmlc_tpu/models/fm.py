@@ -193,17 +193,13 @@ class FMLearner(TrainLoopMixin):
 
         params_sh, batch_sh = self._shardings()
         if params_sh is None:
-            return jax.jit(step, donate_argnums=(0, 1))
+            return self._jit_step(step)
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         rep = NamedSharding(self.mesh, P())
         opt_sh = jax.tree_util.tree_map(lambda _: rep, self.opt_state)
-        return jax.jit(
-            step,
-            donate_argnums=(0, 1),
-            in_shardings=(params_sh, opt_sh, batch_sh),
-            out_shardings=(params_sh, opt_sh, rep),
-        )
+        return self._jit_step(step, params_sh=params_sh, batch_sh=batch_sh,
+                              opt_sh=opt_sh, loss_sh=rep)
 
     def predict(self, batch) -> jax.Array:
         """Raw margin for a batch (apply sigmoid for probabilities)."""

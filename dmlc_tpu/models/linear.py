@@ -222,14 +222,7 @@ class LinearLearner(TrainLoopMixin):
             return params, opt_state, loss
 
         params_sh, batch_sh = self._shardings()
-        if params_sh is None:
-            return jax.jit(step, donate_argnums=(0, 1))
-        return jax.jit(
-            step,
-            donate_argnums=(0, 1),
-            in_shardings=(params_sh, None, batch_sh),
-            out_shardings=(params_sh, None, None),
-        )
+        return self._jit_step(step, params_sh=params_sh, batch_sh=batch_sh)
 
     def _build_predict(self):
         def predict(params, batch):

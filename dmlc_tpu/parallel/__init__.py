@@ -10,9 +10,12 @@ the tracker exports.
 from dmlc_tpu.parallel.mesh import (
     make_mesh, data_sharding, replicated, local_batch_to_global, host_shard_info,
 )
-from dmlc_tpu.parallel.distributed import EnvContract, init_from_env, sync_min
+from dmlc_tpu.parallel.distributed import (
+    EnvContract, init_from_env, pod_identity, sync_min,
+)
 
 __all__ = [
     "make_mesh", "data_sharding", "replicated", "local_batch_to_global",
-    "host_shard_info", "init_from_env", "EnvContract", "sync_min",
+    "host_shard_info", "init_from_env", "EnvContract", "pod_identity",
+    "sync_min",
 ]

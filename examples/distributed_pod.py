@@ -65,7 +65,7 @@ def worker() -> None:
 
     import time
 
-    from dmlc_tpu.parallel.distributed import init_from_env
+    from dmlc_tpu.parallel.distributed import init_from_env, pod_identity
     from dmlc_tpu.tracker.client import WorkerClient
 
     task_id = int(os.environ["DMLC_TASK_ID"])
@@ -106,7 +106,11 @@ def worker() -> None:
     client.start_heartbeat(0.25, metrics=True)
 
     init_from_env()  # DMLC_* -> jax.distributed.initialize
-    rank, world = jax.process_index(), jax.process_count()
+    # resolve rank/world through pod_identity — the SAME env contract
+    # (DMLC_TASK_ID/DMLC_NUM_WORKER first, jax backend as fallback) that
+    # parallel/distributed.py and pod_sharding= use, so the example and
+    # the library can never disagree about which shard a host owns
+    rank, world = pod_identity()
     print(f"[worker {rank}/{world}] backend up", flush=True)
 
     from dmlc_tpu.data import create_parser

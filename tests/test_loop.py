@@ -1,0 +1,184 @@
+"""Regression pins for the two loop-wide contracts in models/_loop.py.
+
+* **Donated step buffers** — every learner's compiled step (and ALS's
+  epoch-boundary finalize) must go through
+  :meth:`TrainLoopMixin._jit_step`'s ``donate_argnums=(0, 1)`` contract.
+  The CPU backend accepts but silently ignores donation, so tier-1 cannot
+  observe ``is_deleted`` on the inputs; instead the compiled callables are
+  stamped with ``_donate_argnums`` and these tests pin the stamp.
+
+* **No per-step host sync** — the epoch loop accumulates device scalars
+  and crosses to the host exactly once per :meth:`fit_epoch` (twice per
+  :meth:`accuracy` / :meth:`eval_loss` pass) through
+  :func:`dmlc_tpu.models._loop.host_scalar`, the single sanctioned sync
+  point. Monkeypatching that one name counts every blocking sync the loop
+  performs — a regression that floats a loss mid-epoch shows up as an
+  extra count here.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import dmlc_tpu.models._loop as loop_mod
+from dmlc_tpu.data import create_parser
+from dmlc_tpu.data.device import DeviceIter
+from dmlc_tpu.models import AlsLearner, FMLearner, LinearLearner
+
+
+def _corpus(tmp_path, n=64, d=6):
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=d)
+    lines = []
+    for _ in range(n):
+        x = rng.normal(size=d)
+        y = int(x @ w_true > 0)
+        feats = " ".join(f"{j}:{x[j]:.5f}" for j in range(d))
+        lines.append(f"{y} {feats}")
+    p = tmp_path / "loop.libsvm"
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+def _iter_for(uri, model, batch=16):
+    parser = create_parser(uri, 0, 1, "libsvm", threaded=False)
+    return DeviceIter(parser, num_col=model.device_num_col(),
+                      batch_size=batch, layout="dense")
+
+
+class _SyncCounter:
+    """Counting stand-in for host_scalar — still performs the sync."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def __call__(self, x):
+        self.calls += 1
+        return float(x)
+
+
+# ---------------- donation contract ----------------
+
+def test_step_donation_stamp_all_learners():
+    learners = [
+        LinearLearner(num_col=6, layout="dense", learning_rate=0.1),
+        FMLearner(num_col=6, num_factors=2),
+        AlsLearner(num_users=8, num_items=6, num_factors=2),
+    ]
+    for model in learners:
+        assert model._step._donate_argnums == (0, 1), type(model).__name__
+
+
+def test_als_finalize_donation_stamp():
+    model = AlsLearner(num_users=8, num_items=6, num_factors=2)
+    assert model._finalize._donate_argnums == (0, 1)
+
+
+def test_sharded_step_keeps_donation():
+    from dmlc_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"data": 8})
+    for model in (LinearLearner(num_col=6, layout="dense", mesh=mesh),
+                  AlsLearner(num_users=8, num_items=6, num_factors=2,
+                             mesh=mesh)):
+        assert model._step._donate_argnums == (0, 1), type(model).__name__
+
+
+# ---------------- no-host-sync-per-step contract ----------------
+
+def test_step_returns_device_scalar(tmp_path):
+    uri = _corpus(tmp_path)
+    model = LinearLearner(num_col=6, layout="dense", learning_rate=0.1)
+    it = _iter_for(uri, model)
+    batch = next(iter(it))
+    loss = model.step(batch)
+    # a float here would mean the step itself forced a blocking sync
+    assert isinstance(loss, jax.Array) and not isinstance(loss, float)
+    it.reset()
+    it.close()
+
+
+def test_fit_epoch_single_host_sync(tmp_path, monkeypatch):
+    uri = _corpus(tmp_path)
+    model = LinearLearner(num_col=6, layout="dense", learning_rate=0.1)
+    it = _iter_for(uri, model)
+    counter = _SyncCounter()
+    monkeypatch.setattr(loop_mod, "host_scalar", counter)
+    loss, n = model.fit_epoch(it)
+    assert n == 4
+    assert isinstance(loss, float) and np.isfinite(loss)
+    assert counter.calls == 1, (
+        f"{counter.calls} host syncs in one epoch; the contract is ONE")
+    it.close()
+
+
+def test_accuracy_two_host_syncs(tmp_path, monkeypatch):
+    uri = _corpus(tmp_path)
+    model = LinearLearner(num_col=6, objective="logistic", layout="dense",
+                          learning_rate=0.5)
+    it = _iter_for(uri, model)
+    model.fit(it, epochs=2)
+    counter = _SyncCounter()
+    monkeypatch.setattr(loop_mod, "host_scalar", counter)
+    acc = model.accuracy(it)
+    assert 0.0 <= acc <= 1.0
+    assert counter.calls == 2, (
+        f"{counter.calls} host syncs in one accuracy pass; contract is TWO")
+    it.close()
+
+
+def test_als_eval_loss_two_host_syncs(monkeypatch):
+    from dmlc_tpu.ops.sparse import EllBatch
+
+    model = AlsLearner(num_users=8, num_items=6, num_factors=2, seed=0)
+    batch = EllBatch(
+        indices=jax.numpy.asarray(np.tile(np.arange(4, dtype=np.int32),
+                                          (8, 1))),
+        values=jax.numpy.ones((8, 4), dtype=np.float32),
+        label=jax.numpy.arange(8, dtype=np.float32),
+        weight=jax.numpy.ones(8, dtype=np.float32))
+
+    class Once:
+        def __iter__(self):
+            return iter([batch])
+
+        def reset(self):
+            pass
+
+    counter = _SyncCounter()
+    monkeypatch.setattr(loop_mod, "host_scalar", counter)
+    mse = model.eval_loss(Once())
+    assert np.isfinite(mse)
+    assert counter.calls == 2, counter.calls
+
+
+def test_fit_epoch_empty_iter_no_sync(monkeypatch):
+    model = LinearLearner(num_col=6, layout="dense")
+
+    class Empty:
+        def __iter__(self):
+            return iter(())
+
+        def reset(self):
+            pass
+
+    counter = _SyncCounter()
+    monkeypatch.setattr(loop_mod, "host_scalar", counter)
+    loss, n = model.fit_epoch(Empty())
+    assert (loss, n) == (0.0, 0)
+    assert counter.calls == 0
+
+
+def test_host_scalar_is_the_only_float_site():
+    """Grep-level pin: no ``float(`` coercion inside the loop bodies other
+    than host_scalar itself — keeps the next edit from quietly adding a
+    per-step sync that the counting tests might not see on their path."""
+    import inspect
+
+    src = inspect.getsource(loop_mod)
+    body = src.split("def host_scalar", 1)[1].split("\n", 3)[-1]
+    # everything after host_scalar's own `return float(x)` must not coerce
+    after = body.split("return float(x)", 1)[1]
+    assert "float(" not in after.replace("host_scalar", ""), (
+        "a float() coercion appeared inside the loop — route it through "
+        "host_scalar so the sync stays countable")

@@ -1,0 +1,116 @@
+"""parallel/mesh.py edge cases — the host-shard / global-batch seam.
+
+``host_shard_info`` + ``local_batch_to_global`` are the TPU analog of
+per-rank ``InputSplit::Create(uri, rank, world)`` feeding one logical
+dataset; these tests pin the contract at its edges (degenerate meshes,
+non-dividing sizes, shard/global order parity) on the 8-virtual-device
+CPU mesh the suite forces.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from dmlc_tpu.parallel import (
+    host_shard_info, local_batch_to_global, make_mesh,
+)
+
+
+# ---------------- make_mesh ----------------
+
+def test_make_mesh_defaults_to_1d_data_axis():
+    mesh = make_mesh()
+    assert mesh.axis_names == ("data",)
+    assert mesh.devices.shape == (len(jax.devices()),)
+
+
+def test_make_mesh_infers_minus_one_axis():
+    mesh = make_mesh({"data": -1, "model": 2})
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "data": len(jax.devices()) // 2, "model": 2}
+
+
+def test_make_mesh_rejects_non_dividing_axes():
+    with pytest.raises(ValueError, match="devices"):
+        make_mesh({"data": 3})
+
+
+def test_make_mesh_single_device_subset():
+    mesh = make_mesh(devices=jax.devices()[:1])
+    assert mesh.devices.shape == (1,)
+
+
+# ---------------- host_shard_info ----------------
+
+def test_host_shard_info_hint_overrides():
+    # explicit num_parts hint: caller-controlled sharding, part 0
+    assert host_shard_info(4) == (0, 4)
+    assert host_shard_info(1) == (0, 1)
+
+
+def test_host_shard_info_defaults_to_process_identity():
+    # single-process run: the jax process grid is 1x1
+    assert host_shard_info() == (jax.process_index(), jax.process_count())
+    assert host_shard_info() == (0, 1)
+
+
+# ---------------- local_batch_to_global ----------------
+
+def test_global_batch_shards_preserve_global_order():
+    """The union of per-device shards, ordered by their global slice,
+    must be exactly the host batch — no permutation, no overlap."""
+    mesh = make_mesh({"data": 8})
+    x = np.arange(32, dtype=np.float32).reshape(16, 2)
+    y = np.arange(16, dtype=np.float32)
+    gx, gy = local_batch_to_global(mesh, [x, y])
+    assert gx.shape == (16, 2) and gy.shape == (16,)
+    assert str(gx.sharding.spec) == "PartitionSpec('data', None)"
+    assert str(gy.sharding.spec) == "PartitionSpec('data',)"
+    shards = sorted(gx.addressable_shards, key=lambda s: s.index[0].start)
+    assert len(shards) == 8
+    starts = [s.index[0].start for s in shards]
+    assert starts == sorted(starts) and len(set(starts)) == 8
+    union = np.concatenate([np.asarray(s.data) for s in shards])
+    np.testing.assert_array_equal(union, x)
+    # each device holds a contiguous 2-row slice
+    assert all(np.asarray(s.data).shape == (2, 2) for s in shards)
+
+
+def test_global_batch_degenerate_single_device_mesh():
+    # world of one: the global array IS the local batch, still sharded
+    # over the (trivial) data axis — same code path as a pod
+    mesh = make_mesh(devices=jax.devices()[:1])
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    (g,) = local_batch_to_global(mesh, [x])
+    assert str(g.sharding.spec) == "PartitionSpec('data', None)"
+    np.testing.assert_array_equal(np.asarray(g), x)
+    assert len(g.addressable_shards) == 1
+
+
+def test_global_batch_non_dividing_rows_raise():
+    """A batch whose row count does not divide the data axis cannot be
+    placed — the error must surface at placement, not as silent padding
+    or truncation (drop_remainder upstream is the sanctioned fix)."""
+    mesh = make_mesh({"data": 8})
+    with pytest.raises(ValueError):
+        local_batch_to_global(mesh, [np.ones((10, 2), np.float32)])
+
+
+def test_global_batch_multiple_arrays_consistent():
+    # the (x, y, w) triple a dense DeviceIter ships must land with
+    # row-aligned shards: device d sees row r of every array or none
+    mesh = make_mesh({"data": 8})
+    x = np.arange(48, dtype=np.float32).reshape(8, 6)
+    y = (np.arange(8) % 2).astype(np.float32)
+    w = np.ones(8, dtype=np.float32)
+    gx, gy, gw = local_batch_to_global(mesh, [x, y, w])
+    for d in range(8):
+        (sx,) = [s for s in gx.addressable_shards
+                 if s.device == mesh.devices.flat[d]]
+        (sy,) = [s for s in gy.addressable_shards
+                 if s.device == mesh.devices.flat[d]]
+        assert sx.index[0] == sy.index[0]
+        r = sx.index[0].start
+        np.testing.assert_array_equal(np.asarray(sx.data), x[r:r + 1])
+        np.testing.assert_array_equal(np.asarray(sy.data), y[r:r + 1])
+    assert np.asarray(gw).sum() == 8.0
